@@ -1,0 +1,23 @@
+"""TPU-native multi-model LLM serving framework for Kubernetes.
+
+A ground-up rebuild of the capabilities of `graz-dev/llms-on-kubernetes`
+(a GitOps multi-model LLM serving stack; see /root/reference) designed
+TPU-first:
+
+- serving engine: pure-JAX models sharded with ``jax.sharding`` over a
+  ``Mesh`` (axes ``data``/``expert``/``model``), Pallas paged-attention and
+  flash-attention kernels, paged KV cache, continuous batching under XLA's
+  static-shape regime (reference delegated all of this to the pulled
+  ``vllm/vllm-openai`` CUDA image — reference
+  vllm-models/helm-chart/templates/model-deployments.yaml:21).
+- serving API: OpenAI-compatible HTTP server with SSE streaming
+  (reference: in-image vLLM server).
+- router: payload-inspecting multi-model gateway, Python (asyncio,
+  streaming) and native C++ implementations (reference: OpenResty/Lua —
+  vllm-models/helm-chart/templates/model-gateway.yaml).
+- packaging: the same declarative ``models[]`` contract rendered into
+  Kubernetes manifests, but scheduling onto GKE TPU node pools
+  (``google.com/tpu``) instead of ``nvidia.com/gpu``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,360 @@
+"""Model configurations and registry.
+
+The reference stack configures models purely through the Helm ``models[]``
+values list (reference vllm-models/helm-chart/values.yaml:1-27) and lets the
+pulled vLLM image resolve the architecture from the HuggingFace repo. Here the
+engine is in-repo, so the architecture configs live here: one frozen dataclass
+covering the decoder families the BASELINE configs demand (Llama-3 8B/70B,
+TinyLlama, Mistral-7B, Mixtral-8x7B MoE) plus the families the reference's
+default values deploy (Gemma-3, Qwen — values.yaml:2-12) and Phi-3 (ramalama
+local path, ramalama-models/README.md:102-106).
+
+``from_hf_config`` maps a HuggingFace ``config.json`` to a ``ModelConfig`` so
+``huggingfaceId``-driven deployment (the reference's contract) works without a
+hand-written registry entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False            # Qwen2-style qkv bias
+    sliding_window: Optional[int] = None    # Mistral-style SWA
+    # Gemma-2/3 interleaved attention: layer i is GLOBAL iff (i+1) % pattern == 0,
+    # else local (sliding_window). None => all layers use `sliding_window` as-is.
+    sliding_window_pattern: Optional[int] = None
+    rope_local_theta: Optional[float] = None  # theta for local layers (gemma3: 1e4)
+    # attention logit scale = query_pre_attn_scalar**-0.5 if set, else head_dim**-0.5
+    query_pre_attn_scalar: Optional[float] = None
+    # MoE (Mixtral)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # activation / norm variants
+    hidden_act: str = "silu"                # silu | gelu_tanh
+    norm_style: str = "llama"               # llama: x*w ; gemma: x*(1+w)
+    post_norms: bool = False                # gemma2/3 post-attn/post-mlp norms
+    qk_norm: bool = False                   # qwen3 / gemma3 per-head q/k RMSNorm
+    logit_softcap: Optional[float] = None   # gemma2
+    attn_softcap: Optional[float] = None    # gemma2
+    embedding_multiplier: Optional[float] = None  # gemma: sqrt(hidden_size)
+    # excluded from __hash__ (dicts are unhashable; configs are jit static args)
+    rope_scaling: Optional[dict] = dataclasses.field(default=None, hash=False)
+    dtype: str = "bfloat16"
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (for memory budgeting)."""
+        d, f, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        attn = d * self.q_dim * 2 + d * self.kv_dim * 2
+        if self.is_moe:
+            mlp = 3 * d * f * self.num_experts + d * self.num_experts
+        else:
+            mlp = 3 * d * f
+        embed = v * d * (1 if self.tie_word_embeddings else 2)
+        return L * (attn + mlp) + embed
+
+
+def _llama(name: str, **kw: Any) -> ModelConfig:
+    return ModelConfig(name=name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Registry. Keys are the short `modelName`s a chart would use; aliases map
+# HuggingFace repo ids onto them.
+# ---------------------------------------------------------------------------
+
+REGISTRY: dict[str, ModelConfig] = {}
+ALIASES: dict[str, str] = {}
+
+
+def _register(cfg: ModelConfig, *hf_ids: str) -> ModelConfig:
+    REGISTRY[cfg.name] = cfg
+    for hf_id in hf_ids:
+        ALIASES[hf_id.lower()] = cfg.name
+    return cfg
+
+
+LLAMA3_ROPE_SCALING = {
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 8192,
+}
+
+_register(
+    _llama(
+        "llama-3-8b",
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "meta-llama/Meta-Llama-3-8B", "meta-llama/Meta-Llama-3-8B-Instruct",
+)
+
+_register(
+    _llama(
+        "llama-3-70b",
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "meta-llama/Meta-Llama-3-70B", "meta-llama/Meta-Llama-3-70B-Instruct",
+)
+
+_register(
+    _llama(
+        "llama-3.1-8b",
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0, max_position_embeddings=131072,
+        rope_scaling=LLAMA3_ROPE_SCALING,
+    ),
+    "meta-llama/Llama-3.1-8B", "meta-llama/Llama-3.1-8B-Instruct",
+)
+
+_register(
+    _llama(
+        "tinyllama-1.1b",
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+        rope_theta=10000.0, max_position_embeddings=2048,
+    ),
+    "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+)
+
+_register(
+    _llama(
+        "mistral-7b",
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=10000.0, max_position_embeddings=32768,
+        sliding_window=4096,
+    ),
+    "mistralai/Mistral-7B-v0.1", "mistralai/Mistral-7B-Instruct-v0.1",
+)
+
+# v0.2+ dropped sliding-window attention and raised rope_theta to 1e6.
+_register(
+    _llama(
+        "mistral-7b-v0.2",
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=32768,
+    ),
+    "mistralai/Mistral-7B-Instruct-v0.2", "mistralai/Mistral-7B-Instruct-v0.3",
+)
+
+_register(
+    _llama(
+        "mixtral-8x7b",
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=32768,
+        num_experts=8, num_experts_per_tok=2,
+    ),
+    "mistralai/Mixtral-8x7B-v0.1", "mistralai/Mixtral-8x7B-Instruct-v0.1",
+)
+
+_register(
+    _llama(
+        "phi-3-mini",
+        vocab_size=32064, hidden_size=3072, intermediate_size=8192,
+        num_layers=32, num_heads=32, num_kv_heads=32, head_dim=96,
+        rope_theta=10000.0, max_position_embeddings=4096,
+        sliding_window=2047,
+    ),
+    "microsoft/Phi-3-mini-4k-instruct",
+)
+
+_register(
+    _llama(
+        "qwen2.5-7b",
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=32768,
+        attention_bias=True, tie_word_embeddings=False,
+    ),
+    "Qwen/Qwen2.5-7B-Instruct",
+)
+
+_register(
+    _llama(
+        "qwen3-8b",
+        vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+        num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=40960,
+        qk_norm=True, rms_norm_eps=1e-6,
+    ),
+    "Qwen/Qwen3-8B",
+)
+
+_register(
+    _llama(
+        "gemma-2-9b",
+        vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+        num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
+        rope_theta=10000.0, max_position_embeddings=8192,
+        hidden_act="gelu_tanh", norm_style="gemma", post_norms=True,
+        logit_softcap=30.0, attn_softcap=50.0,
+        embedding_multiplier=3584 ** 0.5, tie_word_embeddings=True,
+        rms_norm_eps=1e-6,
+        # alternating local(4096)/global layers; query scale 1/sqrt(256)
+        sliding_window=4096, sliding_window_pattern=2, rope_local_theta=10000.0,
+        query_pre_attn_scalar=256.0,
+    ),
+    "google/gemma-2-9b-it",
+)
+
+# The reference's first default model is gemma-3-27b-it
+# (reference vllm-models/helm-chart/values.yaml:2-6).
+_register(
+    _llama(
+        "gemma-3-27b",
+        vocab_size=262208, hidden_size=5376, intermediate_size=21504,
+        num_layers=62, num_heads=32, num_kv_heads=16, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=131072,
+        hidden_act="gelu_tanh", norm_style="gemma", post_norms=True,
+        qk_norm=True, embedding_multiplier=5376 ** 0.5,
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+        # 5 local (SWA-1024, theta 1e4) layers per global layer;
+        # query scale 1/sqrt(hidden/num_heads) = 1/sqrt(168)
+        sliding_window=1024, sliding_window_pattern=6, rope_local_theta=10000.0,
+        query_pre_attn_scalar=5376.0 / 32,
+    ),
+    "google/gemma-3-27b-it",
+)
+
+# Tiny configs for tests / local CPU smoke runs.
+_register(
+    _llama(
+        "debug-tiny",
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512,
+    ),
+)
+_register(
+    _llama(
+        "debug-gemma",
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=4, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512,
+        hidden_act="gelu_tanh", norm_style="gemma", post_norms=True,
+        qk_norm=True, embedding_multiplier=8.0, tie_word_embeddings=True,
+        sliding_window=8, sliding_window_pattern=2, rope_local_theta=10000.0,
+        rope_theta=1000000.0, query_pre_attn_scalar=24.0,
+    ),
+)
+_register(
+    _llama(
+        "debug-moe",
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512, num_experts=4, num_experts_per_tok=2,
+    ),
+)
+
+
+def get_config(name: str) -> ModelConfig:
+    key = name if name in REGISTRY else ALIASES.get(name.lower(), name)
+    if key not in REGISTRY:
+        raise KeyError(
+            f"unknown model config {name!r}; known: {sorted(REGISTRY)} "
+            f"(or pass a HuggingFace config.json via from_hf_config)"
+        )
+    return REGISTRY[key]
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace config.json → ModelConfig
+# ---------------------------------------------------------------------------
+
+def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
+    """Build a ModelConfig from a HuggingFace ``config.json`` dict or path."""
+    if isinstance(hf, str):
+        with open(hf) as f:
+            hf = json.load(f)
+    # gemma3 wraps the text config
+    if "text_config" in hf and isinstance(hf["text_config"], dict):
+        merged = dict(hf["text_config"])
+        merged.setdefault("model_type", hf.get("model_type", ""))
+        hf = merged
+    model_type = hf.get("model_type", "llama")
+    hidden = int(hf["hidden_size"])
+    heads = int(hf["num_attention_heads"])
+    head_dim = int(hf.get("head_dim") or hidden // heads)
+    kw: dict[str, Any] = dict(
+        name=name,
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=hidden,
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(hf.get("num_key_value_heads") or heads),
+        head_dim=head_dim,
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        max_position_embeddings=int(hf.get("max_position_embeddings", 8192)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        sliding_window=hf.get("sliding_window"),
+    )
+    scaling = hf.get("rope_scaling")
+    if isinstance(scaling, dict) and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        kw["rope_scaling"] = scaling
+    if model_type in ("qwen2",):
+        kw["attention_bias"] = True
+    if model_type in ("qwen3",):
+        kw["qk_norm"] = True
+    if model_type in ("mixtral",):
+        kw["num_experts"] = int(hf.get("num_local_experts", 8))
+        kw["num_experts_per_tok"] = int(hf.get("num_experts_per_tok", 2))
+    if hf.get("query_pre_attn_scalar") is not None:
+        kw["query_pre_attn_scalar"] = float(hf["query_pre_attn_scalar"])
+    if model_type.startswith("gemma"):
+        kw.update(
+            hidden_act="gelu_tanh", norm_style="gemma",
+            embedding_multiplier=hidden ** 0.5,
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        )
+        if model_type in ("gemma2", "gemma3", "gemma3_text"):
+            kw["post_norms"] = True
+        if model_type == "gemma2":
+            kw["logit_softcap"] = float(hf.get("final_logit_softcapping") or 30.0)
+            kw["attn_softcap"] = float(hf.get("attn_logit_softcapping") or 50.0)
+            kw["sliding_window_pattern"] = 2
+            kw["rope_local_theta"] = float(hf.get("rope_theta", 10000.0))
+        if model_type in ("gemma3", "gemma3_text"):
+            kw["qk_norm"] = True
+            kw["sliding_window_pattern"] = int(hf.get("sliding_window_pattern", 6))
+            kw["rope_local_theta"] = float(hf.get("rope_local_base_freq", 10000.0))
+    return ModelConfig(**kw)

@@ -1,0 +1,133 @@
+"""Paged KV cache: device-side page pool + host-side page allocator.
+
+The reference stack got paged attention from the vLLM image (reference
+SURVEY §2.3); this is the TPU-native equivalent. Design:
+
+- One global page pool per layer, stacked over layers for ``lax.scan``:
+  ``k_pages``/``v_pages`` have shape [L, P, page_size, n_kv, head_dim].
+  n_kv is the sharded axis (mesh "model") so each TP shard holds its own
+  heads' pages — the pool never crosses chips.
+- Physical page 0 is reserved as a trash page: padded prompt positions
+  write there, so prefill needs no masking on the scatter path. It is never
+  allocated to a sequence and never read (length masks exclude it).
+- The allocator is plain host Python (free list). Page tables and lengths
+  are host numpy, shipped to the device each step as int32 arrays — small
+  (slots × pages_per_seq) and latency-irrelevant next to the step itself.
+
+All shapes are static: ``num_pages``, ``page_size``, ``pages_per_slot`` are
+fixed at engine start, which is what keeps the decode step at exactly one
+compiled executable (XLA retraces on any shape change).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    num_pages: int = 2048
+    page_size: int = 64
+    pages_per_slot: int = 32
+    dtype: str = "bfloat16"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.pages_per_slot * self.page_size
+
+    @property
+    def bytes_per_page(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.page_size * self.num_kv_heads * self.head_dim * itemsize
+
+
+def init_pages(cfg: CacheConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def write_tokens(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new KV for one layer into the page pool.
+
+    k_pages/v_pages: [P, page, n_kv, d] (single layer)
+    k, v:            [B, T, n_kv, d]
+    page_table:      [B, pages_per_seq] int32
+    positions:       [B, T] int32 token positions; negative => trash page 0
+    """
+    page = k_pages.shape[1]
+    trash = positions < 0
+    pos = jnp.where(trash, 0, positions)
+    logical_page = pos // page                                   # [B, T]
+    page_ids = jnp.take_along_axis(page_table, logical_page, axis=1)
+    page_ids = jnp.where(trash, 0, page_ids)
+    offs = pos % page
+    k_pages = k_pages.at[page_ids, offs].set(k, mode="drop")
+    v_pages = v_pages.at[page_ids, offs].set(v, mode="drop")
+    return k_pages, v_pages
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the physical page pool.
+
+    Page 0 is reserved (trash). ``allocate`` grows a slot's page list to
+    cover ``num_tokens``; ``free`` returns a slot's pages to the pool.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, num_slots: int, pages_per_slot: int):
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self.num_slots = num_slots
+        self.free_pages: list[int] = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        # page_tables[s] is the authoritative host copy; unused entries point
+        # at the trash page 0 (never read thanks to length masking).
+        self.page_tables = np.zeros((num_slots, pages_per_slot), dtype=np.int32)
+        self.slot_pages: list[list[int]] = [[] for _ in range(num_slots)]
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self.free_pages)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.page_size)
+
+    def can_allocate(self, slot: int, num_tokens: int) -> bool:
+        need = self.pages_needed(num_tokens) - len(self.slot_pages[slot])
+        return need <= len(self.free_pages) and self.pages_needed(num_tokens) <= self.pages_per_slot
+
+    def allocate(self, slot: int, num_tokens: int) -> None:
+        """Ensure the slot owns enough pages to hold num_tokens tokens."""
+        need = self.pages_needed(num_tokens)
+        if need > self.pages_per_slot:
+            raise ValueError(
+                f"sequence of {num_tokens} tokens needs {need} pages > "
+                f"pages_per_slot={self.pages_per_slot}"
+            )
+        have = len(self.slot_pages[slot])
+        for i in range(have, need):
+            if not self.free_pages:
+                raise MemoryError("KV page pool exhausted")
+            p = self.free_pages.pop()
+            self.slot_pages[slot].append(p)
+            self.page_tables[slot, i] = p
+
+    def free(self, slot: int) -> None:
+        for p in self.slot_pages[slot]:
+            self.free_pages.append(p)
+        self.slot_pages[slot] = []
+        self.page_tables[slot, :] = 0

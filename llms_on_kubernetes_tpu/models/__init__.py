@@ -1,0 +1,7 @@
+from llms_on_kubernetes_tpu.models.decoder import (
+    init_params,
+    forward_prefill,
+    forward_decode,
+)
+
+__all__ = ["init_params", "forward_prefill", "forward_decode"]

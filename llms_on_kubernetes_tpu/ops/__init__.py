@@ -1,0 +1,14 @@
+from llms_on_kubernetes_tpu.ops.norms import rms_norm
+from llms_on_kubernetes_tpu.ops.rope import apply_rope, rope_frequencies
+from llms_on_kubernetes_tpu.ops.attention import (
+    paged_attention,
+    prefill_attention,
+)
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "paged_attention",
+    "prefill_attention",
+]

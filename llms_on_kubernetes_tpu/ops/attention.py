@@ -1,0 +1,128 @@
+"""Reference (pure-XLA) attention ops over the paged KV cache.
+
+These are the semantically-authoritative implementations; the Pallas kernels
+in ``pallas_flash.py`` / ``pallas_paged.py`` must match them bit-for-bit in
+their tests (tolerance: bf16). They are also the CPU fallback path — the
+"ramalama-equivalent" local deployment (reference ramalama-models/) runs the
+same engine on XLA-CPU with these ops.
+
+Layout choices (TPU-first):
+- head_dim is the last (lane) axis, padded shapes are multiples of 128 for
+  the models that matter (Llama/Mistral head_dim=128).
+- GQA is expressed by reshaping q to [.., n_kv, group, ..] and einsumming
+  against k/v at n_kv granularity — no materialized repeat_kv, so the MXU
+  sees one big batched matmul and the KV HBM read happens once.
+- All masking is additive in float32; softmax is computed in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
+
+
+def softcap(logits: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
+    """Gemma-2-style tanh soft-capping (no-op when cap is None)."""
+    if cap is None:
+        return logits
+    return cap * jnp.tanh(logits / cap)
+
+
+def prefill_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a (padded) prompt chunk.
+
+    q:       [B, T, n_q, d]
+    k, v:    [B, T, n_kv, d]
+    lengths: [B] int32 — true prompt lengths (<= T); keys at or beyond a
+             sequence's length are masked out.
+    returns  [B, T, n_q, d]
+    """
+    B, T, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+
+    qg = q.reshape(B, T, n_kv, group, d).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [B, n_kv, group, T(q), T(k)]
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, kf) * scale
+    logits = softcap(logits, attn_softcap)
+
+    q_pos = jnp.arange(T, dtype=jnp.int32)[:, None]   # [T, 1]
+    k_pos = jnp.arange(T, dtype=jnp.int32)[None, :]   # [1, T]
+    mask = k_pos <= q_pos                             # causal
+    if sliding_window is not None:
+        mask = mask & (k_pos > q_pos - sliding_window)
+    # pad mask: key beyond the sequence's true length
+    valid = k_pos < lengths[:, None, None]            # [B, 1, T]
+    mask = mask[None] & valid                          # [B, T, T]
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, vf)
+    return out.reshape(B, T, n_q, d).astype(q.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-token decode attention against the paged KV cache.
+
+    q:          [B, n_q, d]       — one new token per active slot
+    k_pages:    [P, page, n_kv, d] — global page pool (this layer)
+    v_pages:    [P, page, n_kv, d]
+    page_table: [B, pages_per_seq] int32 — physical page ids per slot
+    lengths:    [B] int32 — tokens in cache per slot INCLUDING the current
+                token (i.e. the query attends to keys [0, lengths)).
+    returns     [B, n_q, d]
+
+    The gather materializes each slot's logical KV ([B, S_max, n_kv, d]);
+    that is the XLA-reference strategy. The Pallas kernel streams pages
+    through VMEM instead (pallas_paged.py).
+    """
+    B, n_q, d = q.shape
+    P, page, n_kv, _ = k_pages.shape
+    pages_per_seq = page_table.shape[1]
+    S = pages_per_seq * page
+    group = n_q // n_kv
+
+    k = k_pages[page_table].reshape(B, S, n_kv, d).astype(jnp.float32)
+    v = v_pages[page_table].reshape(B, S, n_kv, d).astype(jnp.float32)
+    qg = q.reshape(B, n_kv, group, d).astype(jnp.float32)
+
+    logits = jnp.einsum("bkgd,bskd->bkgs", qg, k) * scale   # [B, n_kv, g, S]
+    logits = softcap(logits, attn_softcap)
+
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None, :]          # [1, S]
+    mask = k_pos < lengths[:, None]                          # [B, S]
+    if sliding_window is not None:
+        q_pos = lengths[:, None] - 1
+        mask = mask & (k_pos > q_pos - sliding_window)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    return out.reshape(B, n_q, d).astype(q.dtype)

@@ -1,0 +1,76 @@
+"""Mixture-of-Experts block (Mixtral-style top-k routing).
+
+TPU-first design: token→expert dispatch is expressed as two one-hot einsums
+around a batched expert matmul (the Mesh-TensorFlow/Flaxformer pattern), not
+as per-token gather/scatter. Everything is static-shape:
+
+  dispatch [N, E, C]  (one-hot)   xs = dispatch^T · x     -> [E, C, D]
+  expert FFN (batched over E)     ys = ffn(xs)            -> [E, C, D]
+  combine  [N, E, C]  (weighted)  out = combine · ys      -> [N, D]
+
+With the expert axis of the weights sharded over the mesh ("expert","model")
+axes, XLA's SPMD partitioner turns the dispatch/combine einsums into the
+all-to-alls that ride ICI — the NCCL-free equivalent of what the reference's
+vLLM image would do with its fused MoE CUDA kernels (reference pulls the
+engine as an image; SURVEY §2.3 row 1).
+
+Capacity: C = ceil(N * top_k / E * capacity_factor). Tokens overflowing an
+expert's capacity are dropped for that expert (their combine weight is 0);
+with capacity_factor >= E / top_k no token can ever be dropped (C >= N).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_block(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    act=jax.nn.silu,
+    valid: "jnp.ndarray | None" = None,
+) -> jnp.ndarray:
+    """x: [N, D]; router_w: [D, E]; w_gate/w_up: [E, D, F]; w_down: [E, F, D].
+
+    ``valid`` ([N] bool) excludes padding/idle tokens from routing entirely:
+    they claim no expert capacity (so real tokens are never displaced by
+    padding) and their output rows are zero.
+    """
+    N, D = x.shape
+    E = router_w.shape[1]
+    C = max(1, int(-(-N * top_k * capacity_factor // E)))
+    C = min(C, N)
+
+    router_logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)                      # [N, k]
+    # Mixtral renormalizes over the selected experts.
+    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    expert_onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)            # [N, k, E]
+    if valid is not None:
+        expert_onehot = expert_onehot * valid.astype(jnp.int32)[:, None, None]
+    # Position of each (token, choice) within its expert's buffer: number of
+    # earlier claims on the same expert (earlier tokens, or earlier choices
+    # of this token).
+    claims_before = jnp.cumsum(expert_onehot.reshape(N * top_k, E), axis=0).reshape(N, top_k, E)
+    pos_in_expert = claims_before - expert_onehot                           # [N, k, E]
+    claim_ok = (expert_onehot == 1) & (pos_in_expert < C)
+    # one_hot of index C (out of range) is all-zeros => rejected claims vanish.
+    pos_onehot = jax.nn.one_hot(
+        jnp.where(claim_ok, pos_in_expert, C), C, dtype=x.dtype
+    )                                                                       # [N, k, E, C]
+    dispatch = jnp.einsum("nkec->nec", pos_onehot)                          # [N, E, C]
+    combine = jnp.einsum("nk,nkec->nec", topk_probs.astype(x.dtype), pos_onehot)
+
+    xs = jnp.einsum("nec,nd->ecd", dispatch, x)                             # [E, C, D]
+    h = act(jnp.einsum("ecd,edf->ecf", xs, w_gate)) * jnp.einsum("ecd,edf->ecf", xs, w_up)
+    ys = jnp.einsum("ecf,efd->ecd", h, w_down)                              # [E, C, D]
+    return jnp.einsum("nec,ecd->nd", combine, ys)                           # [N, D]

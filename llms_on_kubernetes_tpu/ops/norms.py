@@ -1,0 +1,35 @@
+"""Normalization ops.
+
+Elementwise ops like RMSNorm are HBM-bandwidth bound on TPU; they are written
+so XLA fuses them into the neighbouring matmuls (single pass over the
+activation), with the variance accumulated in float32 regardless of the
+activation dtype — the same numerics HF/vLLM use, which matters for parity
+with checkpoints served by the reference stack's vLLM image.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    *,
+    style: str = "llama",
+) -> jnp.ndarray:
+    """RMSNorm over the last axis.
+
+    style="llama": ``normalize(x) * w``  (Llama/Mistral/Qwen/Phi)
+    style="gemma": ``normalize(x) * (1 + w)``  (Gemma stores weight-1)
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if style == "gemma":
+        w = 1.0 + w
+    return (normed * w).astype(dtype)

@@ -1,0 +1,75 @@
+"""Rotary position embeddings (HF "rotate_half" convention).
+
+Frequencies are computed on the fly from integer positions rather than from a
+precomputed [max_len, dim] table: under ``jit`` XLA folds the trig into the
+surrounding fusion, and avoiding the table keeps the decode step free of a
+max_len-sized HBM read per layer.
+
+Supports the llama3 long-context frequency rescaling used by Llama-3.1+
+(`rope_scaling={"rope_type": "llama3", ...}` in HF configs).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float,
+    rope_scaling: Optional[dict] = None,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim // 2], float32, with optional llama3 scaling."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    if rope_scaling:
+        factor = float(rope_scaling.get("factor", 8.0))
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * math.pi / inv_freq
+        # llama3 scheme: leave high-freq alone, divide low-freq by factor,
+        # smooth interpolation in between.
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = np.where(
+            wavelen > orig / low,  # low frequency band
+            scaled,
+            np.where(
+                wavelen < orig / high,  # high frequency band
+                inv_freq,
+                (1.0 - smooth) * scaled + smooth * inv_freq,
+            ),
+        )
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotate q and k.
+
+    q: [..., T, num_heads, head_dim]
+    k: [..., T, num_kv_heads, head_dim]
+    positions: [..., T] int32
+    inv_freq: [head_dim // 2] float32
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+
+    def rot(x: jnp.ndarray) -> jnp.ndarray:
+        half = x.shape[-1] // 2
+        x1 = x[..., :half].astype(jnp.float32)
+        x2 = x[..., half:].astype(jnp.float32)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)

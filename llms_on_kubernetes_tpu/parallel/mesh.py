@@ -1,0 +1,60 @@
+"""Device mesh construction.
+
+The reference expressed intra-model parallelism as a single vLLM flag
+(``--tensor-parallel-size``, backed by NCCL — reference
+vllm-models/helm-chart/templates/model-deployments.yaml:37-38). Here the
+equivalent surface is a ``jax.sharding.Mesh`` over the slice's chips with
+three logical axes:
+
+- ``model``  — tensor parallelism (attention heads / FFN hidden), collectives
+  ride ICI; the `tensor-parallel-size` analogue.
+- ``expert`` — expert parallelism for MoE (Mixtral), all-to-alls over ICI.
+- ``data``   — within-engine batch parallelism (request-level DP remains K8s
+  `replicas`, exactly as the reference did).
+
+On a multi-host slice the same mesh spans all processes'
+``jax.devices()`` — XLA's SPMD partitioner emits ICI/DCN collectives, no
+NCCL/MPI (see parallel/distributed.py for process-group bring-up on K8s).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_EXPERT = "expert"
+AXIS_MODEL = "model"
+MESH_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL)
+
+
+def make_mesh(
+    data: int = 1,
+    expert: int = 1,
+    model: int = -1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, expert, model) mesh.
+
+    ``model=-1`` absorbs all remaining devices (the common serving case:
+    one engine = one slice, fully tensor-parallel).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model == -1:
+        if n % (data * expert) != 0:
+            raise ValueError(f"{n} devices not divisible by data*expert={data * expert}")
+        model = n // (data * expert)
+    need = data * expert * model
+    if need > n:
+        raise ValueError(f"mesh {data}x{expert}x{model} needs {need} devices, have {n}")
+    arr = np.asarray(devices[:need]).reshape(data, expert, model)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    d = device or jax.devices()[0]
+    return Mesh(np.asarray([d]).reshape(1, 1, 1), MESH_AXES)

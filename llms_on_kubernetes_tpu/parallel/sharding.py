@@ -1,0 +1,96 @@
+"""Sharding rules: parameter / cache PartitionSpecs for the decoder.
+
+Megatron-style tensor parallelism expressed declaratively: column-parallel
+q/k/v and gate/up (output head / hidden axis over "model"), row-parallel
+wo/w_down (input axis over "model" — XLA inserts the psum), vocab-parallel
+embedding and lm_head. MoE expert weights additionally shard their expert
+axis over "expert". The paged KV pool shards its kv-head axis over "model"
+so each chip's pages hold only its own heads.
+
+When a dimension doesn't divide the axis size (e.g. 4 kv heads on an
+8-way model axis), the rule degrades to replication for that tensor —
+same behaviour serving engines use for small-GQA models.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llms_on_kubernetes_tpu.configs import ModelConfig
+from llms_on_kubernetes_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT, AXIS_MODEL
+
+Params = dict[str, Any]
+
+
+def _axis(mesh: Mesh, dim: int, axis: str):
+    """Use `axis` for this dim if it divides evenly, else replicate."""
+    size = mesh.shape[axis]
+    return axis if size > 1 and dim % size == 0 else None
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    m_h = _axis(mesh, H, AXIS_MODEL)
+    m_kv = _axis(mesh, KV, AXIS_MODEL)
+    m_f = _axis(mesh, F, AXIS_MODEL)
+    m_v = _axis(mesh, V, AXIS_MODEL)
+
+    layers: Params = {
+        "attn_norm": P(),
+        "wq": P(None, None, m_h, None),
+        "wk": P(None, None, m_kv, None),
+        "wv": P(None, None, m_kv, None),
+        "wo": P(None, m_h, None, None),
+        "mlp_norm": P(),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = P(None, m_h, None)
+        layers["bk"] = P(None, m_kv, None)
+        layers["bv"] = P(None, m_kv, None)
+    if cfg.qk_norm:
+        layers["q_norm"] = P()
+        layers["k_norm"] = P()
+    if cfg.post_norms:
+        layers["attn_post_norm"] = P()
+        layers["mlp_post_norm"] = P()
+    if cfg.is_moe:
+        e = _axis(mesh, cfg.num_experts, AXIS_EXPERT)
+        layers["router"] = P()
+        layers["w_gate"] = P(None, e, None, m_f)
+        layers["w_up"] = P(None, e, None, m_f)
+        layers["w_down"] = P(None, e, m_f, None)
+    else:
+        layers["w_gate"] = P(None, None, m_f)
+        layers["w_up"] = P(None, None, m_f)
+        layers["w_down"] = P(None, m_f, None)
+
+    specs: Params = {
+        "embed": P(m_v, None),
+        "final_norm": P(),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, m_v)
+    return specs
+
+
+def cache_specs(cfg: ModelConfig, mesh: Mesh) -> tuple[P, P]:
+    m_kv = _axis(mesh, cfg.num_kv_heads, AXIS_MODEL)
+    spec = P(None, None, None, m_kv, None)  # [L, P, page, KV, hd]
+    return spec, spec
+
+
+def batch_spec() -> P:
+    return P(AXIS_DATA)
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Device_put params onto the mesh according to param_specs."""
+    specs = param_specs(cfg, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )

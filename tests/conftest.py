@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Mirrors the build plan's test strategy (SURVEY.md §4): the reference had no
+tests at all; here sharding/serving logic runs in CI on a fake-TPU CPU mesh
+via ``xla_force_host_platform_device_count`` so no TPU hardware is needed.
+
+Note: the platform override must use ``jax.config.update`` (not just env
+vars) because a sitecustomize module may already have imported jax and
+selected a hardware platform before conftest runs; the config update wins as
+long as no backend has been initialized yet.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,63 @@
+"""Paged KV cache: allocator semantics and scatter-write correctness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llms_on_kubernetes_tpu.engine.cache import (
+    CacheConfig, PageAllocator, init_pages, write_tokens,
+)
+
+
+def test_allocator_reserves_trash_page_and_reuses_freed():
+    a = PageAllocator(num_pages=8, page_size=4, num_slots=2, pages_per_slot=4)
+    assert a.num_free_pages == 7  # page 0 reserved
+    a.allocate(0, 9)              # 3 pages
+    assert a.slot_pages[0] == [1, 2, 3]
+    assert (a.page_tables[0, :3] == [1, 2, 3]).all()
+    a.allocate(0, 10)             # still 3 pages — idempotent growth
+    assert len(a.slot_pages[0]) == 3
+    a.free(0)
+    assert a.num_free_pages == 7
+    assert (a.page_tables[0] == 0).all()
+    a.allocate(1, 1)
+    assert a.slot_pages[1] == [3]  # LIFO reuse
+
+
+def test_allocator_exhaustion_and_overflow():
+    a = PageAllocator(num_pages=4, page_size=2, num_slots=1, pages_per_slot=2)
+    with pytest.raises(ValueError):
+        a.allocate(0, 100)  # exceeds pages_per_slot
+    a2 = PageAllocator(num_pages=3, page_size=2, num_slots=2, pages_per_slot=4)
+    a2.allocate(0, 4)
+    with pytest.raises(MemoryError):
+        a2.allocate(1, 2)
+
+
+def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
+    P, page, KV, d = 5, 4, 2, 3
+    k_pages = jnp.zeros((P, page, KV, d))
+    v_pages = jnp.zeros((P, page, KV, d))
+    B, T = 1, 6
+    k = jnp.arange(B * T * KV * d, dtype=jnp.float32).reshape(B, T, KV, d) + 1
+    v = -k
+    page_table = jnp.asarray([[2, 4, 0, 0]], jnp.int32)
+    # positions 0..4 valid, position 5 is padding (-1 => trash page 0)
+    positions = jnp.asarray([[0, 1, 2, 3, 4, -1]], jnp.int32)
+    k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, positions)
+    kn = np.asarray(k_pages)
+    np.testing.assert_allclose(kn[2, 0], np.asarray(k)[0, 0])
+    np.testing.assert_allclose(kn[2, 3], np.asarray(k)[0, 3])
+    np.testing.assert_allclose(kn[4, 0], np.asarray(k)[0, 4])
+    assert np.asarray(v_pages)[2, 1, 0, 0] == -np.asarray(k)[0, 1, 0, 0]
+    # pages other than 2, 4 and trash are untouched
+    assert (kn[1] == 0).all() and (kn[3] == 0).all()
+
+
+def test_cache_config_accounting():
+    cc = CacheConfig(num_layers=2, num_kv_heads=4, head_dim=8,
+                     num_pages=16, page_size=8, pages_per_slot=4, dtype="bfloat16")
+    assert cc.max_seq_len == 32
+    assert cc.bytes_per_page == 2 * 2 * 8 * 4 * 8 * 2  # k&v · L · page · kv · hd · bf16
+    k, v = init_pages(cc)
+    assert k.shape == (2, 16, 8, 4, 8) and k.dtype == jnp.bfloat16

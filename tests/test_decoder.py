@@ -1,0 +1,160 @@
+"""Decoder model tests: prefill/decode consistency, HF parity, cache reuse."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llms_on_kubernetes_tpu.configs import get_config
+from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
+from llms_on_kubernetes_tpu.models.decoder import (
+    forward_decode,
+    forward_prefill,
+    init_params,
+)
+
+
+def make_cache(cfg, num_pages=64, page_size=4, pages_per_slot=8):
+    cc = CacheConfig(
+        num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim, num_pages=num_pages, page_size=page_size,
+        pages_per_slot=pages_per_slot, dtype="float32",
+    )
+    return cc, *init_pages(cc)
+
+
+def sequential_page_table(alloc, slots_tokens):
+    for slot, n in slots_tokens:
+        alloc.allocate(slot, n)
+    return jnp.asarray(alloc.page_tables)
+
+
+@pytest.mark.parametrize("name", ["debug-tiny", "debug-moe", "debug-gemma"])
+def test_prefill_then_decode_matches_full_prefill(name):
+    """Decoding token-by-token must reproduce full-prefill logits."""
+    import dataclasses
+    cfg = dataclasses.replace(get_config(name), dtype="float32")
+    params = init_params(cfg, jax.random.key(0), dtype="float32")
+
+    prompt = np.array([3, 17, 9, 42, 7, 23, 5], np.int32)
+    T = 8  # bucket
+    n = len(prompt)
+
+    cc, kp, vp = make_cache(cfg)
+    alloc = PageAllocator(cc.num_pages, cc.page_size, 2, cc.pages_per_slot)
+    pt = sequential_page_table(alloc, [(0, n + 4)])
+
+    tokens = np.zeros((1, T), np.int32)
+    tokens[0, :n] = prompt
+    logits_full, kp, vp = forward_prefill(
+        params, cfg, jnp.asarray(tokens), jnp.asarray([n], jnp.int32), kp, vp, pt[:1]
+    )
+
+    # token-by-token: prefill first token only, then decode the rest
+    cc2, kp2, vp2 = make_cache(cfg)
+    alloc2 = PageAllocator(cc2.num_pages, cc2.page_size, 2, cc2.pages_per_slot)
+    pt2 = sequential_page_table(alloc2, [(0, n + 4)])
+    t0 = np.zeros((1, T), np.int32)
+    t0[0, 0] = prompt[0]
+    logits_step, kp2, vp2 = forward_prefill(
+        params, cfg, jnp.asarray(t0), jnp.asarray([1], jnp.int32), kp2, vp2, pt2[:1]
+    )
+    for i in range(1, n):
+        logits_step, kp2, vp2 = forward_decode(
+            params, cfg, jnp.asarray([prompt[i]], jnp.int32),
+            jnp.asarray([i + 1], jnp.int32), kp2, vp2, pt2[:1],
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(logits_full), np.asarray(logits_step), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_batched_prefill_rows_are_independent():
+    import dataclasses
+    cfg = dataclasses.replace(get_config("debug-tiny"), dtype="float32")
+    params = init_params(cfg, jax.random.key(1), dtype="float32")
+
+    cc, kp, vp = make_cache(cfg)
+    alloc = PageAllocator(cc.num_pages, cc.page_size, 2, cc.pages_per_slot)
+    pt = sequential_page_table(alloc, [(0, 8), (1, 8)])
+
+    toks = np.array([[5, 6, 7, 0], [9, 8, 7, 6]], np.int32)
+    lens = np.array([3, 4], np.int32)
+    logits_b, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(toks), jnp.asarray(lens), kp, vp, pt
+    )
+
+    # row 0 alone
+    _, kp1, vp1 = make_cache(cfg)
+    logits_0, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(toks[:1]), jnp.asarray(lens[:1]), kp1, vp1, pt[:1]
+    )
+    np.testing.assert_allclose(np.asarray(logits_b)[0], np.asarray(logits_0)[0], rtol=1e-4, atol=1e-4)
+
+
+def test_hf_transformers_parity_tiny_llama():
+    """Logit parity against HuggingFace LlamaForCausalLM (torch CPU) on a
+    random tiny model — validates rope convention, GQA, norms, weight layout."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+
+    from llms_on_kubernetes_tpu.configs import ModelConfig
+    cfg = ModelConfig(
+        name="hf-tiny", vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, rms_norm_eps=1e-5, max_position_embeddings=64,
+        dtype="float32",
+    )
+
+    # convert weights
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    D, H, KV, hd = 32, 4, 2, 8
+    def stack(fmt):
+        return np.stack([sd[fmt.format(i)] for i in range(2)])
+    params = {
+        "embed": sd["model.embed_tokens.weight"],
+        "final_norm": sd["model.norm.weight"],
+        "lm_head": sd["lm_head.weight"].T.copy(),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight").transpose(0, 2, 1).reshape(2, D, H, hd),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight").transpose(0, 2, 1).reshape(2, D, KV, hd),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight").transpose(0, 2, 1).reshape(2, D, KV, hd),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight").transpose(0, 2, 1).reshape(2, H, hd, D),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight").transpose(0, 2, 1),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight").transpose(0, 2, 1),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight").transpose(0, 2, 1),
+        },
+    }
+    params = jax.tree.map(jnp.asarray, params)
+
+    prompt = np.array([[1, 5, 9, 100, 42, 17]], np.int32)
+    n = prompt.shape[1]
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(prompt.astype(np.int64))).logits[0, -1].numpy()
+
+    cc, kp, vp = make_cache(cfg)
+    alloc = PageAllocator(cc.num_pages, cc.page_size, 1, cc.pages_per_slot)
+    alloc.allocate(0, n)
+    pt = jnp.asarray(alloc.page_tables)
+    ours, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(prompt), jnp.asarray([n], jnp.int32), kp, vp, pt
+    )
+    np.testing.assert_allclose(np.asarray(ours)[0], hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_wo_transpose_note():
+    """wo layout: HF o_proj.weight is [D_out, H*hd_in]; ours is [H, hd, D]."""
+    # covered implicitly by parity test; keep as documentation anchor
+    assert True
