@@ -1,0 +1,3 @@
+from llms_on_kubernetes_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""Command-line entry points — the container commands the charts run.
+
+The reference's pods ran `vllm serve <hf-id> --served-model-name <name>
+--port 8080 ...` (reference model-deployments.yaml:26-39) and an
+OpenResty/Python gateway (model-gateway.yaml / api-gateway.yaml). The
+TPU-native equivalents:
+
+    python -m llms_on_kubernetes_tpu serve  --model <ref> --served-model-name <name> [--tp N]
+    python -m llms_on_kubernetes_tpu router --backend name=url ... [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _add_serve(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("serve", help="run the OpenAI-compatible engine server")
+    p.add_argument("--model", required=True,
+                   help="registry name, HF repo id, or checkpoint directory")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--random-weights", action="store_true",
+                   help="skip checkpoint loading (benchmarks/smoke tests)")
+    p.add_argument("--max-decode-slots", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--pages-per-slot", type=int, default=64)
+    p.add_argument("--prefill-buckets", default="256,1024,4096")
+    p.add_argument("--tensor-parallel-size", "--tp", type=int, default=0,
+                   help="0 = all local devices on the mesh 'model' axis")
+    p.add_argument("--expert-parallel-size", "--ep", type=int, default=1)
+
+
+def _add_router(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("router", help="run the multi-model API gateway")
+    p.add_argument("--backend", action="append", required=True,
+                   metavar="NAME=URL", help="repeatable: model name=base url")
+    p.add_argument("--default-model", default=None)
+    p.add_argument("--strict", action="store_true",
+                   help="404 on unknown model instead of silent default fallback")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="llms-on-kubernetes-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _add_serve(sub)
+    _add_router(sub)
+    args = parser.parse_args(argv)
+
+    if args.cmd == "router":
+        from llms_on_kubernetes_tpu.server.router import run_router
+
+        backends = {}
+        for spec in args.backend:
+            name, _, url = spec.partition("=")
+            if not url:
+                parser.error(f"--backend must be NAME=URL, got {spec!r}")
+            backends[name] = url
+        run_router(backends, args.default_model, args.strict,
+                   host=args.host, port=args.port)
+        return 0
+
+    # serve
+    import jax
+
+    from llms_on_kubernetes_tpu.configs import REGISTRY, from_hf_config, get_config
+    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import load_tokenizer
+    from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir
+    from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+    from llms_on_kubernetes_tpu.server.openai_api import run_server
+
+    model_dir = None
+    model_cfg = None
+    try:
+        model_cfg = get_config(args.model)
+    except KeyError:
+        pass
+    if not args.random_weights:
+        try:
+            model_dir = resolve_model_dir(args.model)
+        except FileNotFoundError:
+            if model_cfg is None:
+                raise
+            print(f"[serve] no local checkpoint for {args.model}; "
+                  f"falling back to --random-weights", file=sys.stderr)
+    if model_cfg is None and model_dir is not None:
+        cfg_path = os.path.join(model_dir, "config.json")
+        model_cfg = from_hf_config(cfg_path, name=args.model)
+    if model_cfg is None:
+        raise SystemExit(f"cannot resolve model {args.model!r}")
+
+    n_dev = len(jax.devices())
+    tp = args.tensor_parallel_size or n_dev // max(1, args.expert_parallel_size)
+    mesh = make_mesh(data=1, expert=args.expert_parallel_size, model=tp)
+
+    engine_cfg = EngineConfig(
+        model=model_cfg.name,
+        dtype=args.dtype,
+        max_decode_slots=args.max_decode_slots,
+        num_pages=args.num_pages,
+        page_size=args.page_size,
+        pages_per_slot=args.pages_per_slot,
+        prefill_buckets=tuple(int(x) for x in args.prefill_buckets.split(",")),
+    )
+    engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
+                    model_dir=None if args.random_weights else model_dir)
+    tokenizer = load_tokenizer(model_dir)
+    served = args.served_model_name or model_cfg.name
+    print(f"[serve] {served}: mesh={dict(mesh.shape)} dtype={args.dtype} "
+          f"max_len={engine_cfg.max_model_len}", file=sys.stderr)
+    run_server(engine, tokenizer, served, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

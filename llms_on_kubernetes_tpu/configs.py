@@ -46,6 +46,9 @@ class ModelConfig:
     # MoE (Mixtral)
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # None => dropless dispatch (C=N); training-style capacity limits are
+    # opt-in since drops make logits batch-composition-dependent
+    moe_capacity_factor: Optional[float] = None
     # activation / norm variants
     hidden_act: str = "silu"                # silu | gelu_tanh
     norm_style: str = "llama"               # llama: x*w ; gemma: x*(1+w)
@@ -83,10 +86,6 @@ class ModelConfig:
         return L * (attn + mlp) + embed
 
 
-def _llama(name: str, **kw: Any) -> ModelConfig:
-    return ModelConfig(name=name, **kw)
-
-
 # ---------------------------------------------------------------------------
 # Registry. Keys are the short `modelName`s a chart would use; aliases map
 # HuggingFace repo ids onto them.
@@ -112,7 +111,7 @@ LLAMA3_ROPE_SCALING = {
 }
 
 _register(
-    _llama(
+    ModelConfig(
         "llama-3-8b",
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -122,7 +121,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "llama-3-70b",
         vocab_size=128256, hidden_size=8192, intermediate_size=28672,
         num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
@@ -132,7 +131,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "llama-3.1-8b",
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -143,7 +142,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "tinyllama-1.1b",
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
@@ -153,7 +152,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "mistral-7b",
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -165,7 +164,7 @@ _register(
 
 # v0.2+ dropped sliding-window attention and raised rope_theta to 1e6.
 _register(
-    _llama(
+    ModelConfig(
         "mistral-7b-v0.2",
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -175,7 +174,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "mixtral-8x7b",
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -186,7 +185,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "phi-3-mini",
         vocab_size=32064, hidden_size=3072, intermediate_size=8192,
         num_layers=32, num_heads=32, num_kv_heads=32, head_dim=96,
@@ -197,7 +196,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "qwen2.5-7b",
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
@@ -208,7 +207,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "qwen3-8b",
         vocab_size=151936, hidden_size=4096, intermediate_size=12288,
         num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -219,7 +218,7 @@ _register(
 )
 
 _register(
-    _llama(
+    ModelConfig(
         "gemma-2-9b",
         vocab_size=256000, hidden_size=3584, intermediate_size=14336,
         num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
@@ -238,7 +237,7 @@ _register(
 # The reference's first default model is gemma-3-27b-it
 # (reference vllm-models/helm-chart/values.yaml:2-6).
 _register(
-    _llama(
+    ModelConfig(
         "gemma-3-27b",
         vocab_size=262208, hidden_size=5376, intermediate_size=21504,
         num_layers=62, num_heads=32, num_kv_heads=16, head_dim=128,
@@ -250,13 +249,16 @@ _register(
         # query scale 1/sqrt(hidden/num_heads) = 1/sqrt(168)
         sliding_window=1024, sliding_window_pattern=6, rope_local_theta=10000.0,
         query_pre_attn_scalar=5376.0 / 32,
+        # global layers use linearly-scaled RoPE (factor 8); local layers
+        # keep unscaled rope_local_theta
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
     ),
     "google/gemma-3-27b-it",
 )
 
 # Tiny configs for tests / local CPU smoke runs.
 _register(
-    _llama(
+    ModelConfig(
         "debug-tiny",
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
@@ -264,7 +266,7 @@ _register(
     ),
 )
 _register(
-    _llama(
+    ModelConfig(
         "debug-gemma",
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_layers=4, num_heads=4, num_kv_heads=2, head_dim=16,
@@ -276,7 +278,7 @@ _register(
     ),
 )
 _register(
-    _llama(
+    ModelConfig(
         "debug-moe",
         vocab_size=256, hidden_size=64, intermediate_size=96,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
@@ -329,8 +331,16 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
         sliding_window=hf.get("sliding_window"),
     )
     scaling = hf.get("rope_scaling")
-    if isinstance(scaling, dict) and scaling.get("rope_type", scaling.get("type")) == "llama3":
-        kw["rope_scaling"] = scaling
+    if isinstance(scaling, dict):
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind in ("llama3", "linear"):
+            kw["rope_scaling"] = scaling
+        elif kind is not None:
+            # fail fast: serving with a dropped scaling scheme (yarn,
+            # longrope, ...) silently produces wrong positions
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} is not supported yet"
+            )
     if model_type in ("qwen2",):
         kw["attention_bias"] = True
     if model_type in ("qwen3",):

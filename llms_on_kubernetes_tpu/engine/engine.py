@@ -1,0 +1,399 @@
+"""The serving engine: continuous batching over jitted prefill/decode steps.
+
+This is the TPU-native replacement for the vLLM container the reference
+pulled (reference vllm-models/helm-chart/templates/model-deployments.yaml:21
+— continuous batching, paged attention, OpenAI serving all lived in that
+image). Design, per SURVEY §7 "hard parts" #2:
+
+- **Static shapes under jit.** Decode runs a fixed slot batch
+  [max_decode_slots]; idle slots ride along with length 0. Prompts are
+  padded to a small set of prefill buckets. Result: exactly
+  1 + len(buckets) compiled executables, no recompilation storms.
+- **One scheduler iteration** = admit-waiting → prefill (≤1 bucket call) →
+  one decode step for all active slots. Tokens stream out per iteration —
+  requests join/leave the batch without stopping it (continuous batching).
+- **Paged KV** (engine/cache.py): pages allocated on demand per step;
+  pool exhaustion preempts the youngest request back to the wait queue
+  (it re-prefills later — prompt + generated so far).
+- **Sampling fused into the step** (engine/sampling.py): only [B] int32
+  token ids cross the host boundary per step.
+
+The engine is synchronous; server/openai_api.py runs it on a thread and
+bridges to asyncio.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
+from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
+from llms_on_kubernetes_tpu.engine.sampling import sample
+from llms_on_kubernetes_tpu.models.decoder import forward_decode, forward_prefill, init_params
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0          # 0 => disabled
+    top_p: float = 1.0
+    max_tokens: int = 128
+    stop_token_ids: tuple[int, ...] = ()
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "debug-tiny"
+    dtype: str = "bfloat16"
+    max_decode_slots: int = 8
+    page_size: int = 64
+    num_pages: int = 512
+    pages_per_slot: int = 32
+    prefill_buckets: tuple[int, ...] = (64, 256, 1024)
+    seed: int = 0
+
+    @property
+    def max_model_len(self) -> int:
+        return self.page_size * self.pages_per_slot
+
+
+@dataclasses.dataclass
+class Request:
+    id: str
+    prompt: list[int]
+    params: SamplingParams
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # runtime state
+    output: list[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    pending_token: int = -1        # sampled but KV not yet cached
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    first_token_at: Optional[float] = None
+    events: "queue.SimpleQueue[tuple[list[int], bool, Optional[str]]]" = dataclasses.field(
+        default_factory=queue.SimpleQueue
+    )
+
+
+@dataclasses.dataclass
+class StepEvent:
+    request: Request
+    new_tokens: list[int]
+    finished: bool
+    finish_reason: Optional[str]
+
+
+def _prefill_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+                  key, temps, top_ks, top_ps):
+    logits, k_pages, v_pages = forward_prefill(
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+    )
+    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
+
+
+def _decode_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+                 key, temps, top_ks, top_ps):
+    logits, k_pages, v_pages = forward_decode(
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+    )
+    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
+
+
+class Engine:
+    """Multi-request continuous-batching engine for one model."""
+
+    def __init__(
+        self,
+        engine_config: EngineConfig,
+        model_config: Optional[ModelConfig] = None,
+        params: Optional[Params] = None,
+        mesh=None,
+        model_dir: Optional[str] = None,
+    ):
+        self.config = engine_config
+        self.model_config = model_config or get_config(engine_config.model)
+        cfg = self.model_config
+        self.mesh = mesh
+
+        if params is not None:
+            self.params = params
+        elif model_dir is not None:
+            from llms_on_kubernetes_tpu.engine.weights import load_hf_params
+            self.params = load_hf_params(cfg, model_dir, mesh=mesh, dtype=engine_config.dtype)
+        else:  # random weights (tests / benchmarks)
+            self.params = init_params(cfg, jax.random.key(engine_config.seed),
+                                      dtype=engine_config.dtype)
+            if mesh is not None:
+                from llms_on_kubernetes_tpu.parallel.sharding import shard_params
+                self.params = shard_params(self.params, cfg, mesh)
+
+        self.cache_config = CacheConfig(
+            num_layers=cfg.num_layers,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            num_pages=engine_config.num_pages,
+            page_size=engine_config.page_size,
+            pages_per_slot=engine_config.pages_per_slot,
+            dtype=engine_config.dtype,
+        )
+        self.k_pages, self.v_pages = init_pages(self.cache_config)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from llms_on_kubernetes_tpu.parallel.sharding import cache_specs
+            ks, vs = cache_specs(cfg, mesh)
+            self.k_pages = jax.device_put(self.k_pages, NamedSharding(mesh, ks))
+            self.v_pages = jax.device_put(self.v_pages, NamedSharding(mesh, vs))
+
+        B = engine_config.max_decode_slots
+        self.allocator = PageAllocator(
+            engine_config.num_pages, engine_config.page_size, B,
+            engine_config.pages_per_slot,
+        )
+        self.slots: list[Optional[Request]] = [None] * B
+        self.slot_len = np.zeros((B,), np.int64)  # tokens whose KV is cached
+        self.waiting: "collections.deque[Request]" = collections.deque()
+        self._key = jax.random.key(engine_config.seed)
+        self._step_counter = itertools.count()
+        self._id_counter = itertools.count()
+        self._lock = threading.Lock()
+
+        self._prefill = jax.jit(
+            _prefill_step, static_argnums=(1,), donate_argnums=(4, 5)
+        )
+        self._decode = jax.jit(
+            _decode_step, static_argnums=(1,), donate_argnums=(4, 5)
+        )
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: list[int],
+        params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> Request:
+        params = params or SamplingParams()
+        max_len = self.config.max_model_len
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > max(self.config.prefill_buckets):
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the largest prefill "
+                f"bucket ({max(self.config.prefill_buckets)})"
+            )
+        if len(prompt) + params.max_tokens > max_len:
+            params = dataclasses.replace(
+                params, max_tokens=max(1, max_len - len(prompt))
+            )
+        req = Request(
+            id=request_id or f"req-{next(self._id_counter)}",
+            prompt=list(prompt), params=params,
+        )
+        with self._lock:
+            self.waiting.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(r is not None for r in self.slots)
+
+    # ------------------------------------------------------------------
+    # scheduler iteration
+    # ------------------------------------------------------------------
+
+    def step(self) -> list[StepEvent]:
+        events: list[StepEvent] = []
+        events += self._admit_one()
+        events += self._decode_once()
+        for ev in events:
+            ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
+        return events
+
+    def _next_key(self) -> jax.Array:
+        return jax.random.fold_in(self._key, next(self._step_counter))
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no prefill bucket fits {n} tokens")
+
+    def _admit_one(self) -> list[StepEvent]:
+        """Admit + prefill at most one waiting request per iteration.
+
+        A resumed (previously preempted) request re-prefills its prompt plus
+        every already-emitted token except the pending one; the prefill's
+        sampled token is discarded and the old pending token is restored, so
+        the output stream is unaffected by preemption.
+        """
+        with self._lock:
+            if not self.waiting:
+                return []
+            slot = self._free_slot()
+            if slot is None:
+                return []
+            req = self.waiting[0]
+            resumed = bool(req.output)
+            prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
+            n = len(prefill_tokens)
+            if n > max(self.config.prefill_buckets):
+                # resumed request grew beyond prefill reach; end it gracefully
+                self.waiting.popleft()
+                ev = self._finish(req, "length")
+                return [ev]
+            if not self.allocator.can_allocate(slot, n + 1):
+                return []  # wait for pages to free up
+            self.waiting.popleft()
+        self.allocator.allocate(slot, n + 1)
+        self.slots[slot] = req
+        req.slot = slot
+
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prefill_tokens
+        page_table = jnp.asarray(self.allocator.page_tables[slot:slot + 1])
+        temps = jnp.asarray([req.params.temperature], jnp.float32)
+        top_ks = jnp.asarray([req.params.top_k], jnp.int32)
+        top_ps = jnp.asarray([req.params.top_p], jnp.float32)
+
+        toks, _lps, self.k_pages, self.v_pages = self._prefill(
+            self.params, self.model_config, jnp.asarray(tokens),
+            jnp.asarray([n], jnp.int32), self.k_pages, self.v_pages,
+            page_table, self._next_key(), temps, top_ks, top_ps,
+        )
+        self.slot_len[slot] = n
+        if resumed:
+            req.pending_token = req.output[-1]
+            return []
+        first = int(np.asarray(toks)[0])
+        req.pending_token = first
+        req.first_token_at = time.monotonic()
+        return self._emit(req, first)
+
+    def _emit(self, req: Request, token: int) -> list[StepEvent]:
+        """Record a sampled token and decide whether the request finishes."""
+        req.output.append(token)
+        reason = None
+        if token in set(req.params.stop_token_ids):
+            reason = "stop"
+        elif len(req.output) >= req.params.max_tokens:
+            reason = "length"
+        elif self.slot_len[req.slot] + 1 >= self.config.max_model_len:
+            reason = "length"
+        if reason is not None:
+            self._finish(req, reason)
+        return [StepEvent(req, [token], req.finished, reason)]
+
+    def _finish(self, req: Request, reason: str) -> StepEvent:
+        """Release a request's slot/pages and mark it finished."""
+        req.finished = True
+        req.finish_reason = reason
+        if req.slot >= 0:
+            self.allocator.free(req.slot)
+            self.slot_len[req.slot] = 0
+            self.slots[req.slot] = None
+            req.slot = -1
+        return StepEvent(req, [], True, reason)
+
+    def _preempt_youngest(self) -> None:
+        """Free the most recently admitted request's pages; requeue it to
+        re-prefill (prompt + generated so far) when memory frees up."""
+        victims = [r for r in self.slots if r is not None]
+        if not victims:
+            raise MemoryError("KV pool exhausted with no preemptable request")
+        victim = max(victims, key=lambda r: r.submitted_at)
+        slot = victim.slot
+        self.allocator.free(slot)
+        self.slot_len[slot] = 0
+        self.slots[slot] = None
+        victim.slot = -1
+        victim.pending_token = -1
+        with self._lock:
+            self.waiting.appendleft(victim)
+
+    def _decode_once(self) -> list[StepEvent]:
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return []
+
+        # grow page tables; preempt on exhaustion
+        for i, r in list(active):
+            while True:
+                try:
+                    self.allocator.allocate(i, int(self.slot_len[i]) + 1)
+                    break
+                except MemoryError:
+                    self._preempt_youngest()
+                    active = [(j, rr) for j, rr in enumerate(self.slots) if rr is not None]
+                    if (i, r) not in active:
+                        break
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return []
+
+        B = self.config.max_decode_slots
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        for i, r in active:
+            tokens[i] = r.pending_token
+            lengths[i] = self.slot_len[i] + 1
+            temps[i] = r.params.temperature
+            top_ks[i] = r.params.top_k
+            top_ps[i] = r.params.top_p
+
+        toks, _lps, self.k_pages, self.v_pages = self._decode(
+            self.params, self.model_config, jnp.asarray(tokens),
+            jnp.asarray(lengths), self.k_pages, self.v_pages,
+            jnp.asarray(self.allocator.page_tables),
+            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        sampled = np.asarray(toks)
+
+        events: list[StepEvent] = []
+        for i, r in active:
+            self.slot_len[i] += 1  # pending token's KV is now cached
+            new = int(sampled[i])
+            r.pending_token = new
+            events += self._emit(r, new)
+        return events
+
+    # ------------------------------------------------------------------
+    # convenience
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        prompt: list[int],
+        params: Optional[SamplingParams] = None,
+    ) -> list[int]:
+        """Synchronous single-request generation (drives the scheduler)."""
+        req = self.submit(prompt, params)
+        while not req.finished:
+            self.step()
+        return req.output

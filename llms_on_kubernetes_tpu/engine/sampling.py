@@ -1,0 +1,83 @@
+"""On-device batched token sampling.
+
+One fused function handles the whole decode batch with PER-SLOT sampling
+parameters (temperature / top-k / top-p as [B] arrays), so heterogeneous
+requests share one compiled step — the continuous-batching analogue of what
+the reference's vLLM image did per sequence (SURVEY §2.3 row 1).
+
+TPU-first: everything stays on device inside the jitted decode step; only the
+sampled token ids ([B] int32) come back to the host each step. Greedy is
+expressed as temperature==0 via masking, not Python branching, so one
+executable covers all modes.
+
+Top-k/top-p both work on a single descending sort of the logits (O(V log V),
+fused by XLA); the categorical draw uses the Gumbel trick on the masked,
+renormalized logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38
+
+
+def sample(
+    logits: jnp.ndarray,       # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] float32; 0 => greedy
+    top_k: jnp.ndarray,        # [B] int32; 0 or >=V => disabled
+    top_p: jnp.ndarray,        # [B] float32; 1.0 => disabled
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens [B] int32, logprobs of the sampled tokens [B] f32)."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    # --- filtering in sorted space ------------------------------------
+    sort_idx = jnp.argsort(-logits, axis=-1)                 # [B, V] desc
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+
+    rank = jnp.arange(V, dtype=jnp.int32)[None, :]           # [1, V]
+    k = jnp.where(top_k <= 0, V, top_k)[:, None]             # [B, 1]
+    keep_k = rank < k
+
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprob = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens whose cumulative prob *before* them is < top_p (always
+    # keeps the argmax token)
+    keep_p = (cumprob - sorted_probs) < top_p[:, None]
+
+    keep = keep_k & keep_p
+    masked_sorted = jnp.where(keep, sorted_logits, NEG_INF)
+
+    # --- draw ----------------------------------------------------------
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
+    perturbed = masked_sorted / safe_temp + gumbel
+    sampled_rank = jnp.argmax(perturbed, axis=-1)            # [B]
+
+    greedy_rank = jnp.zeros((B,), sampled_rank.dtype)        # sorted => rank 0
+    chosen_rank = jnp.where(temperature <= 0.0, greedy_rank, sampled_rank)
+
+    tokens = jnp.take_along_axis(sort_idx, chosen_rank[:, None], axis=-1)[:, 0]
+    logprobs_all = jax.nn.log_softmax(logits, axis=-1)
+    logprobs = jnp.take_along_axis(logprobs_all, tokens[:, None], axis=-1)[:, 0]
+    return tokens.astype(jnp.int32), logprobs
+
+
+def make_sampling_arrays(requests, num_slots: int):
+    """Host helper: build [num_slots] parameter arrays from per-slot request
+    objects (None => defaults)."""
+    import numpy as np
+
+    temps = np.zeros((num_slots,), np.float32)
+    top_ks = np.zeros((num_slots,), np.int32)
+    top_ps = np.ones((num_slots,), np.float32)
+    for i, r in enumerate(requests):
+        if r is None:
+            continue
+        temps[i] = r.temperature
+        top_ks[i] = r.top_k
+        top_ps[i] = r.top_p
+    return temps, top_ks, top_ps

@@ -1,0 +1,93 @@
+"""Tokenizer abstraction: HF tokenizers in production, byte-level for tests.
+
+The reference delegated tokenization to the serving images (vLLM /
+llama-server); here it is part of the engine. ``load_tokenizer`` returns an
+object with the small protocol the engine/server need:
+
+    encode(text) -> list[int]
+    decode(ids)  -> str
+    apply_chat_template(messages) -> list[int]
+    eos_ids      -> set[int]
+
+``ByteTokenizer`` (256 bytes + BOS/EOS) keeps every test and the local
+CPU path hermetic — no Hub download, mirroring the ramalama solution's
+"weights are already on disk" stance (reference ramalama-models/values.yaml:26).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Protocol, Sequence
+
+
+class TokenizerLike(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def apply_chat_template(self, messages: list[dict]) -> list[int]: ...
+    @property
+    def eos_ids(self) -> set[int]: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0-255 are bytes, 256=BOS, 257=EOS."""
+
+    BOS = 256
+    EOS = 257
+    vocab_size = 258
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        text = "".join(
+            f"<{m.get('role', 'user')}>{m.get('content', '')}</{m.get('role', 'user')}>"
+            for m in messages
+        )
+        return [self.BOS] + self.encode(text)
+
+    @property
+    def eos_ids(self) -> set[int]:
+        return {self.EOS}
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer wrapper (local files only — zero egress)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        return self._tok.apply_chat_template(
+            messages, tokenize=True, add_generation_prompt=True
+        )
+
+    @property
+    def eos_ids(self) -> set[int]:
+        ids = set()
+        if self._tok.eos_token_id is not None:
+            ids.add(int(self._tok.eos_token_id))
+        # llama-3 style end-of-turn
+        for tok in ("<|eot_id|>", "<|im_end|>", "<end_of_turn>"):
+            tid = self._tok.convert_tokens_to_ids(tok)
+            if tid is not None and tid >= 0 and tid != getattr(self._tok, "unk_token_id", None):
+                ids.add(int(tid))
+        return ids
+
+
+def load_tokenizer(model_ref: Optional[str]) -> TokenizerLike:
+    if model_ref and os.path.isdir(model_ref):
+        for fname in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json"):
+            if os.path.exists(os.path.join(model_ref, fname)):
+                return HFTokenizer(model_ref)
+    return ByteTokenizer()

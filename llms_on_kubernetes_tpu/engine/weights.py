@@ -1,0 +1,196 @@
+"""Checkpoint loading: HuggingFace safetensors → layer-stacked sharded params.
+
+The reference stack's model pods pull weights from the HF Hub into a PVC
+cache and let vLLM map them (reference
+vllm-models/helm-chart/templates/model-deployments.yaml:26-47). Here the
+engine owns that mapping: safetensors shards on disk (same PVC layout,
+``/root/.cache/huggingface`` or an explicit dir) are read tensor-by-tensor,
+transposed into the decoder's [D, H, hd]-style layouts, stacked across
+layers, and ``device_put`` with their ``NamedSharding`` so each chip only
+materializes its own shard of the (possibly multi-host) mesh.
+
+Supported families: llama/tinyllama/mistral (same key schema), mixtral
+(block_sparse_moe), qwen2 (attention biases), qwen3 (q/k norms), phi3
+(fused qkv_proj / gate_up_proj), gemma2/gemma3 (4-norm layers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llms_on_kubernetes_tpu.configs import ModelConfig
+from llms_on_kubernetes_tpu.parallel.sharding import param_specs
+
+Params = dict[str, Any]
+
+
+def _open_safetensors(model_dir: str) -> dict[str, Callable[[], np.ndarray]]:
+    """Map tensor name -> lazy loader over all *.safetensors files in dir."""
+    import safetensors
+
+    loaders: dict[str, Callable[[], np.ndarray]] = {}
+    files = sorted(pathlib.Path(model_dir).glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {model_dir}")
+    for f in files:
+        handle = safetensors.safe_open(str(f), framework="numpy")
+        for name in handle.keys():
+            loaders[name] = (lambda h=handle, n=name: h.get_tensor(n))
+    return loaders
+
+
+def _bf16_to_np(x: np.ndarray) -> np.ndarray:
+    return x  # safetensors numpy framework yields ml_dtypes bfloat16 already
+
+
+class _Fetch:
+    """Reads HF tensors with layout transforms; records missing keys."""
+
+    def __init__(self, loaders):
+        self.loaders = loaders
+        self.missing: list[str] = []
+
+    def __call__(self, name: str) -> np.ndarray:
+        if name not in self.loaders:
+            self.missing.append(name)
+            raise KeyError(name)
+        return np.asarray(self.loaders[name]())
+
+    def linear(self, name: str, out_reshape=None) -> np.ndarray:
+        """HF linear weight [out, in] -> [in, out] (+ optional reshape)."""
+        w = self(name).T
+        if out_reshape is not None:
+            w = w.reshape(w.shape[0], *out_reshape)
+        return w
+
+
+def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int) -> Params:
+    """Return our per-layer param dict for HF layer ``i``."""
+    H, KV, hd, D, F = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size, cfg.intermediate_size
+    p = f"model.layers.{i}."
+    out: Params = {}
+
+    # --- attention ------------------------------------------------------
+    try:
+        out["wq"] = fetch.linear(p + "self_attn.q_proj.weight", (H, hd))
+        out["wk"] = fetch.linear(p + "self_attn.k_proj.weight", (KV, hd))
+        out["wv"] = fetch.linear(p + "self_attn.v_proj.weight", (KV, hd))
+    except KeyError:
+        # phi3 fused qkv: [(H + 2KV) * hd, D]
+        qkv = fetch(p + "self_attn.qkv_proj.weight")
+        q, k, v = np.split(qkv, [H * hd, (H + KV) * hd], axis=0)
+        out["wq"] = q.T.reshape(D, H, hd)
+        out["wk"] = k.T.reshape(D, KV, hd)
+        out["wv"] = v.T.reshape(D, KV, hd)
+    wo = fetch(p + "self_attn.o_proj.weight")  # [D, H*hd]
+    out["wo"] = wo.T.reshape(H, hd, D)
+
+    if cfg.attention_bias:
+        out["bq"] = fetch(p + "self_attn.q_proj.bias").reshape(H, hd)
+        out["bk"] = fetch(p + "self_attn.k_proj.bias").reshape(KV, hd)
+        out["bv"] = fetch(p + "self_attn.v_proj.bias").reshape(KV, hd)
+    if cfg.qk_norm:
+        out["q_norm"] = fetch(p + "self_attn.q_norm.weight")
+        out["k_norm"] = fetch(p + "self_attn.k_norm.weight")
+
+    # --- norms ----------------------------------------------------------
+    out["attn_norm"] = fetch(p + "input_layernorm.weight")
+    if cfg.post_norms:  # gemma2/3: 4 norms per layer
+        out["attn_post_norm"] = fetch(p + "post_attention_layernorm.weight")
+        out["mlp_norm"] = fetch(p + "pre_feedforward_layernorm.weight")
+        out["mlp_post_norm"] = fetch(p + "post_feedforward_layernorm.weight")
+    else:
+        out["mlp_norm"] = fetch(p + "post_attention_layernorm.weight")
+
+    # --- mlp ------------------------------------------------------------
+    if cfg.is_moe:
+        E = cfg.num_experts
+        out["router"] = fetch(p + "block_sparse_moe.gate.weight").T  # [D, E]
+        gates, ups, downs = [], [], []
+        for e in range(E):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            gates.append(fetch(ep + "w1.weight").T)   # [D, F]
+            ups.append(fetch(ep + "w3.weight").T)     # [D, F]
+            downs.append(fetch(ep + "w2.weight").T)   # [F, D]
+        out["w_gate"] = np.stack(gates)
+        out["w_up"] = np.stack(ups)
+        out["w_down"] = np.stack(downs)
+    else:
+        try:
+            out["w_gate"] = fetch.linear(p + "mlp.gate_proj.weight")
+            out["w_up"] = fetch.linear(p + "mlp.up_proj.weight")
+        except KeyError:
+            gu = fetch(p + "mlp.gate_up_proj.weight")  # phi3 fused [2F, D]
+            g, u = np.split(gu, 2, axis=0)
+            out["w_gate"] = g.T
+            out["w_up"] = u.T
+        out["w_down"] = fetch.linear(p + "mlp.down_proj.weight")
+    return out
+
+
+def load_hf_params(
+    cfg: ModelConfig,
+    model_dir: str,
+    mesh=None,
+    dtype: Optional[str] = None,
+) -> Params:
+    """Load a HF checkpoint directory into (optionally mesh-sharded) params."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    loaders = _open_safetensors(model_dir)
+    fetch = _Fetch(loaders)
+
+    per_layer: list[Params] = [hf_layer_maps(cfg, fetch, i) for i in range(cfg.num_layers)]
+    layers = {
+        k: np.stack([pl[k] for pl in per_layer]).astype(dt)
+        for k in per_layer[0]
+    }
+    params: Params = {
+        "embed": np.asarray(fetch("model.embed_tokens.weight")).astype(dt),
+        "final_norm": np.asarray(fetch("model.norm.weight")).astype(dt),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = fetch.linear("lm_head.weight").astype(dt)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        specs = param_specs(cfg, mesh)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), NamedSharding(mesh, s)),
+            params, specs,
+        )
+    else:
+        params = jax.tree.map(jnp.asarray, params)
+    return params
+
+
+def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
+    """Resolve a local dir or a HF-cache snapshot path for ``model_ref``.
+
+    Mirrors the reference's PVC cache convention: weights live under
+    ``/root/.cache/huggingface`` (reference model-deployments.yaml:45-47).
+    Zero-egress environments must pre-populate the cache (the reference's
+    first-boot Hub download happens out-of-band here).
+    """
+    if os.path.isdir(model_ref):
+        return model_ref
+    cache = cache_dir or os.path.expanduser(
+        os.environ.get("HF_HOME", "~/.cache/huggingface")
+    )
+    repo_dir = pathlib.Path(cache) / "hub" / ("models--" + model_ref.replace("/", "--"))
+    snaps = sorted((repo_dir / "snapshots").glob("*")) if repo_dir.exists() else []
+    for snap in snaps:
+        if list(snap.glob("*.safetensors")):
+            return str(snap)
+    raise FileNotFoundError(
+        f"no local checkpoint for {model_ref!r}; expected a directory or a "
+        f"HF cache snapshot under {repo_dir}"
+    )

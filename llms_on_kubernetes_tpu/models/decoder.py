@@ -126,6 +126,7 @@ def _mlp(lp: Params, cfg: ModelConfig, h: jnp.ndarray, token_valid: jnp.ndarray)
             h.reshape(B * T, D),
             lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             top_k=cfg.num_experts_per_tok, act=act,
+            capacity_factor=cfg.moe_capacity_factor,
             valid=token_valid.reshape(B * T),
         )
         return out.reshape(B, T, D)

@@ -17,6 +17,9 @@ engine as an image; SURVEY §2.3 row 1).
 Capacity: C = ceil(N * top_k / E * capacity_factor). Tokens overflowing an
 expert's capacity are dropped for that expert (their combine weight is 0);
 with capacity_factor >= E / top_k no token can ever be dropped (C >= N).
+``capacity_factor=None`` (the inference default) means exactly that dropless
+setting — serving must not make a token's logits depend on which other
+requests share its batch.
 """
 
 from __future__ import annotations
@@ -33,7 +36,7 @@ def moe_block(
     w_down: jnp.ndarray,
     *,
     top_k: int,
-    capacity_factor: float = 2.0,
+    capacity_factor: "float | None" = None,
     act=jax.nn.silu,
     valid: "jnp.ndarray | None" = None,
 ) -> jnp.ndarray:
@@ -45,8 +48,10 @@ def moe_block(
     """
     N, D = x.shape
     E = router_w.shape[1]
-    C = max(1, int(-(-N * top_k * capacity_factor // E)))
-    C = min(C, N)
+    if capacity_factor is None:
+        C = N  # dropless
+    else:
+        C = min(N, max(1, int(-(-N * top_k * capacity_factor // E))))
 
     router_logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [N, E]
     probs = jax.nn.softmax(router_logits, axis=-1)

@@ -23,9 +23,19 @@ def rope_frequencies(
     theta: float,
     rope_scaling: Optional[dict] = None,
 ) -> np.ndarray:
-    """Inverse frequencies [head_dim // 2], float32, with optional llama3 scaling."""
+    """Inverse frequencies [head_dim // 2], float32.
+
+    Supported ``rope_scaling`` schemes: llama3 (Llama-3.1+) and linear
+    (e.g. Gemma-3 global layers). Anything else raises — silently dropping
+    a scaling scheme would serve wrong positions (see configs.from_hf_config).
+    """
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
     if rope_scaling:
+        kind = rope_scaling.get("rope_type", rope_scaling.get("type", "llama3"))
+        if kind == "linear":
+            return (inv_freq / float(rope_scaling.get("factor", 1.0))).astype(np.float32)
+        if kind != "llama3":
+            raise NotImplementedError(f"unsupported rope_scaling type {kind!r}")
         factor = float(rope_scaling.get("factor", 8.0))
         low = float(rope_scaling.get("low_freq_factor", 1.0))
         high = float(rope_scaling.get("high_freq_factor", 4.0))

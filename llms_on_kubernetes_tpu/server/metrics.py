@@ -1,0 +1,136 @@
+"""Minimal Prometheus-text metrics registry.
+
+The reference had NO metrics story: vLLM's /metrics existed in-image but
+nothing scraped it, and the Python gateway actively suppressed logs
+(reference ramalama-models/helm-chart/templates/api-gateway.yaml:106-108;
+SURVEY §5 "Metrics"). This closes that gap with a dependency-free registry
+exposing the serving numbers that matter on TPU: TTFT, tokens/s, batch
+occupancy, KV-page usage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name, self.help = name, help_
+        self.value = 0.0
+        registry._add(self)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name, self.help = name, help_
+        self.value = 0.0
+        registry._add(self)
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: tuple[float, ...], registry: "Registry"):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        registry._add(self)
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate percentile from bucket upper bounds (for bench/tests)."""
+        if self.n == 0:
+            return None
+        target = q * self.n
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += self.counts[i]
+            if acc >= target:
+                return b
+        return float("inf")
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += self.counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        acc += self.counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
+        out.append(f"{self.name}_sum {self.total}")
+        out.append(f"{self.name}_count {self.n}")
+        return "\n".join(out) + "\n"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def _add(self, m) -> None:
+        with self._lock:
+            self._metrics.append(m)
+
+    def render(self) -> str:
+        with self._lock:
+            return "".join(m.render() for m in self._metrics)
+
+
+def engine_metrics(registry: Registry) -> dict:
+    """The standard serving metric set (SURVEY §5 gap list)."""
+    return {
+        "requests_total": Counter(
+            "llm_requests_total", "Requests received", registry),
+        "requests_finished": Counter(
+            "llm_requests_finished_total", "Requests finished", registry),
+        "tokens_generated": Counter(
+            "llm_tokens_generated_total", "Output tokens sampled", registry),
+        "prompt_tokens": Counter(
+            "llm_prompt_tokens_total", "Prompt tokens prefilled", registry),
+        "preemptions": Counter(
+            "llm_preemptions_total", "Requests preempted for KV memory", registry),
+        "ttft": Histogram(
+            "llm_ttft_seconds", "Time to first token",
+            (0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0), registry),
+        "decode_step": Histogram(
+            "llm_decode_step_seconds", "Per-decode-step latency",
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5), registry),
+        "batch_occupancy": Gauge(
+            "llm_decode_batch_occupancy", "Active decode slots", registry),
+        "kv_pages_used": Gauge(
+            "llm_kv_pages_used", "KV pages allocated", registry),
+        "waiting": Gauge(
+            "llm_waiting_requests", "Requests queued for admission", registry),
+    }

@@ -1,0 +1,322 @@
+"""OpenAI-compatible HTTP server over the engine.
+
+The per-model serving surface the reference got from the vLLM image
+(`vllm serve ... --port 8080`, reference
+vllm-models/helm-chart/templates/model-deployments.yaml:26-39) and from
+`llama-server` (reference ramalama model-deployments.yaml:26-35):
+
+    GET  /health               -> 200 "OK"          (probe target, :48-63)
+    GET  /v1/models            -> model list
+    POST /v1/chat/completions  -> chat completion (+ SSE streaming)
+    POST /v1/completions       -> text completion (+ SSE streaming)
+    GET  /metrics              -> Prometheus text (gap fixed vs reference)
+
+SSE streaming is end-to-end: engine events flow through an asyncio bridge
+into chunked responses — by design, since the reference's Python gateway
+demonstrably buffered whole upstream responses and broke streaming
+(reference api-gateway.yaml:99; SURVEY §3.1).
+
+The engine runs on a dedicated thread (JAX dispatch is blocking); the
+aiohttp event loop never blocks on device work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, Request, SamplingParams
+from llms_on_kubernetes_tpu.engine.tokenizer import TokenizerLike
+from llms_on_kubernetes_tpu.server.metrics import Registry, engine_metrics
+
+
+class EngineLoop(threading.Thread):
+    """Drives Engine.step() whenever there is work; sleeps otherwise."""
+
+    def __init__(self, engine: Engine, metrics: Optional[dict] = None):
+        super().__init__(daemon=True, name="engine-loop")
+        self.engine = engine
+        self.metrics = metrics
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._ttft_seen: set[str] = set()
+
+    def submit(self, *args, **kw) -> Request:
+        req = self.engine.submit(*args, **kw)
+        if self.metrics:
+            self.metrics["requests_total"].inc()
+            self.metrics["prompt_tokens"].inc(len(req.prompt))
+        self._wake.set()
+        return req
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def run(self) -> None:
+        eng = self.engine
+        while not self._stop.is_set():
+            if not eng.has_work():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            t0 = time.monotonic()
+            events = eng.step()
+            dt = time.monotonic() - t0
+            if self.metrics:
+                m = self.metrics
+                m["decode_step"].observe(dt)
+                m["batch_occupancy"].set(sum(r is not None for r in eng.slots))
+                m["kv_pages_used"].set(
+                    eng.config.num_pages - 1 - eng.allocator.num_free_pages)
+                m["waiting"].set(len(eng.waiting))
+                for ev in events:
+                    m["tokens_generated"].inc(len(ev.new_tokens))
+                    if ev.finished:
+                        m["requests_finished"].inc()
+                    r = ev.request
+                    if r.first_token_at and r.id not in self._ttft_seen:
+                        self._ttft_seen.add(r.id)
+                        m["ttft"].observe(r.first_token_at - r.submitted_at)
+                    if ev.finished:
+                        self._ttft_seen.discard(r.id)
+
+
+async def _next_event(req: Request) -> tuple[list[int], bool, Optional[str]]:
+    """Await the engine thread's next event for this request."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, req.events.get)
+
+
+class IncrementalDetokenizer:
+    """Emit text deltas from a growing token list, holding back bytes that
+    may still change (partial UTF-8 / merged tokens)."""
+
+    def __init__(self, tokenizer: TokenizerLike):
+        self.tok = tokenizer
+        self.ids: list[int] = []
+        self.sent = 0
+
+    def push(self, new_ids: list[int], final: bool = False) -> str:
+        self.ids += new_ids
+        text = self.tok.decode(self.ids)
+        if not final and text and text[-1] == "�":
+            # trailing replacement char: likely mid-UTF-8 sequence; hold back
+            text = text[:-1]
+        delta = text[self.sent:]
+        if final:
+            delta = self.tok.decode(self.ids)[self.sent:]
+        self.sent += len(delta)
+        return delta
+
+
+class OpenAIServer:
+    def __init__(
+        self,
+        engine: Engine,
+        tokenizer: TokenizerLike,
+        model_name: str,
+        registry: Optional[Registry] = None,
+    ):
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.registry = registry or Registry()
+        self.metrics = engine_metrics(self.registry)
+        self.loop_thread = EngineLoop(engine, self.metrics)
+        self.engine = engine
+
+    # ------------------------------------------------------------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/metrics", self.prometheus)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.on_startup.append(self._start_loop)
+        app.on_cleanup.append(self._stop_loop)
+        return app
+
+    async def _start_loop(self, app) -> None:
+        if not self.loop_thread.is_alive():
+            self.loop_thread.start()
+
+    async def _stop_loop(self, app) -> None:
+        self.loop_thread.stop()
+
+    # ------------------------------------------------------------------
+    # endpoints
+    # ------------------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="OK")
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": self.model_name,
+                "object": "model",
+                "created": int(time.time()),
+                "owned_by": "llms-on-kubernetes-tpu",
+            }],
+        })
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.registry.render(),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    def _sampling_from_body(self, body: dict) -> SamplingParams:
+        max_tokens = body.get("max_tokens") or body.get("max_completion_tokens") or 256
+        eos = tuple(self.tokenizer.eos_ids)
+        return SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            max_tokens=int(max_tokens),
+            stop_token_ids=eos,
+        )
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response(
+                {"error": {"message": "messages must be a non-empty list"}}, status=400)
+        try:
+            prompt_ids = self.tokenizer.apply_chat_template(messages)
+        except Exception as e:  # bad roles/content shape
+            return web.json_response({"error": {"message": f"bad messages: {e}"}}, status=400)
+        return await self._serve(request, body, prompt_ids, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        prompt_ids = self.tokenizer.encode(prompt)
+        if not prompt_ids:
+            return web.json_response({"error": {"message": "empty prompt"}}, status=400)
+        return await self._serve(request, body, prompt_ids, chat=False)
+
+    # ------------------------------------------------------------------
+
+    async def _serve(self, request, body, prompt_ids, *, chat: bool) -> web.StreamResponse:
+        params = self._sampling_from_body(body)
+        try:
+            req = self.loop_thread.submit(prompt_ids, params)
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(time.time())
+        if body.get("stream"):
+            return await self._stream_response(request, req, rid, created, chat)
+        return await self._full_response(req, rid, created, chat, prompt_ids)
+
+    async def _full_response(self, req, rid, created, chat, prompt_ids) -> web.Response:
+        finish_reason = None
+        while True:
+            _toks, done, reason = await _next_event(req)
+            if done:
+                finish_reason = reason
+                break
+        # exclude trailing stop token from the visible text (OpenAI behavior)
+        out_ids = req.output
+        if finish_reason == "stop" and out_ids and out_ids[-1] in set(req.params.stop_token_ids):
+            out_ids = out_ids[:-1]
+        text = self.tokenizer.decode(out_ids)
+        usage = {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(req.output),
+            "total_tokens": len(prompt_ids) + len(req.output),
+        }
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            obj = "text_completion"
+        return web.json_response({
+            "id": rid, "object": obj, "created": created,
+            "model": self.model_name, "choices": [choice], "usage": usage,
+        })
+
+    async def _stream_response(self, request, req, rid, created, chat) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            },
+        )
+        await resp.prepare(request)
+        detok = IncrementalDetokenizer(self.tokenizer)
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def chunk(delta_text: Optional[str], reason: Optional[str]) -> bytes:
+            if chat:
+                delta = {}
+                if delta_text is not None:
+                    delta = {"content": delta_text}
+                choice = {"index": 0, "delta": delta, "finish_reason": reason}
+            else:
+                choice = {"index": 0, "text": delta_text or "", "finish_reason": reason}
+            payload = {
+                "id": rid, "object": obj, "created": created,
+                "model": self.model_name, "choices": [choice],
+            }
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        if chat:
+            first = {"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}
+            await resp.write(
+                f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': self.model_name, 'choices': [first]})}\n\n".encode()
+            )
+        stop_ids = set(req.params.stop_token_ids)
+        try:
+            while True:
+                toks, done, reason = await _next_event(req)
+                visible = [t for t in toks if not (done and reason == "stop" and t in stop_ids)]
+                text = detok.push(visible, final=done)
+                if text:
+                    await resp.write(chunk(text, None))
+                if done:
+                    await resp.write(chunk(None, reason))
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away; engine finishes the request on its own
+        await resp.write_eof()
+        return resp
+
+
+def run_server(
+    engine: Engine,
+    tokenizer: TokenizerLike,
+    model_name: str,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+) -> None:
+    server = OpenAIServer(engine, tokenizer, model_name)
+    web.run_app(server.make_app(), host=host, port=port, print=None)

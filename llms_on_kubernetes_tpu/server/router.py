@@ -1,0 +1,171 @@
+"""Payload-inspecting multi-model API gateway (router).
+
+Reproduces the routing semantics of the reference's OpenResty/Lua gateway
+(reference vllm-models/helm-chart/templates/model-gateway.yaml:29-86,
+SURVEY §3.1) with its defects fixed:
+
+- ``GET /v1/models`` is answered AT THE GATEWAY, synthesizing the model list
+  from config — no backend is consulted (model-gateway.yaml:29-49).
+- ``POST`` bodies are JSON-decoded; ``body["model"]`` is EXACT-matched
+  against the configured model names; no/unknown model falls back to the
+  default backend (model-gateway.yaml:51-75). Unlike the reference's silent
+  fallback, ``strict=True`` turns unknown models into a 404 with an
+  OpenAI-style error (SURVEY §7 router item: "404-or-default as a config
+  choice").
+- ``GET /health`` -> 200 "OK" (model-gateway.yaml:84-86).
+- Everything else is proxied to the selected backend **streaming**, chunk
+  by chunk — the reference's Python gateway buffered entire responses and
+  broke SSE (api-gateway.yaml:99); this one never buffers.
+- 502 with a JSON error on upstream failure (api-gateway.yaml:100-104).
+
+A native C++ implementation with identical semantics lives in
+native/router/ for the OpenResty-equivalent deployment; this Python one is
+the local-path/default router and the executable spec both are tested
+against.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+HOP_BY_HOP = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host",
+    "content-length",
+}
+
+
+class Router:
+    def __init__(
+        self,
+        backends: dict[str, str],
+        default_model: Optional[str] = None,
+        strict: bool = False,
+        upstream_timeout: float = 300.0,
+    ):
+        """backends: model name -> base URL (e.g. http://svc:8080)."""
+        if not backends:
+            raise ValueError("router needs at least one backend")
+        self.backends = dict(backends)
+        self.default_model = default_model or next(iter(backends))
+        if self.default_model not in backends:
+            raise ValueError(f"default model {self.default_model!r} not in backends")
+        self.strict = strict
+        self.timeout = aiohttp.ClientTimeout(total=upstream_timeout)
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_route("*", "/{path:.*}", self.proxy)
+        app.on_startup.append(self._startup)
+        app.on_cleanup.append(self._cleanup)
+        return app
+
+    async def _startup(self, app) -> None:
+        self._session = aiohttp.ClientSession(timeout=self.timeout)
+
+    async def _cleanup(self, app) -> None:
+        if self._session:
+            await self._session.close()
+
+    # ------------------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="OK")
+
+    async def models(self, request: web.Request) -> web.Response:
+        """Synthesized exactly like the reference gateway (no backend hop)."""
+        now = int(time.time())
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": name, "object": "model", "created": now,
+                 "owned_by": "llms-on-kubernetes-tpu"}
+                for name in self.backends
+            ],
+        })
+
+    def select_backend(self, body: bytes) -> tuple[str, Optional[str]]:
+        """Exact-match routing on the JSON `model` field.
+
+        Returns (model_name, error); error is set only in strict mode.
+        """
+        model = None
+        if body:
+            try:
+                data = json.loads(body)
+                if isinstance(data, dict):
+                    model = data.get("model")
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                model = None
+        if isinstance(model, str) and model in self.backends:
+            return model, None
+        if self.strict and model is not None:
+            return self.default_model, f"model {model!r} not found"
+        return self.default_model, None
+
+    # ------------------------------------------------------------------
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        body = await request.read()
+        model, err = self.select_backend(body)
+        if err:
+            return web.json_response(
+                {"error": {"message": err, "type": "invalid_request_error",
+                           "code": "model_not_found"}},
+                status=404,
+            )
+        base = self.backends[model].rstrip("/")
+        url = f"{base}/{request.match_info['path']}"
+        if request.query_string:
+            url += f"?{request.query_string}"
+
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in HOP_BY_HOP
+        }
+        peername = request.transport.get_extra_info("peername") if request.transport else None
+        client_ip = peername[0] if peername else ""
+        headers["X-Real-IP"] = client_ip
+        prior = request.headers.get("X-Forwarded-For")
+        headers["X-Forwarded-For"] = f"{prior}, {client_ip}" if prior else client_ip
+        headers["X-Forwarded-Proto"] = request.scheme
+
+        try:
+            async with self._session.request(
+                request.method, url, data=body or None, headers=headers,
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_BY_HOP:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                # never buffer: relay chunks as they arrive (SSE-safe)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, TimeoutError, OSError) as e:
+            return web.json_response(
+                {"error": {"message": f"upstream error: {e}",
+                           "type": "bad_gateway"}},
+                status=502,
+            )
+
+
+def run_router(
+    backends: dict[str, str],
+    default_model: Optional[str] = None,
+    strict: bool = False,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+) -> None:
+    router = Router(backends, default_model, strict)
+    web.run_app(router.make_app(), host=host, port=port, print=None)

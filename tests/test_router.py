@@ -1,0 +1,150 @@
+"""Router semantics tests against fake backends.
+
+Pinned to the reference gateway's behavior (SURVEY §3.1): exact model-name
+match, silent default fallback, gateway-synthesized /v1/models, /health,
+502 on upstream failure — plus the fixes: strict-404 mode and streaming
+passthrough (the reference's Python gateway buffered; api-gateway.yaml:99).
+"""
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.server.router import Router
+
+
+def make_backend(name: str) -> web.Application:
+    async def completions(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        return web.json_response({
+            "served_by": name,
+            "model": body.get("model"),
+            "x_real_ip": request.headers.get("X-Real-IP", ""),
+            "x_fwd": request.headers.get("X-Forwarded-For", ""),
+        })
+
+    async def stream(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for i in range(3):
+            await resp.write(f"data: {name}-{i}\n\n".encode())
+            await asyncio.sleep(0.01)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", completions)
+    app.router.add_post("/v1/stream", stream)
+    return app
+
+
+def run_with_router(fn, strict=False):
+    async def go():
+        b1 = TestClient(TestServer(make_backend("modelA")))
+        b2 = TestClient(TestServer(make_backend("modelB")))
+        await b1.start_server()
+        await b2.start_server()
+        router = Router(
+            {
+                "modelA": str(b1.make_url("")),
+                "modelB": str(b2.make_url("")),
+            },
+            strict=strict,
+        )
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+            await b1.close()
+            await b2.close()
+    asyncio.run(go())
+
+
+def test_exact_match_routes_to_named_backend():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "modelB"})
+        assert (await r.json())["served_by"] == "modelB"
+        r = await client.post("/v1/chat/completions", json={"model": "modelA"})
+        assert (await r.json())["served_by"] == "modelA"
+    run_with_router(body)
+
+
+def test_unknown_or_missing_model_falls_back_to_default():
+    async def body(client):
+        # reference semantics: silent fallback to first model (SURVEY §3.1)
+        r = await client.post("/v1/chat/completions", json={"model": "nope"})
+        assert (await r.json())["served_by"] == "modelA"
+        r = await client.post("/v1/chat/completions", json={})
+        assert (await r.json())["served_by"] == "modelA"
+        r = await client.post("/v1/chat/completions", data=b"not json",
+                              headers={"Content-Type": "application/json"})
+        assert (await r.json())["served_by"] == "modelA"
+    run_with_router(body)
+
+
+def test_strict_mode_404s_unknown_model():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "nope"})
+        assert r.status == 404
+        err = await r.json()
+        assert err["error"]["code"] == "model_not_found"
+        # absent model still falls back even in strict mode
+        r = await client.post("/v1/chat/completions", json={})
+        assert (await r.json())["served_by"] == "modelA"
+    run_with_router(body, strict=True)
+
+
+def test_models_synthesized_at_gateway():
+    async def body(client):
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert [m["id"] for m in data["data"]] == ["modelA", "modelB"]
+    run_with_router(body)
+
+
+def test_health():
+    async def body(client):
+        r = await client.get("/health")
+        assert r.status == 200 and await r.text() == "OK"
+    run_with_router(body)
+
+
+def test_forwarded_headers():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "modelA"})
+        data = await r.json()
+        assert data["x_real_ip"] != ""
+        assert data["x_fwd"] != ""
+    run_with_router(body)
+
+
+def test_streaming_passthrough():
+    async def body(client):
+        r = await client.post("/v1/stream", json={"model": "modelB"})
+        assert r.status == 200
+        text = await r.text()
+        assert "data: modelB-0" in text and "data: [DONE]" in text
+    run_with_router(body)
+
+
+def test_upstream_down_returns_502():
+    async def go():
+        router = Router({"m": "http://127.0.0.1:1"})  # nothing listening
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={"model": "m"})
+            assert r.status == 502
+            err = await r.json()
+            assert err["error"]["type"] == "bad_gateway"
+        finally:
+            await client.close()
+    asyncio.run(go())

@@ -1,0 +1,82 @@
+"""Sampling op semantics: greedy, top-k, top-p, per-slot parameter mixing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llms_on_kubernetes_tpu.engine.sampling import sample
+
+
+def _logits(rows):
+    return jnp.asarray(np.array(rows, np.float32))
+
+
+def test_temperature_zero_is_greedy():
+    logits = _logits([[0.1, 3.0, -1.0, 2.9], [5.0, 0.0, 0.0, 0.0]])
+    toks, lps = sample(
+        logits, jax.random.key(0),
+        temperature=jnp.asarray([0.0, 0.0]),
+        top_k=jnp.asarray([0, 0], jnp.int32),
+        top_p=jnp.asarray([1.0, 1.0]),
+    )
+    assert toks.tolist() == [1, 0]
+    np.testing.assert_allclose(
+        np.asarray(lps),
+        np.asarray(jax.nn.log_softmax(logits)[jnp.arange(2), toks]),
+        rtol=1e-5,
+    )
+
+
+def test_top_k_one_is_greedy_even_with_temperature():
+    logits = _logits([[0.1, 3.0, -1.0, 2.9]])
+    for seed in range(5):
+        toks, _ = sample(
+            logits, jax.random.key(seed),
+            temperature=jnp.asarray([5.0]),
+            top_k=jnp.asarray([1], jnp.int32),
+            top_p=jnp.asarray([1.0]),
+        )
+        assert toks.tolist() == [1]
+
+
+def test_tiny_top_p_keeps_only_argmax():
+    logits = _logits([[0.0, 4.0, 3.9, 0.0]])
+    for seed in range(5):
+        toks, _ = sample(
+            logits, jax.random.key(seed),
+            temperature=jnp.asarray([2.0]),
+            top_k=jnp.asarray([0], jnp.int32),
+            top_p=jnp.asarray([1e-6]),
+        )
+        assert toks.tolist() == [1]
+
+
+def test_top_k_restricts_support():
+    logits = _logits([[10.0, 9.0, -50.0, -50.0]])
+    seen = set()
+    for seed in range(30):
+        toks, _ = sample(
+            logits, jax.random.key(seed),
+            temperature=jnp.asarray([3.0]),
+            top_k=jnp.asarray([2], jnp.int32),
+            top_p=jnp.asarray([1.0]),
+        )
+        seen.add(int(toks[0]))
+    assert seen <= {0, 1}
+    assert len(seen) == 2  # with temp 3 both top-2 should appear over 30 draws
+
+
+def test_per_slot_params_are_independent():
+    # slot 0 greedy, slot 1 heavily random over a flat distribution
+    logits = jnp.tile(_logits([[1.0, 1.01, 1.0, 1.0]]), (2, 1))
+    seen1 = set()
+    for seed in range(20):
+        toks, _ = sample(
+            logits, jax.random.key(seed),
+            temperature=jnp.asarray([0.0, 10.0]),
+            top_k=jnp.asarray([0, 0], jnp.int32),
+            top_p=jnp.asarray([1.0, 1.0]),
+        )
+        assert int(toks[0]) == 1  # greedy slot stays pinned
+        seen1.add(int(toks[1]))
+    assert len(seen1) > 1  # random slot explores

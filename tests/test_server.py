@@ -1,0 +1,152 @@
+"""OpenAI server tests: endpoints, streaming SSE, error handling, metrics.
+
+Driven through real HTTP (aiohttp TestClient) against a debug-tiny engine
+with the byte tokenizer — the reference's black-box curl runbook
+(reference vllm-models/README.md:219-251) turned into automated tests.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+from llms_on_kubernetes_tpu.server.openai_api import IncrementalDetokenizer, OpenAIServer
+
+
+def make_server():
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=4, num_pages=256, pages_per_slot=32,
+        prefill_buckets=(32, 64),
+    ))
+    return OpenAIServer(eng, ByteTokenizer(), "debug-tiny")
+
+
+def with_client(fn):
+    async def go():
+        server = make_server()
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_health_and_models():
+    async def body(client):
+        r = await client.get("/health")
+        assert r.status == 200 and (await r.text()) == "OK"
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert data["object"] == "list"
+        assert data["data"][0]["id"] == "debug-tiny"
+    with_client(body)
+
+
+def test_chat_completion_non_streaming():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 8, "temperature": 0,
+        })
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "chat.completion"
+        assert data["choices"][0]["message"]["role"] == "assistant"
+        assert data["choices"][0]["finish_reason"] in ("length", "stop")
+        assert data["usage"]["completion_tokens"] <= 8
+    with_client(body)
+
+
+def test_completions_endpoint():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc", "max_tokens": 4,
+            "temperature": 0,
+        })
+        data = await r.json()
+        assert r.status == 200
+        assert data["object"] == "text_completion"
+        assert isinstance(data["choices"][0]["text"], str)
+    with_client(body)
+
+
+def test_streaming_sse_chunks():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6, "temperature": 0, "stream": True,
+        })
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = await r.text()
+        events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        assert parsed[0]["choices"][0]["delta"].get("role") == "assistant"
+        finals = [p for p in parsed if p["choices"][0]["finish_reason"]]
+        assert len(finals) == 1
+        assert parsed[0]["object"] == "chat.completion.chunk"
+    with_client(body)
+
+
+def test_streaming_matches_non_streaming_greedy():
+    async def body(client):
+        payload = {
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "xyz"}],
+            "max_tokens": 8, "temperature": 0,
+        }
+        r1 = await client.post("/v1/chat/completions", json=payload)
+        full = (await r1.json())["choices"][0]["message"]["content"]
+        r2 = await client.post("/v1/chat/completions", json={**payload, "stream": True})
+        raw = await r2.text()
+        events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")][:-1]
+        text = "".join(
+            json.loads(e)["choices"][0]["delta"].get("content", "") for e in events
+        )
+        assert text == full
+    with_client(body)
+
+
+def test_error_handling():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", data=b"{not json")
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={"messages": []})
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={"prompt": ""})
+        assert r.status == 400
+        # prompt longer than the largest bucket
+        r = await client.post("/v1/completions", json={"prompt": "x" * 500})
+        assert r.status == 400
+    with_client(body)
+
+
+def test_metrics_endpoint_counts():
+    async def body(client):
+        await client.post("/v1/completions", json={
+            "prompt": "abc", "max_tokens": 3, "temperature": 0})
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "llm_requests_total 1.0" in text
+        assert "llm_tokens_generated_total 3.0" in text
+        assert "llm_ttft_seconds_count 1" in text
+    with_client(body)
+
+
+def test_incremental_detokenizer_holds_partial_utf8():
+    tok = ByteTokenizer()
+    d = IncrementalDetokenizer(tok)
+    snowman = "☃".encode()  # 3 bytes
+    assert d.push([snowman[0]]) == ""
+    assert d.push([snowman[1]]) == ""
+    assert d.push([snowman[2]]) == "☃"
+    assert d.push(list("ok".encode()), final=True) == "ok"
