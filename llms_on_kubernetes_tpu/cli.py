@@ -34,6 +34,8 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--tensor-parallel-size", "--tp", type=int, default=0,
                    help="0 = all local devices on the mesh 'model' axis")
     p.add_argument("--expert-parallel-size", "--ep", type=int, default=1)
+    p.add_argument("--quantization", choices=["int8"], default=None,
+                   help="weight-only int8 (FP8/AWQ-checkpoint parity path)")
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -98,8 +100,14 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"cannot resolve model {args.model!r}")
 
     n_dev = len(jax.devices())
-    tp = args.tensor_parallel_size or n_dev // max(1, args.expert_parallel_size)
-    mesh = make_mesh(data=1, expert=args.expert_parallel_size, model=tp)
+    ep = args.expert_parallel_size
+    if ep < 1 or n_dev % ep != 0:
+        parser.error(f"--expert-parallel-size {ep} must divide the local "
+                     f"device count ({n_dev})")
+    tp = args.tensor_parallel_size or n_dev // ep
+    if tp < 1 or ep * tp > n_dev:
+        parser.error(f"--tp {tp} x --ep {ep} exceeds the {n_dev} local devices")
+    mesh = make_mesh(data=1, expert=ep, model=tp)
 
     engine_cfg = EngineConfig(
         model=model_cfg.name,
@@ -109,6 +117,7 @@ def main(argv: list[str] | None = None) -> int:
         page_size=args.page_size,
         pages_per_slot=args.pages_per_slot,
         prefill_buckets=tuple(int(x) for x in args.prefill_buckets.split(",")),
+        quantization=args.quantization,
     )
     engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
                     model_dir=None if args.random_weights else model_dir)

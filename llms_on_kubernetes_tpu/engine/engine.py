@@ -63,6 +63,7 @@ class EngineConfig:
     num_pages: int = 512
     pages_per_slot: int = 32
     prefill_buckets: tuple[int, ...] = (64, 256, 1024)
+    quantization: Optional[str] = None  # None | "int8" (weight-only)
     seed: int = 0
 
     @property
@@ -82,6 +83,7 @@ class Request:
     pending_token: int = -1        # sampled but KV not yet cached
     finished: bool = False
     finish_reason: Optional[str] = None
+    abort_reason: Optional[str] = None  # set by any thread; reaped by step()
     first_token_at: Optional[float] = None
     events: "queue.SimpleQueue[tuple[list[int], bool, Optional[str]]]" = dataclasses.field(
         default_factory=queue.SimpleQueue
@@ -126,6 +128,11 @@ class Engine:
         model_dir: Optional[str] = None,
     ):
         self.config = engine_config
+        if engine_config.quantization not in (None, "int8"):
+            raise ValueError(
+                f"unknown quantization {engine_config.quantization!r} "
+                f"(supported: int8)"
+            )
         self.model_config = model_config or get_config(engine_config.model)
         cfg = self.model_config
         self.mesh = mesh
@@ -134,10 +141,16 @@ class Engine:
             self.params = params
         elif model_dir is not None:
             from llms_on_kubernetes_tpu.engine.weights import load_hf_params
-            self.params = load_hf_params(cfg, model_dir, mesh=mesh, dtype=engine_config.dtype)
+            self.params = load_hf_params(
+                cfg, model_dir, mesh=mesh, dtype=engine_config.dtype,
+                quantization=engine_config.quantization,
+            )
         else:  # random weights (tests / benchmarks)
             self.params = init_params(cfg, jax.random.key(engine_config.seed),
                                       dtype=engine_config.dtype)
+            if engine_config.quantization == "int8":
+                from llms_on_kubernetes_tpu.ops.quant import quantize_params
+                self.params = quantize_params(self.params)
             if mesh is not None:
                 from llms_on_kubernetes_tpu.parallel.sharding import shard_params
                 self.params = shard_params(self.params, cfg, mesh)
@@ -171,6 +184,7 @@ class Engine:
         self._step_counter = itertools.count()
         self._id_counter = itertools.count()
         self._lock = threading.Lock()
+        self.preemptions = 0  # total KV-pressure preemptions (metrics)
 
         self._prefill = jax.jit(
             _prefill_step, static_argnums=(1,), donate_argnums=(4, 5)
@@ -198,6 +212,13 @@ class Engine:
                 f"prompt of {len(prompt)} tokens exceeds the largest prefill "
                 f"bucket ({max(self.config.prefill_buckets)})"
             )
+        # prompt + 1 sampled token must fit a slot's pages — a prompt that can
+        # never be admitted would livelock the whole waiting queue behind it.
+        if len(prompt) + 1 > max_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens cannot fit max_model_len="
+                f"{max_len} (page_size*pages_per_slot) with room to generate"
+            )
         if len(prompt) + params.max_tokens > max_len:
             params = dataclasses.replace(
                 params, max_tokens=max(1, max_len - len(prompt))
@@ -219,10 +240,31 @@ class Engine:
 
     def step(self) -> list[StepEvent]:
         events: list[StepEvent] = []
+        events += self._reap_aborted()
         events += self._admit_one()
         events += self._decode_once()
         for ev in events:
             ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
+        return events
+
+    def abort(self, req: Request, reason: str = "abort") -> None:
+        """Request cancellation from any thread (client disconnect, server-side
+        stop sequence). The engine thread releases the slot/pages at the start
+        of its next step and emits a final finished event."""
+        req.abort_reason = reason
+
+    def _reap_aborted(self) -> list[StepEvent]:
+        events: list[StepEvent] = []
+        with self._lock:
+            doomed_waiting = [r for r in self.waiting
+                              if r.abort_reason and not r.finished]
+            for r in doomed_waiting:
+                self.waiting.remove(r)
+        for r in doomed_waiting:
+            events.append(self._finish(r, r.abort_reason))
+        for r in list(self.slots):
+            if r is not None and r.abort_reason and not r.finished:
+                events.append(self._finish(r, r.abort_reason))
         return events
 
     def _next_key(self) -> jax.Array:
@@ -258,8 +300,10 @@ class Engine:
             resumed = bool(req.output)
             prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
             n = len(prefill_tokens)
-            if n > max(self.config.prefill_buckets):
-                # resumed request grew beyond prefill reach; end it gracefully
+            if (n > max(self.config.prefill_buckets)
+                    or self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot):
+                # resumed request grew beyond prefill/page reach; end it
+                # gracefully rather than livelocking the queue behind it
                 self.waiting.popleft()
                 ev = self._finish(req, "length")
                 return [ev]
@@ -324,6 +368,7 @@ class Engine:
         if not victims:
             raise MemoryError("KV pool exhausted with no preemptable request")
         victim = max(victims, key=lambda r: r.submitted_at)
+        self.preemptions += 1
         slot = victim.slot
         self.allocator.free(slot)
         self.slot_len[slot] = 0

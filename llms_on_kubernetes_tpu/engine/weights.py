@@ -26,7 +26,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig
-from llms_on_kubernetes_tpu.parallel.sharding import param_specs
 
 Params = dict[str, Any]
 
@@ -46,21 +45,18 @@ def _open_safetensors(model_dir: str) -> dict[str, Callable[[], np.ndarray]]:
     return loaders
 
 
-def _bf16_to_np(x: np.ndarray) -> np.ndarray:
-    return x  # safetensors numpy framework yields ml_dtypes bfloat16 already
-
-
 class _Fetch:
-    """Reads HF tensors with layout transforms; records missing keys."""
+    """Reads HF tensors with layout transforms."""
 
     def __init__(self, loaders):
         self.loaders = loaders
-        self.missing: list[str] = []
 
     def __call__(self, name: str) -> np.ndarray:
         if name not in self.loaders:
-            self.missing.append(name)
-            raise KeyError(name)
+            raise KeyError(
+                f"checkpoint is missing tensor {name!r} "
+                f"(have {len(self.loaders)} tensors)"
+            )
         return np.asarray(self.loaders[name]())
 
     def linear(self, name: str, out_reshape=None) -> np.ndarray:
@@ -140,8 +136,14 @@ def load_hf_params(
     model_dir: str,
     mesh=None,
     dtype: Optional[str] = None,
+    quantization: Optional[str] = None,
 ) -> Params:
-    """Load a HF checkpoint directory into (optionally mesh-sharded) params."""
+    """Load a HF checkpoint directory into (optionally mesh-sharded) params.
+
+    ``quantization="int8"`` quantizes the matmul weights host-side before
+    device placement (dequant-on-load parity with the reference's FP8/AWQ
+    checkpoints, reference values.yaml:2-12; SURVEY §7 hard-part 5).
+    """
     dt = jnp.dtype(dtype or cfg.dtype)
     loaders = _open_safetensors(model_dir)
     fetch = _Fetch(loaders)
@@ -159,16 +161,19 @@ def load_hf_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = fetch.linear("lm_head.weight").astype(dt)
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding
+    if quantization == "int8":
+        from llms_on_kubernetes_tpu.ops.quant import quantize_params
 
-        specs = param_specs(cfg, mesh)
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), NamedSharding(mesh, s)),
-            params, specs,
-        )
+        params = quantize_params(params)
+    elif quantization is not None:
+        raise ValueError(f"unknown quantization {quantization!r} (supported: int8)")
+
+    if mesh is not None:
+        from llms_on_kubernetes_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, cfg, mesh)
     else:
-        params = jax.tree.map(jnp.asarray, params)
+        params = jax.tree.map(jnp.asarray, params)  # QTensor is a pytree node
     return params
 
 

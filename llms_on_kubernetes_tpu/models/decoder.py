@@ -33,6 +33,7 @@ from llms_on_kubernetes_tpu.engine.cache import write_tokens
 from llms_on_kubernetes_tpu.ops.attention import paged_attention, prefill_attention, softcap
 from llms_on_kubernetes_tpu.ops.moe import moe_block
 from llms_on_kubernetes_tpu.ops.norms import rms_norm
+from llms_on_kubernetes_tpu.ops.quant import qeinsum
 from llms_on_kubernetes_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
@@ -105,9 +106,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[str] = None) -
 # ---------------------------------------------------------------------------
 
 def _qkv(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
-    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"])
-    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"])
-    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"])
+    q = qeinsum("btd,dhk->bthk", h, lp["wq"])
+    k = qeinsum("btd,dhk->bthk", h, lp["wk"])
+    v = qeinsum("btd,dhk->bthk", h, lp["wv"])
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -130,9 +131,9 @@ def _mlp(lp: Params, cfg: ModelConfig, h: jnp.ndarray, token_valid: jnp.ndarray)
             valid=token_valid.reshape(B * T),
         )
         return out.reshape(B, T, D)
-    gate = act(jnp.einsum("btd,df->btf", h, lp["w_gate"]))
-    up = jnp.einsum("btd,df->btf", h, lp["w_up"])
-    return jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
+    gate = act(qeinsum("btd,df->btf", h, lp["w_gate"]))
+    up = qeinsum("btd,df->btf", h, lp["w_up"])
+    return qeinsum("btf,fd->btd", gate * up, lp["w_down"])
 
 
 def _layer_step(
@@ -177,7 +178,7 @@ def _layer_step(
             scale=scale, sliding_window=window,
             attn_softcap=cfg.attn_softcap,
         )[:, None]
-    out = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+    out = qeinsum("bthk,hkd->btd", attn, lp["wo"])
     if cfg.post_norms:
         out = rms_norm(out, lp["attn_post_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     x = x + out

@@ -27,6 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from llms_on_kubernetes_tpu.ops.quant import qeinsum
+
 
 def moe_block(
     x: jnp.ndarray,
@@ -76,6 +78,6 @@ def moe_block(
     combine = jnp.einsum("nk,nkec->nec", topk_probs.astype(x.dtype), pos_onehot)
 
     xs = jnp.einsum("nec,nd->ecd", dispatch, x)                             # [E, C, D]
-    h = act(jnp.einsum("ecd,edf->ecf", xs, w_gate)) * jnp.einsum("ecd,edf->ecf", xs, w_up)
-    ys = jnp.einsum("ecf,efd->ecd", h, w_down)                              # [E, C, D]
+    h = act(qeinsum("ecd,edf->ecf", xs, w_gate)) * qeinsum("ecd,edf->ecf", xs, w_up)
+    ys = qeinsum("ecf,efd->ecd", h, w_down)                              # [E, C, D]
     return jnp.einsum("nec,ecd->nd", combine, ys)                           # [N, D]

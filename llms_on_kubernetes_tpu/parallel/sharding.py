@@ -89,8 +89,26 @@ def batch_spec() -> P:
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
-    """Device_put params onto the mesh according to param_specs."""
+    """Device_put params onto the mesh according to param_specs.
+
+    Int8-quantized weights (``QTensor``) shard their data with the weight's
+    spec and their (keepdims) scale with the same axes on the non-reduced
+    dims — so a TP-sharded weight carries its channel scales on the same
+    chip as the channels.
+    """
+    from llms_on_kubernetes_tpu.ops.quant import QTensor, scale_spec
+
     specs = param_specs(cfg, mesh)
+
+    def put(x, s):
+        if isinstance(x, QTensor):
+            data = jax.device_put(x.data, NamedSharding(mesh, s))
+            scale = jax.device_put(
+                x.scale, NamedSharding(mesh, scale_spec(s, x.scale.shape))
+            )
+            return QTensor(data, scale)
+        return jax.device_put(x, NamedSharding(mesh, s))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        put, params, specs, is_leaf=lambda x: isinstance(x, QTensor)
     )

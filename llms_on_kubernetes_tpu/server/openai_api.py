@@ -46,6 +46,7 @@ class EngineLoop(threading.Thread):
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._ttft_seen: set[str] = set()
+        self._preempt_seen = 0
 
     def submit(self, *args, **kw) -> Request:
         req = self.engine.submit(*args, **kw)
@@ -54,6 +55,10 @@ class EngineLoop(threading.Thread):
             self.metrics["prompt_tokens"].inc(len(req.prompt))
         self._wake.set()
         return req
+
+    def abort(self, req: Request, reason: str = "abort") -> None:
+        self.engine.abort(req, reason)
+        self._wake.set()
 
     def stop(self) -> None:
         self._stop.set()
@@ -72,6 +77,9 @@ class EngineLoop(threading.Thread):
             if self.metrics:
                 m = self.metrics
                 m["decode_step"].observe(dt)
+                if eng.preemptions > self._preempt_seen:
+                    m["preemptions"].inc(eng.preemptions - self._preempt_seen)
+                    self._preempt_seen = eng.preemptions
                 m["batch_occupancy"].set(sum(r is not None for r in eng.slots))
                 m["kv_pages_used"].set(
                     eng.config.num_pages - 1 - eng.allocator.num_free_pages)
@@ -114,6 +122,46 @@ class IncrementalDetokenizer:
             delta = self.tok.decode(self.ids)[self.sent:]
         self.sent += len(delta)
         return delta
+
+
+class StopChecker:
+    """Server-side stop-SEQUENCE matching (the OpenAI ``stop`` parameter).
+
+    Stop token ids are handled inside the engine; stop *strings* can span
+    token boundaries, so they are matched on the detokenized text stream.
+    ``push`` returns (text safe to emit, hit): while streaming, the last
+    ``max(len(stop)) - 1`` characters are held back so a stop sequence split
+    across deltas is never partially emitted.
+    """
+
+    def __init__(self, stops: list[str]):
+        self.stops = [s for s in stops if s]
+        self.holdback = max((len(s) for s in self.stops), default=1) - 1
+        self.text = ""
+        self.emitted = 0
+
+    def push(self, delta: str, final: bool = False) -> tuple[str, bool]:
+        self.text += delta
+        for s in self.stops:
+            idx = self.text.find(s)
+            if idx != -1:
+                out = self.text[self.emitted:idx]
+                self.emitted = idx
+                return out, True
+        cut = len(self.text) if final or not self.stops else max(
+            self.emitted, len(self.text) - self.holdback)
+        out = self.text[self.emitted:cut]
+        self.emitted = cut
+        return out, False
+
+
+def _parse_stops(body: dict) -> list[str]:
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        return [stop]
+    if isinstance(stop, list):
+        return [s for s in stop if isinstance(s, str)]
+    return []
 
 
 class OpenAIServer:
@@ -199,69 +247,128 @@ class OpenAIServer:
             prompt_ids = self.tokenizer.apply_chat_template(messages)
         except Exception as e:  # bad roles/content shape
             return web.json_response({"error": {"message": f"bad messages: {e}"}}, status=400)
-        return await self._serve(request, body, prompt_ids, chat=True)
+        return await self._serve(request, body, [prompt_ids], chat=True)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
+        """Supports every OpenAI ``prompt`` form: a string, a token-id list,
+        a list of strings, and a list of token-id lists (one choice each)."""
         try:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         prompt = body.get("prompt", "")
-        if isinstance(prompt, list):
-            prompt = prompt[0] if prompt else ""
-        prompt_ids = self.tokenizer.encode(prompt)
-        if not prompt_ids:
+        if isinstance(prompt, list) and prompt and all(isinstance(t, int) for t in prompt):
+            prompts: list[list[int]] = [list(prompt)]
+        elif isinstance(prompt, list):
+            prompts = []
+            for p in prompt:
+                if isinstance(p, str):
+                    prompts.append(self.tokenizer.encode(p))
+                elif isinstance(p, list) and all(isinstance(t, int) for t in p):
+                    prompts.append(list(p))
+                else:
+                    return web.json_response(
+                        {"error": {"message": "prompt list items must be strings "
+                                   "or token-id lists"}}, status=400)
+        elif isinstance(prompt, str):
+            prompts = [self.tokenizer.encode(prompt)]
+        else:
+            return web.json_response(
+                {"error": {"message": "prompt must be a string or list"}}, status=400)
+        if not prompts or any(not p for p in prompts):
             return web.json_response({"error": {"message": "empty prompt"}}, status=400)
-        return await self._serve(request, body, prompt_ids, chat=False)
+        return await self._serve(request, body, prompts, chat=False)
 
     # ------------------------------------------------------------------
 
-    async def _serve(self, request, body, prompt_ids, *, chat: bool) -> web.StreamResponse:
+    async def _serve(self, request, body, prompts, *, chat: bool) -> web.StreamResponse:
         params = self._sampling_from_body(body)
+        stops = _parse_stops(body)
+        reqs = []
         try:
-            req = self.loop_thread.submit(prompt_ids, params)
+            for prompt_ids in prompts:
+                reqs.append(self.loop_thread.submit(prompt_ids, params))
         except ValueError as e:
+            for r in reqs:
+                self.loop_thread.abort(r)
             return web.json_response({"error": {"message": str(e)}}, status=400)
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
         if body.get("stream"):
-            return await self._stream_response(request, req, rid, created, chat)
-        return await self._full_response(req, rid, created, chat, prompt_ids)
+            return await self._stream_response(request, reqs, rid, created, chat, stops)
+        return await self._full_response(reqs, rid, created, chat, prompts, stops)
 
-    async def _full_response(self, req, rid, created, chat, prompt_ids) -> web.Response:
-        finish_reason = None
+    async def _drain(self, req, stops):
+        """Async generator over one request's events: yields
+        ``(text_delta, done, finish_reason, tokens_so_far)``.
+
+        Single source of truth for stop-token filtering, incremental
+        detokenization, stop-sequence matching, and early abort — consumed
+        by both the streaming and non-streaming paths. ``tokens_so_far``
+        counts event tokens deterministically (``req.output`` may still be
+        growing on the engine thread after an abort).
+        """
+        detok = IncrementalDetokenizer(self.tokenizer)
+        stopper = StopChecker(stops)
+        stop_ids = set(req.params.stop_token_ids)
+        total = 0
         while True:
-            _toks, done, reason = await _next_event(req)
+            toks, done, reason = await _next_event(req)
+            total += len(toks)
+            # exclude trailing stop token from visible text (OpenAI behavior)
+            visible = [t for t in toks if not (done and reason == "stop" and t in stop_ids)]
+            text, hit = stopper.push(detok.push(visible, final=done), final=done)
+            if hit:
+                self.loop_thread.abort(req)
+                yield text, True, "stop", total
+                return
+            yield text, done, reason, total
+            if done:
+                return
+
+    async def _consume(self, req, stops) -> tuple[str, Optional[str], int]:
+        parts: list[str] = []
+        finish_reason, total = None, 0
+        async for text, done, reason, total in self._drain(req, stops):
+            parts.append(text)
             if done:
                 finish_reason = reason
-                break
-        # exclude trailing stop token from the visible text (OpenAI behavior)
-        out_ids = req.output
-        if finish_reason == "stop" and out_ids and out_ids[-1] in set(req.params.stop_token_ids):
-            out_ids = out_ids[:-1]
-        text = self.tokenizer.decode(out_ids)
+        return "".join(parts), finish_reason, total
+
+    async def _full_response(self, reqs, rid, created, chat, prompts, stops) -> web.Response:
+        choices = []
+        completion_tokens = 0
+        try:
+            for i, req in enumerate(reqs):
+                text, finish_reason, ntok = await self._consume(req, stops)
+                completion_tokens += ntok
+                if chat:
+                    choices.append({
+                        "index": i,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": finish_reason,
+                    })
+                else:
+                    choices.append({"index": i, "text": text, "finish_reason": finish_reason})
+        except asyncio.CancelledError:
+            # client went away mid-generation: free slots/pages now
+            for r in reqs:
+                self.loop_thread.abort(r, "disconnect")
+            raise
+        prompt_tokens = sum(len(p) for p in prompts)
         usage = {
-            "prompt_tokens": len(prompt_ids),
-            "completion_tokens": len(req.output),
-            "total_tokens": len(prompt_ids) + len(req.output),
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
         }
-        if chat:
-            choice = {
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": finish_reason,
-            }
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
-            obj = "text_completion"
         return web.json_response({
-            "id": rid, "object": obj, "created": created,
-            "model": self.model_name, "choices": [choice], "usage": usage,
+            "id": rid, "object": "chat.completion" if chat else "text_completion",
+            "created": created, "model": self.model_name,
+            "choices": choices, "usage": usage,
         })
 
-    async def _stream_response(self, request, req, rid, created, chat) -> web.StreamResponse:
+    async def _stream_response(self, request, reqs, rid, created, chat, stops) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -271,42 +378,47 @@ class OpenAIServer:
             },
         )
         await resp.prepare(request)
-        detok = IncrementalDetokenizer(self.tokenizer)
         obj = "chat.completion.chunk" if chat else "text_completion"
+        write_lock = asyncio.Lock()
 
-        def chunk(delta_text: Optional[str], reason: Optional[str]) -> bytes:
+        def chunk(index: int, delta_text: Optional[str], reason: Optional[str],
+                  role: bool = False) -> bytes:
             if chat:
-                delta = {}
+                delta: dict = {}
+                if role:
+                    delta["role"] = "assistant"
                 if delta_text is not None:
-                    delta = {"content": delta_text}
-                choice = {"index": 0, "delta": delta, "finish_reason": reason}
+                    delta["content"] = delta_text
+                choice = {"index": index, "delta": delta, "finish_reason": reason}
             else:
-                choice = {"index": 0, "text": delta_text or "", "finish_reason": reason}
+                choice = {"index": index, "text": delta_text or "", "finish_reason": reason}
             payload = {
                 "id": rid, "object": obj, "created": created,
                 "model": self.model_name, "choices": [choice],
             }
             return f"data: {json.dumps(payload)}\n\n".encode()
 
-        if chat:
-            first = {"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}
-            await resp.write(
-                f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': self.model_name, 'choices': [first]})}\n\n".encode()
-            )
-        stop_ids = set(req.params.stop_token_ids)
+        async def pump(index: int, req) -> None:
+            """Relay one request's tokens as SSE chunks (choices interleave
+            across requests; the write lock keeps individual events intact)."""
+            if chat:
+                async with write_lock:
+                    await resp.write(chunk(index, None, None, role=True))
+            async for text, done, reason, _total in self._drain(req, stops):
+                async with write_lock:
+                    if text:
+                        await resp.write(chunk(index, text, None))
+                    if done:
+                        await resp.write(chunk(index, None, reason))
+
         try:
-            while True:
-                toks, done, reason = await _next_event(req)
-                visible = [t for t in toks if not (done and reason == "stop" and t in stop_ids)]
-                text = detok.push(visible, final=done)
-                if text:
-                    await resp.write(chunk(text, None))
-                if done:
-                    await resp.write(chunk(None, reason))
-                    await resp.write(b"data: [DONE]\n\n")
-                    break
+            await asyncio.gather(*(pump(i, r) for i, r in enumerate(reqs)))
+            await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
-            pass  # client went away; engine finishes the request on its own
+            # client went away: cancel generation so slots/pages free up now
+            for r in reqs:
+                self.loop_thread.abort(r, "disconnect")
+            raise
         await resp.write_eof()
         return resp
 

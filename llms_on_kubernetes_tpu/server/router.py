@@ -138,6 +138,7 @@ class Router:
         headers["X-Forwarded-For"] = f"{prior}, {client_ip}" if prior else client_ip
         headers["X-Forwarded-Proto"] = request.scheme
 
+        resp: Optional[web.StreamResponse] = None
         try:
             async with self._session.request(
                 request.method, url, data=body or None, headers=headers,
@@ -153,11 +154,18 @@ class Router:
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, TimeoutError, OSError) as e:
-            return web.json_response(
-                {"error": {"message": f"upstream error: {e}",
-                           "type": "bad_gateway"}},
-                status=502,
-            )
+            if resp is None or not resp.prepared:
+                return web.json_response(
+                    {"error": {"message": f"upstream error: {e}",
+                               "type": "bad_gateway"}},
+                    status=502,
+                )
+            # Upstream died mid-stream: headers are already on the wire, so a
+            # 502 can't be sent. Close the downstream connection so the client
+            # sees EOF/reset instead of hanging forever on a half-open stream.
+            if request.transport is not None:
+                request.transport.close()
+            return resp
 
 
 def run_router(

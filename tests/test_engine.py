@@ -113,3 +113,54 @@ def test_events_stream():
         toks, done, reason = req.events.get_nowait()
         streamed += toks
     assert streamed == req.output
+
+
+def test_oversized_prompt_rejected_not_livelocked():
+    """A prompt that fits a prefill bucket but can never fit a slot's pages
+    must be rejected at submit() (review finding: it used to livelock the
+    whole queue)."""
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=4, num_pages=64, pages_per_slot=4,  # max_model_len=16
+        prefill_buckets=(32,),
+    ))
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(list(range(16)), SamplingParams(max_tokens=4))
+    # boundary: 15-token prompt + 1 generated fits exactly
+    req = eng.submit(list(range(15)), SamplingParams(temperature=0.0, max_tokens=1))
+    while not req.finished:
+        eng.step()
+    assert len(req.output) == 1
+
+
+def test_abort_frees_slot_and_emits_final_event():
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=4, num_pages=64, pages_per_slot=8, prefill_buckets=(16,),
+    ))
+    req = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=64))
+    eng.step()  # admit + first decode
+    assert req.slot >= 0
+    eng.abort(req, "disconnect")
+    eng.step()
+    assert req.finished and req.finish_reason == "disconnect"
+    assert req.slot == -1 and all(r is None for r in eng.slots)
+    # final event is observable by a consumer
+    drained = []
+    while not req.events.empty():
+        drained.append(req.events.get_nowait())
+    assert drained[-1][1] is True and drained[-1][2] == "disconnect"
+
+
+def test_abort_waiting_request():
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=1,
+        page_size=4, num_pages=64, pages_per_slot=8, prefill_buckets=(16,),
+    ))
+    r1 = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=32))
+    r2 = eng.submit([4, 5, 6], SamplingParams(temperature=0.0, max_tokens=4))
+    eng.step()
+    eng.abort(r2)  # still waiting (1 slot)
+    while not r1.finished:
+        eng.step()
+    assert r2.finished and r2.finish_reason == "abort" and not r2.output

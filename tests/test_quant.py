@@ -1,0 +1,99 @@
+"""Int8 weight-only quantization: numerics, engine integration, sharding.
+
+Parity target: the reference's default deployment serves FP8/AWQ quantized
+checkpoints through vLLM's dequantizing kernels (reference
+vllm-models/helm-chart/values.yaml:2-12); here the equivalent is QTensor +
+qeinsum (ops/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llms_on_kubernetes_tpu.configs import get_config
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.models.decoder import init_params
+from llms_on_kubernetes_tpu.ops.quant import QTensor, qeinsum, quantize, quantize_params
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+from llms_on_kubernetes_tpu.parallel.sharding import shard_params
+
+
+def test_quantize_roundtrip_accuracy(rng):
+    w = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+    qt = quantize(w, reduce_axes=(0,))
+    assert qt.data.dtype == jnp.int8
+    assert qt.scale.shape == (1, 128)
+    err = jnp.abs(qt.dequantize(jnp.float32) - w)
+    # per-channel symmetric: error bounded by scale/2 per element
+    assert float(err.max()) <= float(qt.scale.max()) * 0.5 + 1e-6
+
+
+def test_qeinsum_matches_dense(rng):
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+    qt = quantize(w, reduce_axes=(0,))
+    ref = jnp.einsum("bd,df->bf", x, qt.dequantize(jnp.float32))
+    out = qeinsum("bd,df->bf", x, qt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_qtensor_is_scan_sliceable():
+    """lax.scan over a layer-stacked QTensor slices data and scale together."""
+    w = jnp.arange(2 * 4 * 6, dtype=jnp.float32).reshape(2, 4, 6)
+    qt = quantize(w, reduce_axes=(1,))  # scale [2, 1, 6]
+
+    def body(carry, lp):
+        assert lp.data.shape == (4, 6) and lp.scale.shape == (1, 6)
+        return carry + lp.dequantize(jnp.float32).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0), qt)
+    np.testing.assert_allclose(float(total), float(w.sum()), rtol=1e-2)
+
+
+def _greedy(model, quantization):
+    cfg = EngineConfig(model=model, max_decode_slots=2, page_size=16,
+                       num_pages=64, pages_per_slot=8, prefill_buckets=(16,),
+                       quantization=quantization, dtype="float32")
+    eng = Engine(cfg)
+    return eng.generate([1, 2, 3, 4, 5], SamplingParams(temperature=0.0, max_tokens=8))
+
+
+def test_quantized_engine_tracks_dense():
+    """Greedy decode from int8 weights stays close to the fp32 engine: same
+    model, same seed — most tokens should agree (int8 rounding can flip
+    near-ties, so exact match is not required)."""
+    dense = _greedy("debug-tiny", None)
+    quant = _greedy("debug-tiny", "int8")
+    assert len(dense) == len(quant) == 8
+    agree = sum(d == q for d, q in zip(dense, quant))
+    assert agree >= 4, f"int8 diverged from fp32: {dense} vs {quant}"
+
+
+def test_quantized_moe_engine_runs():
+    out = _greedy("debug-moe", "int8")
+    assert len(out) == 8
+
+
+def test_quantized_params_shard_over_mesh():
+    cfg = get_config("debug-tiny")
+    params = quantize_params(init_params(cfg, jax.random.key(0), dtype="float32"))
+    mesh = make_mesh(model=4, data=2)
+    sharded = shard_params(params, cfg, mesh)
+    wq = sharded["layers"]["wq"]
+    assert isinstance(wq, QTensor)
+    assert wq.data.dtype == jnp.int8
+    # head axis (4 heads) sharded over 4-way model axis
+    assert wq.data.sharding.spec == jax.sharding.PartitionSpec(None, None, "model", None)
+    assert wq.scale.sharding.spec[2] == "model"
+
+
+def test_quantized_memory_halves():
+    cfg = get_config("debug-tiny")
+    params = init_params(cfg, jax.random.key(0), dtype="bfloat16")
+    q = quantize_params(params)
+
+    def nbytes(tree):
+        return sum(x.nbytes for x in jax.tree.leaves(tree))
+
+    dense_mm = nbytes(params["layers"])
+    quant_mm = nbytes(q["layers"])
+    assert quant_mm < dense_mm * 0.62  # ~0.5 + scales + norms

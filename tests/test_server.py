@@ -150,3 +150,108 @@ def test_incremental_detokenizer_holds_partial_utf8():
     assert d.push([snowman[1]]) == ""
     assert d.push([snowman[2]]) == "☃"
     assert d.push(list("ok".encode()), final=True) == "ok"
+
+
+def test_stop_sequence_truncates_and_aborts():
+    """OpenAI `stop` strings end generation server-side (review finding:
+    previously silently ignored)."""
+    async def body(client):
+        # greedy output of debug-tiny from "abc" is deterministic; find it
+        r = await client.post("/v1/completions", json={
+            "prompt": "abc", "temperature": 0.0, "max_tokens": 12,
+        })
+        base = (await r.json())["choices"][0]["text"]
+        assert len(base) > 2
+        stop = base[1:3]  # a substring the model definitely emits
+        r = await client.post("/v1/completions", json={
+            "prompt": "abc", "temperature": 0.0, "max_tokens": 12,
+            "stop": [stop],
+        })
+        out = (await r.json())["choices"][0]
+        assert out["finish_reason"] == "stop"
+        assert stop not in out["text"]
+        assert out["text"] == base[:base.find(stop)]
+    with_client(body)
+
+
+def test_stop_sequence_streaming():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": "abc", "temperature": 0.0, "max_tokens": 12,
+        })
+        base = (await r.json())["choices"][0]["text"]
+        stop = base[1:3]
+        r = await client.post("/v1/completions", json={
+            "prompt": "abc", "temperature": 0.0, "max_tokens": 12,
+            "stop": stop, "stream": True,
+        })
+        text, reasons = "", []
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            c = json.loads(line[6:])["choices"][0]
+            text += c.get("text", "")
+            if c["finish_reason"]:
+                reasons.append(c["finish_reason"])
+        assert reasons == ["stop"]
+        assert stop not in text
+        assert text == base[:base.find(stop)]
+    with_client(body)
+
+
+def test_completions_list_of_prompts():
+    """A list of string prompts yields one indexed choice per prompt
+    (review finding: previously dropped all but the first)."""
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": ["ab", "xy"], "temperature": 0.0, "max_tokens": 4,
+        })
+        data = await r.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1]
+        assert all(isinstance(c["text"], str) for c in data["choices"])
+        # each choice must match the same prompt served alone
+        for prompt, choice in zip(["ab", "xy"], data["choices"]):
+            r1 = await client.post("/v1/completions", json={
+                "prompt": prompt, "temperature": 0.0, "max_tokens": 4,
+            })
+            solo = (await r1.json())["choices"][0]["text"]
+            assert choice["text"] == solo
+        assert data["usage"]["prompt_tokens"] == 4
+    with_client(body)
+
+
+def test_completions_token_id_prompt():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": [97, 98, 99], "temperature": 0.0, "max_tokens": 4,
+        })
+        data = await r.json()
+        assert r.status == 200
+        assert len(data["choices"]) == 1
+        r2 = await client.post("/v1/completions", json={
+            "prompt": "abc", "temperature": 0.0, "max_tokens": 4,
+        })
+        assert data["choices"][0]["text"] == (await r2.json())["choices"][0]["text"]
+    with_client(body)
+
+
+def test_multi_prompt_streaming_interleaves_indices():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": ["ab", "xy"], "temperature": 0.0, "max_tokens": 4,
+            "stream": True,
+        })
+        per_index = {0: "", 1: ""}
+        finishes = set()
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            c = json.loads(line[6:])["choices"][0]
+            per_index[c["index"]] += c.get("text", "")
+            if c["finish_reason"]:
+                finishes.add(c["index"])
+        assert finishes == {0, 1}
+        assert all(per_index.values())
+    with_client(body)
