@@ -1,0 +1,50 @@
+{{/*
+Shared helpers. The GKE accelerator label value per TPU generation.
+*/}}
+{{- define "tpu-models.gkeAccelerator" -}}
+{{- if eq . "v5e" -}}tpu-v5-lite-podslice
+{{- else if eq . "v5p" -}}tpu-v5p-slice
+{{- else if eq . "v6e" -}}tpu-v6e-slice
+{{- else -}}{{ fail (printf "unknown TPU accelerator %q (v5e|v5p|v6e)" .) }}
+{{- end -}}
+{{- end -}}
+
+{{/* Chips requested per host: whole-slice count for single-host, an even
+     split for multi-host pod groups. */}}
+{{- define "tpu-models.chipsPerHost" -}}
+{{- $hosts := int (default 1 .tpu.hosts) -}}
+{{- div (int .tpu.chips) $hosts -}}
+{{- end -}}
+
+{{- define "tpu-models.labels" -}}
+app.kubernetes.io/part-of: llms-on-kubernetes-tpu
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+{{- end -}}
+
+{{/* Engine container args for one model entry (scope: dict model/root). */}}
+{{- define "tpu-models.engineArgs" -}}
+{{- $m := .model -}}
+- serve
+- --model
+- {{ $m.huggingfaceId | quote }}
+- --served-model-name
+- {{ $m.modelName | quote }}
+- --host
+- "0.0.0.0"
+- --port
+- "8080"
+- --tensor-parallel-size
+- {{ $m.sharding.tp | default $m.tpu.chips | quote }}
+{{- if gt (int (default 1 $m.sharding.ep)) 1 }}
+- --expert-parallel-size
+- {{ $m.sharding.ep | quote }}
+{{- end }}
+{{- if $m.quantization }}
+- --quantization
+- {{ $m.quantization | quote }}
+{{- end }}
+{{- range $m.engineArgs }}
+- {{ . | quote }}
+{{- end }}
+{{- end -}}

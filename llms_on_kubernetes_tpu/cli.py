@@ -40,8 +40,10 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser("router", help="run the multi-model API gateway")
-    p.add_argument("--backend", action="append", required=True,
+    p.add_argument("--backend", action="append", default=None,
                    metavar="NAME=URL", help="repeatable: model name=base url")
+    p.add_argument("--config", default=None,
+                   help="router.json (from `render`): backends/default/strict")
     p.add_argument("--default-model", default=None)
     p.add_argument("--strict", action="store_true",
                    help="404 on unknown model instead of silent default fallback")
@@ -49,23 +51,55 @@ def _add_router(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--port", type=int, default=8080)
 
 
+def _add_render(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "render",
+        help="render K8s manifests from a models[] config (helm-free path)")
+    p.add_argument("--config", required=True, help="deploy config YAML")
+    p.add_argument("-o", "--output", default="-",
+                   help="output file (default: stdout)")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="llms-on-kubernetes-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
     _add_serve(sub)
     _add_router(sub)
+    _add_render(sub)
     args = parser.parse_args(argv)
 
+    if args.cmd == "render":
+        from llms_on_kubernetes_tpu.deploy import load_spec, render_manifests, to_yaml
+
+        text = to_yaml(render_manifests(load_spec(args.config)))
+        if args.output == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.output, "w") as f:
+                f.write(text)
+        return 0
+
     if args.cmd == "router":
+        import json
+
         from llms_on_kubernetes_tpu.server.router import run_router
 
         backends = {}
-        for spec in args.backend:
+        default_model, strict = args.default_model, args.strict
+        if args.config:
+            with open(args.config) as f:
+                cfg = json.load(f)
+            backends.update(cfg.get("backends", {}))
+            default_model = default_model or cfg.get("default_model")
+            strict = strict or bool(cfg.get("strict", False))
+        for spec in args.backend or ():
             name, _, url = spec.partition("=")
             if not url:
                 parser.error(f"--backend must be NAME=URL, got {spec!r}")
             backends[name] = url
-        run_router(backends, args.default_model, args.strict,
+        if not backends:
+            parser.error("router needs --config or at least one --backend")
+        run_router(backends, default_model, strict,
                    host=args.host, port=args.port)
         return 0
 

@@ -1,0 +1,17 @@
+"""Deployment layer: the reference's Helm/GitOps packaging, TPU-native.
+
+The reference's real public API is its Helm ``models[]`` values contract
+(reference vllm-models/helm-chart/values.yaml:1-27) fanned out into per-model
+Deployments/Services/PVCs, a routing gateway, Istio ingress, and a WebUI
+(SURVEY §3.2). Here that contract lives in ``spec.py`` (validated dataclasses
+— the values.schema.json the reference lacked) and ``manifests.py`` (a pure
+renderer emitting the same resource set with TPU scheduling:
+``google.com/tpu`` requests, GKE TPU nodeSelectors, multi-host slice
+topologies). ``charts/`` at the repo root carries the equivalent Helm chart
+for ArgoCD-based GitOps sync.
+"""
+
+from llms_on_kubernetes_tpu.deploy.spec import (  # noqa: F401
+    DeploySpec, ModelSpec, ShardingSpec, TPUSpec, load_spec,
+)
+from llms_on_kubernetes_tpu.deploy.manifests import render_manifests, to_yaml  # noqa: F401

@@ -1,0 +1,513 @@
+"""Kubernetes manifest renderer: models[] spec → the full serving topology.
+
+The TPU-native equivalent of the reference chart's template fan-out
+(reference vllm-models/helm-chart/templates/: model-deployments.yaml,
+model-services.yaml, model-pvcs.yaml, model-gateway.yaml,
+webui-deployment.yaml, gateway.yaml — SURVEY §3.2 "config fan-out"). Per
+model it emits:
+
+- single-host (tpu.hosts == 1): a Deployment requesting
+  ``google.com/tpu: <chips>`` with GKE TPU nodeSelectors
+  (``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) — the
+  analogue of the reference's ``nvidia.com/gpu`` requests + taint
+  tolerations (model-deployments.yaml:40-44,75-78);
+- multi-host (v5p-16 etc.): a StatefulSet pod group + headless Service for
+  stable worker DNS, each pod one slice host, with
+  ``jax.distributed``-compatible env (coordinator = pod 0) — the
+  capability the reference lacked entirely (SURVEY §2.4);
+- a ClusterIP Service per model, an optional HF-cache PVC (ReadOnlyMany
+  opt-in to fix the reference's RWO x replicas deadlock, SURVEY §5);
+- the router ConfigMap/Deployment/Service with a **config-hash pod
+  annotation** so ArgoCD syncing a model-list change rolls the router —
+  the reference's gateway silently kept stale routes until manually
+  restarted (SURVEY §3.2);
+- Istio Gateway + VirtualService (same 4-route shape as the reference:
+  exact /v1/models, prefix /v1/, /health, / → webui);
+- OpenWebUI Deployment/Service/PVC pointed at the router.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Optional
+
+from llms_on_kubernetes_tpu.deploy.spec import DeploySpec, ModelSpec
+
+Manifest = dict[str, Any]
+
+ENGINE_PORT = 8080
+ROUTER_PORT = 8080
+WEBUI_PORT = 8080
+
+
+def _labels(app: str, component: str) -> dict[str, str]:
+    return {
+        "app": app,
+        "app.kubernetes.io/component": component,
+        "app.kubernetes.io/part-of": "llms-on-kubernetes-tpu",
+    }
+
+
+def _meta(name: str, spec: DeploySpec, component: str,
+          annotations: Optional[dict] = None) -> Manifest:
+    meta: Manifest = {
+        "name": name,
+        "namespace": spec.namespace,
+        "labels": _labels(name, component),
+    }
+    if annotations:
+        meta["annotations"] = annotations
+    return meta
+
+
+def _engine_args(m: ModelSpec, spec: DeploySpec) -> list[str]:
+    ref = m.huggingface_id or m.model_path
+    args = [
+        "serve",
+        "--model", str(ref),
+        "--served-model-name", m.model_name,
+        "--host", "0.0.0.0",
+        "--port", str(ENGINE_PORT),
+    ]
+    if m.tpu is not None:
+        sh = m.sharding.resolve(m.tpu.chips)
+        args += ["--tensor-parallel-size", str(sh.tp)]
+        if sh.ep > 1:
+            args += ["--expert-parallel-size", str(sh.ep)]
+    if m.quantization:
+        args += ["--quantization", m.quantization]
+    args += list(m.engine_args)
+    return args
+
+
+def _probes() -> dict[str, Any]:
+    """Same probe budget as the reference's vLLM pods (cold start can include
+    an HF download; reference model-deployments.yaml:48-63)."""
+    return {
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": ENGINE_PORT},
+            "initialDelaySeconds": 120, "periodSeconds": 30,
+            "failureThreshold": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": ENGINE_PORT},
+            "initialDelaySeconds": 300, "periodSeconds": 60,
+            "failureThreshold": 5,
+        },
+    }
+
+
+def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
+    c: Manifest = {
+        "name": "engine",
+        "image": spec.image,
+        "imagePullPolicy": spec.image_pull_policy,
+        "command": ["python", "-m", "llms_on_kubernetes_tpu"],
+        "args": _engine_args(m, spec),
+        "ports": [
+            {"containerPort": ENGINE_PORT, "name": "http"},
+        ],
+        "env": [
+            {"name": "HUGGING_FACE_HUB_TOKEN", "valueFrom": {"secretKeyRef": {
+                "name": spec.hf_secret_name, "key": "token",
+                "optional": True,
+            }}},
+        ],
+        **_probes(),
+    }
+    if m.tpu is not None:
+        c["resources"] = {
+            "requests": {"google.com/tpu": str(m.tpu.chips_per_host)},
+            "limits": {"google.com/tpu": str(m.tpu.chips_per_host)},
+        }
+    if m.huggingface_id:
+        c["volumeMounts"] = [{
+            "name": "hf-cache", "mountPath": "/root/.cache/huggingface",
+        }]
+    elif spec.host_model_path:
+        c["volumeMounts"] = [{
+            "name": "models", "mountPath": "/mnt/models", "readOnly": True,
+        }]
+    return c
+
+
+def _tpu_node_selector(m: ModelSpec) -> dict[str, str]:
+    assert m.tpu is not None
+    return {
+        "cloud.google.com/gke-tpu-accelerator": m.tpu.gke_accelerator,
+        "cloud.google.com/gke-tpu-topology": m.tpu.resolved_topology(),
+    }
+
+
+def _volumes(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
+    if m.huggingface_id:
+        return [{
+            "name": "hf-cache",
+            "persistentVolumeClaim": {
+                "claimName": f"model-{m.model_name}-cache",
+                **({"readOnly": True} if m.pvc_shared else {}),
+            },
+        }]
+    if spec.host_model_path:
+        return [{
+            "name": "models",
+            "hostPath": {"path": spec.host_model_path, "type": "Directory"},
+        }]
+    return []
+
+
+def render_model_single_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
+    pod_spec: Manifest = {
+        "containers": [_engine_container(m, spec)],
+        "volumes": _volumes(m, spec),
+    }
+    if m.tpu is not None:
+        pod_spec["nodeSelector"] = _tpu_node_selector(m)
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"model-{m.model_name}", spec, "model-server"),
+        "spec": {
+            "replicas": m.replicas,
+            "selector": {"matchLabels": {"app": f"model-{m.model_name}"}},
+            "template": {
+                "metadata": {"labels": _labels(f"model-{m.model_name}", "model-server")},
+                "spec": pod_spec,
+            },
+        },
+    }
+    return [dep]
+
+
+def render_model_multi_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
+    """One logical server spanning a pod group (v5p-16 ⇒ 4 pods × 4 chips).
+
+    StatefulSet + headless Service gives each worker a stable DNS name;
+    pod 0 is the ``jax.distributed`` coordinator and the only pod the
+    model Service routes requests to (workers follow the jit program).
+    SURVEY §7 hard-part 3: the reference's single-pod Deployment shape
+    could never express this.
+    """
+    assert m.tpu is not None and m.tpu.multi_host
+    name = f"model-{m.model_name}"
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"{name}-workers", spec, "model-worker-discovery"),
+        "spec": {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {"app": name},
+            "ports": [{"port": ENGINE_PORT, "name": "http"}],
+        },
+    }
+    container = _engine_container(m, spec)
+    container["env"] += [
+        {"name": "POD_NAME", "valueFrom": {
+            "fieldRef": {"fieldPath": "metadata.name"}}},
+        {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(
+            f"{name}-{i}.{name}-workers.{spec.namespace}.svc.cluster.local"
+            for i in range(m.tpu.hosts)
+        )},
+        {"name": "JAX_COORDINATOR_ADDRESS", "value":
+            f"{name}-0.{name}-workers.{spec.namespace}.svc.cluster.local:8476"},
+        {"name": "JAX_NUM_PROCESSES", "value": str(m.tpu.hosts)},
+    ]
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": _meta(name, spec, "model-server"),
+        "spec": {
+            "serviceName": f"{name}-workers",
+            "replicas": m.tpu.hosts,
+            "podManagementPolicy": "Parallel",  # gang start: all workers at once
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": _labels(name, "model-server")},
+                "spec": {
+                    "subdomain": f"{name}-workers",
+                    "nodeSelector": _tpu_node_selector(m),
+                    "containers": [container],
+                    "volumes": _volumes(m, spec),
+                },
+            },
+        },
+    }
+    return [headless, sts]
+
+
+def render_model_service(m: ModelSpec, spec: DeploySpec) -> Manifest:
+    name = f"model-{m.model_name}"
+    selector: Manifest = {"app": name}
+    if m.tpu is not None and m.tpu.multi_host:
+        # only the coordinator pod serves HTTP
+        selector = {"statefulset.kubernetes.io/pod-name": f"{name}-0"}
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(name, spec, "model-service"),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": selector,
+            "ports": [{"port": ENGINE_PORT, "targetPort": ENGINE_PORT,
+                       "name": "http"}],
+        },
+    }
+
+
+def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
+    if not m.huggingface_id:
+        return None
+    access = "ReadOnlyMany" if m.pvc_shared else "ReadWriteOnce"
+    pvc: Manifest = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": _meta(f"model-{m.model_name}-cache", spec, "weight-cache"),
+        "spec": {
+            "accessModes": [access],
+            "resources": {"requests": {"storage": m.pvc_size}},
+        },
+    }
+    if spec.storage_class:
+        pvc["spec"]["storageClassName"] = spec.storage_class
+    return pvc
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+def router_config(spec: DeploySpec) -> dict[str, Any]:
+    """The router's model→backend table (consumed by server/router.py and
+    by the native C++ router alike)."""
+    return {
+        "backends": {
+            m.model_name:
+                f"http://model-{m.model_name}.{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"
+            for m in spec.models
+        },
+        "default_model": spec.resolved_default,
+        "strict": spec.strict_routing,
+    }
+
+
+def config_hash(spec: DeploySpec) -> str:
+    blob = json.dumps(router_config(spec), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def render_router(spec: DeploySpec) -> list[Manifest]:
+    cfg = router_config(spec)
+    cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": _meta("api-gateway-config", spec, "router-config"),
+        "data": {"router.json": json.dumps(cfg, indent=2, sort_keys=True)},
+    }
+    args = ["router", "--config", "/etc/router/router.json"]
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta("api-gateway", spec, "router"),
+        "spec": {
+            "replicas": 2,   # the reference's only redundancy was its python
+                             # gateway's 2 replicas (api-gateway.yaml:121)
+            "selector": {"matchLabels": {"app": "api-gateway"}},
+            "template": {
+                "metadata": {
+                    "labels": _labels("api-gateway", "router"),
+                    # config-hash annotation: rolls the router pods whenever
+                    # the models[] list changes (reference gap, SURVEY §3.2)
+                    "annotations": {"checksum/router-config": config_hash(spec)},
+                },
+                "spec": {
+                    "containers": [{
+                        "name": "router",
+                        "image": spec.image,
+                        "imagePullPolicy": spec.image_pull_policy,
+                        "command": (
+                            ["/usr/local/bin/tpu-router"] if spec.native_router
+                            else ["python", "-m", "llms_on_kubernetes_tpu"]
+                        ),
+                        "args": args,
+                        "ports": [{"containerPort": ROUTER_PORT, "name": "http"}],
+                        "volumeMounts": [{
+                            "name": "config", "mountPath": "/etc/router",
+                        }],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/health", "port": ROUTER_PORT},
+                            "initialDelaySeconds": 2, "periodSeconds": 5,
+                        },
+                        "livenessProbe": {
+                            "httpGet": {"path": "/health", "port": ROUTER_PORT},
+                            "initialDelaySeconds": 10, "periodSeconds": 20,
+                        },
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"},
+                            "limits": {"cpu": "1", "memory": "512Mi"},
+                        },
+                    }],
+                    "volumes": [{
+                        "name": "config",
+                        "configMap": {"name": "api-gateway-config"},
+                    }],
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta("api-gateway", spec, "router"),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"app": "api-gateway"},
+            "ports": [{"port": ROUTER_PORT, "targetPort": ROUTER_PORT,
+                       "name": "http"}],
+        },
+    }
+    return [cm, dep, svc]
+
+
+# ---------------------------------------------------------------------------
+# Istio + WebUI
+# ---------------------------------------------------------------------------
+
+def render_istio(spec: DeploySpec) -> list[Manifest]:
+    gw = {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "Gateway",
+        "metadata": _meta("tpu-models-gateway", spec, "ingress"),
+        "spec": {
+            "selector": {"istio": "ingressgateway"},
+            "servers": [{
+                "port": {"number": 80, "name": "http", "protocol": "HTTP"},
+                "hosts": ["*"],
+            }],
+        },
+    }
+    gateway_dst = [{"destination": {
+        "host": f"api-gateway.{spec.namespace}.svc.cluster.local",
+        "port": {"number": ROUTER_PORT}}}]
+    routes = [
+        {"match": [{"uri": {"exact": "/v1/models"}}], "route": gateway_dst},
+        {"match": [{"uri": {"prefix": "/v1/"}}], "route": gateway_dst},
+        {"match": [{"uri": {"prefix": "/health"}}], "route": gateway_dst},
+    ]
+    if spec.webui_enabled:
+        routes.append({
+            "match": [{"uri": {"prefix": "/"}}],
+            "route": [{"destination": {
+                "host": f"webui.{spec.namespace}.svc.cluster.local",
+                "port": {"number": WEBUI_PORT}}}],
+        })
+    vs = {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": _meta("tpu-models-routes", spec, "ingress"),
+        "spec": {
+            "hosts": ["*"],
+            "gateways": ["tpu-models-gateway"],
+            "http": routes,
+        },
+    }
+    return [gw, vs]
+
+
+def render_webui(spec: DeploySpec) -> list[Manifest]:
+    if not spec.webui_enabled:
+        return []
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta("webui", spec, "webui"),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "webui"}},
+            "template": {
+                "metadata": {"labels": _labels("webui", "webui")},
+                "spec": {
+                    "containers": [{
+                        "name": "webui",
+                        "image": "ghcr.io/open-webui/open-webui:dev-slim",
+                        "env": [
+                            {"name": "OPENAI_API_BASE_URLS", "value":
+                                f"http://api-gateway.{spec.namespace}.svc.cluster.local:{ROUTER_PORT}/v1"},
+                            {"name": "WEBUI_NAME", "value": spec.webui_name},
+                        ],
+                        "ports": [{"containerPort": WEBUI_PORT}],
+                        "volumeMounts": [{
+                            "name": "data", "mountPath": "/app/backend/data",
+                        }],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/", "port": WEBUI_PORT},
+                            "initialDelaySeconds": 15, "periodSeconds": 10,
+                        },
+                        "resources": {
+                            "requests": {"cpu": "200m", "memory": "512Mi"},
+                            "limits": {"cpu": "1", "memory": "1Gi"},
+                        },
+                    }],
+                    "volumes": [{
+                        "name": "data",
+                        "persistentVolumeClaim": {"claimName": "webui-data"},
+                    }],
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta("webui", spec, "webui"),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"app": "webui"},
+            "ports": [{"port": WEBUI_PORT, "targetPort": WEBUI_PORT}],
+        },
+    }
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        # chat history survives chart deletion, like the reference's
+        # `helm.sh/resource-policy: keep` (webui-pvc.yaml:8-9)
+        "metadata": _meta("webui-data", spec, "webui",
+                          annotations={"helm.sh/resource-policy": "keep"}),
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "1Gi"}},
+        },
+    }
+    return [dep, svc, pvc]
+
+
+# ---------------------------------------------------------------------------
+# Top level
+# ---------------------------------------------------------------------------
+
+def render_manifests(spec: DeploySpec) -> list[Manifest]:
+    spec.validate()
+    out: list[Manifest] = []
+    for m in spec.models:
+        if m.tpu is not None and m.tpu.multi_host:
+            out += render_model_multi_host(m, spec)
+        else:
+            out += render_model_single_host(m, spec)
+        out.append(render_model_service(m, spec))
+        pvc = render_model_pvc(m, spec)
+        if pvc:
+            out.append(pvc)
+    out += render_router(spec)
+    out += render_istio(spec)
+    out += render_webui(spec)
+    return out
+
+
+def to_yaml(manifests: list[Manifest]) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(m, sort_keys=False, default_flow_style=False)
+        for m in manifests
+    )

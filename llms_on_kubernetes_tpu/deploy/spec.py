@@ -1,0 +1,260 @@
+"""The ``models[]`` configuration contract, validated.
+
+Extends the reference's schema (reference vllm-models/helm-chart/
+values.yaml:1-27: ``huggingfaceId, modelName, gpuRequestCount, replicas,
+pvcSize``) the way SURVEY §7.6 prescribes: TPU topology instead of a GPU
+count, explicit sharding (tp/ep/dp), per-model engine-arg passthrough (the
+reference hardcoded engine flags in its template — SURVEY §5 "Config"), and
+schema validation with actionable errors (the reference had none; its dead
+``dnsResolver`` value shipped unnoticed, values.yaml:36-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,51}[a-z0-9])?$")
+
+# chips per host for each accelerator type: a request larger than this
+# renders a multi-host slice (LeaderWorkerSet-style pod group).
+CHIPS_PER_HOST = {"v5e": 8, "v5p": 4, "v6e": 8}
+VALID_TOPOLOGIES = {
+    "v5e": {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8", 64: "8x8", 256: "16x16"},
+    "v5p": {4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4"},
+    "v6e": {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8", 64: "8x8", 256: "16x16"},
+}
+
+
+class SpecError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUSpec:
+    accelerator: str = "v5e"     # v5e | v5p | v6e
+    chips: int = 8
+    topology: Optional[str] = None  # derived from chips if omitted
+
+    def resolved_topology(self) -> str:
+        if self.topology:
+            return self.topology
+        table = VALID_TOPOLOGIES[self.accelerator]
+        if self.chips not in table:
+            raise SpecError(
+                f"no default topology for {self.chips} {self.accelerator} chips; "
+                f"known: {sorted(table)} (or set tpu.topology explicitly)"
+            )
+        return table[self.chips]
+
+    @property
+    def hosts(self) -> int:
+        per = CHIPS_PER_HOST[self.accelerator]
+        return max(1, -(-self.chips // per))
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.chips, CHIPS_PER_HOST[self.accelerator])
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def gke_accelerator(self) -> str:
+        return {
+            "v5e": "tpu-v5-lite-podslice",
+            "v5p": "tpu-v5p-slice",
+            "v6e": "tpu-v6e-slice",
+        }[self.accelerator]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingSpec:
+    tp: int = 0        # 0 => all chips on the tensor axis
+    ep: int = 1
+    data: int = 1
+
+    def resolve(self, chips: int) -> "ShardingSpec":
+        tp = self.tp or chips // (self.ep * self.data)
+        if tp * self.ep * self.data != chips:
+            raise SpecError(
+                f"sharding tp={tp} x ep={self.ep} x data={self.data} != "
+                f"{chips} chips"
+            )
+        return ShardingSpec(tp=tp, ep=self.ep, data=self.data)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    model_name: str
+    huggingface_id: Optional[str] = None
+    model_path: Optional[str] = None       # local path (ramalama-equivalent)
+    replicas: int = 1
+    pvc_size: str = "30Gi"
+    pvc_shared: bool = False               # ReadOnlyMany cache (fixes the
+                                           # reference's RWO x replicas
+                                           # deadlock, SURVEY §5 Checkpoint)
+    tpu: Optional[TPUSpec] = dataclasses.field(default_factory=TPUSpec)
+    sharding: ShardingSpec = dataclasses.field(default_factory=ShardingSpec)
+    quantization: Optional[str] = None     # None | int8
+    max_model_len: int = 4096
+    engine_args: tuple[str, ...] = ()      # passthrough (reference gap)
+
+    def validate(self) -> None:
+        if not _NAME_RE.match(self.model_name):
+            raise SpecError(
+                f"modelName {self.model_name!r} must be a DNS-1123 label"
+            )
+        if not (self.huggingface_id or self.model_path):
+            raise SpecError(
+                f"model {self.model_name}: need huggingfaceId or modelPath"
+            )
+        if self.replicas < 1:
+            raise SpecError(f"model {self.model_name}: replicas must be >= 1")
+        if self.quantization not in (None, "int8"):
+            raise SpecError(
+                f"model {self.model_name}: unknown quantization "
+                f"{self.quantization!r}"
+            )
+        if self.tpu is not None:
+            if self.tpu.accelerator not in CHIPS_PER_HOST:
+                raise SpecError(
+                    f"model {self.model_name}: unknown accelerator "
+                    f"{self.tpu.accelerator!r} (known: {sorted(CHIPS_PER_HOST)})"
+                )
+            self.tpu.resolved_topology()
+            self.sharding.resolve(self.tpu.chips)
+        if self.replicas > 1 and not self.pvc_shared and self.huggingface_id:
+            raise SpecError(
+                f"model {self.model_name}: replicas={self.replicas} with a "
+                f"ReadWriteOnce cache PVC deadlocks on volume attach; set "
+                f"pvcShared: true (ReadOnlyMany) or replicas: 1"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class DeploySpec:
+    models: tuple[ModelSpec, ...]
+    namespace: str = "tpu-models"
+    image: str = "llms-on-kubernetes-tpu:latest"
+    image_pull_policy: str = "IfNotPresent"
+    storage_class: Optional[str] = None
+    default_model: Optional[str] = None    # router fallback; first if None
+    strict_routing: bool = False           # 404 unknown models (reference
+                                           # silently fell back, SURVEY §3.1)
+    native_router: bool = True             # C++ router image vs python
+    webui_enabled: bool = True
+    webui_name: str = "TPU Multi-Model WebUI"
+    hf_secret_name: str = "huggingface-token"
+    host_model_path: Optional[str] = None  # local path mount (CPU profile)
+
+    def validate(self) -> None:
+        if not self.models:
+            raise SpecError("at least one model is required")
+        names = [m.model_name for m in self.models]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise SpecError(f"duplicate modelName(s): {sorted(dupes)}")
+        for m in self.models:
+            m.validate()
+        if self.default_model is not None and self.default_model not in names:
+            raise SpecError(
+                f"defaultModel {self.default_model!r} is not in models[] "
+                f"({names})"
+            )
+
+    @property
+    def resolved_default(self) -> str:
+        return self.default_model or self.models[0].model_name
+
+
+# ---------------------------------------------------------------------------
+# YAML / dict loading (the values.yaml surface)
+# ---------------------------------------------------------------------------
+
+def _tpu_from(d: Optional[dict]) -> Optional[TPUSpec]:
+    if d is None:
+        return None
+    unknown = set(d) - {"accelerator", "chips", "topology"}
+    if unknown:
+        raise SpecError(f"unknown tpu keys: {sorted(unknown)}")
+    return TPUSpec(
+        accelerator=d.get("accelerator", "v5e"),
+        chips=int(d.get("chips", 8)),
+        topology=d.get("topology"),
+    )
+
+
+def _model_from(d: dict) -> ModelSpec:
+    known = {
+        "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
+        "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
+        "engineArgs",
+    }
+    unknown = set(d) - known
+    if unknown:
+        raise SpecError(
+            f"unknown model keys: {sorted(unknown)} (known: {sorted(known)})"
+        )
+    sh = d.get("sharding") or {}
+    return ModelSpec(
+        model_name=d.get("modelName", ""),
+        huggingface_id=d.get("huggingfaceId"),
+        model_path=d.get("modelPath"),
+        replicas=int(d.get("replicas", 1)),
+        pvc_size=str(d.get("pvcSize", "30Gi")),
+        pvc_shared=bool(d.get("pvcShared", False)),
+        tpu=_tpu_from(d["tpu"]) if "tpu" in d else TPUSpec(),
+        sharding=ShardingSpec(
+            tp=int(sh.get("tp", 0)), ep=int(sh.get("ep", 1)),
+            data=int(sh.get("data", 1)),
+        ),
+        quantization=d.get("quantization"),
+        max_model_len=int(d.get("maxModelLen", 4096)),
+        engine_args=tuple(d.get("engineArgs", ())),
+    )
+
+
+def load_spec(source: "str | dict") -> DeploySpec:
+    """Load + validate a DeploySpec from a YAML path/string or a dict."""
+    import yaml
+
+    if isinstance(source, str):
+        if "\n" not in source and source.endswith((".yaml", ".yml")):
+            with open(source) as f:
+                data = yaml.safe_load(f)
+        else:
+            data = yaml.safe_load(source)
+    else:
+        data = source
+    if not isinstance(data, dict):
+        raise SpecError("config must be a mapping")
+
+    models = tuple(_model_from(m) for m in data.get("models", ()))
+    webui = data.get("webui", {}) or {}
+    image = data.get("image", {}) or {}
+    if isinstance(image, dict):
+        repo = image.get("repository", "llms-on-kubernetes-tpu")
+        tag = image.get("tag", "latest")
+        image_str = f"{repo}:{tag}"
+        pull = image.get("pullPolicy", "IfNotPresent")
+    else:
+        image_str, pull = str(image), "IfNotPresent"
+    spec = DeploySpec(
+        models=models,
+        namespace=data.get("namespace", "tpu-models"),
+        image=image_str,
+        image_pull_policy=pull,
+        storage_class=(data.get("storage") or {}).get("className"),
+        default_model=(data.get("router") or {}).get("defaultModel"),
+        strict_routing=bool((data.get("router") or {}).get("strict", False)),
+        native_router=bool((data.get("router") or {}).get("native", True)),
+        webui_enabled=bool(webui.get("enabled", True)),
+        webui_name=webui.get("name", "TPU Multi-Model WebUI"),
+        hf_secret_name=data.get("hfSecretName", "huggingface-token"),
+        host_model_path=data.get("hostModelPath"),
+    )
+    spec.validate()
+    return spec

@@ -127,11 +127,13 @@ class Engine:
         mesh=None,
         model_dir: Optional[str] = None,
     ):
+        from llms_on_kubernetes_tpu.ops.quant import SUPPORTED_QUANTIZATIONS
+
         self.config = engine_config
-        if engine_config.quantization not in (None, "int8"):
+        if engine_config.quantization not in SUPPORTED_QUANTIZATIONS:
             raise ValueError(
                 f"unknown quantization {engine_config.quantization!r} "
-                f"(supported: int8)"
+                f"(supported: {[q for q in SUPPORTED_QUANTIZATIONS if q]})"
             )
         self.model_config = model_config or get_config(engine_config.model)
         cfg = self.model_config

@@ -161,12 +161,15 @@ def load_hf_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = fetch.linear("lm_head.weight").astype(dt)
 
-    if quantization == "int8":
-        from llms_on_kubernetes_tpu.ops.quant import quantize_params
+    from llms_on_kubernetes_tpu.ops.quant import SUPPORTED_QUANTIZATIONS, quantize_params
 
+    if quantization not in SUPPORTED_QUANTIZATIONS:
+        raise ValueError(
+            f"unknown quantization {quantization!r} "
+            f"(supported: {[q for q in SUPPORTED_QUANTIZATIONS if q]})"
+        )
+    if quantization == "int8":
         params = quantize_params(params)
-    elif quantization is not None:
-        raise ValueError(f"unknown quantization {quantization!r} (supported: int8)")
 
     if mesh is not None:
         from llms_on_kubernetes_tpu.parallel.sharding import shard_params

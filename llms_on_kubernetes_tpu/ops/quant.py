@@ -24,6 +24,7 @@ channel (relative error <= 1/254 per weight).
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -97,6 +98,8 @@ def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
 # Whole-model quantization
 # ---------------------------------------------------------------------------
 
+SUPPORTED_QUANTIZATIONS = (None, "int8")
+
 # Weight name -> contraction (input) axes of the PER-LAYER slice, offset by
 # +1 for the stacked layer axis. wq [L, D, H, hd] contracts over D -> (1,).
 _LAYER_REDUCE_AXES = {
@@ -104,6 +107,17 @@ _LAYER_REDUCE_AXES = {
     "wo": (1, 2),                 # [L, H, hd, D] contracts over (H, hd)
     "w_gate": None, "w_up": None, "w_down": None,  # shape-dependent (MoE)
 }
+
+
+def reduce_axes_for(name: str, ndim: int) -> tuple[int, ...]:
+    """Contraction axes for a stacked weight — single source of the
+    quantization-axis policy (used by quantize_params and the benchmark
+    param generator alike)."""
+    axes = _LAYER_REDUCE_AXES[name]
+    if axes is not None:
+        return axes
+    # mlp weights: MoE [L, E, in, out]-style contracts dim 2, dense dim 1
+    return (2,) if ndim == 4 else (1,)
 
 
 def quantize_params(params: Params) -> Params:
@@ -116,17 +130,64 @@ def quantize_params(params: Params) -> Params:
     """
     out = dict(params)
     layers = dict(params["layers"])
-    for name in ("wq", "wk", "wv", "wo"):
-        if name in layers:
-            layers[name] = quantize(layers[name], _LAYER_REDUCE_AXES[name])
-    for name in ("w_gate", "w_up", "w_down"):
+    for name in _LAYER_REDUCE_AXES:
         w = layers.get(name)
         if w is None or isinstance(w, QTensor):
             continue
-        if w.ndim == 4:   # MoE: [L, E, D, F] / [L, E, F, D] — contract dim 2
-            layers[name] = quantize(w, (2,))
-        else:             # dense: [L, D, F] / [L, F, D] — contract dim 1
-            layers[name] = quantize(w, (1,))
+        layers[name] = quantize(w, reduce_axes_for(name, w.ndim))
+    out["layers"] = layers
+    return out
+
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _pattern(shape, dtype, seed: int):
+    """Cheap pseudo-random fill: fused iota -> hash -> cast, so only the
+    final dtype ever materializes (an 8B model's int8 weights build in
+    milliseconds with ~zero temp HBM — real RNG over a device tunnel took
+    minutes and doubled peak memory)."""
+    n = 1
+    for s in shape:
+        n *= s
+    x = jax.lax.iota(jnp.uint32, n) * jnp.uint32(2654435761) + jnp.uint32(seed)
+    x = (x >> 8) % 255  # [0, 255)
+    if jnp.dtype(dtype) == jnp.int8:
+        return (x.astype(jnp.int32) - 127).astype(jnp.int8).reshape(shape)
+    return ((x.astype(jnp.float32) / 127.0 - 1.0) * 0.02).astype(dtype).reshape(shape)
+
+
+def random_quantized_params(cfg, key: jax.Array) -> Params:
+    """Pseudo-random already-int8 params for big-model compile checks and
+    weight-streaming benchmarks (values don't matter, shapes/dtypes do).
+
+    Never materializes a full-precision weight: matmul weights are generated
+    directly as int8 (+ constant scales), so Llama-3-8B fits a single 16 GB
+    v5e chip (~9 GB) — the configuration the BASELINE north star benches.
+    """
+    from llms_on_kubernetes_tpu.models.decoder import init_params
+
+    del key  # deterministic pattern fill; kept for API symmetry
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k), jax.random.key(0))
+    quant_names = set(_LAYER_REDUCE_AXES)
+    seed = iter(range(1, 256))
+    out: Params = {}
+    for section, val in shapes.items():
+        if section != "layers":
+            out[section] = _pattern(val.shape, val.dtype, next(seed))
+    layers: Params = {}
+    for name, leaf in shapes["layers"].items():
+        if name in quant_names:
+            data = _pattern(leaf.shape, jnp.int8, next(seed))
+            axes = reduce_axes_for(name, len(leaf.shape))
+            # keep leading (layer-stack) axis and out channels in the scale
+            sshape = tuple(1 if i in axes else s
+                           for i, s in enumerate(leaf.shape))
+            layers[name] = QTensor(data, jnp.full(sshape, 1e-3, jnp.float32))
+        elif name in ("attn_norm", "mlp_norm", "q_norm", "k_norm",
+                      "attn_post_norm", "mlp_post_norm", "final_norm"):
+            layers[name] = jnp.ones(leaf.shape, leaf.dtype)
+        else:
+            layers[name] = _pattern(leaf.shape, leaf.dtype, next(seed))
     out["layers"] = layers
     return out
 

@@ -141,9 +141,12 @@ class StopChecker:
         self.emitted = 0
 
     def push(self, delta: str, final: bool = False) -> tuple[str, bool]:
+        prev_len = len(self.text)
         self.text += delta
         for s in self.stops:
-            idx = self.text.find(s)
+            # only the window a NEW match could occupy needs scanning
+            # (earlier positions were covered by previous pushes)
+            idx = self.text.find(s, max(0, prev_len - len(s) + 1))
             if idx != -1:
                 out = self.text[self.emitted:idx]
                 self.emitted = idx
@@ -431,4 +434,7 @@ def run_server(
     port: int = 8080,
 ) -> None:
     server = OpenAIServer(engine, tokenizer, model_name)
-    web.run_app(server.make_app(), host=host, port=port, print=None)
+    # handler_cancellation: client disconnects must cancel non-streaming
+    # handlers so the abort path frees decode slots (aiohttp defaults False)
+    web.run_app(server.make_app(), host=host, port=port, print=None,
+                handler_cancellation=True)

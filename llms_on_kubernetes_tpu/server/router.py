@@ -176,4 +176,5 @@ def run_router(
     port: int = 8080,
 ) -> None:
     router = Router(backends, default_model, strict)
-    web.run_app(router.make_app(), host=host, port=port, print=None)
+    web.run_app(router.make_app(), host=host, port=port, print=None,
+                handler_cancellation=True)

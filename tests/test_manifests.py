@@ -1,0 +1,227 @@
+"""Deploy layer: spec validation + manifest rendering.
+
+The golden-file tests the reference never had for its Helm fan-out
+(SURVEY §4: "manifest golden tests ... the one thing the reference could
+have tested"). Covers the reference's per-model resource fan-out semantics
+plus the TPU-native extensions (topologies, multi-host pod groups) and the
+fixed reference defects (config-hash rollout, RWO x replicas deadlock)."""
+
+import json
+
+import pytest
+import yaml
+
+from llms_on_kubernetes_tpu.deploy.manifests import (
+    config_hash, render_manifests, router_config, to_yaml,
+)
+from llms_on_kubernetes_tpu.deploy.spec import (
+    DeploySpec, ModelSpec, ShardingSpec, SpecError, TPUSpec, load_spec,
+)
+
+BASE_YAML = """
+namespace: tpu-models
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    pvcSize: 40Gi
+    tpu: {accelerator: v5e, chips: 8}
+  - modelName: mistral-7b
+    huggingfaceId: mistralai/Mistral-7B-Instruct-v0.2
+    tpu: {accelerator: v5e, chips: 8}
+router:
+  strict: true
+"""
+
+
+def kinds(manifests, kind):
+    return [m for m in manifests if m["kind"] == kind]
+
+
+def by_name(manifests, kind, name):
+    (m,) = [m for m in kinds(manifests, kind)
+            if m["metadata"]["name"] == name]
+    return m
+
+
+def test_spec_round_trip_and_fanout():
+    spec = load_spec(BASE_YAML)
+    ms = render_manifests(spec)
+    # reference fan-out: per model Deployment + Service + PVC (SURVEY §3.2)
+    assert len(kinds(ms, "Deployment")) == 2 + 1 + 1  # models + router + webui
+    assert {s["metadata"]["name"] for s in kinds(ms, "Service")} >= {
+        "model-llama-3-8b", "model-mistral-7b", "api-gateway", "webui"}
+    assert len(kinds(ms, "PersistentVolumeClaim")) == 3  # 2 caches + webui
+    # every manifest lands in the namespace
+    assert all(m["metadata"]["namespace"] == "tpu-models" for m in ms)
+    # renders to valid multi-doc YAML
+    docs = list(yaml.safe_load_all(to_yaml(ms)))
+    assert len(docs) == len(ms)
+
+
+def test_tpu_scheduling_replaces_gpu():
+    """google.com/tpu + GKE nodeSelectors stand in for the reference's
+    nvidia.com/gpu + taints (model-deployments.yaml:40-44,75-78)."""
+    ms = render_manifests(load_spec(BASE_YAML))
+    dep = by_name(ms, "Deployment", "model-llama-3-8b")
+    pod = dep["spec"]["template"]["spec"]
+    assert pod["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }
+    res = pod["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == "8"
+    assert res["limits"]["google.com/tpu"] == "8"
+    args = pod["containers"][0]["args"]
+    assert "--tensor-parallel-size" in args
+    assert args[args.index("--tensor-parallel-size") + 1] == "8"
+
+
+def test_multi_host_renders_pod_group():
+    """v5p-16 = 4 hosts x 4 chips -> StatefulSet pod group + headless
+    Service + jax.distributed env (the capability gap in SURVEY §2.4)."""
+    spec = load_spec("""
+models:
+  - modelName: llama-3-70b
+    huggingfaceId: meta-llama/Meta-Llama-3-70B-Instruct
+    pvcShared: true
+    tpu: {accelerator: v5p, chips: 16}
+""")
+    ms = render_manifests(spec)
+    sts = by_name(ms, "StatefulSet", "model-llama-3-70b")
+    assert sts["spec"]["replicas"] == 4
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    env = {e["name"]: e.get("value") for e in
+           sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert "model-llama-3-70b-0.model-llama-3-70b-workers" in env["JAX_COORDINATOR_ADDRESS"]
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+    headless = by_name(ms, "Service", "model-llama-3-70b-workers")
+    assert headless["spec"]["clusterIP"] == "None"
+    # the request Service pins to the coordinator pod
+    svc = by_name(ms, "Service", "model-llama-3-70b")
+    assert svc["spec"]["selector"] == {
+        "statefulset.kubernetes.io/pod-name": "model-llama-3-70b-0"}
+    # per-host chip count, not whole-slice
+    res = sts["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == "4"
+
+
+def test_router_semantics_and_config_hash_rollout():
+    spec = load_spec(BASE_YAML)
+    ms = render_manifests(spec)
+    cm = by_name(ms, "ConfigMap", "api-gateway-config")
+    cfg = json.loads(cm["data"]["router.json"])
+    assert cfg["default_model"] == "llama-3-8b"  # first model, like reference
+    assert cfg["strict"] is True
+    assert set(cfg["backends"]) == {"llama-3-8b", "mistral-7b"}
+    assert cfg["backends"]["mistral-7b"] == (
+        "http://model-mistral-7b.tpu-models.svc.cluster.local:8080")
+    # config-hash annotation rolls the router on model changes (SURVEY §3.2
+    # gap: the reference's gateway kept stale routes until restarted)
+    dep = by_name(ms, "Deployment", "api-gateway")
+    h1 = dep["spec"]["template"]["metadata"]["annotations"]["checksum/router-config"]
+    assert h1 == config_hash(spec)
+    spec2 = load_spec(BASE_YAML.replace("mistral-7b", "qwen3-8b"))
+    assert config_hash(spec2) != h1
+
+
+def test_istio_routes_match_reference_shape():
+    ms = render_manifests(load_spec(BASE_YAML))
+    vs = by_name(ms, "VirtualService", "tpu-models-routes")
+    matches = [r["match"][0]["uri"] for r in vs["spec"]["http"]]
+    # 4-route shape of reference gateway.yaml:26-57
+    assert matches == [
+        {"exact": "/v1/models"}, {"prefix": "/v1/"},
+        {"prefix": "/health"}, {"prefix": "/"},
+    ]
+    webui_dst = vs["spec"]["http"][-1]["route"][0]["destination"]["host"]
+    assert webui_dst.startswith("webui.")
+
+
+def test_webui_points_at_router():
+    ms = render_manifests(load_spec(BASE_YAML))
+    dep = by_name(ms, "Deployment", "webui")
+    env = {e["name"]: e["value"] for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["OPENAI_API_BASE_URLS"].endswith("api-gateway.tpu-models.svc.cluster.local:8080/v1")
+    pvc = by_name(ms, "PersistentVolumeClaim", "webui-data")
+    assert pvc["metadata"]["annotations"]["helm.sh/resource-policy"] == "keep"
+
+
+def test_local_cpu_profile_uses_hostpath():
+    """The ramalama-equivalent local path: hostPath weights, no TPU, no PVC
+    (reference ramalama-models/helm-chart values.yaml:26)."""
+    spec = DeploySpec(
+        models=(ModelSpec(model_name="tinyllama", model_path="/mnt/models/tiny",
+                          tpu=None),),
+        host_model_path="/mnt/models", webui_enabled=True,
+    )
+    ms = render_manifests(spec)
+    dep = by_name(ms, "Deployment", "model-tinyllama")
+    pod = dep["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod
+    assert pod["volumes"][0]["hostPath"]["path"] == "/mnt/models"
+    assert "resources" not in pod["containers"][0]
+    assert kinds(ms, "PersistentVolumeClaim") == [
+        by_name(ms, "PersistentVolumeClaim", "webui-data")]
+
+
+def test_validation_errors():
+    with pytest.raises(SpecError, match="DNS-1123"):
+        load_spec("models: [{modelName: 'Bad_Name', huggingfaceId: x}]")
+    with pytest.raises(SpecError, match="duplicate"):
+        load_spec("""
+models:
+  - {modelName: a, huggingfaceId: x}
+  - {modelName: a, huggingfaceId: y}
+""")
+    with pytest.raises(SpecError, match="deadlock"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, replicas: 2}]")
+    # the fix: shared read-only cache allows replicas
+    load_spec("models: [{modelName: a, huggingfaceId: x, replicas: 2, pvcShared: true}]")
+    with pytest.raises(SpecError, match="unknown model keys"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, dnsResolver: z}]")
+    with pytest.raises(SpecError, match="sharding"):
+        ModelSpec(model_name="a", huggingface_id="x",
+                  tpu=TPUSpec(chips=8),
+                  sharding=ShardingSpec(tp=3)).validate()
+    with pytest.raises(SpecError, match="defaultModel"):
+        spec = load_spec(BASE_YAML)
+        DeploySpec(models=spec.models, default_model="nope").validate()
+
+
+def test_sharding_resolution():
+    assert ShardingSpec().resolve(8) == ShardingSpec(tp=8, ep=1, data=1)
+    assert ShardingSpec(ep=8).resolve(16) == ShardingSpec(tp=2, ep=8, data=1)
+    # mixtral EP config from BASELINE.json configs[3]
+    spec = load_spec("""
+models:
+  - modelName: mixtral-8x7b
+    huggingfaceId: mistralai/Mixtral-8x7B-Instruct-v0.1
+    tpu: {accelerator: v5e, chips: 8}
+    sharding: {ep: 8}
+""")
+    args = render_manifests(spec)[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--expert-parallel-size") + 1] == "8"
+    assert args[args.index("--tensor-parallel-size") + 1] == "1"
+
+
+def test_render_cli(tmp_path, capsys):
+    from llms_on_kubernetes_tpu.cli import main
+
+    cfg = tmp_path / "models.yaml"
+    cfg.write_text(BASE_YAML)
+    assert main(["render", "--config", str(cfg)]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    assert any(d["kind"] == "ConfigMap" for d in docs)
+
+
+def test_router_config_matches_python_router():
+    """The rendered router.json drives server/router.py directly."""
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    cfg = router_config(load_spec(BASE_YAML))
+    r = Router(cfg["backends"], cfg["default_model"], cfg["strict"])
+    assert r.select_backend(b'{"model": "mistral-7b"}')[0] == "mistral-7b"
+    name, err = r.select_backend(b'{"model": "nope"}')
+    assert err is not None  # strict
