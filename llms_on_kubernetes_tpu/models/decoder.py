@@ -30,7 +30,9 @@ import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig
 from llms_on_kubernetes_tpu.engine.cache import write_tokens
-from llms_on_kubernetes_tpu.ops.attention import paged_attention, prefill_attention, softcap
+from llms_on_kubernetes_tpu.ops.attention import (
+    dispatch_paged_attention, dispatch_prefill_attention, softcap,
+)
 from llms_on_kubernetes_tpu.ops.moe import moe_block
 from llms_on_kubernetes_tpu.ops.norms import rms_norm
 from llms_on_kubernetes_tpu.ops.quant import qeinsum
@@ -167,13 +169,13 @@ def _layer_step(
     k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, write_positions)
 
     if mode == "prefill":
-        attn = prefill_attention(
+        attn = dispatch_prefill_attention(
             q, k, v, lengths,
             scale=scale, sliding_window=window,
             attn_softcap=cfg.attn_softcap,
         )
     else:
-        attn = paged_attention(
+        attn = dispatch_paged_attention(
             q[:, 0], k_pages, v_pages, page_table, lengths,
             scale=scale, sliding_window=window,
             attn_softcap=cfg.attn_softcap,

@@ -17,11 +17,31 @@ Layout choices (TPU-first):
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
+
+
+def use_pallas_kernels() -> bool:
+    """Kernel selection: LLMK_ATTENTION_IMPL = pallas | xla | auto.
+
+    auto (default) picks the Pallas kernels on TPU and the XLA reference
+    path everywhere else (CPU tests, local/ramalama-equivalent serving).
+    """
+    impl = os.environ.get("LLMK_ATTENTION_IMPL", "auto")
+    if impl == "pallas":
+        return True
+    if impl == "xla":
+        return False
+    if impl != "auto":
+        raise ValueError(
+            f"LLMK_ATTENTION_IMPL={impl!r} is not one of pallas|xla|auto"
+        )
+    return jax.default_backend() == "tpu"
 
 
 def softcap(logits: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
@@ -126,3 +146,45 @@ def paged_attention(
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
     return out.reshape(B, n_q, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dispatchers (what the decoder calls)
+# ---------------------------------------------------------------------------
+
+def _static_window(w) -> bool:
+    # Gemma-style interleaved layers trace the window as a scalar inside
+    # lax.scan; the Pallas kernels need it static -> fall back to XLA there.
+    return w is None or isinstance(w, int)
+
+
+def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
+                               attn_softcap=None):
+    if use_pallas_kernels() and _static_window(sliding_window):
+        from llms_on_kubernetes_tpu.ops.pallas_flash import BLOCK_Q, flash_prefill_attention
+
+        T = q.shape[1]
+        if T % min(BLOCK_Q, T) == 0:
+            return flash_prefill_attention(
+                q, k, v, lengths, scale=scale,
+                sliding_window=sliding_window, attn_softcap=attn_softcap,
+                interpret=jax.default_backend() == "cpu",
+            )
+    return prefill_attention(q, k, v, lengths, scale=scale,
+                             sliding_window=sliding_window,
+                             attn_softcap=attn_softcap)
+
+
+def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
+                             scale, sliding_window=None, attn_softcap=None):
+    if use_pallas_kernels() and _static_window(sliding_window):
+        from llms_on_kubernetes_tpu.ops.pallas_paged import pallas_paged_attention
+
+        return pallas_paged_attention(
+            q, k_pages, v_pages, page_table, lengths, scale=scale,
+            sliding_window=sliding_window, attn_softcap=attn_softcap,
+            interpret=jax.default_backend() == "cpu",
+        )
+    return paged_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=scale, sliding_window=sliding_window,
+                           attn_softcap=attn_softcap)

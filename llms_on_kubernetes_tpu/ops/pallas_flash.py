@@ -1,0 +1,119 @@
+"""Pallas blockwise (flash) prefill attention.
+
+The TPU-native replacement for the flash-attention CUDA kernels the
+reference pulled inside the vLLM image (SURVEY §2.3 row 1). Semantics match
+``ops/attention.py::prefill_attention`` (the XLA reference implementation)
+and are pinned by tests/test_pallas.py.
+
+Kernel shape (v1):
+- grid = (B, n_q_heads, T // BLOCK_Q); each program owns one query block of
+  one head and streams the head's full K/V through VMEM (prefill buckets
+  are <= a few K tokens, so K/V fit VMEM comfortably: T=4096, d=128, bf16
+  -> 1 MB each). Logits never touch HBM — the [T, T] score matrix the XLA
+  path materializes per head stays in VMEM one [BLOCK_Q, T] tile at a time.
+- GQA via the index map: query head h reads kv head h // group, so the MXU
+  sees per-head [BLOCK_Q, d] x [d, T] matmuls and K/V are fetched once per
+  q-block, not repeated per query head in HBM.
+- Masking (causal + pad-length + optional sliding window) is additive in
+  f32; softmax in f32 (same numerics policy as the reference impl).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
+BLOCK_Q = 128
+
+
+def _flash_kernel(
+    lengths_ref,   # SMEM [1, 1] — this batch row's true length
+    q_ref,         # VMEM [1, BLOCK_Q, 1, d]
+    k_ref,         # VMEM [1, T, 1, d]
+    v_ref,         # VMEM [1, T, 1, d]
+    o_ref,         # VMEM [1, BLOCK_Q, 1, d]
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    attn_softcap: Optional[float],
+    block_q: int,
+):
+    qi = pl.program_id(2)
+    T = k_ref.shape[1]
+    length = lengths_ref[0, 0]
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [Bq, d]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [T, d]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [T, d]
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [Bq, T]
+    logits = softcap(logits, attn_softcap)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, T), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 1)
+    mask = (k_pos <= q_pos) & (k_pos < length)
+    if sliding_window is not None:
+        mask &= k_pos > q_pos - sliding_window
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / denom
+    o_ref[0, :, 0, :] = o.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
+)
+def flash_prefill_attention(
+    q: jnp.ndarray,           # [B, T, n_q, d]
+    k: jnp.ndarray,           # [B, T, n_kv, d]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,     # [B] int32
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, T, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    block_q = min(BLOCK_Q, T)
+    assert T % block_q == 0, f"prefill bucket {T} not a multiple of {block_q}"
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale, sliding_window=sliding_window,
+        attn_softcap=attn_softcap, block_q=block_q,
+    )
+    grid = (B, n_q, T // block_q)
+    lengths2d = lengths.reshape(B, 1).astype(jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, 1, d), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, T, 1, d), lambda b, h, i: (b, 0, h // group, 0)),
+            pl.BlockSpec((1, T, 1, d), lambda b, h, i: (b, 0, h // group, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, d), lambda b, h, i: (b, i, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, T, n_q, d), q.dtype),
+        interpret=interpret,
+    )(lengths2d, q, k, v)

@@ -1,0 +1,159 @@
+"""Pallas paged decode attention.
+
+The TPU-native replacement for vLLM's PagedAttention CUDA kernel (SURVEY
+§2.3 row 1; §7 hard-part 1). Semantics match
+``ops/attention.py::paged_attention`` (the XLA reference) and are pinned by
+tests/test_pallas.py.
+
+Why a kernel at all: the XLA path materializes every slot's logical KV
+([B, S_max, n_kv, d]) in HBM via gather before the matmul — decode reads
+the KV pool twice (gather write + matmul read). This kernel DMAs each
+slot's pages HBM→VMEM once and attends in-place:
+
+- ``PrefetchScalarGridSpec`` prefetches the page table and lengths into
+  SMEM so DMA source addresses are computable before the body runs.
+- grid = (B, n_kv); each program owns one slot x one kv head: it issues
+  one async DMA per page (unused table entries point at the reserved
+  trash page 0 — uniform DMA pattern, garbage masked out), waits once,
+  then computes the whole group's attention with two MXU matmuls
+  ([group, d] x [d, S] and [group, S] x [S, d]) in f32.
+- K/V stream through VMEM scratch ([S_max, d] each: 32 pages x 64 x 128
+  x bf16 = 512 KB — well under the ~16 MB budget).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
+
+
+def _paged_kernel(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    lengths_ref,      # SMEM [B]                (scalar prefetch)
+    q_ref,            # VMEM [1, group, d]
+    k_hbm,            # ANY  [P, page, n_kv, d]
+    v_hbm,            # ANY  [P, page, n_kv, d]
+    o_ref,            # VMEM [1, group, d]
+    k_buf,            # VMEM [S, d] scratch
+    v_buf,            # VMEM [S, d] scratch
+    sems,             # DMA semaphores [2, pages_per_seq]
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    attn_softcap: Optional[float],
+    page_size: int,
+    pages_per_seq: int,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    S = pages_per_seq * page_size
+    length = lengths_ref[b]
+
+    # one DMA per page per K/V; trash-page entries keep the pattern uniform
+    for i in range(pages_per_seq):
+        page_id = page_table_ref[b, i]
+        pltpu.make_async_copy(
+            k_hbm.at[page_id, :, h, :],
+            k_buf.at[pl.ds(i * page_size, page_size), :],
+            sems.at[0, i],
+        ).start()
+        pltpu.make_async_copy(
+            v_hbm.at[page_id, :, h, :],
+            v_buf.at[pl.ds(i * page_size, page_size), :],
+            sems.at[1, i],
+        ).start()
+    for i in range(pages_per_seq):
+        pltpu.make_async_copy(
+            k_hbm.at[page_table_ref[b, i], :, h, :],
+            k_buf.at[pl.ds(i * page_size, page_size), :],
+            sems.at[0, i],
+        ).wait()
+        pltpu.make_async_copy(
+            v_hbm.at[page_table_ref[b, i], :, h, :],
+            v_buf.at[pl.ds(i * page_size, page_size), :],
+            sems.at[1, i],
+        ).wait()
+
+    q = q_ref[0].astype(jnp.float32)                   # [group, d]
+    k = k_buf[:].astype(jnp.float32)                   # [S, d]
+    v = v_buf[:].astype(jnp.float32)
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [group, S]
+    logits = softcap(logits, attn_softcap)
+
+    group = q.shape[0]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (group, S), 1)
+    mask = k_pos < length
+    if sliding_window is not None:
+        mask &= k_pos > (length - 1) - sliding_window
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / denom
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
+)
+def pallas_paged_attention(
+    q: jnp.ndarray,            # [B, n_q, d]
+    k_pages: jnp.ndarray,      # [P, page, n_kv, d]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    lengths: jnp.ndarray,      # [B] int32 (incl. current token)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, n_q, d = q.shape
+    P, page_size, n_kv, _ = k_pages.shape
+    pages_per_seq = page_table.shape[1]
+    S = pages_per_seq * page_size
+    group = n_q // n_kv
+
+    kernel = functools.partial(
+        _paged_kernel,
+        scale=scale, sliding_window=sliding_window,
+        attn_softcap=attn_softcap,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, group, d), lambda b, h, *_: (b, h, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), lambda b, h, *_: (b, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((S, d), k_pages.dtype),
+            pltpu.VMEM((S, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_seq)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_q, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
