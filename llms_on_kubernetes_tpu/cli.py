@@ -104,6 +104,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     # serve
+    from llms_on_kubernetes_tpu.parallel.distributed import maybe_initialize
+
+    multi_host = maybe_initialize()  # join the pod group BEFORE backend init
+
     import jax
 
     from llms_on_kubernetes_tpu.configs import REGISTRY, from_hf_config, get_config
@@ -152,13 +156,28 @@ def main(argv: list[str] | None = None) -> int:
         pages_per_slot=args.pages_per_slot,
         prefill_buckets=tuple(int(x) for x in args.prefill_buckets.split(",")),
         quantization=args.quantization,
+        # only the coordinator schedules; its engine broadcasts step inputs
+        multihost=multi_host,
     )
     engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
                     model_dir=None if args.random_weights else model_dir)
     tokenizer = load_tokenizer(model_dir)
     served = args.served_model_name or model_cfg.name
     print(f"[serve] {served}: mesh={dict(mesh.shape)} dtype={args.dtype} "
-          f"max_len={engine_cfg.max_model_len}", file=sys.stderr)
+          f"max_len={engine_cfg.max_model_len} multi_host={multi_host}",
+          file=sys.stderr)
+    if multi_host:
+        from llms_on_kubernetes_tpu.engine.multihost import follower_loop
+        from llms_on_kubernetes_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            # followers never serve HTTP: they mirror the coordinator's
+            # broadcast step sequence so every process enters the same
+            # SPMD programs (engine/multihost.py)
+            print("[serve] follower pod: entering SPMD mirror loop",
+                  file=sys.stderr)
+            follower_loop(engine)
+            return 0
     run_server(engine, tokenizer, served, host=args.host, port=args.port)
     return 0
 

@@ -64,6 +64,9 @@ class EngineConfig:
     pages_per_slot: int = 32
     prefill_buckets: tuple[int, ...] = (64, 256, 1024)
     quantization: Optional[str] = None  # None | "int8" (weight-only)
+    # multi-host pod group: coordinator broadcasts each step's inputs so
+    # follower processes enter the same SPMD programs (engine/multihost.py)
+    multihost: bool = False
     seed: int = 0
 
     @property
@@ -272,6 +275,36 @@ class Engine:
     def _next_key(self) -> jax.Array:
         return jax.random.fold_in(self._key, next(self._step_counter))
 
+    def _run_device_step(self, op: int, fn, tokens: np.ndarray,
+                         lengths: np.ndarray, page_table: np.ndarray,
+                         temps: np.ndarray, top_ks: np.ndarray,
+                         top_ps: np.ndarray):
+        """Enter a jitted step — after broadcasting its inputs to follower
+        processes when this engine coordinates a multi-host pod group."""
+        step = next(self._step_counter)
+        key = jax.random.fold_in(self._key, step)
+        if self.config.multihost:
+            from llms_on_kubernetes_tpu.engine import multihost as mh
+
+            bucket = tokens.shape[1] if tokens.ndim == 2 else 0
+            mh.broadcast_header(op, bucket, tokens.shape[0])
+            mh.broadcast_payload(
+                {"tokens": np.asarray(tokens, np.int32),
+                 "lengths": np.asarray(lengths, np.int32),
+                 "page_table": np.asarray(page_table, np.int32),
+                 "temps": np.asarray(temps, np.float32),
+                 "top_ks": np.asarray(top_ks, np.int32),
+                 "top_ps": np.asarray(top_ps, np.float32),
+                 "step": np.asarray(step, np.int64)},
+                op, bucket, tokens.shape[0], self.config.pages_per_slot,
+            )
+        return fn(
+            self.params, self.model_config, jnp.asarray(tokens),
+            jnp.asarray(lengths), self.k_pages, self.v_pages,
+            jnp.asarray(page_table), key, jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps),
+        )
+
     def _free_slot(self) -> Optional[int]:
         for i, r in enumerate(self.slots):
             if r is None:
@@ -319,15 +352,16 @@ class Engine:
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prefill_tokens
-        page_table = jnp.asarray(self.allocator.page_tables[slot:slot + 1])
-        temps = jnp.asarray([req.params.temperature], jnp.float32)
-        top_ks = jnp.asarray([req.params.top_k], jnp.int32)
-        top_ps = jnp.asarray([req.params.top_p], jnp.float32)
 
-        toks, _lps, self.k_pages, self.v_pages = self._prefill(
-            self.params, self.model_config, jnp.asarray(tokens),
-            jnp.asarray([n], jnp.int32), self.k_pages, self.v_pages,
-            page_table, self._next_key(), temps, top_ks, top_ps,
+        from llms_on_kubernetes_tpu.engine.multihost import OP_PREFILL
+
+        toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
+            OP_PREFILL, self._prefill, tokens,
+            np.asarray([n], np.int32),
+            self.allocator.page_tables[slot:slot + 1],
+            np.asarray([req.params.temperature], np.float32),
+            np.asarray([req.params.top_k], np.int32),
+            np.asarray([req.params.top_p], np.float32),
         )
         self.slot_len[slot] = n
         if resumed:
@@ -413,12 +447,11 @@ class Engine:
             top_ks[i] = r.params.top_k
             top_ps[i] = r.params.top_p
 
-        toks, _lps, self.k_pages, self.v_pages = self._decode(
-            self.params, self.model_config, jnp.asarray(tokens),
-            jnp.asarray(lengths), self.k_pages, self.v_pages,
-            jnp.asarray(self.allocator.page_tables),
-            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+        from llms_on_kubernetes_tpu.engine.multihost import OP_DECODE
+
+        toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
+            OP_DECODE, self._decode, tokens, lengths,
+            self.allocator.page_tables, temps, top_ks, top_ps,
         )
         sampled = np.asarray(toks)
 

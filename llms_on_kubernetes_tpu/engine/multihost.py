@@ -1,0 +1,116 @@
+"""Multi-host engine stepping: one scheduler, N SPMD participants.
+
+In multi-controller JAX every process must enter the same jitted
+computation for its collectives to match (the partitioner's ICI
+all-reduces span all hosts). So the coordinator (pod 0) cannot just run
+``Engine.step()`` by itself while followers idle — followers would never
+enter the program and the slice would deadlock.
+
+Protocol (the TPU-native stand-in for the reference's NCCL rendezvous,
+SURVEY §2.4 / §5 "Distributed communication backend"):
+
+- The scheduler (admission, page allocation, sampling-parameter tables)
+  runs ONLY on the coordinator; it is plain host Python.
+- Before every device step, the coordinator broadcasts a fixed-size int32
+  HEADER [op, bucket, batch] then the step's host inputs; followers mirror
+  the broadcast, materialize the same global arrays, and enter the same
+  jitted function. Payload shapes are derivable from the header alone, so
+  followers never need scheduler state.
+- op codes: 0 = idle tick (followers wait again), 1 = prefill(bucket),
+  2 = decode, 3 = shutdown.
+
+``multihost_utils.broadcast_one_to_all`` carries the payload (psum under
+the hood over DCN/ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+OP_IDLE = 0
+OP_PREFILL = 1
+OP_DECODE = 2
+OP_SHUTDOWN = 3
+
+HEADER_LEN = 3  # [op, bucket, batch]
+
+
+def _broadcast(value):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def broadcast_header(op: int, bucket: int = 0, batch: int = 0) -> np.ndarray:
+    hdr = np.asarray([op, bucket, batch], np.int32)
+    return np.asarray(_broadcast(hdr))
+
+
+def _payload_struct(op: int, bucket: int, batch: int, pages_per_seq: int):
+    """Shapes of the host-side step inputs, derivable from the header."""
+    if op == OP_PREFILL:
+        return {
+            "tokens": np.zeros((batch, bucket), np.int32),
+            "lengths": np.zeros((batch,), np.int32),
+            "page_table": np.zeros((batch, pages_per_seq), np.int32),
+            "temps": np.zeros((batch,), np.float32),
+            "top_ks": np.zeros((batch,), np.int32),
+            "top_ps": np.zeros((batch,), np.float32),
+            "step": np.zeros((), np.int64),
+        }
+    if op == OP_DECODE:
+        return {
+            "tokens": np.zeros((batch,), np.int32),
+            "lengths": np.zeros((batch,), np.int32),
+            "page_table": np.zeros((batch, pages_per_seq), np.int32),
+            "temps": np.zeros((batch,), np.float32),
+            "top_ks": np.zeros((batch,), np.int32),
+            "top_ps": np.zeros((batch,), np.float32),
+            "step": np.zeros((), np.int64),
+        }
+    raise ValueError(f"op {op} carries no payload")
+
+
+def broadcast_payload(payload: Optional[dict], op: int, bucket: int,
+                      batch: int, pages_per_seq: int) -> dict:
+    """Coordinator passes the real payload; followers pass None and get the
+    coordinator's values back (broadcast ignores non-zero-process input)."""
+    if payload is None:
+        payload = _payload_struct(op, bucket, batch, pages_per_seq)
+    out = _broadcast(payload)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def follower_loop(engine: Any) -> None:
+    """Run on pods 1..N-1: mirror the coordinator's step sequence forever.
+
+    The engine instance holds the sharded params/cache (global arrays whose
+    addressable shards live on this host's chips) and the same jitted
+    step functions; this loop feeds them the broadcast inputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pps = engine.config.pages_per_slot
+    while True:
+        hdr = broadcast_header(OP_IDLE)  # actually receives coordinator's hdr
+        op, bucket, batch = int(hdr[0]), int(hdr[1]), int(hdr[2])
+        if op == OP_SHUTDOWN:
+            return
+        if op == OP_IDLE:
+            continue
+        p = broadcast_payload(None, op, bucket, batch, pps)
+        key = jax.random.fold_in(engine._key, int(p["step"]))
+        args = (
+            engine.params, engine.model_config, jnp.asarray(p["tokens"]),
+            jnp.asarray(p["lengths"]), engine.k_pages, engine.v_pages,
+            jnp.asarray(p["page_table"]), key,
+            jnp.asarray(p["temps"]), jnp.asarray(p["top_ks"]),
+            jnp.asarray(p["top_ps"]),
+        )
+        if op == OP_PREFILL:
+            _t, _l, engine.k_pages, engine.v_pages = engine._prefill(*args)
+        else:
+            _t, _l, engine.k_pages, engine.v_pages = engine._decode(*args)

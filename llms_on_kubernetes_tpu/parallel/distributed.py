@@ -1,0 +1,73 @@
+"""Multi-host process-group bring-up on Kubernetes.
+
+The reference's distributed story was NCCL inside one pod (SURVEY §2.4);
+multi-host serving did not exist. Here a model larger than one TPU host
+(e.g. Llama-3-70B on v5p-16) runs as a StatefulSet pod group (see
+deploy/manifests.py::render_model_multi_host): every pod runs the same
+engine binary, and this module turns the pod group into one JAX process
+group — after ``jax.distributed.initialize``, ``jax.devices()`` spans the
+whole slice and the SPMD partitioner emits ICI collectives across hosts.
+
+Contract with the manifests (env injected there):
+  JAX_COORDINATOR_ADDRESS  host:port of pod 0 (stable headless-Service DNS)
+  JAX_NUM_PROCESSES        number of slice hosts
+  POD_NAME                 ``<statefulset>-<ordinal>`` — ordinal = process id
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def pod_ordinal(pod_name: str) -> int:
+    """StatefulSet pod name -> stable process index (``model-x-3`` -> 3)."""
+    m = re.search(r"-(\d+)$", pod_name)
+    if not m:
+        raise ValueError(
+            f"POD_NAME {pod_name!r} has no trailing ordinal; multi-host "
+            f"serving requires StatefulSet-style pod names"
+        )
+    return int(m.group(1))
+
+
+def distributed_env() -> Optional[dict]:
+    """Parse the K8s multi-host env contract; None = single-host mode."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
+    if not addr or n <= 1:
+        return None
+    pid_env = os.environ.get("JAX_PROCESS_ID")
+    if pid_env is not None:
+        pid = int(pid_env)
+    else:
+        pid = pod_ordinal(os.environ.get("POD_NAME", ""))
+    if not (0 <= pid < n):
+        raise ValueError(f"process id {pid} out of range for {n} processes")
+    return {"coordinator_address": addr, "num_processes": n, "process_id": pid}
+
+
+def maybe_initialize() -> bool:
+    """Join the pod group's JAX process group if the env says we're one of
+    a multi-host set. Returns True when distributed mode is active.
+
+    Idempotent: safe to call from both the CLI and library entry points.
+    """
+    env = distributed_env()
+    if env is None:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=env["coordinator_address"],
+        num_processes=env["num_processes"],
+        process_id=env["process_id"],
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    """Pod 0 serves HTTP; followers execute the same SPMD programs."""
+    env = distributed_env()
+    return env is None or env["process_id"] == 0

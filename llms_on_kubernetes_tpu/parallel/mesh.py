@@ -26,35 +26,43 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DATA = "data"
+AXIS_SEQ = "seq"        # context/sequence parallelism (ring attention)
 AXIS_EXPERT = "expert"
 AXIS_MODEL = "model"
-MESH_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL)
+MESH_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
 
 
 def make_mesh(
     data: int = 1,
+    seq: int = 1,
     expert: int = 1,
     model: int = -1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, expert, model) mesh.
+    """Build a (data, seq, expert, model) mesh.
 
     ``model=-1`` absorbs all remaining devices (the common serving case:
-    one engine = one slice, fully tensor-parallel).
+    one engine = one slice, fully tensor-parallel). ``seq`` is the ring
+    axis for long-context attention (ops/ring_attention.py); placing it
+    outermost-but-one keeps ring neighbours physically adjacent on the ICI
+    torus within each data replica.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if model == -1:
-        if n % (data * expert) != 0:
-            raise ValueError(f"{n} devices not divisible by data*expert={data * expert}")
-        model = n // (data * expert)
-    need = data * expert * model
+        if n % (data * seq * expert) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by data*seq*expert="
+                f"{data * seq * expert}")
+        model = n // (data * seq * expert)
+    need = data * seq * expert * model
     if need > n:
-        raise ValueError(f"mesh {data}x{expert}x{model} needs {need} devices, have {n}")
-    arr = np.asarray(devices[:need]).reshape(data, expert, model)
+        raise ValueError(
+            f"mesh {data}x{seq}x{expert}x{model} needs {need} devices, have {n}")
+    arr = np.asarray(devices[:need]).reshape(data, seq, expert, model)
     return Mesh(arr, MESH_AXES)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     d = device or jax.devices()[0]
-    return Mesh(np.asarray([d]).reshape(1, 1, 1), MESH_AXES)
+    return Mesh(np.asarray([d]).reshape(1, 1, 1, 1), MESH_AXES)

@@ -1,0 +1,133 @@
+"""Ring attention (context parallelism) + multi-host plumbing.
+
+Ring attention runs on a virtual 8-device CPU ring (conftest forces
+xla_force_host_platform_device_count=8) and is pinned against the
+single-device XLA reference — the long-context capability the reference
+stack lacked entirely (SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llms_on_kubernetes_tpu.ops.attention import prefill_attention
+from llms_on_kubernetes_tpu.ops.ring_attention import ring_prefill_attention
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.parametrize("ring", [2, 4, 8])
+def test_ring_matches_reference(rng, ring):
+    B, T, n_q, n_kv, d = 2, 64, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, n_q, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray([T, T - 17], jnp.int32)
+
+    ref = prefill_attention(q, k, v, lengths, scale=d ** -0.5)
+    mesh = make_mesh(seq=ring, model=1)
+    out = ring_prefill_attention(q, k, v, lengths, mesh, scale=d ** -0.5)
+    # padding rows are don't-care; compare valid rows only
+    for b, n in enumerate([T, T - 17]):
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(ref)[b, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ring_softcap_and_window(rng):
+    B, T, n_q, n_kv, d = 1, 32, 2, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, n_q, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray([T], jnp.int32)
+    ref = prefill_attention(q, k, v, lengths, scale=d ** -0.5,
+                            sliding_window=9, attn_softcap=30.0)
+    mesh = make_mesh(seq=4, model=1)
+    out = ring_prefill_attention(q, k, v, lengths, mesh, scale=d ** -0.5,
+                                 sliding_window=9, attn_softcap=30.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_composes_with_tensor_parallel(rng):
+    """seq x model mesh: ring over 4 devices, TP over 2."""
+    B, T, n_q, n_kv, d = 1, 32, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, n_q, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray([T], jnp.int32)
+    ref = prefill_attention(q, k, v, lengths, scale=d ** -0.5)
+    mesh = make_mesh(seq=4, model=2)
+    out = ring_prefill_attention(q, k, v, lengths, mesh, scale=d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# multi-host plumbing (single-process units)
+# ---------------------------------------------------------------------------
+
+def test_pod_ordinal_parsing():
+    from llms_on_kubernetes_tpu.parallel.distributed import pod_ordinal
+
+    assert pod_ordinal("model-llama-3-70b-0") == 0
+    assert pod_ordinal("model-llama-3-70b-13") == 13
+    with pytest.raises(ValueError):
+        pod_ordinal("api-gateway")
+
+
+def test_distributed_env_contract(monkeypatch):
+    from llms_on_kubernetes_tpu.parallel.distributed import (
+        distributed_env, is_coordinator,
+    )
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert distributed_env() is None
+    assert is_coordinator()
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "model-x-0.svc:8476")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("POD_NAME", "model-x-2")
+    env = distributed_env()
+    assert env == {"coordinator_address": "model-x-0.svc:8476",
+                   "num_processes": 4, "process_id": 2}
+    assert not is_coordinator()
+    monkeypatch.setenv("POD_NAME", "model-x-0")
+    assert is_coordinator()
+    monkeypatch.setenv("JAX_PROCESS_ID", "9")
+    with pytest.raises(ValueError, match="out of range"):
+        distributed_env()
+
+
+def test_multihost_payload_struct_roundtrip():
+    """Coordinator payload and follower dummy struct must match exactly —
+    that is the broadcast contract (same pytree, same shapes/dtypes)."""
+    from llms_on_kubernetes_tpu.engine import multihost as mh
+
+    for op, bucket, batch in [(mh.OP_PREFILL, 256, 1), (mh.OP_DECODE, 0, 16)]:
+        follower = mh._payload_struct(op, bucket, batch, pages_per_seq=32)
+        coordinator = {
+            "tokens": np.zeros((batch, bucket) if op == mh.OP_PREFILL
+                               else (batch,), np.int32),
+            "lengths": np.zeros((batch,), np.int32),
+            "page_table": np.zeros((batch, 32), np.int32),
+            "temps": np.zeros((batch,), np.float32),
+            "top_ks": np.zeros((batch,), np.int32),
+            "top_ps": np.zeros((batch,), np.float32),
+            "step": np.asarray(7, np.int64),
+        }
+        assert set(follower) == set(coordinator)
+        for name in follower:
+            assert follower[name].shape == coordinator[name].shape, name
+            assert follower[name].dtype == coordinator[name].dtype, name
+
+
+def test_engine_single_host_unaffected_by_multihost_flag_default():
+    """multihost=False (default) must not touch broadcast machinery."""
+    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=16, num_pages=64, pages_per_slot=8, prefill_buckets=(16,),
+    ))
+    out = eng.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=4))
+    assert len(out) == 4

@@ -71,6 +71,8 @@ def test_sharded_forward_matches_unsharded(name, mesh_dims):
 
 def test_mesh_shapes():
     m = make_mesh(data=2, expert=2, model=2)
-    assert m.shape == {"data": 2, "expert": 2, "model": 2}
+    assert m.shape == {"data": 2, "seq": 1, "expert": 2, "model": 2}
+    m = make_mesh(seq=4, model=2)
+    assert m.shape == {"data": 1, "seq": 4, "expert": 1, "model": 2}
     with pytest.raises(ValueError):
         make_mesh(data=3)
