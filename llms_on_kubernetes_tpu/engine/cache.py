@@ -4,9 +4,13 @@ The reference stack got paged attention from the vLLM image (reference
 SURVEY §2.3); this is the TPU-native equivalent. Design:
 
 - One global page pool per layer, stacked over layers for ``lax.scan``:
-  ``k_pages``/``v_pages`` have shape [L, P, page_size, n_kv, head_dim].
-  n_kv is the sharded axis (mesh "model") so each TP shard holds its own
-  heads' pages — the pool never crosses chips.
+  ``k_pages``/``v_pages`` have shape [L, n_kv, P, page_size, head_dim] —
+  **head-major**, so one (head, page) slice is a contiguous [page, d]
+  block: the Pallas decode kernel DMAs it HBM→VMEM in a single aligned
+  transfer (a head-minor layout puts n_kv in the tiled sublane slot and
+  Mosaic rejects the size-1 slice). n_kv is the sharded axis (mesh
+  "model") so each TP shard holds its own heads' pages — the pool never
+  crosses chips.
 - Physical page 0 is reserved as a trash page: padded prompt positions
   write there, so prefill needs no masking on the scatter path. It is never
   allocated to a sequence and never read (length masks exclude it).
@@ -50,7 +54,7 @@ class CacheConfig:
 
 
 def init_pages(cfg: CacheConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
-    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, cfg.num_pages, cfg.page_size, cfg.head_dim)
     dt = jnp.dtype(cfg.dtype)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
@@ -65,20 +69,23 @@ def write_tokens(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter new KV for one layer into the page pool.
 
-    k_pages/v_pages: [P, page, n_kv, d] (single layer)
+    k_pages/v_pages: [n_kv, P, page, d] (single layer, head-major)
     k, v:            [B, T, n_kv, d]
     page_table:      [B, pages_per_seq] int32
     positions:       [B, T] int32 token positions; negative => trash page 0
     """
-    page = k_pages.shape[1]
+    page = k_pages.shape[2]
     trash = positions < 0
     pos = jnp.where(trash, 0, positions)
     logical_page = pos // page                                   # [B, T]
     page_ids = jnp.take_along_axis(page_table, logical_page, axis=1)
     page_ids = jnp.where(trash, 0, page_ids)
     offs = pos % page
-    k_pages = k_pages.at[page_ids, offs].set(k, mode="drop")
-    v_pages = v_pages.at[page_ids, offs].set(v, mode="drop")
+    # adjacent advanced indices on dims (1, 2): result [n_kv, B, T, d]
+    kh = jnp.moveaxis(k, 2, 0)
+    vh = jnp.moveaxis(v, 2, 0)
+    k_pages = k_pages.at[:, page_ids, offs].set(kh, mode="drop")
+    v_pages = v_pages.at[:, page_ids, offs].set(vh, mode="drop")
     return k_pages, v_pages
 
 

@@ -148,7 +148,7 @@ def _layer_step(
     mode: str,                     # "prefill" | "decode"
     x: jnp.ndarray,                # [B, T, D]
     lp: Params,
-    k_pages: jnp.ndarray,          # [P, page, KV, hd]
+    k_pages: jnp.ndarray,          # [KV, P, page, hd] (head-major)
     v_pages: jnp.ndarray,
     layer_idx: "jnp.ndarray | None" = None,
     inv_freq_local: "jnp.ndarray | None" = None,
@@ -197,7 +197,7 @@ def _run_layers(
     cfg: ModelConfig,
     params: Params,
     x: jnp.ndarray,
-    k_pages: jnp.ndarray,          # [L, P, page, KV, hd]
+    k_pages: jnp.ndarray,          # [L, KV, P, page, hd]
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     positions: jnp.ndarray,
@@ -250,7 +250,7 @@ def forward_prefill(
     cfg: ModelConfig,
     tokens: jnp.ndarray,      # [B, T] padded prompt bucket
     lengths: jnp.ndarray,     # [B] true lengths (<= T); 0 => inactive row
-    k_pages: jnp.ndarray,     # [L, P, page, KV, hd]
+    k_pages: jnp.ndarray,     # [L, KV, P, page, hd]
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, pages_per_seq]
 ):

@@ -111,28 +111,28 @@ def paged_attention(
     """Single-token decode attention against the paged KV cache.
 
     q:          [B, n_q, d]       — one new token per active slot
-    k_pages:    [P, page, n_kv, d] — global page pool (this layer)
-    v_pages:    [P, page, n_kv, d]
+    k_pages:    [n_kv, P, page, d] — global page pool (this layer, head-major)
+    v_pages:    [n_kv, P, page, d]
     page_table: [B, pages_per_seq] int32 — physical page ids per slot
     lengths:    [B] int32 — tokens in cache per slot INCLUDING the current
                 token (i.e. the query attends to keys [0, lengths)).
     returns     [B, n_q, d]
 
-    The gather materializes each slot's logical KV ([B, S_max, n_kv, d]);
+    The gather materializes each slot's logical KV ([n_kv, B, S_max, d]);
     that is the XLA-reference strategy. The Pallas kernel streams pages
     through VMEM instead (pallas_paged.py).
     """
     B, n_q, d = q.shape
-    P, page, n_kv, _ = k_pages.shape
+    n_kv, P, page, _ = k_pages.shape
     pages_per_seq = page_table.shape[1]
     S = pages_per_seq * page
     group = n_q // n_kv
 
-    k = k_pages[page_table].reshape(B, S, n_kv, d).astype(jnp.float32)
-    v = v_pages[page_table].reshape(B, S, n_kv, d).astype(jnp.float32)
+    k = k_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    v = v_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
     qg = q.reshape(B, n_kv, group, d).astype(jnp.float32)
 
-    logits = jnp.einsum("bkgd,bskd->bkgs", qg, k) * scale   # [B, n_kv, g, S]
+    logits = jnp.einsum("bkgd,kbsd->bkgs", qg, k) * scale   # [B, n_kv, g, S]
     logits = softcap(logits, attn_softcap)
 
     k_pos = jnp.arange(S, dtype=jnp.int32)[None, :]          # [1, S]
@@ -144,7 +144,7 @@ def paged_attention(
 
     probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    out = jnp.einsum("bkgs,kbsd->bkgd", probs, v)
     return out.reshape(B, n_q, d).astype(q.dtype)
 
 
@@ -177,7 +177,11 @@ def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
 
 def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
                              scale, sliding_window=None, attn_softcap=None):
-    if use_pallas_kernels() and _static_window(sliding_window):
+    # The decode kernel's manual page DMA needs a lane-aligned head_dim on
+    # real TPU (Mosaic pads the pool's minor dim to 128 and rejects sub-tile
+    # slices); d=64/96 models (TinyLlama, Phi-3) take the XLA gather path.
+    d_ok = q.shape[-1] % 128 == 0 or jax.default_backend() == "cpu"
+    if use_pallas_kernels() and _static_window(sliding_window) and d_ok:
         from llms_on_kubernetes_tpu.ops.pallas_paged import pallas_paged_attention
 
         return pallas_paged_attention(

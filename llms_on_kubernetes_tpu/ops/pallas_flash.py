@@ -5,15 +5,20 @@ reference pulled inside the vLLM image (SURVEY §2.3 row 1). Semantics match
 ``ops/attention.py::prefill_attention`` (the XLA reference implementation)
 and are pinned by tests/test_pallas.py.
 
-Kernel shape (v1):
+Kernel shape (v2):
+- Inputs are transposed to head-major [B, H, T, d] at the wrapper so every
+  block's minor two dims are (T-block, d) — Mosaic requires the last two
+  block dims be multiples of (8, 128) or the full axis, which the v1
+  token-major layout [B, T, H, d] violated (head axis block of 1 in the
+  sublane slot fails to lower on real TPU; interpret mode hid it).
 - grid = (B, n_q_heads, T // BLOCK_Q); each program owns one query block of
   one head and streams the head's full K/V through VMEM (prefill buckets
   are <= a few K tokens, so K/V fit VMEM comfortably: T=4096, d=128, bf16
   -> 1 MB each). Logits never touch HBM — the [T, T] score matrix the XLA
   path materializes per head stays in VMEM one [BLOCK_Q, T] tile at a time.
-- GQA via the index map: query head h reads kv head h // group, so the MXU
-  sees per-head [BLOCK_Q, d] x [d, T] matmuls and K/V are fetched once per
-  q-block, not repeated per query head in HBM.
+- GQA via the index map: query head h reads kv head h // group; the q-block
+  index varies fastest so the same K/V block is reused across the whole
+  row of q-blocks without re-fetching.
 - Masking (causal + pad-length + optional sliding window) is additive in
   f32; softmax in f32 (same numerics policy as the reference impl).
 """
@@ -29,28 +34,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
+
 BLOCK_Q = 128
 
 
 def _flash_kernel(
-    lengths_ref,   # SMEM [1, 1] — this batch row's true length
-    q_ref,         # VMEM [1, BLOCK_Q, 1, d]
-    k_ref,         # VMEM [1, T, 1, d]
-    v_ref,         # VMEM [1, T, 1, d]
-    o_ref,         # VMEM [1, BLOCK_Q, 1, d]
+    lengths_ref,   # SMEM [B] — true lengths (whole array, indexed by b)
+    q_ref,         # VMEM [1, 1, BLOCK_Q, d]
+    k_ref,         # VMEM [1, 1, T, d]
+    v_ref,         # VMEM [1, 1, T, d]
+    o_ref,         # VMEM [1, 1, BLOCK_Q, d]
     *,
     scale: float,
     sliding_window: Optional[int],
     attn_softcap: Optional[float],
     block_q: int,
 ):
+    b = pl.program_id(0)
     qi = pl.program_id(2)
-    T = k_ref.shape[1]
-    length = lengths_ref[0, 0]
+    T = k_ref.shape[2]
+    length = lengths_ref[b]
 
-    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [Bq, d]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [T, d]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [T, d]
+    q = q_ref[0, 0].astype(jnp.float32)                # [Bq, d]
+    k = k_ref[0, 0].astype(jnp.float32)                # [T, d]
+    v = v_ref[0, 0].astype(jnp.float32)                # [T, d]
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -73,7 +80,7 @@ def _flash_kernel(
         p, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) / denom
-    o_ref[0, :, 0, :] = o.astype(o_ref.dtype)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -96,24 +103,28 @@ def flash_prefill_attention(
     block_q = min(BLOCK_Q, T)
     assert T % block_q == 0, f"prefill bucket {T} not a multiple of {block_q}"
 
+    # head-major layout so block minor dims are (tokens, head_dim)
+    qh = jnp.swapaxes(q, 1, 2)  # [B, n_q, T, d]
+    kh = jnp.swapaxes(k, 1, 2)  # [B, n_kv, T, d]
+    vh = jnp.swapaxes(v, 1, 2)
+
     kernel = functools.partial(
         _flash_kernel,
         scale=scale, sliding_window=sliding_window,
         attn_softcap=attn_softcap, block_q=block_q,
     )
     grid = (B, n_q, T // block_q)
-    lengths2d = lengths.reshape(B, 1).astype(jnp.int32)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, 1, d), lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((1, T, 1, d), lambda b, h, i: (b, 0, h // group, 0)),
-            pl.BlockSpec((1, T, 1, d), lambda b, h, i: (b, 0, h // group, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, T, d), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, T, d), lambda b, h, i: (b, h // group, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, d), lambda b, h, i: (b, i, h, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, T, n_q, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, n_q, T, d), q.dtype),
         interpret=interpret,
-    )(lengths2d, q, k, v)
+    )(lengths.astype(jnp.int32), qh, kh, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, T, n_q, d]

@@ -12,6 +12,10 @@ slot's pages HBM→VMEM once and attends in-place:
 
 - ``PrefetchScalarGridSpec`` prefetches the page table and lengths into
   SMEM so DMA source addresses are computable before the body runs.
+- The page pool is **head-major** [n_kv, P, page, d] (engine/cache.py), so
+  each (head, page) slice is one contiguous aligned [page, d] block — a
+  single DMA with no sublane-tile slicing (a head-minor pool layout is
+  rejected by Mosaic: slicing n_kv to 1 in the tiled sublane slot).
 - grid = (B, n_kv); each program owns one slot x one kv head: it issues
   one async DMA per page (unused table entries point at the reserved
   trash page 0 — uniform DMA pattern, garbage masked out), waits once,
@@ -37,10 +41,10 @@ from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
 def _paged_kernel(
     page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
     lengths_ref,      # SMEM [B]                (scalar prefetch)
-    q_ref,            # VMEM [1, group, d]
-    k_hbm,            # ANY  [P, page, n_kv, d]
-    v_hbm,            # ANY  [P, page, n_kv, d]
-    o_ref,            # VMEM [1, group, d]
+    q_ref,            # VMEM [1, 1, group, d]
+    k_hbm,            # ANY  [n_kv, P, page, d] (head-major pool)
+    v_hbm,            # ANY  [n_kv, P, page, d]
+    o_ref,            # VMEM [1, 1, group, d]
     k_buf,            # VMEM [S, d] scratch
     v_buf,            # VMEM [S, d] scratch
     sems,             # DMA semaphores [2, pages_per_seq]
@@ -56,32 +60,33 @@ def _paged_kernel(
     S = pages_per_seq * page_size
     length = lengths_ref[b]
 
-    # one DMA per page per K/V; trash-page entries keep the pattern uniform
+    # one contiguous [page, d] DMA per page per K/V; trash-page entries
+    # keep the pattern uniform
     for i in range(pages_per_seq):
         page_id = page_table_ref[b, i]
         pltpu.make_async_copy(
-            k_hbm.at[page_id, :, h, :],
+            k_hbm.at[h, page_id],
             k_buf.at[pl.ds(i * page_size, page_size), :],
             sems.at[0, i],
         ).start()
         pltpu.make_async_copy(
-            v_hbm.at[page_id, :, h, :],
+            v_hbm.at[h, page_id],
             v_buf.at[pl.ds(i * page_size, page_size), :],
             sems.at[1, i],
         ).start()
     for i in range(pages_per_seq):
         pltpu.make_async_copy(
-            k_hbm.at[page_table_ref[b, i], :, h, :],
+            k_hbm.at[h, page_table_ref[b, i]],
             k_buf.at[pl.ds(i * page_size, page_size), :],
             sems.at[0, i],
         ).wait()
         pltpu.make_async_copy(
-            v_hbm.at[page_table_ref[b, i], :, h, :],
+            v_hbm.at[h, page_table_ref[b, i]],
             v_buf.at[pl.ds(i * page_size, page_size), :],
             sems.at[1, i],
         ).wait()
 
-    q = q_ref[0].astype(jnp.float32)                   # [group, d]
+    q = q_ref[0, 0].astype(jnp.float32)                # [group, d]
     k = k_buf[:].astype(jnp.float32)                   # [S, d]
     v = v_buf[:].astype(jnp.float32)
 
@@ -105,7 +110,7 @@ def _paged_kernel(
         p, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) / denom
-    o_ref[0] = o.astype(o_ref.dtype)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -113,7 +118,7 @@ def _paged_kernel(
 )
 def pallas_paged_attention(
     q: jnp.ndarray,            # [B, n_q, d]
-    k_pages: jnp.ndarray,      # [P, page, n_kv, d]
+    k_pages: jnp.ndarray,      # [n_kv, P, page, d] (head-major pool)
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,   # [B, pages_per_seq] int32
     lengths: jnp.ndarray,      # [B] int32 (incl. current token)
@@ -124,7 +129,7 @@ def pallas_paged_attention(
     interpret: bool = False,
 ) -> jnp.ndarray:
     B, n_q, d = q.shape
-    P, page_size, n_kv, _ = k_pages.shape
+    n_kv, P, page_size, _ = k_pages.shape
     pages_per_seq = page_table.shape[1]
     S = pages_per_seq * page_size
     group = n_q // n_kv
@@ -135,25 +140,31 @@ def pallas_paged_attention(
         attn_softcap=attn_softcap,
         page_size=page_size, pages_per_seq=pages_per_seq,
     )
+    # [B, n_kv, group, d]: the block's minor two dims are (group, d), both
+    # equal to the full axis — satisfies Mosaic's (8, 128)-or-full-dim rule
+    # for any group size (the flat [B, n_q, d] layout did not).
+    qg = q.reshape(B, n_kv, group, d)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, n_kv),
         in_specs=[
-            pl.BlockSpec((1, group, d), lambda b, h, *_: (b, h, 0)),
+            pl.BlockSpec((1, 1, group, d), lambda b, h, *_: (b, h, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, group, d), lambda b, h, *_: (b, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, group, d), lambda b, h, *_: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((S, d), k_pages.dtype),
             pltpu.VMEM((S, d), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, pages_per_seq)),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, n_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pages, v_pages)
+      qg, k_pages, v_pages)
+    return out.reshape(B, n_q, d)

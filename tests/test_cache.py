@@ -36,8 +36,8 @@ def test_allocator_exhaustion_and_overflow():
 
 def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     P, page, KV, d = 5, 4, 2, 3
-    k_pages = jnp.zeros((P, page, KV, d))
-    v_pages = jnp.zeros((P, page, KV, d))
+    k_pages = jnp.zeros((KV, P, page, d))
+    v_pages = jnp.zeros((KV, P, page, d))
     B, T = 1, 6
     k = jnp.arange(B * T * KV * d, dtype=jnp.float32).reshape(B, T, KV, d) + 1
     v = -k
@@ -45,13 +45,13 @@ def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     # positions 0..4 valid, position 5 is padding (-1 => trash page 0)
     positions = jnp.asarray([[0, 1, 2, 3, 4, -1]], jnp.int32)
     k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, positions)
-    kn = np.asarray(k_pages)
-    np.testing.assert_allclose(kn[2, 0], np.asarray(k)[0, 0])
-    np.testing.assert_allclose(kn[2, 3], np.asarray(k)[0, 3])
-    np.testing.assert_allclose(kn[4, 0], np.asarray(k)[0, 4])
-    assert np.asarray(v_pages)[2, 1, 0, 0] == -np.asarray(k)[0, 1, 0, 0]
+    kn = np.asarray(k_pages)  # [KV, P, page, d]
+    np.testing.assert_allclose(kn[:, 2, 0], np.asarray(k)[0, 0])
+    np.testing.assert_allclose(kn[:, 2, 3], np.asarray(k)[0, 3])
+    np.testing.assert_allclose(kn[:, 4, 0], np.asarray(k)[0, 4])
+    assert np.asarray(v_pages)[0, 2, 1, 0] == -np.asarray(k)[0, 1, 0, 0]
     # pages other than 2, 4 and trash are untouched
-    assert (kn[1] == 0).all() and (kn[3] == 0).all()
+    assert (kn[:, 1] == 0).all() and (kn[:, 3] == 0).all()
 
 
 def test_cache_config_accounting():
@@ -60,4 +60,4 @@ def test_cache_config_accounting():
     assert cc.max_seq_len == 32
     assert cc.bytes_per_page == 2 * 2 * 8 * 4 * 8 * 2  # k&v · L · page · kv · hd · bf16
     k, v = init_pages(cc)
-    assert k.shape == (2, 16, 8, 4, 8) and k.dtype == jnp.bfloat16
+    assert k.shape == (2, 4, 16, 8, 8) and k.dtype == jnp.bfloat16

@@ -130,14 +130,14 @@ def test_paged_attention_matches_dense():
 
     # build a shared pool: give each sequence disjoint physical pages
     page_table = np.zeros((B, pps), np.int32)
-    pool_k = np.zeros((1 + B * pps, page, KV, d), np.float32)
+    pool_k = np.zeros((KV, 1 + B * pps, page, d), np.float32)  # head-major
     pool_v = np.zeros_like(pool_k)
     nxt = 1
     for b in range(B):
         for i in range(pps):
             page_table[b, i] = nxt
-            pool_k[nxt] = k_seqs[b, i * page:(i + 1) * page]
-            pool_v[nxt] = v_seqs[b, i * page:(i + 1) * page]
+            pool_k[:, nxt] = k_seqs[b, i * page:(i + 1) * page].transpose(1, 0, 2)
+            pool_v[:, nxt] = v_seqs[b, i * page:(i + 1) * page].transpose(1, 0, 2)
             nxt += 1
 
     scale = d ** -0.5

@@ -58,8 +58,8 @@ def test_flash_prefill_multiblock(rng):
 
 def _paged_setup(rng, B, n_kv, d, page, pages_per_seq, lengths):
     P = B * pages_per_seq + 1
-    k_pages = jnp.asarray(rng.normal(size=(P, page, n_kv, d)), jnp.float32)
-    v_pages = jnp.asarray(rng.normal(size=(P, page, n_kv, d)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(n_kv, P, page, d)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(n_kv, P, page, d)), jnp.float32)
     # distinct page tables with some shared structure
     table = np.zeros((B, pages_per_seq), np.int32)
     perm = rng.permutation(P - 1) + 1
