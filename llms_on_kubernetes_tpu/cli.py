@@ -104,6 +104,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     # serve
+    # Honor an explicit CPU request even when a preloaded sitecustomize
+    # already registered a hardware platform plugin (the env var alone is
+    # evaluated too late in that case) — same guard as bench.py. Done here,
+    # not at the top of main(): render/router must not pay the jax import.
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
     from llms_on_kubernetes_tpu.parallel.distributed import maybe_initialize
 
     multi_host = maybe_initialize()  # join the pod group BEFORE backend init

@@ -1,0 +1,293 @@
+// HTTP/1.1 plumbing for the native router: socket helpers, request/response
+// header parsing, body framing (Content-Length + chunked), URL parsing.
+//
+// Scope mirrors what the reference's OpenResty gateway relied on from nginx
+// (reference vllm-models/helm-chart/templates/model-gateway.yaml:51-81):
+// read a request + body, connect upstream, relay a response while
+// PRESERVING streaming (write every chunk as it arrives — the defect the
+// reference's Python gateway had, api-gateway.yaml:99, buffering whole
+// responses and breaking SSE, is explicitly avoided here).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstring>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace llkt {
+
+inline std::string lower(std::string s) {
+  std::transform(s.begin(), s.end(), s.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return s;
+}
+
+// Ordered header list (order and duplicates preserved for forwarding).
+struct Headers {
+  std::vector<std::pair<std::string, std::string>> items;
+
+  const std::string* get(const std::string& name) const {
+    std::string n = lower(name);
+    for (const auto& kv : items)
+      if (lower(kv.first) == n) return &kv.second;
+    return nullptr;
+  }
+  void add(std::string name, std::string value) {
+    items.emplace_back(std::move(name), std::move(value));
+  }
+  void remove(const std::string& name) {
+    std::string n = lower(name);
+    items.erase(std::remove_if(items.begin(), items.end(),
+                               [&](const auto& kv) { return lower(kv.first) == n; }),
+                items.end());
+  }
+  void set(std::string name, std::string value) {
+    remove(name);
+    add(std::move(name), std::move(value));
+  }
+};
+
+// Buffered reader over a socket fd: line reads for headers/chunk sizes,
+// bulk reads for bodies, raw reads for streaming relay.
+class SockReader {
+ public:
+  explicit SockReader(int fd) : fd_(fd) {}
+
+  // Reads until "\r\n" (tolerates bare "\n"); returns false on EOF/error.
+  bool read_line(std::string& line, size_t max_len = 64 * 1024) {
+    line.clear();
+    while (line.size() < max_len) {
+      if (pos_ >= len_ && !fill()) return false;
+      char c = buf_[pos_++];
+      if (c == '\n') {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        return true;
+      }
+      line += c;
+    }
+    return false;  // header line too long
+  }
+
+  // Reads exactly n bytes into out (appending); false on EOF first.
+  bool read_exact(std::string& out, size_t n) {
+    while (n > 0) {
+      if (pos_ >= len_ && !fill()) return false;
+      size_t take = std::min(n, len_ - pos_);
+      out.append(buf_ + pos_, take);
+      pos_ += take;
+      n -= take;
+    }
+    return true;
+  }
+
+  // Reads up to max bytes (at least 1 unless EOF); returns bytes read, 0 on
+  // EOF, -1 on error.
+  ssize_t read_some(char* out, size_t max) {
+    if (pos_ < len_) {
+      size_t take = std::min(max, len_ - pos_);
+      memcpy(out, buf_ + pos_, take);
+      pos_ += take;
+      return static_cast<ssize_t>(take);
+    }
+    ssize_t n = ::recv(fd_, out, max, 0);
+    return n;
+  }
+
+ private:
+  bool fill() {
+    ssize_t n = ::recv(fd_, buf_, sizeof buf_, 0);
+    if (n <= 0) return false;
+    pos_ = 0;
+    len_ = static_cast<size_t>(n);
+    return true;
+  }
+
+  int fd_;
+  char buf_[16 * 1024];
+  size_t pos_ = 0, len_ = 0;
+};
+
+inline bool send_all(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd, data, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    data += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+inline bool send_all(int fd, const std::string& s) {
+  return send_all(fd, s.data(), s.size());
+}
+
+struct Request {
+  std::string method;
+  std::string target;   // path + optional ?query, as received
+  std::string version;  // "HTTP/1.1"
+  Headers headers;
+  std::string body;
+  bool keep_alive = true;
+};
+
+struct ResponseHead {
+  std::string status_line;  // full "HTTP/1.1 200 OK"
+  int status = 0;
+  Headers headers;
+};
+
+// Parses request line + headers + body (Content-Length or chunked; chunked
+// request bodies are de-chunked so they can be re-framed upstream with a
+// plain Content-Length). Returns false on EOF/malformed/oversized.
+inline bool read_request(SockReader& r, Request& req,
+                         size_t max_body = 64 * 1024 * 1024) {
+  std::string line;
+  if (!r.read_line(line) || line.empty()) return false;
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) return false;
+  req.method = line.substr(0, sp1);
+  req.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  req.version = line.substr(sp2 + 1);
+
+  while (r.read_line(line)) {
+    if (line.empty()) break;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) return false;
+    std::string name = line.substr(0, colon);
+    size_t vstart = line.find_first_not_of(" \t", colon + 1);
+    req.headers.add(name, vstart == std::string::npos ? "" : line.substr(vstart));
+  }
+
+  const std::string* conn = req.headers.get("connection");
+  req.keep_alive = req.version == "HTTP/1.1";
+  if (conn) {
+    std::string c = lower(*conn);
+    if (c.find("close") != std::string::npos) req.keep_alive = false;
+    if (c.find("keep-alive") != std::string::npos) req.keep_alive = true;
+  }
+
+  const std::string* te = req.headers.get("transfer-encoding");
+  if (te && lower(*te).find("chunked") != std::string::npos) {
+    // de-chunk into req.body
+    while (true) {
+      if (!r.read_line(line)) return false;
+      size_t semi = line.find(';');
+      unsigned long sz = 0;
+      try {
+        sz = std::stoul(line.substr(0, semi), nullptr, 16);
+      } catch (...) {
+        return false;
+      }
+      if (sz == 0) {
+        // trailers until blank line
+        while (r.read_line(line) && !line.empty()) {}
+        break;
+      }
+      if (req.body.size() + sz > max_body) return false;
+      if (!r.read_exact(req.body, sz)) return false;
+      if (!r.read_line(line)) return false;  // CRLF after chunk
+    }
+  } else if (const std::string* cl = req.headers.get("content-length")) {
+    unsigned long n = 0;
+    try {
+      n = std::stoul(*cl);
+    } catch (...) {
+      return false;
+    }
+    if (n > max_body) return false;
+    if (!r.read_exact(req.body, n)) return false;
+  }
+  return true;
+}
+
+// Parses an upstream response's status line + headers (body is relayed
+// separately, streaming).
+inline bool read_response_head(SockReader& r, ResponseHead& resp) {
+  std::string line;
+  if (!r.read_line(line) || line.compare(0, 5, "HTTP/") != 0) return false;
+  resp.status_line = line;
+  size_t sp = line.find(' ');
+  if (sp == std::string::npos) return false;
+  try {
+    resp.status = std::stoi(line.substr(sp + 1));
+  } catch (...) {
+    return false;
+  }
+  while (r.read_line(line)) {
+    if (line.empty()) return true;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) return false;
+    size_t vstart = line.find_first_not_of(" \t", colon + 1);
+    resp.headers.add(line.substr(0, colon),
+                     vstart == std::string::npos ? "" : line.substr(vstart));
+  }
+  return false;
+}
+
+// http://host[:port][/path] -> (host, port, path)
+struct Url {
+  std::string host;
+  int port = 80;
+  std::string path = "/";
+};
+
+inline std::optional<Url> parse_url(const std::string& url) {
+  const std::string scheme = "http://";
+  if (url.compare(0, scheme.size(), scheme) != 0) return std::nullopt;
+  Url u;
+  std::string rest = url.substr(scheme.size());
+  size_t slash = rest.find('/');
+  std::string hostport = rest.substr(0, slash);
+  if (slash != std::string::npos) u.path = rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    u.host = hostport.substr(0, colon);
+    try {
+      u.port = std::stoi(hostport.substr(colon + 1));
+    } catch (...) {
+      return std::nullopt;
+    }
+  } else {
+    u.host = hostport;
+  }
+  if (u.host.empty()) return std::nullopt;
+  return u;
+}
+
+// Blocking connect with timeout (seconds). Returns fd or -1.
+inline int connect_to(const std::string& host, int port, int timeout_s) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv {timeout_s, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+}  // namespace llkt

@@ -1,0 +1,348 @@
+// Minimal recursive-descent JSON parser + serializer for the native router.
+//
+// Scope: exactly what the router needs — parse the gateway config file and
+// inspect request bodies for the "model" field (the routing key the
+// reference's Lua gateway extracts with cjson, reference
+// vllm-models/helm-chart/templates/model-gateway.yaml:62-70), and emit the
+// synthesized /v1/models and error payloads. Not a general-purpose library:
+// no streaming, no comments, UTF-16 surrogate pairs folded to UTF-8.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace llkt {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool boolean = false;
+  double number = 0.0;
+  std::string str;
+  std::vector<JsonPtr> arr;
+  // insertion-ordered object: vector of pairs (order matters for tests
+  // comparing against the python router's output key order)
+  std::vector<std::pair<std::string, JsonPtr>> obj;
+
+  static JsonPtr make(Type t) {
+    auto j = std::make_shared<Json>();
+    j->type = t;
+    return j;
+  }
+  static JsonPtr of_string(const std::string& s) {
+    auto j = make(Type::String);
+    j->str = s;
+    return j;
+  }
+  static JsonPtr of_number(double n) {
+    auto j = make(Type::Number);
+    j->number = n;
+    return j;
+  }
+  static JsonPtr of_bool(bool b) {
+    auto j = make(Type::Bool);
+    j->boolean = b;
+    return j;
+  }
+
+  const Json* get(const std::string& key) const {
+    if (type != Type::Object) return nullptr;
+    for (const auto& kv : obj)
+      if (kv.first == key) return kv.second.get();
+    return nullptr;
+  }
+  void set(const std::string& key, JsonPtr v) {
+    for (auto& kv : obj)
+      if (kv.first == key) {
+        kv.second = std::move(v);
+        return;
+      }
+    obj.emplace_back(key, std::move(v));
+  }
+
+  bool is_string() const { return type == Type::String; }
+  bool is_object() const { return type == Type::Object; }
+
+  std::string dump() const {
+    std::string out;
+    dump_to(out);
+    return out;
+  }
+
+ private:
+  static void dump_string(const std::string& s, std::string& out) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  void dump_to(std::string& out) const {
+    switch (type) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += boolean ? "true" : "false"; break;
+      case Type::Number: {
+        if (std::isfinite(number) && number == std::floor(number) &&
+            std::fabs(number) < 1e15) {
+          char buf[32];
+          snprintf(buf, sizeof buf, "%lld", (long long)number);
+          out += buf;
+        } else {
+          char buf[32];
+          snprintf(buf, sizeof buf, "%.17g", number);
+          out += buf;
+        }
+        break;
+      }
+      case Type::String: dump_string(str, out); break;
+      case Type::Array: {
+        out += '[';
+        for (size_t i = 0; i < arr.size(); ++i) {
+          if (i) out += ',';
+          arr[i]->dump_to(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        for (size_t i = 0; i < obj.size(); ++i) {
+          if (i) out += ',';
+          dump_string(obj[i].first, out);
+          out += ':';
+          obj[i].second->dump_to(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+};
+
+class JsonParser {
+ public:
+  // Returns nullptr on malformed input (the router treats an unparseable
+  // body the same way the reference's Lua gateway does: route to default).
+  static JsonPtr parse(const std::string& text) {
+    JsonParser p(text);
+    try {
+      JsonPtr v = p.parse_value();
+      p.skip_ws();
+      if (p.pos_ != text.size()) return nullptr;  // trailing garbage
+      return v;
+    } catch (const std::exception&) {
+      return nullptr;
+    }
+  }
+
+ private:
+  explicit JsonParser(const std::string& t) : text_(t) {}
+
+  const std::string& text_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void fail(const char* what) { throw std::runtime_error(what); }
+
+  char peek() {
+    if (pos_ >= text_.size()) fail("eof");
+    return text_[pos_];
+  }
+  char next() {
+    char c = peek();
+    ++pos_;
+    return c;
+  }
+  void skip_ws() {
+    while (pos_ < text_.size()) {
+      char c = text_[pos_];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r')
+        ++pos_;
+      else
+        break;
+    }
+  }
+  void expect(char c) {
+    if (next() != c) fail("unexpected character");
+  }
+
+  JsonPtr parse_value() {
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Json::of_string(parse_string());
+      case 't': literal("true"); return Json::of_bool(true);
+      case 'f': literal("false"); return Json::of_bool(false);
+      case 'n': literal("null"); return Json::make(Json::Type::Null);
+      default: return parse_number();
+    }
+  }
+
+  void literal(const char* lit) {
+    for (const char* p = lit; *p; ++p)
+      if (pos_ >= text_.size() || text_[pos_++] != *p) fail("bad literal");
+  }
+
+  JsonPtr parse_object() {
+    expect('{');
+    auto o = Json::make(Json::Type::Object);
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return o;
+    }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      o->obj.emplace_back(std::move(key), parse_value());
+      skip_ws();
+      char c = next();
+      if (c == '}') return o;
+      if (c != ',') fail("expected , or }");
+    }
+  }
+
+  JsonPtr parse_array() {
+    expect('[');
+    auto a = Json::make(Json::Type::Array);
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return a;
+    }
+    while (true) {
+      a->arr.push_back(parse_value());
+      skip_ws();
+      char c = next();
+      if (c == ']') return a;
+      if (c != ',') fail("expected , or ]");
+    }
+  }
+
+  void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  uint32_t parse_hex4() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= c - '0';
+      else if (c >= 'a' && c <= 'f')
+        v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F')
+        v |= c - 'A' + 10;
+      else
+        fail("bad \\u escape");
+    }
+    return v;
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            uint32_t cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              if (pos_ + 1 < text_.size() && text_[pos_] == '\\' &&
+                  text_[pos_ + 1] == 'u') {
+                pos_ += 2;
+                uint32_t lo = parse_hex4();
+                if (lo >= 0xDC00 && lo <= 0xDFFF)
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                else
+                  fail("bad surrogate pair");
+              } else {
+                fail("lone surrogate");
+              }
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else if (static_cast<unsigned char>(c) < 0x20) {
+        fail("control character in string");
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  JsonPtr parse_number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < text_.size() &&
+           (isdigit(text_[pos_]) || text_[pos_] == '.' || text_[pos_] == 'e' ||
+            text_[pos_] == 'E' || text_[pos_] == '+' || text_[pos_] == '-'))
+      ++pos_;
+    if (pos_ == start) fail("bad number");
+    try {
+      return Json::of_number(std::stod(text_.substr(start, pos_ - start)));
+    } catch (...) {
+      fail("bad number");
+    }
+  }
+};
+
+}  // namespace llkt

@@ -1,0 +1,508 @@
+// llkt-router: native payload-inspecting multi-model API gateway.
+//
+// The C++ equivalent of the reference's OpenResty/Lua gateway (reference
+// vllm-models/helm-chart/templates/model-gateway.yaml — nginx C core +
+// LuaJIT routing block), with identical routing semantics, shared with the
+// Python router in llms_on_kubernetes_tpu/server/router.py (SURVEY §3.1):
+//
+//   GET /v1/models   -> synthesized at the gateway from config, no backend
+//                       hop (model-gateway.yaml:29-49)
+//   GET /health      -> 200 "OK" (model-gateway.yaml:84-86)
+//   anything else    -> JSON body's "model" field exact-matched against the
+//                       configured model names (model-gateway.yaml:62-70);
+//                       unknown/absent model -> default backend (silent
+//                       fallback, model-gateway.yaml:20-27), or 404 in
+//                       --strict mode (the rebuild's "404-or-default"
+//                       config choice, SURVEY §7 router item)
+//
+// Responses are relayed CHUNK BY CHUNK as they arrive — SSE/token
+// streaming is never buffered (the reference's Python gateway buffered
+// whole upstream responses, api-gateway.yaml:99; its nginx gateway and
+// this one do not). X-Real-IP / X-Forwarded-For / X-Forwarded-Proto are
+// appended like the reference's proxy block (model-gateway.yaml:78-81).
+//
+// Config: JSON file (--config) with
+//   {"models": {"<name>": "http://host:port", ...},
+//    "default": "<name>",             // optional; first model otherwise
+//    "strict": false,                 // optional; 404 unknown models
+//    "upstream_timeout_s": 300}       // optional; reference used 300s
+// or inline --models "name=url,name2=url2" (tests, quick runs).
+//
+// Threading: one detached thread per connection (the gateway is I/O-bound;
+// per-model backends do the heavy work). Client keep-alive is honored;
+// upstream connections are per-request, Connection: close.
+
+#include <cstdarg>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+
+namespace llkt {
+
+struct Config {
+  // insertion-ordered: first model is the default (like the reference's
+  // `default_backend` = first entry, model-gateway.yaml:20-22)
+  std::vector<std::pair<std::string, Url>> models;
+  std::string default_model;
+  bool strict = false;
+  int upstream_timeout_s = 300;
+  int port = 8080;
+  bool quiet = false;
+
+  const Url* find(const std::string& name) const {
+    for (const auto& kv : models)
+      if (kv.first == name) return &kv.second;
+    return nullptr;
+  }
+};
+
+static std::mutex g_log_mu;
+
+static void logf(const Config& cfg, const char* fmt, ...) {
+  if (cfg.quiet) return;
+  std::lock_guard<std::mutex> lock(g_log_mu);
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(stderr, fmt, ap);
+  va_end(ap);
+  fputc('\n', stderr);
+}
+
+// ---------------------------------------------------------------------------
+// Routing (the Lua access_by_lua_block equivalent)
+// ---------------------------------------------------------------------------
+
+// Returns the model name to route to; sets *not_found in strict mode when
+// the body names an unknown model.
+static std::string select_backend(const Config& cfg, const std::string& body,
+                                  bool* not_found) {
+  *not_found = false;
+  std::string requested;
+  if (!body.empty()) {
+    JsonPtr parsed = JsonParser::parse(body);
+    if (parsed && parsed->is_object()) {
+      const Json* m = parsed->get("model");
+      if (m && m->is_string()) requested = m->str;
+    }
+  }
+  if (!requested.empty() && cfg.find(requested)) return requested;
+  if (cfg.strict && !requested.empty()) {
+    *not_found = true;
+    return cfg.default_model;
+  }
+  return cfg.default_model;  // silent fallback, like the reference
+}
+
+// ---------------------------------------------------------------------------
+// Local responses
+// ---------------------------------------------------------------------------
+
+static std::string simple_response(int status, const char* reason,
+                                   const std::string& content_type,
+                                   const std::string& body, bool keep_alive) {
+  std::ostringstream out;
+  out << "HTTP/1.1 " << status << " " << reason << "\r\n"
+      << "Content-Type: " << content_type << "\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: " << (keep_alive ? "keep-alive" : "close") << "\r\n"
+      << "\r\n"
+      << body;
+  return out.str();
+}
+
+static std::string models_json(const Config& cfg) {
+  auto root = Json::make(Json::Type::Object);
+  root->set("object", Json::of_string("list"));
+  auto data = Json::make(Json::Type::Array);
+  double now = static_cast<double>(time(nullptr));
+  for (const auto& kv : cfg.models) {
+    auto m = Json::make(Json::Type::Object);
+    m->set("id", Json::of_string(kv.first));
+    m->set("object", Json::of_string("model"));
+    m->set("created", Json::of_number(now));
+    m->set("owned_by", Json::of_string("llms-on-kubernetes-tpu"));
+    data->arr.push_back(m);
+  }
+  root->set("data", data);
+  return root->dump();
+}
+
+static std::string error_json(const std::string& message, const std::string& type,
+                              const std::string& code = "") {
+  auto root = Json::make(Json::Type::Object);
+  auto err = Json::make(Json::Type::Object);
+  err->set("message", Json::of_string(message));
+  err->set("type", Json::of_string(type));
+  if (!code.empty()) err->set("code", Json::of_string(code));
+  root->set("error", err);
+  return root->dump();
+}
+
+// ---------------------------------------------------------------------------
+// Proxy
+// ---------------------------------------------------------------------------
+
+static const char* kHopByHop[] = {"connection",        "keep-alive",
+                                  "proxy-authenticate", "proxy-authorization",
+                                  "te",                "trailers",
+                                  "transfer-encoding", "upgrade",
+                                  "host",              "content-length"};
+
+static bool is_hop_by_hop(const std::string& name) {
+  std::string n = lower(name);
+  for (const char* h : kHopByHop)
+    if (n == h) return true;
+  return false;
+}
+
+// Relays the upstream response body downstream with the upstream's own
+// framing, writing every chunk as soon as it is read (SSE-safe).
+// Returns true if the body completed per its framing (downstream may be
+// kept alive), false if the connection must close.
+static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) {
+  char buf[16 * 1024];
+  const std::string* te = head.headers.get("transfer-encoding");
+  if (te && lower(*te).find("chunked") != std::string::npos) {
+    // relay raw chunked framing: parse sizes, forward bytes as they come
+    SockReader& r = up;
+    std::string line;
+    while (true) {
+      if (!r.read_line(line)) return false;
+      std::string wire = line + "\r\n";
+      if (!send_all(client_fd, wire)) return false;
+      unsigned long sz = 0;
+      try {
+        sz = std::stoul(line.substr(0, line.find(';')), nullptr, 16);
+      } catch (...) {
+        return false;
+      }
+      unsigned long left = sz + 2;  // chunk data + trailing CRLF
+      while (left > 0) {
+        ssize_t n = r.read_some(buf, std::min(left, sizeof buf));
+        if (n <= 0) return false;
+        if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
+        left -= static_cast<unsigned long>(n);
+      }
+      if (sz == 0) return true;  // final chunk (trailers folded into CRLF)
+    }
+  }
+  if (const std::string* cl = head.headers.get("content-length")) {
+    unsigned long left = 0;
+    try {
+      left = std::stoul(*cl);
+    } catch (...) {
+      return false;
+    }
+    while (left > 0) {
+      ssize_t n = up.read_some(buf, std::min(left, sizeof buf));
+      if (n <= 0) return false;
+      if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
+      left -= static_cast<unsigned long>(n);
+    }
+    return true;
+  }
+  // EOF-terminated body: stream until upstream closes, then close downstream
+  while (true) {
+    ssize_t n = up.read_some(buf, sizeof buf);
+    if (n < 0) return false;
+    if (n == 0) return false;  // report "must close" — framing was EOF
+    if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
+  }
+}
+
+// Proxies one request; returns true iff the client connection can be
+// reused for another request.
+static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
+                          const std::string& client_ip, const std::string& model) {
+  const Url* base = cfg.find(model);
+  Url target = *base;
+  // join upstream base path with the request target
+  std::string path = target.path == "/" ? req.target : target.path + req.target;
+
+  int up_fd = connect_to(target.host, target.port, cfg.upstream_timeout_s);
+  if (up_fd < 0) {
+    std::string body =
+        error_json("upstream connect failed: " + target.host + ":" +
+                       std::to_string(target.port),
+                   "bad_gateway");
+    send_all(client_fd,
+             simple_response(502, "Bad Gateway", "application/json", body,
+                             req.keep_alive));
+    return req.keep_alive;
+  }
+
+  // build upstream request
+  std::ostringstream out;
+  out << req.method << " " << path << " HTTP/1.1\r\n";
+  out << "Host: " << target.host << ":" << target.port << "\r\n";
+  for (const auto& kv : req.headers.items) {
+    std::string n = lower(kv.first);
+    if (is_hop_by_hop(n) || n == "x-real-ip" || n == "x-forwarded-proto")
+      continue;
+    if (n == "x-forwarded-for") continue;  // re-added with client appended
+    out << kv.first << ": " << kv.second << "\r\n";
+  }
+  out << "X-Real-IP: " << client_ip << "\r\n";
+  const std::string* fwd = req.headers.get("x-forwarded-for");
+  out << "X-Forwarded-For: " << (fwd ? *fwd + ", " + client_ip : client_ip)
+      << "\r\n";
+  out << "X-Forwarded-Proto: http\r\n";
+  out << "Content-Length: " << req.body.size() << "\r\n";
+  out << "Connection: close\r\n\r\n";
+
+  bool ok = send_all(up_fd, out.str()) &&
+            (req.body.empty() || send_all(up_fd, req.body));
+  ResponseHead head;
+  SockReader up(up_fd);
+  if (!ok || !read_response_head(up, head)) {
+    ::close(up_fd);
+    std::string body = error_json("upstream error", "bad_gateway");
+    send_all(client_fd,
+             simple_response(502, "Bad Gateway", "application/json", body,
+                             req.keep_alive));
+    return req.keep_alive;
+  }
+
+  // forward response head; keep the upstream's framing headers
+  // (Transfer-Encoding/Content-Length) so the relayed body matches
+  bool has_framing = head.headers.get("content-length") ||
+                     head.headers.get("transfer-encoding");
+  std::ostringstream rh;
+  rh << head.status_line << "\r\n";
+  for (const auto& kv : head.headers.items) {
+    std::string n = lower(kv.first);
+    if (n == "connection" || n == "keep-alive") continue;
+    rh << kv.first << ": " << kv.second << "\r\n";
+  }
+  bool reusable = req.keep_alive && has_framing;
+  rh << "Connection: " << (reusable ? "keep-alive" : "close") << "\r\n\r\n";
+  if (!send_all(client_fd, rh.str())) {
+    ::close(up_fd);
+    return false;
+  }
+
+  bool body_done = (req.method == "HEAD" || head.status == 204 ||
+                    head.status == 304)
+                       ? true
+                       : relay_body(up, client_fd, head);
+  ::close(up_fd);
+  return reusable && body_done;
+}
+
+// ---------------------------------------------------------------------------
+// Connection loop
+// ---------------------------------------------------------------------------
+
+static void handle_connection(const Config& cfg, int client_fd,
+                              std::string client_ip) {
+  int one = 1;
+  setsockopt(client_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  SockReader reader(client_fd);
+  while (true) {
+    Request req;
+    if (!read_request(reader, req)) break;
+
+    std::string path = req.target.substr(0, req.target.find('?'));
+    bool keep = false;
+    if (path == "/health") {
+      keep = send_all(client_fd, simple_response(200, "OK", "text/plain", "OK",
+                                                 req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "%s %s -> 200 (local)", req.method.c_str(), req.target.c_str());
+    } else if (path == "/v1/models" && req.method == "GET") {
+      keep = send_all(client_fd,
+                      simple_response(200, "OK", "application/json",
+                                      models_json(cfg), req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "GET /v1/models -> 200 (synthesized)");
+    } else {
+      bool not_found = false;
+      std::string model = select_backend(cfg, req.body, &not_found);
+      if (not_found) {
+        std::string body = error_json("model not found", "invalid_request_error",
+                                      "model_not_found");
+        keep = send_all(client_fd,
+                        simple_response(404, "Not Found", "application/json",
+                                        body, req.keep_alive)) &&
+               req.keep_alive;
+        logf(cfg, "%s %s -> 404 (unknown model)", req.method.c_str(),
+             req.target.c_str());
+      } else {
+        keep = proxy_request(cfg, req, client_fd, client_ip, model);
+        logf(cfg, "%s %s -> %s", req.method.c_str(), req.target.c_str(),
+             model.c_str());
+      }
+    }
+    if (!keep) break;
+  }
+  ::close(client_fd);
+}
+
+// ---------------------------------------------------------------------------
+// Config loading
+// ---------------------------------------------------------------------------
+
+static bool load_config_json(const std::string& file, Config& cfg) {
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "llkt-router: cannot open config %s\n", file.c_str());
+    return false;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  JsonPtr root = JsonParser::parse(ss.str());
+  if (!root || !root->is_object()) {
+    fprintf(stderr, "llkt-router: malformed config %s\n", file.c_str());
+    return false;
+  }
+  const Json* models = root->get("models");
+  if (!models || !models->is_object() || models->obj.empty()) {
+    fprintf(stderr, "llkt-router: config needs a non-empty models object\n");
+    return false;
+  }
+  for (const auto& kv : models->obj) {
+    if (!kv.second->is_string()) return false;
+    auto url = parse_url(kv.second->str);
+    if (!url) {
+      fprintf(stderr, "llkt-router: bad backend url %s\n",
+              kv.second->str.c_str());
+      return false;
+    }
+    cfg.models.emplace_back(kv.first, *url);
+  }
+  if (const Json* d = root->get("default"); d && d->is_string())
+    cfg.default_model = d->str;
+  if (const Json* s = root->get("strict"); s && s->type == Json::Type::Bool)
+    cfg.strict = s->boolean;
+  if (const Json* t = root->get("upstream_timeout_s");
+      t && t->type == Json::Type::Number)
+    cfg.upstream_timeout_s = static_cast<int>(t->number);
+  return true;
+}
+
+static bool load_models_inline(const std::string& spec, Config& cfg) {
+  size_t start = 0;
+  while (start < spec.size()) {
+    size_t comma = spec.find(',', start);
+    std::string item = spec.substr(start, comma - start);
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) return false;
+    auto url = parse_url(item.substr(eq + 1));
+    if (!url) return false;
+    cfg.models.emplace_back(item.substr(0, eq), *url);
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return !cfg.models.empty();
+}
+
+}  // namespace llkt
+
+int main(int argc, char** argv) {
+  using namespace llkt;
+  signal(SIGPIPE, SIG_IGN);
+
+  Config cfg;
+  std::string config_file, models_inline;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      return i + 1 < argc ? argv[++i] : nullptr;
+    };
+    if (a == "--config") {
+      const char* v = next();
+      if (!v) return 2;
+      config_file = v;
+    } else if (a == "--models") {
+      const char* v = next();
+      if (!v) return 2;
+      models_inline = v;
+    } else if (a == "--port") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.port = atoi(v);
+    } else if (a == "--default") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.default_model = v;
+    } else if (a == "--strict") {
+      cfg.strict = true;
+    } else if (a == "--quiet") {
+      cfg.quiet = true;
+    } else if (a == "--upstream-timeout") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.upstream_timeout_s = atoi(v);
+    } else {
+      fprintf(stderr,
+              "usage: llkt-router (--config FILE | --models n=url,...) "
+              "[--port P] [--default NAME] [--strict] [--quiet] "
+              "[--upstream-timeout S]\n");
+      return 2;
+    }
+  }
+
+  if (!config_file.empty()) {
+    if (!load_config_json(config_file, cfg)) return 1;
+  } else if (!models_inline.empty()) {
+    if (!load_models_inline(models_inline, cfg)) {
+      fprintf(stderr, "llkt-router: bad --models spec\n");
+      return 1;
+    }
+  } else {
+    fprintf(stderr, "llkt-router: need --config or --models\n");
+    return 2;
+  }
+  if (cfg.default_model.empty()) cfg.default_model = cfg.models.front().first;
+  if (!cfg.find(cfg.default_model)) {
+    fprintf(stderr, "llkt-router: default model %s not in models\n",
+            cfg.default_model.c_str());
+    return 1;
+  }
+
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd < 0) {
+    perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(cfg.port));
+  if (bind(listen_fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof addr) <
+      0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listen_fd, 128) < 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "llkt-router: listening on :%d (%zu models, default=%s%s)\n",
+          cfg.port, cfg.models.size(), cfg.default_model.c_str(),
+          cfg.strict ? ", strict" : "");
+
+  while (true) {
+    struct sockaddr_in peer {};
+    socklen_t plen = sizeof peer;
+    int client =
+        accept(listen_fd, reinterpret_cast<struct sockaddr*>(&peer), &plen);
+    if (client < 0) continue;
+    char ip[INET_ADDRSTRLEN] = "";
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof ip);
+    std::thread(handle_connection, std::cref(cfg), client, std::string(ip))
+        .detach();
+  }
+}

@@ -1,0 +1,300 @@
+"""Integration tests for the native C++ router (native/router/).
+
+Builds llkt-router with make, runs it against fake OpenAI backends, and
+pins the same routing semantics as the Python router's tests
+(tests/test_router.py, SURVEY §3.1): exact model match, silent default
+fallback, synthesized /v1/models, /health, strict-404, forwarded headers,
+502 on dead upstream — plus streaming: chunks must arrive incrementally
+(never buffered), both for chunked and EOF-framed upstream responses.
+"""
+
+import http.client
+import http.server
+import json
+import shutil
+import socket
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+ROUTER_DIR = REPO / "native" / "router"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeBackend(http.server.BaseHTTPRequestHandler):
+    """Echo backend: reports its name, the routed model and proxy headers.
+
+    /v1/stream replies with chunked transfer-encoding, one SSE event per
+    chunk with a delay between them (so a buffering proxy is detectable by
+    first-chunk latency). /v1/stream-eof replies HTTP/1.0-style with no
+    framing (EOF-terminated body).
+    """
+
+    server_version = "FakeBackend/1"
+    protocol_version = "HTTP/1.1"
+    name = "backend"
+
+    def log_message(self, *a):  # noqa: N802
+        pass
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            body = {}
+        if self.path == "/v1/stream":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for i in range(3):
+                data = f"data: {self.name}-{i}\n\n".encode()
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                self.wfile.flush()
+                time.sleep(0.25)
+            self.wfile.write(b"0\r\n\r\n")
+            return
+        if self.path == "/v1/stream-eof":
+            # EOF-framed: no Content-Length, no chunking, close at the end
+            self.protocol_version = "HTTP/1.0"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            for i in range(3):
+                self.wfile.write(f"data: {self.name}-{i}\n\n".encode())
+                self.wfile.flush()
+                time.sleep(0.25)
+            self.close_connection = True
+            return
+        payload = json.dumps({
+            "served_by": self.name,
+            "model": body.get("model"),
+            "x_real_ip": self.headers.get("X-Real-IP", ""),
+            "x_fwd": self.headers.get("X-Forwarded-For", ""),
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def start_backend(name: str):
+    handler = type(f"Backend_{name}", (FakeBackend,), {"name": name})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def binary():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["make", "-C", str(ROUTER_DIR)], check=True,
+                   capture_output=True)
+    return ROUTER_DIR / "llkt-router"
+
+
+class RouterProc:
+    def __init__(self, binary, backends: dict[str, int], strict=False):
+        self.port = free_port()
+        spec = ",".join(f"{n}=http://127.0.0.1:{p}" for n, p in backends.items())
+        args = [str(binary), "--models", spec, "--port", str(self.port),
+                "--quiet"]
+        if strict:
+            args.append("--strict")
+        self.proc = subprocess.Popen(args, stderr=subprocess.PIPE)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                                  timeout=1)
+                conn.request("GET", "/health")
+                if conn.getresponse().read() == b"OK":
+                    conn.close()
+                    return
+            except OSError:
+                time.sleep(0.02)
+        raise RuntimeError("router did not come up")
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait(timeout=5)
+
+    def request(self, method, path, body=None, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=10)
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers=headers or
+                     ({"Content-Type": "application/json"} if payload else {}))
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+
+@pytest.fixture(scope="module")
+def stack(binary):
+    b1, b2 = start_backend("modelA"), start_backend("modelB")
+    router = RouterProc(binary, {
+        "modelA": b1.server_address[1],
+        "modelB": b2.server_address[1],
+    })
+    yield router
+    router.stop()
+    b1.shutdown()
+    b2.shutdown()
+
+
+def test_health(stack):
+    status, data = stack.request("GET", "/health")
+    assert status == 200 and data == b"OK"
+
+
+def test_models_synthesized(stack):
+    status, data = stack.request("GET", "/v1/models")
+    assert status == 200
+    models = json.loads(data)
+    assert models["object"] == "list"
+    assert [m["id"] for m in models["data"]] == ["modelA", "modelB"]
+    assert all(m["owned_by"] == "llms-on-kubernetes-tpu" for m in models["data"])
+
+
+def test_exact_match_routes_to_named_backend(stack):
+    for model in ("modelA", "modelB"):
+        status, data = stack.request("POST", "/v1/chat/completions",
+                                     {"model": model})
+        assert status == 200
+        assert json.loads(data)["served_by"] == model
+
+
+def test_unknown_or_missing_model_falls_back_to_default(stack):
+    # reference semantics: silent fallback to the first model (SURVEY §3.1)
+    for body in ({"model": "nope"}, {}):
+        status, data = stack.request("POST", "/v1/chat/completions", body)
+        assert json.loads(data)["served_by"] == "modelA"
+    # malformed JSON body also falls back
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=10)
+    conn.request("POST", "/v1/chat/completions", body=b"not json",
+                 headers={"Content-Type": "application/json"})
+    assert json.loads(conn.getresponse().read())["served_by"] == "modelA"
+    conn.close()
+
+
+def test_forwarded_headers(stack):
+    _, data = stack.request("POST", "/v1/chat/completions", {"model": "modelA"})
+    resp = json.loads(data)
+    assert resp["x_real_ip"] == "127.0.0.1"
+    assert resp["x_fwd"].endswith("127.0.0.1")
+
+
+def test_keep_alive_multiple_requests_one_connection(stack):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=10)
+    for model in ("modelA", "modelB", "modelA"):
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps({"model": model}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert json.loads(resp.read())["served_by"] == model
+    conn.close()
+
+
+@pytest.mark.parametrize("path", ["/v1/stream", "/v1/stream-eof"])
+def test_streaming_is_not_buffered(stack, path):
+    """First SSE event must arrive well before the backend finishes
+    (backend sleeps 0.25s between events; a buffering proxy would deliver
+    everything at ~0.75s)."""
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=10)
+    t0 = time.monotonic()
+    conn.request("POST", path,
+                 body=json.dumps({"model": "modelB"}).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    # read the raw relay (framing included) so arrival timing is observable
+    buf = b""
+    first_latency = None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        chunk = resp.fp.read1(4096)
+        if not chunk:
+            break
+        buf += chunk
+        if first_latency is None and b"modelB-0" in buf:
+            first_latency = time.monotonic() - t0
+        if b"modelB-2" in buf and (path == "/v1/stream-eof"
+                                   or buf.endswith(b"0\r\n\r\n")):
+            break
+    total = time.monotonic() - t0
+    conn.close()
+    assert b"modelB-0" in buf and b"modelB-2" in buf
+    assert first_latency is not None and first_latency < 0.2, (
+        f"first chunk took {first_latency}s (buffered?)")
+    assert total > 0.4  # the later events really were delayed
+
+
+def test_upstream_down_returns_502(binary):
+    router = RouterProc(binary, {"dead": free_port()})
+    try:
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "dead"})
+        assert status == 502
+        assert json.loads(data)["error"]["type"] == "bad_gateway"
+    finally:
+        router.stop()
+
+
+def test_strict_mode_404s_unknown_model(binary):
+    backend = start_backend("modelA")
+    router = RouterProc(binary, {"modelA": backend.server_address[1]},
+                        strict=True)
+    try:
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "nope"})
+        assert status == 404
+        assert json.loads(data)["error"]["code"] == "model_not_found"
+        # absent model still falls back even in strict mode
+        status, data = router.request("POST", "/v1/chat/completions", {})
+        assert status == 200 and json.loads(data)["served_by"] == "modelA"
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_config_file_mode(binary, tmp_path):
+    backend = start_backend("cfgmodel")
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "models": {"cfgmodel": f"http://127.0.0.1:{backend.server_address[1]}"},
+        "default": "cfgmodel",
+        "upstream_timeout_s": 10,
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    try:
+        deadline = time.monotonic() + 5
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+                conn.request("GET", "/v1/models")
+                ok = b"cfgmodel" in conn.getresponse().read()
+                conn.close()
+            except OSError:
+                time.sleep(0.02)
+        assert ok
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
