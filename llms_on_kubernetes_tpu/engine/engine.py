@@ -47,7 +47,10 @@ Params = dict[str, Any]
 @dataclasses.dataclass
 class SamplingParams:
     temperature: float = 1.0
-    top_k: int = 0          # 0 => disabled
+    # 0 => no top-k filter. NOTE: sampling draws from a fixed top-64
+    # candidate pool regardless (sampling.MAX_CANDIDATES — a full-vocab
+    # sort is ~16 ms/step on TPU); values > 64 are effectively clamped.
+    top_k: int = 0
     top_p: float = 1.0
     max_tokens: int = 128
     stop_token_ids: tuple[int, ...] = ()
@@ -67,6 +70,21 @@ class EngineConfig:
     # multi-host pod group: coordinator broadcasts each step's inputs so
     # follower processes enter the same SPMD programs (engine/multihost.py)
     multihost: bool = False
+    # async (pipelined) scheduling: keep up to async_depth decode steps in
+    # flight, feeding each step's on-device sampled tokens straight into the
+    # next launch and harvesting host copies afterwards — hides the
+    # host<->device round trip behind device compute (vLLM-style async
+    # scheduling, re-done for JAX's dispatch model). Finishes/stop tokens
+    # are detected one harvest late; the speculative extra step is harmless
+    # (its writes land in pages that are only reused after device-ordered
+    # completion). Disabled automatically under multihost (the broadcast
+    # protocol carries host values).
+    async_scheduling: bool = True
+    async_depth: int = 2
+    # async admission: up to this many same-bucket waiting requests prefill
+    # together in one [K, bucket] call (padded to exactly 1 or admit_batch
+    # rows so each bucket compiles two executables, not one per K)
+    admit_batch: int = 4
     seed: int = 0
 
     @property
@@ -99,6 +117,76 @@ class StepEvent:
     new_tokens: list[int]
     finished: bool
     finish_reason: Optional[str]
+
+
+@dataclasses.dataclass
+class InflightStep:
+    """A launched-but-unharvested decode step (async scheduling)."""
+    toks: Any                              # device array [B] int32
+    active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
+    prefetched: bool = False               # copy_to_host_async() issued
+
+
+def _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row):
+    """Builds the decode input vector on device: per slot take the previous
+    in-flight step's sampled token (src 0), a host-known value (src 1), or
+    the token sampled by this step's prefill at row prefill_row (src 2)."""
+    return jnp.where(src == 0, last_toks,
+                     jnp.where(src == 1, vals, prefill_toks[prefill_row]))
+
+
+# --- packed single-upload step variants (async scheduling) -----------------
+# Over a remote-device tunnel every host->device transfer costs a round
+# trip; shipping the scheduler's 7 small arrays separately costs ~35 ms per
+# step vs ~5 ms for one packed int32 array (floats ride along bitcast).
+# The token merge and the PRNG fold_in also move inside the executable so a
+# decode step is exactly ONE upload + ONE dispatch.
+
+# packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
+# 5 top_p(bits), 6 step(row 0), 7 prefill_row, 8.. page_table
+_DEC_COLS = 8
+
+
+def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
+                        k_pages, v_pages, base_key):
+    lengths = packed[:, 0]
+    src, vals = packed[:, 1], packed[:, 2]
+    top_ks = packed[:, 3]
+    temps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+    step = packed[0, 6]
+    prefill_row = packed[:, 7]
+    page_table = packed[:, _DEC_COLS:]
+
+    tokens = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
+    key = jax.random.fold_in(base_key, step)
+    logits, k_pages, v_pages = forward_decode(
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+    )
+    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
+
+
+# packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
+# 4 step(row 0), 5.. page_table
+_PRE_COLS = 5
+
+
+def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
+                         base_key):
+    lengths = packed[:, 0]
+    top_ks = packed[:, 1]
+    temps = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
+    step = packed[0, 4]
+    page_table = packed[:, _PRE_COLS:]
+
+    key = jax.random.fold_in(base_key, step)
+    logits, k_pages, v_pages = forward_prefill(
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+    )
+    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
 
 
 def _prefill_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
@@ -197,6 +285,21 @@ class Engine:
         self._decode = jax.jit(
             _decode_step, static_argnums=(1,), donate_argnums=(4, 5)
         )
+        self._prefill_packed = jax.jit(
+            _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
+        )
+        self._decode_packed = jax.jit(
+            _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6)
+        )
+
+        # async scheduling state (see EngineConfig.async_scheduling)
+        self._async = bool(engine_config.async_scheduling) and not engine_config.multihost
+        self._inflight: "collections.deque[InflightStep]" = collections.deque()
+        # (request, prefill toks device array, row) awaiting first-token harvest
+        self._pending_first: list[tuple[Request, Any, int]] = []
+        # device-resident zero vectors for the packed steps (uploaded once)
+        self._zeros_B = jnp.zeros((B,), jnp.int32)
+        self._zeros_1 = jnp.zeros((1,), jnp.int32)
 
     # ------------------------------------------------------------------
     # submission
@@ -237,7 +340,8 @@ class Engine:
         return req
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(r is not None for r in self.slots)
+        return (bool(self.waiting) or any(r is not None for r in self.slots)
+                or bool(self._inflight) or bool(self._pending_first))
 
     # ------------------------------------------------------------------
     # scheduler iteration
@@ -246,8 +350,13 @@ class Engine:
     def step(self) -> list[StepEvent]:
         events: list[StepEvent] = []
         events += self._reap_aborted()
-        events += self._admit_one()
-        events += self._decode_once()
+        if self._async:
+            admitted = self._admit_async(events)
+            launched = self._launch_decode_async(admitted, events)
+            events += self._harvest(drain=not launched)
+        else:
+            events += self._admit_one()
+            events += self._decode_once()
         for ev in events:
             ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
         return events
@@ -461,6 +570,223 @@ class Engine:
             new = int(sampled[i])
             r.pending_token = new
             events += self._emit(r, new)
+        return events
+
+    # ------------------------------------------------------------------
+    # async (pipelined) scheduling
+    # ------------------------------------------------------------------
+
+    def _inflight_count(self, slot: int) -> int:
+        """In-flight decode steps that will grow THIS slot's current
+        request. Steps whose entry at this slot refers to a previous
+        (finished/preempted) occupant must not count — they write garbage
+        the harvest skips, and counting them would inflate the new
+        request's attention length into unwritten positions."""
+        cur = self.slots[slot]
+        return sum(1 for s in self._inflight
+                   for j, r in s.active if j == slot and r is cur)
+
+    def _admit_async(self, events: list[StepEvent]):
+        """Admission without host sync: prefill up to admit_batch waiting
+        same-bucket requests in ONE padded call; first-token reads are
+        deferred to _harvest. Returns None or a dict describing the
+        admissions for the decode launch's on-device token merge."""
+        picked: list[tuple[int, "Request", bool, list[int]]] = []
+        with self._lock:
+            while self.waiting and len(picked) < self.config.admit_batch:
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                req = self.waiting[0]
+                resumed = bool(req.output)
+                prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
+                n = len(prefill_tokens)
+                if (n > max(self.config.prefill_buckets)
+                        or self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot):
+                    self.waiting.popleft()
+                    events.append(self._finish(req, "length"))
+                    continue
+                if picked and self._bucket_for(n) != self._bucket_for(
+                        len(picked[0][3])):
+                    break  # next request needs a different bucket
+                if not self.allocator.can_allocate(slot, n + 1):
+                    break  # wait for pages to free up
+                self.waiting.popleft()
+                self.allocator.allocate(slot, n + 1)
+                self.slots[slot] = req
+                req.slot = slot
+                picked.append((slot, req, resumed, prefill_tokens))
+        if not picked:
+            return None
+
+        bucket = max(self._bucket_for(len(p[3])) for p in picked)
+        # pad the batch to 1 or admit_batch rows (two executables per bucket)
+        K = 1 if len(picked) == 1 else self.config.admit_batch
+        pps = self.allocator.pages_per_slot
+        tokens = np.zeros((K, bucket), np.int32)
+        packed = np.zeros((K, _PRE_COLS + pps), np.int32)
+        packed[:, 3] = np.float32(1.0).view(np.int32)  # top_p disabled
+        packed[0, 4] = next(self._step_counter)
+        for row, (slot, req, _resumed, ptoks) in enumerate(picked):
+            n = len(ptoks)
+            tokens[row, :n] = ptoks
+            packed[row, 0] = n
+            packed[row, 1] = req.params.top_k
+            packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
+            packed[row, 3] = np.float32(req.params.top_p).view(np.int32)
+            packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
+            self.slot_len[slot] = n
+
+        toks, _lps, self.k_pages, self.v_pages = self._prefill_packed(
+            self.params, self.model_config, jnp.asarray(tokens),
+            jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
+        )
+        merge = {"toks": toks, "slots": {}}
+        for row, (slot, req, resumed, _ptoks) in enumerate(picked):
+            if resumed:
+                # pending token is already host-known (the last emitted
+                # token); the prefill's sampled token is discarded, as in
+                # the sync path
+                req.pending_token = req.output[-1]
+                merge["slots"][slot] = (True, req.output[-1], row)
+            else:
+                merge["slots"][slot] = (False, 0, row)
+                self._pending_first.append((req, toks, row))
+        return merge
+
+    def _launch_decode_async(self, admitted, events: list[StepEvent]) -> bool:
+        """Launch one decode step whose input tokens are assembled ON DEVICE
+        from the newest in-flight step's output (continuing slots), host
+        values (slots with no step in flight), and this step's prefill
+        (just-admitted slots). Returns True iff a step was launched."""
+        B = self.config.max_decode_slots
+        max_len = self.config.max_model_len
+
+        # grow page tables; drain in-flight work, then preempt, on exhaustion
+        i = 0
+        while i < B:
+            r = self.slots[i]
+            if r is None:
+                i += 1
+                continue
+            need = int(self.slot_len[i]) + self._inflight_count(i) + 1
+            if need > max_len:
+                i += 1  # rides along idle; finishes by length at harvest
+                continue
+            try:
+                self.allocator.allocate(i, need)
+                i += 1
+            except MemoryError:
+                if self._inflight or self._pending_first:
+                    # freeing may come from finishes hiding in unharvested
+                    # steps — drain before resorting to preemption
+                    events += self._harvest(drain=True)
+                    continue
+                self._preempt_youngest()
+
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return False
+
+        pps = self.allocator.pages_per_slot
+        packed = np.zeros((B, _DEC_COLS + pps), np.int32)
+        packed[:, 1] = 1                                   # src: host value
+        packed[:, 5] = np.float32(1.0).view(np.int32)      # top_p disabled
+        packed[0, 6] = next(self._step_counter)
+        for i, r in active:
+            need = int(self.slot_len[i]) + self._inflight_count(i) + 1
+            packed[i, 0] = 0 if need > max_len else need
+            packed[i, 3] = r.params.top_k
+            packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
+            packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
+            if admitted is not None and i in admitted["slots"]:
+                resumed, host_val, row = admitted["slots"][i]
+                if resumed:              # resumed: host-known pending token
+                    packed[i, 1], packed[i, 2] = 1, host_val
+                else:                    # fresh: token sampled by the prefill
+                    packed[i, 1], packed[i, 7] = 2, row
+            elif self._inflight_count(i) > 0:
+                packed[i, 1] = 0         # newest in-flight step's output
+            else:
+                packed[i, 1], packed[i, 2] = 1, r.pending_token
+        packed[:, _DEC_COLS:] = self.allocator.page_tables
+
+        last_toks = self._inflight[-1].toks if self._inflight else self._zeros_B
+        prefill_toks = admitted["toks"] if admitted is not None else self._zeros_1
+
+        toks, _lps, self.k_pages, self.v_pages = self._decode_packed(
+            self.params, self.model_config, jnp.asarray(packed),
+            last_toks, prefill_toks, self.k_pages, self.v_pages, self._key,
+        )
+        self._inflight.append(InflightStep(toks, active))
+        # start device->host transfers for every OLDER queued step (their
+        # compute has finished or will before ours): by harvest time the
+        # host copy is already local and device_get returns immediately
+        for step in list(self._inflight)[:-1]:
+            if not step.prefetched:
+                step.prefetched = True
+                try:
+                    step.toks.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+        return True
+
+    def _harvest(self, drain: bool) -> list[StepEvent]:
+        """Read host copies of completed device work: prefill first tokens
+        (always — the prefill finished before anything launched after it)
+        and in-flight decode steps beyond the pipeline depth (all of them
+        when draining). The np.asarray calls overlap with whatever is still
+        executing on device."""
+        events: list[StepEvent] = []
+        # Hysteresis: start harvesting only when the pipeline is full, then
+        # pop down to HALF depth in one batched read. The device->host round
+        # trip is a flat cost per read no matter how much it carries, so
+        # reading steps one-by-one would pay it every step; reading
+        # depth/2 steps at once amortizes it across that many tokens/slot.
+        popped: list[InflightStep] = []
+        if drain:
+            while self._inflight:
+                popped.append(self._inflight.popleft())
+        elif len(self._inflight) >= max(1, self.config.async_depth):
+            low = max(1, self.config.async_depth // 2)
+            while len(self._inflight) > low:
+                popped.append(self._inflight.popleft())
+        firsts, self._pending_first = self._pending_first, []
+
+        if not popped and not firsts:
+            return events
+        # ONE device->host transfer for everything harvestable this step:
+        # over a remote device tunnel each read costs a full round trip
+        # (~100 ms flat), so per-step reads must never be issued separately.
+        host = jax.device_get([s.toks for s in popped]
+                              + [t for _, t, _ in firsts])
+
+        for (req, _, row), first in zip(firsts, host[len(popped):]):
+            if req.finished:
+                continue
+            tok = int(first[row])
+            req.pending_token = tok
+            req.first_token_at = time.monotonic()
+            events += self._emit(req, tok)
+
+        for step, toks in zip(popped, host[:len(popped)]):
+            for slot, req in step.active:
+                # skip slots whose request finished/aborted/was preempted
+                # after this step launched — their sampled token is garbage
+                if req.finished or req.slot != slot:
+                    continue
+                self.slot_len[slot] += 1
+                tok = int(toks[slot])
+                req.pending_token = tok
+                events += self._emit(req, tok)
+        return events
+
+    def _drain_async(self) -> list[StepEvent]:
+        """Synchronize: harvest everything in flight (used before state
+        inspection / shutdown)."""
+        events = self._harvest(drain=True)
+        for ev in events:
+            ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
         return events
 
     # ------------------------------------------------------------------

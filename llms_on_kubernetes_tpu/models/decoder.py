@@ -237,7 +237,12 @@ def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray
 def _logits(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("bd,dv->bv", x.astype(jnp.float32), head.astype(jnp.float32))
+    # bf16 operands + f32 accumulation: native MXU path. Casting the head
+    # to f32 would stream the whole [D, V] matrix (the model's biggest
+    # tensor) through a convert on every step for no accuracy gain — TPU
+    # f32 matmuls decompose into bf16 passes anyway.
+    logits = jnp.einsum("bd,dv->bv", x, head.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
     return softcap(logits, cfg.logit_softcap)
 
 

@@ -86,12 +86,25 @@ def quantize(w, reduce_axes: tuple[int, ...]) -> QTensor:
 def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
     """einsum where the second operand may be a QTensor.
 
-    The dequantize (convert + scale multiply) is expressed inline so XLA
-    fuses it into the dot's operand read — no bf16 weight in HBM.
+    The per-output-channel scale is applied to the einsum OUTPUT, not the
+    weight: scales live only on non-contracted axes (keepdims policy), so
+    ``einsum(x, w_int8 * s) == einsum(x, w_int8) * s_broadcast`` exactly —
+    and the multiply touches the small activation tensor instead of the
+    weight, guaranteeing the dequantized weight never materializes in HBM
+    no matter how XLA schedules the fusion. Only the int8->bf16 convert
+    rides on the weight read (fused into the MXU operand load).
     """
-    if isinstance(w, QTensor):
-        w = (w.data.astype(x.dtype) * w.scale.astype(x.dtype))
-    return jnp.einsum(eq, x, w)
+    if not isinstance(w, QTensor):
+        return jnp.einsum(eq, x, w)
+    lhs, out = eq.split("->")
+    _, w_sub = lhs.split(",")
+    # scale's non-1 dims sit on w's non-contracted axes, which appear in
+    # the output in the same relative order (true for every decoder eq)
+    out_shape = tuple(
+        w.scale.shape[w_sub.index(c)] if c in w_sub else 1 for c in out
+    )
+    y = jnp.einsum(eq, x, w.data.astype(x.dtype))
+    return (y * w.scale.reshape(out_shape).astype(x.dtype)).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,15 @@ class EngineLoop(threading.Thread):
 
     def run(self) -> None:
         eng = self.engine
+        try:
+            self._run()
+        finally:
+            # harvest anything still in flight so streaming clients get
+            # their final events instead of hanging on a graceful shutdown
+            eng._drain_async()
+
+    def _run(self) -> None:
+        eng = self.engine
         while not self._stop.is_set():
             if not eng.has_work():
                 self._wake.wait(timeout=0.05)

@@ -21,12 +21,17 @@
 // this one do not). X-Real-IP / X-Forwarded-For / X-Forwarded-Proto are
 // appended like the reference's proxy block (model-gateway.yaml:78-81).
 //
-// Config: JSON file (--config) with
-//   {"models": {"<name>": "http://host:port", ...},
-//    "default": "<name>",             // optional; first model otherwise
+// Config: JSON file (--config) with the same schema the Helm chart's
+// ConfigMap emits for the python router (k8s/*/templates/router-config.yaml,
+// deploy/manifests.py:router_config):
+//   {"backends": {"<name>": "http://host:port", ...},
+//    "default_model": "<name>",       // optional; first model otherwise
 //    "strict": false,                 // optional; 404 unknown models
 //    "upstream_timeout_s": 300}       // optional; reference used 300s
-// or inline --models "name=url,name2=url2" (tests, quick runs).
+// ("models"/"default" are accepted as aliases.) Or inline
+// --models "name=url,name2=url2" (tests, quick runs). A leading "router"
+// subcommand token is accepted and ignored so the binary is invocable with
+// the exact argv the chart passes the python CLI (`router --config ...`).
 //
 // Threading: one detached thread per connection (the gateway is I/O-bound;
 // per-model backends do the heavy work). Client keep-alive is honored;
@@ -365,9 +370,11 @@ static bool load_config_json(const std::string& file, Config& cfg) {
     fprintf(stderr, "llkt-router: malformed config %s\n", file.c_str());
     return false;
   }
-  const Json* models = root->get("models");
+  const Json* models = root->get("backends");
+  if (!models) models = root->get("models");
   if (!models || !models->is_object() || models->obj.empty()) {
-    fprintf(stderr, "llkt-router: config needs a non-empty models object\n");
+    fprintf(stderr,
+            "llkt-router: config needs a non-empty backends/models object\n");
     return false;
   }
   for (const auto& kv : models->obj) {
@@ -380,8 +387,9 @@ static bool load_config_json(const std::string& file, Config& cfg) {
     }
     cfg.models.emplace_back(kv.first, *url);
   }
-  if (const Json* d = root->get("default"); d && d->is_string())
-    cfg.default_model = d->str;
+  const Json* d = root->get("default_model");
+  if (!d) d = root->get("default");
+  if (d && d->is_string()) cfg.default_model = d->str;
   if (const Json* s = root->get("strict"); s && s->type == Json::Type::Bool)
     cfg.strict = s->boolean;
   if (const Json* t = root->get("upstream_timeout_s");
@@ -419,7 +427,9 @@ int main(int argc, char** argv) {
     auto next = [&]() -> const char* {
       return i + 1 < argc ? argv[++i] : nullptr;
     };
-    if (a == "--config") {
+    if (i == 1 && a == "router") {
+      continue;  // python-CLI-compatible subcommand token (see header)
+    } else if (a == "--config") {
       const char* v = next();
       if (!v) return 2;
       config_file = v;

@@ -271,16 +271,51 @@ def test_strict_mode_404s_unknown_model(binary):
         backend.shutdown()
 
 
-def test_config_file_mode(binary, tmp_path):
-    backend = start_backend("cfgmodel")
+def test_config_file_mode_legacy_schema(binary, tmp_path):
+    """The legacy models/default config keys stay accepted as aliases
+    (router.cpp load_config_json falls back to them)."""
+    backend = start_backend("legacymodel")
     cfg = tmp_path / "router.json"
     cfg.write_text(json.dumps({
-        "models": {"cfgmodel": f"http://127.0.0.1:{backend.server_address[1]}"},
-        "default": "cfgmodel",
-        "upstream_timeout_s": 10,
+        "models": {"legacymodel": f"http://127.0.0.1:{backend.server_address[1]}"},
+        "default": "legacymodel",
     }))
     port = free_port()
     proc = subprocess.Popen([str(binary), "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    try:
+        deadline = time.monotonic() + 5
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+                conn.request("GET", "/v1/models")
+                ok = b"legacymodel" in conn.getresponse().read()
+                conn.close()
+            except OSError:
+                time.sleep(0.02)
+        assert ok
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+
+
+def test_config_file_mode_chart_schema(binary, tmp_path):
+    """The exact invocation + config schema the Helm chart uses for the
+    python router must work verbatim on the native binary: a leading
+    `router` subcommand token and backends/default_model keys
+    (k8s/*/templates/router-config.yaml)."""
+    backend = start_backend("cfgmodel")
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {"cfgmodel": f"http://127.0.0.1:{backend.server_address[1]}"},
+        "default_model": "cfgmodel",
+        "strict": False,
+        "upstream_timeout_s": 10,
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
                              "--port", str(port), "--quiet"])
     try:
         deadline = time.monotonic() + 5
