@@ -127,21 +127,34 @@ def main(argv: list[str] | None = None) -> int:
 
     model_dir = None
     model_cfg = None
-    try:
-        model_cfg = get_config(args.model)
-    except KeyError:
-        pass
-    if not args.random_weights:
+    gguf_path = None
+    # GGUF file path: the local solution's `modelPath` contract (reference
+    # ramalama values.yaml modelPath -> llama-server --model <file>.gguf)
+    if args.model.endswith(".gguf"):
+        if not os.path.isfile(args.model):
+            raise SystemExit(f"GGUF file not found: {args.model}")
+        gguf_path = args.model
+        from llms_on_kubernetes_tpu.engine.gguf import GGUFFile, config_from_gguf
+
+        gf = GGUFFile(gguf_path)
+        model_cfg = config_from_gguf(gf, name=args.served_model_name)
+        gf.close()
+    else:
         try:
-            model_dir = resolve_model_dir(args.model)
-        except FileNotFoundError:
-            if model_cfg is None:
-                raise
-            print(f"[serve] no local checkpoint for {args.model}; "
-                  f"falling back to --random-weights", file=sys.stderr)
-    if model_cfg is None and model_dir is not None:
-        cfg_path = os.path.join(model_dir, "config.json")
-        model_cfg = from_hf_config(cfg_path, name=args.model)
+            model_cfg = get_config(args.model)
+        except KeyError:
+            pass
+        if not args.random_weights:
+            try:
+                model_dir = resolve_model_dir(args.model)
+            except FileNotFoundError:
+                if model_cfg is None:
+                    raise
+                print(f"[serve] no local checkpoint for {args.model}; "
+                      f"falling back to --random-weights", file=sys.stderr)
+        if model_cfg is None and model_dir is not None:
+            cfg_path = os.path.join(model_dir, "config.json")
+            model_cfg = from_hf_config(cfg_path, name=args.model)
     if model_cfg is None:
         raise SystemExit(f"cannot resolve model {args.model!r}")
 
@@ -167,9 +180,22 @@ def main(argv: list[str] | None = None) -> int:
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
     )
+    gguf_params = None
+    if gguf_path is not None and not args.random_weights:
+        from llms_on_kubernetes_tpu.engine.gguf import load_gguf_params
+
+        _, gguf_params = load_gguf_params(
+            gguf_path, cfg=model_cfg, dtype=args.dtype,
+            quantization=args.quantization, mesh=mesh,
+        )
     engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
-                    model_dir=None if args.random_weights else model_dir)
-    tokenizer = load_tokenizer(model_dir)
+                    params=gguf_params,
+                    model_dir=None if (args.random_weights or gguf_params is not None)
+                    else model_dir)
+    # for GGUF serving, tokenizer files conventionally sit beside the file
+    tokenizer = load_tokenizer(
+        model_dir if gguf_path is None else os.path.dirname(gguf_path) or "."
+    )
     served = args.served_model_name or model_cfg.name
     print(f"[serve] {served}: mesh={dict(mesh.shape)} dtype={args.dtype} "
           f"max_len={engine_cfg.max_model_len} multi_host={multi_host}",
