@@ -44,6 +44,10 @@ helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
 - --quantization
 - {{ $m.quantization | quote }}
 {{- end }}
+{{- if $m.dtype }}
+- --dtype
+- {{ $m.dtype | quote }}
+{{- end }}
 {{- range $m.engineArgs }}
 - {{ . | quote }}
 {{- end }}

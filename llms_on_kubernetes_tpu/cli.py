@@ -130,15 +130,16 @@ def main(argv: list[str] | None = None) -> int:
     gguf_path = None
     # GGUF file path: the local solution's `modelPath` contract (reference
     # ramalama values.yaml modelPath -> llama-server --model <file>.gguf)
+    gguf_file = None
     if args.model.endswith(".gguf"):
         if not os.path.isfile(args.model):
             raise SystemExit(f"GGUF file not found: {args.model}")
         gguf_path = args.model
         from llms_on_kubernetes_tpu.engine.gguf import GGUFFile, config_from_gguf
 
-        gf = GGUFFile(gguf_path)
-        model_cfg = config_from_gguf(gf, name=args.served_model_name)
-        gf.close()
+        # parsed ONCE; reused for config, weights, and the embedded tokenizer
+        gguf_file = GGUFFile(gguf_path)
+        model_cfg = config_from_gguf(gguf_file, name=args.served_model_name)
     else:
         try:
             model_cfg = get_config(args.model)
@@ -181,21 +182,33 @@ def main(argv: list[str] | None = None) -> int:
         multihost=multi_host,
     )
     gguf_params = None
-    if gguf_path is not None and not args.random_weights:
+    if gguf_file is not None and not args.random_weights:
         from llms_on_kubernetes_tpu.engine.gguf import load_gguf_params
 
         _, gguf_params = load_gguf_params(
-            gguf_path, cfg=model_cfg, dtype=args.dtype,
+            gguf_file, cfg=model_cfg, dtype=args.dtype,
             quantization=args.quantization, mesh=mesh,
-        )
+        )  # closes the mmap; the parsed metadata dict stays usable
+    elif gguf_file is not None:
+        gguf_file.close()
     engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
                     params=gguf_params,
                     model_dir=None if (args.random_weights or gguf_params is not None)
                     else model_dir)
-    # for GGUF serving, tokenizer files conventionally sit beside the file
-    tokenizer = load_tokenizer(
-        model_dir if gguf_path is None else os.path.dirname(gguf_path) or "."
-    )
+    if gguf_file is not None:
+        # prefer HF tokenizer files beside the .gguf; else the tokenizer
+        # embedded in the GGUF metadata itself (a bare .gguf is the
+        # documented modelPath contract — it carries its own vocab)
+        from llms_on_kubernetes_tpu.engine.tokenizer import (
+            ByteTokenizer, GGUFTokenizer,
+        )
+
+        tokenizer = load_tokenizer(os.path.dirname(gguf_path) or ".")
+        if (isinstance(tokenizer, ByteTokenizer)
+                and "tokenizer.ggml.tokens" in gguf_file.metadata):
+            tokenizer = GGUFTokenizer(gguf_file.metadata)
+    else:
+        tokenizer = load_tokenizer(model_dir)
     served = args.served_model_name or model_cfg.name
     print(f"[serve] {served}: mesh={dict(mesh.shape)} dtype={args.dtype} "
           f"max_len={engine_cfg.max_model_len} multi_host={multi_host}",

@@ -77,6 +77,8 @@ def _engine_args(m: ModelSpec, spec: DeploySpec) -> list[str]:
             args += ["--expert-parallel-size", str(sh.ep)]
     if m.quantization:
         args += ["--quantization", m.quantization]
+    if m.dtype:
+        args += ["--dtype", m.dtype]
     args += list(m.engine_args)
     return args
 
@@ -116,11 +118,20 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
         ],
         **_probes(),
     }
+    if m.tpu is None:
+        # local/CPU profile: force the XLA-CPU backend (same env the
+        # local-models chart sets) so the TPU-enabled image runs on
+        # accelerator-less nodes
+        c["env"].append({"name": "JAX_PLATFORMS", "value": "cpu"})
     if m.tpu is not None:
         c["resources"] = {
             "requests": {"google.com/tpu": str(m.tpu.chips_per_host)},
             "limits": {"google.com/tpu": str(m.tpu.chips_per_host)},
         }
+    elif m.resources:
+        # local/CPU profile: verbatim passthrough, like the reference's
+        # `toYaml .resources` (ramalama model-deployments.yaml:36-37)
+        c["resources"] = m.resources
     if m.huggingface_id:
         c["volumeMounts"] = [{
             "name": "hf-cache", "mountPath": "/root/.cache/huggingface",

@@ -36,6 +36,8 @@ class TPUSpec:
     accelerator: str = "v5e"     # v5e | v5p | v6e
     chips: int = 8
     topology: Optional[str] = None  # derived from chips if omitted
+    # explicit pod-group size; None => derived from chips / chips-per-host
+    hosts_override: Optional[int] = None
 
     def resolved_topology(self) -> str:
         if self.topology:
@@ -50,12 +52,17 @@ class TPUSpec:
 
     @property
     def hosts(self) -> int:
+        if self.hosts_override is not None:
+            return self.hosts_override
         per = CHIPS_PER_HOST[self.accelerator]
         return max(1, -(-self.chips // per))
 
     @property
     def chips_per_host(self) -> int:
-        return min(self.chips, CHIPS_PER_HOST[self.accelerator])
+        # derives from the ACTUAL host count so an explicit hosts override
+        # keeps sum(per-pod google.com/tpu) == chips (same formula as the
+        # Helm chart's chipsPerHost helper: ceil(chips / hosts))
+        return max(1, -(-self.chips // self.hosts))
 
     @property
     def multi_host(self) -> bool:
@@ -101,6 +108,11 @@ class ModelSpec:
     quantization: Optional[str] = None     # None | int8
     max_model_len: int = 4096
     engine_args: tuple[str, ...] = ()      # passthrough (reference gap)
+    # free-form k8s resources for CPU/local models (the ramalama chart's
+    # verbatim `toYaml .resources` passthrough, reference
+    # ramalama model-deployments.yaml:36-37); ignored when tpu is set
+    resources: Optional[dict] = None
+    dtype: Optional[str] = None            # engine --dtype override
 
     def validate(self) -> None:
         if not _NAME_RE.match(self.model_name):
@@ -177,13 +189,17 @@ class DeploySpec:
 def _tpu_from(d: Optional[dict]) -> Optional[TPUSpec]:
     if d is None:
         return None
-    unknown = set(d) - {"accelerator", "chips", "topology"}
+    unknown = set(d) - {"accelerator", "chips", "topology", "hosts"}
     if unknown:
         raise SpecError(f"unknown tpu keys: {sorted(unknown)}")
+    hosts = d.get("hosts")
+    if hosts is not None and int(hosts) < 1:
+        raise SpecError(f"tpu.hosts must be >= 1, got {hosts}")
     return TPUSpec(
         accelerator=d.get("accelerator", "v5e"),
         chips=int(d.get("chips", 8)),
         topology=d.get("topology"),
+        hosts_override=int(hosts) if hosts is not None else None,
     )
 
 
@@ -191,7 +207,7 @@ def _model_from(d: dict) -> ModelSpec:
     known = {
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
-        "engineArgs",
+        "engineArgs", "resources", "dtype",
     }
     unknown = set(d) - known
     if unknown:
@@ -206,7 +222,10 @@ def _model_from(d: dict) -> ModelSpec:
         replicas=int(d.get("replicas", 1)),
         pvc_size=str(d.get("pvcSize", "30Gi")),
         pvc_shared=bool(d.get("pvcShared", False)),
-        tpu=_tpu_from(d["tpu"]) if "tpu" in d else TPUSpec(),
+        # modelPath without an explicit tpu block = the local/CPU profile
+        # (the ramalama-equivalent contract has no accelerator at all)
+        tpu=(_tpu_from(d["tpu"]) if "tpu" in d
+             else (None if d.get("modelPath") else TPUSpec())),
         sharding=ShardingSpec(
             tp=int(sh.get("tp", 0)), ep=int(sh.get("ep", 1)),
             data=int(sh.get("data", 1)),
@@ -214,6 +233,8 @@ def _model_from(d: dict) -> ModelSpec:
         quantization=d.get("quantization"),
         max_model_len=int(d.get("maxModelLen", 4096)),
         engine_args=tuple(d.get("engineArgs", ())),
+        resources=d.get("resources"),
+        dtype=d.get("dtype"),
     )
 
 

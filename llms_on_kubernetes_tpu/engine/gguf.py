@@ -317,7 +317,7 @@ def config_from_gguf(gf: GGUFFile, name: Optional[str] = None) -> ModelConfig:
 # ---------------------------------------------------------------------------
 
 def load_gguf_params(
-    path: str,
+    path: "str | GGUFFile",
     cfg: Optional[ModelConfig] = None,
     dtype: Optional[str] = None,
     quantization: Optional[str] = None,
@@ -334,7 +334,7 @@ def load_gguf_params(
     import jax
     import jax.numpy as jnp
 
-    gf = GGUFFile(path)
+    gf = path if isinstance(path, GGUFFile) else GGUFFile(path)
     cfg = cfg or config_from_gguf(gf)
     dt = jnp.dtype(dtype or cfg.dtype)
     H, KV, hd, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size

@@ -85,9 +85,116 @@ class HFTokenizer:
         return ids
 
 
+class GGUFTokenizer:
+    """SentencePiece-style tokenizer from GGUF-embedded vocab metadata.
+
+    llama.cpp ships the tokenizer inside the model file
+    (``tokenizer.ggml.tokens`` / ``scores`` / ``token_type``); serving a
+    bare .gguf (the local solution's modelPath contract, reference
+    ramalama values.yaml) must therefore tokenize from the file itself —
+    no tokenizer.json exists on disk. Implements the SPM scheme
+    (``tokenizer.ggml.model == "llama"``): ▁-for-space normalization,
+    highest-score greedy bigram merging, <0xNN> byte fallback.
+    """
+
+    def __init__(self, metadata: dict):
+        if metadata.get("tokenizer.ggml.model", "llama") != "llama":
+            raise ValueError(
+                "only SentencePiece ('llama') GGUF tokenizers are supported; "
+                f"got {metadata.get('tokenizer.ggml.model')!r}"
+            )
+        self.tokens: list[str] = metadata["tokenizer.ggml.tokens"]
+        self.scores: list[float] = metadata.get(
+            "tokenizer.ggml.scores", [0.0] * len(self.tokens))
+        types = metadata.get("tokenizer.ggml.token_type", [1] * len(self.tokens))
+        self._rank = {t: i for i, t in enumerate(self.tokens)}
+        self._byte_ids = {}
+        self._control = set()
+        for i, (tok, tt) in enumerate(zip(self.tokens, types)):
+            if tt == 6 or (tok.startswith("<0x") and tok.endswith(">")):
+                try:
+                    self._byte_ids[int(tok[3:-1], 16)] = i
+                except ValueError:
+                    pass
+            if tt == 3:  # control
+                self._control.add(i)
+        self.bos_id = int(metadata.get("tokenizer.ggml.bos_token_id", 1))
+        self.eos_id = int(metadata.get("tokenizer.ggml.eos_token_id", 2))
+        self.add_bos = bool(metadata.get("tokenizer.ggml.add_bos_token", True))
+        self._prefix = " " if metadata.get(
+            "tokenizer.ggml.add_space_prefix", True) else ""
+
+    def _encode_piece(self, text: str) -> list[int]:
+        """Greedy SPM: chars -> repeatedly merge the best-scoring bigram."""
+        pieces = list(text)
+        while True:
+            best_i, best_score, best_merged = -1, -1e30, None
+            for i in range(len(pieces) - 1):
+                merged = pieces[i] + pieces[i + 1]
+                rank = self._rank.get(merged)
+                if rank is not None and self.scores[rank] > best_score:
+                    best_i, best_score, best_merged = i, self.scores[rank], merged
+            if best_i < 0:
+                break
+            pieces[best_i:best_i + 2] = [best_merged]
+        out: list[int] = []
+        for p in pieces:
+            rank = self._rank.get(p)
+            if rank is not None:
+                out.append(rank)
+            else:  # byte fallback
+                for b in p.encode("utf-8"):
+                    if b in self._byte_ids:
+                        out.append(self._byte_ids[b])
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        norm = (self._prefix + text).replace(" ", "▁")
+        ids = self._encode_piece(norm)
+        return ([self.bos_id] + ids) if self.add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out = bytearray()
+        for i in ids:
+            if i in self._control or not (0 <= i < len(self.tokens)):
+                continue
+            if i in set(self._byte_ids.values()):
+                tok = self.tokens[i]
+                out.append(int(tok[3:-1], 16))
+            else:
+                out += self.tokens[i].replace("▁", " ").encode("utf-8")
+        return out.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        # generic [INST]-style template (llama.cpp's default for SPM models)
+        text = ""
+        for m in messages:
+            role, content = m.get("role", "user"), m.get("content", "")
+            if role == "system":
+                text += f"<<SYS>>\n{content}\n<</SYS>>\n\n"
+            elif role == "user":
+                text += f"[INST] {content} [/INST]"
+            else:
+                text += f" {content} "
+        return self.encode(text)
+
+    @property
+    def eos_ids(self) -> set[int]:
+        return {self.eos_id}
+
+
 def load_tokenizer(model_ref: Optional[str]) -> TokenizerLike:
     if model_ref and os.path.isdir(model_ref):
         for fname in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json"):
             if os.path.exists(os.path.join(model_ref, fname)):
                 return HFTokenizer(model_ref)
+    if model_ref and model_ref.endswith(".gguf") and os.path.isfile(model_ref):
+        from llms_on_kubernetes_tpu.engine.gguf import GGUFFile
+
+        gf = GGUFFile(model_ref)
+        try:
+            if "tokenizer.ggml.tokens" in gf.metadata:
+                return GGUFTokenizer(gf.metadata)
+        finally:
+            gf.close()
     return ByteTokenizer()

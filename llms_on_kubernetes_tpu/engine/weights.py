@@ -31,7 +31,17 @@ Params = dict[str, Any]
 
 
 def _open_safetensors(model_dir: str) -> dict[str, Callable[[], np.ndarray]]:
-    """Map tensor name -> lazy loader over all *.safetensors files in dir."""
+    """Map tensor name -> lazy loader over all *.safetensors files in dir.
+
+    Prefers the native mmap reader (native/loader/libstload.so via
+    engine/native_loader.py) when built; falls back to the Python
+    ``safetensors`` package."""
+    from llms_on_kubernetes_tpu.engine.native_loader import open_native_safetensors
+
+    native = open_native_safetensors(model_dir)
+    if native is not None:
+        return native
+
     import safetensors
 
     loaders: dict[str, Callable[[], np.ndarray]] = {}

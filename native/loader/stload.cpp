@@ -1,0 +1,248 @@
+// libstload: native safetensors reader for the weight-loading path.
+//
+// The reference stack's data-loading lived in native code inside pulled
+// images (vLLM's C++/safetensors-rust readers, llama.cpp's mmap loader —
+// SURVEY §2.3); this is the TPU-native framework's equivalent for its own
+// engine: mmap every *.safetensors shard in a checkpoint directory, parse
+// the JSON headers (u64-LE length + JSON, per the public safetensors
+// format), and serve tensor reads as multithreaded copies out of the page
+// cache — one madvise(WILLNEED) per tensor so the kernel prefetches ahead
+// of the memcpy. Exposed as a C ABI consumed through ctypes
+// (llms_on_kubernetes_tpu/engine/native_loader.py); the pure-Python
+// safetensors path remains the fallback.
+//
+// Build: make -C native/loader  ->  libstload.so
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <filesystem>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../router/json.hpp"
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Mapped {
+  void* addr = nullptr;
+  size_t size = 0;
+  int fd = -1;
+};
+
+struct TensorInfo {
+  std::string dtype;            // "F32", "BF16", ...
+  std::vector<int64_t> shape;
+  const uint8_t* data = nullptr;  // into the mmap
+  size_t nbytes = 0;
+};
+
+struct Handle {
+  std::vector<Mapped> maps;
+  std::map<std::string, TensorInfo> tensors;
+  std::vector<std::string> names;  // stable iteration order
+};
+
+bool map_file(const std::string& path, Mapped& m) {
+  m.fd = ::open(path.c_str(), O_RDONLY);
+  if (m.fd < 0) {
+    g_error = "cannot open " + path;
+    return false;
+  }
+  struct stat st{};
+  if (fstat(m.fd, &st) != 0 || st.st_size < 8) {
+    g_error = "cannot stat " + path;
+    ::close(m.fd);
+    return false;
+  }
+  m.size = static_cast<size_t>(st.st_size);
+  m.addr = mmap(nullptr, m.size, PROT_READ, MAP_PRIVATE, m.fd, 0);
+  if (m.addr == MAP_FAILED) {
+    g_error = "mmap failed for " + path;
+    ::close(m.fd);
+    return false;
+  }
+  return true;
+}
+
+bool parse_shard(const std::string& path, Handle* h) {
+  Mapped m;
+  if (!map_file(path, m)) return false;
+  h->maps.push_back(m);
+  const uint8_t* base = static_cast<const uint8_t*>(m.addr);
+
+  uint64_t header_len;
+  memcpy(&header_len, base, 8);  // little-endian per spec (and x86/arm64)
+  if (header_len + 8 > m.size) {
+    g_error = "corrupt safetensors header in " + path;
+    return false;
+  }
+  std::string header(reinterpret_cast<const char*>(base + 8), header_len);
+  llkt::JsonPtr root = llkt::JsonParser::parse(header);
+  if (!root || !root->is_object()) {
+    g_error = "unparseable safetensors JSON header in " + path;
+    return false;
+  }
+  const uint8_t* data_base = base + 8 + header_len;
+  size_t data_size = m.size - 8 - header_len;
+
+  for (const auto& kv : root->obj) {
+    if (kv.first == "__metadata__") continue;
+    const llkt::Json* t = kv.second.get();
+    const llkt::Json* dtype = t->get("dtype");
+    const llkt::Json* shape = t->get("shape");
+    const llkt::Json* offs = t->get("data_offsets");
+    if (!dtype || !shape || !offs || offs->arr.size() != 2) {
+      g_error = "malformed tensor entry " + kv.first + " in " + path;
+      return false;
+    }
+    TensorInfo info;
+    info.dtype = dtype->str;
+    for (const auto& d : shape->arr)
+      info.shape.push_back(static_cast<int64_t>(d->number));
+    auto begin = static_cast<size_t>(offs->arr[0]->number);
+    auto end = static_cast<size_t>(offs->arr[1]->number);
+    if (end < begin || end > data_size) {
+      g_error = "tensor " + kv.first + " offsets out of range in " + path;
+      return false;
+    }
+    info.data = data_base + begin;
+    info.nbytes = end - begin;
+    if (h->tensors.emplace(kv.first, info).second)
+      h->names.push_back(kv.first);
+  }
+  return true;
+}
+
+void parallel_copy(void* dst, const void* src, size_t n) {
+  // the page-cache copy is memory-bound; a few threads saturate it
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t nthreads = std::min<size_t>(hw ? hw : 4, 8);
+  const size_t kMin = 8u << 20;  // don't spawn threads under 8 MB
+  if (n < kMin || nthreads <= 1) {
+    memcpy(dst, src, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  size_t chunk = (n + nthreads - 1) / nthreads;
+  for (size_t i = 0; i < nthreads; ++i) {
+    size_t off = i * chunk;
+    if (off >= n) break;
+    size_t len = std::min(chunk, n - off);
+    ts.emplace_back([=] {
+      memcpy(static_cast<uint8_t*>(dst) + off,
+             static_cast<const uint8_t*>(src) + off, len);
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* stl_error() { return g_error.c_str(); }
+
+void* stl_open(const char* path_cstr) {
+  namespace fs = std::filesystem;
+  g_error.clear();
+  auto h = new Handle();
+  std::vector<std::string> files;
+  fs::path p(path_cstr);
+  std::error_code ec;
+  if (fs::is_directory(p, ec)) {
+    for (const auto& e : fs::directory_iterator(p, ec))
+      if (e.path().extension() == ".safetensors")
+        files.push_back(e.path().string());
+    std::sort(files.begin(), files.end());
+  } else if (fs::is_regular_file(p, ec)) {
+    files.push_back(p.string());
+  }
+  if (files.empty()) {
+    g_error = std::string("no *.safetensors under ") + path_cstr;
+    delete h;
+    return nullptr;
+  }
+  for (const auto& f : files) {
+    if (!parse_shard(f, h)) {
+      for (auto& m : h->maps) {
+        if (m.addr) munmap(m.addr, m.size);
+        if (m.fd >= 0) ::close(m.fd);
+      }
+      delete h;
+      return nullptr;
+    }
+  }
+  return h;
+}
+
+int64_t stl_count(void* hv) {
+  return static_cast<int64_t>(static_cast<Handle*>(hv)->names.size());
+}
+
+const char* stl_name(void* hv, int64_t i) {
+  auto* h = static_cast<Handle*>(hv);
+  if (i < 0 || i >= static_cast<int64_t>(h->names.size())) return nullptr;
+  return h->names[static_cast<size_t>(i)].c_str();
+}
+
+// dtype_out: caller buffer >= 16 bytes; shape_out: caller buffer of 8 i64.
+// Returns ndim (>=0) on success, -1 unknown tensor, -2 rank > 8.
+int64_t stl_info(void* hv, const char* name, char* dtype_out,
+                 int64_t* shape_out, int64_t* nbytes_out) {
+  auto* h = static_cast<Handle*>(hv);
+  auto it = h->tensors.find(name);
+  if (it == h->tensors.end()) {
+    g_error = std::string("unknown tensor ") + name;
+    return -1;
+  }
+  const TensorInfo& t = it->second;
+  if (t.shape.size() > 8) return -2;
+  snprintf(dtype_out, 16, "%s", t.dtype.c_str());
+  for (size_t i = 0; i < t.shape.size(); ++i) shape_out[i] = t.shape[i];
+  *nbytes_out = static_cast<int64_t>(t.nbytes);
+  return static_cast<int64_t>(t.shape.size());
+}
+
+// Copies the tensor's bytes into dst (caller-allocated, nbytes long).
+// Returns 0 on success.
+int stl_read(void* hv, const char* name, void* dst, int64_t dst_bytes) {
+  auto* h = static_cast<Handle*>(hv);
+  auto it = h->tensors.find(name);
+  if (it == h->tensors.end()) {
+    g_error = std::string("unknown tensor ") + name;
+    return -1;
+  }
+  const TensorInfo& t = it->second;
+  if (dst_bytes < static_cast<int64_t>(t.nbytes)) {
+    g_error = "destination buffer too small";
+    return -2;
+  }
+  // hint the kernel to read ahead of the copy
+  uintptr_t page = 4096;
+  uintptr_t start = reinterpret_cast<uintptr_t>(t.data) & ~(page - 1);
+  size_t span = t.nbytes + (reinterpret_cast<uintptr_t>(t.data) - start);
+  madvise(reinterpret_cast<void*>(start), span, MADV_WILLNEED);
+  parallel_copy(dst, t.data, t.nbytes);
+  return 0;
+}
+
+void stl_close(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  for (auto& m : h->maps) {
+    if (m.addr) munmap(m.addr, m.size);
+    if (m.fd >= 0) ::close(m.fd);
+  }
+  delete h;
+}
+
+}  // extern "C"

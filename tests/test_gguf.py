@@ -289,3 +289,104 @@ def test_load_gguf_int8_quantized(tmp_path):
                                      quantization="int8")
     assert isinstance(params["layers"]["wq"], QTensor)
     assert params["layers"]["wq"].data.dtype.name == "int8"
+
+
+def test_gguf_tokenizer_spm_semantics():
+    """SPM greedy merging from GGUF-embedded vocab: highest-score bigram
+    merges first, byte fallback for unknown chars, ▁ space handling."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import GGUFTokenizer
+
+    tokens = ["<unk>", "<s>", "</s>"]
+    scores = [0.0, 0.0, 0.0]
+    types = [2, 3, 3]
+    for b in range(256):  # byte fallback tokens
+        tokens.append(f"<0x{b:02X}>")
+        scores.append(0.0)
+        types.append(6)
+    base = len(tokens)
+    # vocab: chars + merges with scores favoring "he" then "hell"
+    vocab = [("h", -10.0), ("e", -10.0), ("l", -10.0), ("o", -10.0),
+             ("▁", -5.0), ("he", -1.0), ("ll", -2.0), ("hell", -0.5),
+             ("hello", -0.2), ("▁hello", -0.1)]
+    for t, s in vocab:
+        tokens.append(t)
+        scores.append(s)
+        types.append(1)
+    md = {
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.scores": scores,
+        "tokenizer.ggml.token_type": types,
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    tok = GGUFTokenizer(md)
+    ids = tok.encode("hello")
+    assert ids[0] == 1  # BOS
+    # "▁hello" (prefix space + full merge) is in vocab with the best score
+    assert tok.tokens[ids[1]] == "▁hello"
+    assert tok.decode(ids) == " hello"
+
+    # unknown char goes through byte fallback and round-trips
+    ids2 = tok.encode("h€")
+    assert tok.decode(ids2).endswith("h€")
+    assert tok.eos_ids == {2}
+
+
+def test_gguf_tokenizer_loaded_from_file(tmp_path):
+    """A GGUF file with embedded vocab yields a working tokenizer via
+    load_tokenizer(path.gguf)."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import GGUFTokenizer, load_tokenizer
+
+    rng = np.random.default_rng(5)
+    # reuse the tiny checkpoint and append tokenizer metadata
+    path, _ = _tiny_llama_gguf(tmp_path, rng)
+    # rebuild with tokenizer metadata included
+    D, F, H, KV, hd, L, V = 256, 512, 8, 4, 32, 2, 256
+    meta = {
+        "general.architecture": (8, "llama"),
+        "llama.embedding_length": (4, D),
+        "llama.block_count": (4, L),
+        "llama.feed_forward_length": (4, F),
+        "llama.attention.head_count": (4, H),
+        "llama.attention.head_count_kv": (4, KV),
+        "tokenizer.ggml.model": (8, "llama"),
+    }
+    # array KV values need custom encoding; simplest: write via _kv-style
+    # strings array
+    toks = ["<unk>", "<s>", "</s>", "a", "b", "▁", "ab"]
+    scs = [0.0, 0.0, 0.0, -3.0, -3.0, -2.0, -1.0]
+    tts = [2, 3, 3, 1, 1, 1, 1]
+
+    def kv_array_str(key, values):
+        out = _s(key) + struct.pack("<I", 9) + struct.pack("<IQ", 8, len(values))
+        for v in values:
+            out += _s(v)
+        return out
+
+    def kv_array_f32(key, values):
+        out = _s(key) + struct.pack("<I", 9) + struct.pack("<IQ", 6, len(values))
+        for v in values:
+            out += struct.pack("<f", v)
+        return out
+
+    def kv_array_i32(key, values):
+        out = _s(key) + struct.pack("<I", 9) + struct.pack("<IQ", 5, len(values))
+        for v in values:
+            out += struct.pack("<i", v)
+        return out
+
+    head = b"GGUF" + struct.pack("<IQQ", 3, 0, len(meta) + 3)
+    kv = b"".join(_kv(k, t, v) for k, (t, v) in meta.items())
+    kv += kv_array_str("tokenizer.ggml.tokens", toks)
+    kv += kv_array_f32("tokenizer.ggml.scores", scs)
+    kv += kv_array_i32("tokenizer.ggml.token_type", tts)
+    blob = head + kv
+    blob += b"\x00" * ((-len(blob)) % 32)
+    p = tmp_path / "tok.gguf"
+    p.write_bytes(blob)
+
+    tok = load_tokenizer(str(p))
+    assert isinstance(tok, GGUFTokenizer)
+    ids = tok.encode("ab")
+    assert tok.tokens[ids[-1]] == "ab"  # merged

@@ -225,3 +225,54 @@ def test_router_config_matches_python_router():
     assert r.select_backend(b'{"model": "mistral-7b"}')[0] == "mistral-7b"
     name, err = r.select_backend(b'{"model": "nope"}')
     assert err is not None  # strict
+
+
+def test_values_schema_validates_chart_defaults():
+    """Both charts' values.yaml must validate against their
+    values.schema.json (the reference shipped no schema — SURVEY §5 gap),
+    and obvious misconfigurations must be rejected."""
+    import copy
+    import json
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    for chart in ("tpu-models", "local-models"):
+        cdir = root / chart / "helm-chart"
+        schema = json.loads((cdir / "values.schema.json").read_text())
+        values = yaml.safe_load((cdir / "values.yaml").read_text())
+        jsonschema.validate(values, schema)
+
+        bad = copy.deepcopy(values)
+        bad["models"][0]["modelName"] = "Bad_Name!"  # not DNS-safe
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+        bad = copy.deepcopy(values)
+        bad["models"][0]["unknownKey"] = 1  # dead values rejected
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+
+
+def test_renderer_consumes_chart_values_verbatim():
+    """The Python renderer and the Helm charts share one contract: both
+    charts' shipped values.yaml must load and render (catches drift like a
+    chart key the spec rejects)."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    tpu = load_spec(str(root / "tpu-models" / "helm-chart" / "values.yaml"))
+    docs = render_manifests(tpu)
+    kinds = [d["kind"] for d in docs]
+    assert "Deployment" in kinds and "ConfigMap" in kinds
+    # tpu profile: every model container requests google.com/tpu
+    for d in docs:
+        if d["kind"] == "Deployment" and d["metadata"]["name"].startswith("model-"):
+            res = d["spec"]["template"]["spec"]["containers"][0]["resources"]
+            assert "google.com/tpu" in res["requests"]
+
+    local = load_spec(str(root / "local-models" / "helm-chart" / "values.yaml"))
+    docs = render_manifests(local)
+    for d in docs:
+        if d["kind"] == "Deployment" and d["metadata"]["name"].startswith("model-"):
+            res = d["spec"]["template"]["spec"]["containers"][0].get("resources", {})
+            assert "google.com/tpu" not in res.get("requests", {})

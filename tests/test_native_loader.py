@@ -1,0 +1,102 @@
+"""Native safetensors reader vs the Python safetensors package.
+
+Builds libstload.so, writes real sharded checkpoints with the Python
+``safetensors`` library, and pins the native reads bit-for-bit against
+it — including bf16 tensors, multi-shard dirs, and the weights.py
+integration point.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+LOADER_DIR = REPO / "native" / "loader"
+
+
+@pytest.fixture(scope="module")
+def lib(tmp_path_factory):
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["make", "-C", str(LOADER_DIR)], check=True,
+                   capture_output=True)
+    import llms_on_kubernetes_tpu.engine.native_loader as nl
+
+    # reset the module cache in case an earlier test ran without the lib
+    nl._lib = None
+    nl._lib_tried = False
+    assert nl._load_lib() is not None
+    return nl
+
+
+def _write_checkpoint(d: Path) -> dict[str, np.ndarray]:
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    shard1 = {
+        "model.embed.weight": rng.standard_normal((64, 16)).astype(np.float32),
+        "model.layers.0.w.weight":
+            rng.standard_normal((16, 48)).astype(np.float16),
+        "model.bias": rng.standard_normal((48,)).astype(np.float32),
+    }
+    shard2 = {
+        "model.layers.1.w.weight":
+            rng.standard_normal((16, 48)).astype(ml_dtypes.bfloat16),
+        "model.ids": rng.integers(0, 100, (7,)).astype(np.int64),
+    }
+    save_file(shard1, str(d / "model-00001-of-00002.safetensors"))
+    save_file(shard2, str(d / "model-00002-of-00002.safetensors"))
+    return {**shard1, **shard2}
+
+
+def test_native_matches_python_bit_for_bit(lib, tmp_path):
+    want = _write_checkpoint(tmp_path)
+    loaders = lib.open_native_safetensors(str(tmp_path))
+    assert loaders is not None
+    assert set(loaders) == set(want)
+    for name, ref in want.items():
+        got = loaders[name]()
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        np.testing.assert_array_equal(
+            got.view(np.uint8), ref.view(np.uint8), err_msg=name)
+
+
+def test_native_unknown_tensor_raises(lib, tmp_path):
+    _write_checkpoint(tmp_path)
+    loaders = lib.open_native_safetensors(str(tmp_path))
+    shards = next(iter(loaders.values())).__defaults__[0]
+    with pytest.raises(KeyError):
+        shards.read("not.a.tensor")
+
+
+def test_native_missing_dir_returns_none(lib, tmp_path):
+    assert lib.open_native_safetensors(str(tmp_path / "empty")) is None
+
+
+def test_weights_py_uses_native_path(lib, tmp_path, monkeypatch):
+    """_open_safetensors must return native loaders when the lib exists."""
+    from llms_on_kubernetes_tpu.engine.weights import _open_safetensors
+
+    want = _write_checkpoint(tmp_path)
+    loaders = _open_safetensors(str(tmp_path))
+    # native loaders close over _NativeShards; python ones over safe_open
+    sample = next(iter(loaders.values()))
+    assert type(sample.__defaults__[0]).__name__ == "_NativeShards"
+    got = loaders["model.embed.weight"]()
+    np.testing.assert_array_equal(got, want["model.embed.weight"])
+
+
+def test_env_kill_switch(lib, tmp_path, monkeypatch):
+    monkeypatch.setenv("LLMK_NATIVE_LOADER", "0")
+    lib._lib = None
+    lib._lib_tried = False
+    _write_checkpoint(tmp_path)
+    assert lib.open_native_safetensors(str(tmp_path)) is None
+    # restore for subsequent tests in this process
+    monkeypatch.delenv("LLMK_NATIVE_LOADER")
+    lib._lib = None
+    lib._lib_tried = False
