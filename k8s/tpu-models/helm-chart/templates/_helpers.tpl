@@ -13,7 +13,11 @@ Shared helpers. The GKE accelerator label value per TPU generation.
      split for multi-host pod groups. */}}
 {{- define "tpu-models.chipsPerHost" -}}
 {{- $hosts := int (default 1 .tpu.hosts) -}}
-{{- div (int .tpu.chips) $hosts -}}
+{{- $chips := int .tpu.chips -}}
+{{- if ne (mod $chips $hosts) 0 -}}
+{{- fail (printf "tpu.chips=%d not divisible by tpu.hosts=%d" $chips $hosts) -}}
+{{- end -}}
+{{- div $chips $hosts -}}
 {{- end -}}
 
 {{- define "tpu-models.labels" -}}

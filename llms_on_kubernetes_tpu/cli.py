@@ -34,6 +34,9 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--tensor-parallel-size", "--tp", type=int, default=0,
                    help="0 = all local devices on the mesh 'model' axis")
     p.add_argument("--expert-parallel-size", "--ep", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", "--sp", type=int, default=1,
+                   help="context-parallel ring size for long prompts "
+                        "(prefill runs ring attention over the 'seq' axis)")
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="weight-only int8 (FP8/AWQ-checkpoint parity path)")
 
@@ -161,13 +164,15 @@ def main(argv: list[str] | None = None) -> int:
 
     n_dev = len(jax.devices())
     ep = args.expert_parallel_size
-    if ep < 1 or n_dev % ep != 0:
-        parser.error(f"--expert-parallel-size {ep} must divide the local "
+    sp = args.sequence_parallel_size
+    if ep < 1 or sp < 1 or n_dev % (ep * sp) != 0:
+        parser.error(f"--ep {ep} x --sp {sp} must divide the local "
                      f"device count ({n_dev})")
-    tp = args.tensor_parallel_size or n_dev // ep
-    if tp < 1 or ep * tp > n_dev:
-        parser.error(f"--tp {tp} x --ep {ep} exceeds the {n_dev} local devices")
-    mesh = make_mesh(data=1, expert=ep, model=tp)
+    tp = args.tensor_parallel_size or n_dev // (ep * sp)
+    if tp < 1 or ep * sp * tp > n_dev:
+        parser.error(f"--tp {tp} x --ep {ep} x --sp {sp} exceeds the "
+                     f"{n_dev} local devices")
+    mesh = make_mesh(data=1, seq=sp, expert=ep, model=tp)
 
     engine_cfg = EngineConfig(
         model=model_cfg.name,

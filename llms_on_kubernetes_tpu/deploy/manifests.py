@@ -168,6 +168,16 @@ def _volumes(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
     return []
 
 
+def _scrape_annotations() -> dict[str, str]:
+    """Prometheus scrape hints for the engine's /metrics (SURVEY §5: the
+    reference never scraped its engines' metrics endpoints)."""
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(ENGINE_PORT),
+        "prometheus.io/path": "/metrics",
+    }
+
+
 def render_model_single_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
     pod_spec: Manifest = {
         "containers": [_engine_container(m, spec)],
@@ -183,7 +193,10 @@ def render_model_single_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
             "replicas": m.replicas,
             "selector": {"matchLabels": {"app": f"model-{m.model_name}"}},
             "template": {
-                "metadata": {"labels": _labels(f"model-{m.model_name}", "model-server")},
+                "metadata": {
+                    "labels": _labels(f"model-{m.model_name}", "model-server"),
+                    "annotations": _scrape_annotations(),
+                },
                 "spec": pod_spec,
             },
         },
@@ -235,7 +248,10 @@ def render_model_multi_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
             "podManagementPolicy": "Parallel",  # gang start: all workers at once
             "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": _labels(name, "model-server")},
+                "metadata": {
+                    "labels": _labels(name, "model-server"),
+                    "annotations": _scrape_annotations(),
+                },
                 "spec": {
                     "subdomain": f"{name}-workers",
                     "nodeSelector": _tpu_node_selector(m),

@@ -59,10 +59,10 @@ class TPUSpec:
 
     @property
     def chips_per_host(self) -> int:
-        # derives from the ACTUAL host count so an explicit hosts override
-        # keeps sum(per-pod google.com/tpu) == chips (same formula as the
-        # Helm chart's chipsPerHost helper: ceil(chips / hosts))
-        return max(1, -(-self.chips // self.hosts))
+        # chips % hosts == 0 is enforced at load time (_tpu_from), so this
+        # equals the Helm chart's chipsPerHost helper (exact division) and
+        # per-pod requests always sum to tpu.chips
+        return max(1, self.chips // self.hosts)
 
     @property
     def multi_host(self) -> bool:
@@ -193,8 +193,15 @@ def _tpu_from(d: Optional[dict]) -> Optional[TPUSpec]:
     if unknown:
         raise SpecError(f"unknown tpu keys: {sorted(unknown)}")
     hosts = d.get("hosts")
-    if hosts is not None and int(hosts) < 1:
-        raise SpecError(f"tpu.hosts must be >= 1, got {hosts}")
+    if hosts is not None:
+        if int(hosts) < 1:
+            raise SpecError(f"tpu.hosts must be >= 1, got {hosts}")
+        chips = int(d.get("chips", 8))
+        if chips % int(hosts) != 0:
+            raise SpecError(
+                f"tpu.chips={chips} not divisible by tpu.hosts={hosts} — "
+                f"every slice host carries the same chip count"
+            )
     return TPUSpec(
         accelerator=d.get("accelerator", "v5e"),
         chips=int(d.get("chips", 8)),

@@ -229,6 +229,20 @@ class Engine:
         self.model_config = model_config or get_config(engine_config.model)
         cfg = self.model_config
         self.mesh = mesh
+        from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ, set_active_mesh
+
+        if mesh is not None:
+            sp = int(mesh.shape.get(AXIS_SEQ, 1))
+            bad = [b for b in engine_config.prefill_buckets if b % sp != 0]
+            if sp > 1 and bad:
+                raise ValueError(
+                    f"prefill buckets {bad} not divisible by the seq-parallel "
+                    f"ring size {sp} (ring attention shards the bucket)"
+                )
+        # ring-attention dispatch reads this at trace time; ALWAYS set it
+        # (including to None) so a previous engine's mesh never leaks into
+        # this engine's traces
+        set_active_mesh(mesh)
 
         if params is not None:
             self.params = params

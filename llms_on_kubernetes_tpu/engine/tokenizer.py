@@ -123,22 +123,53 @@ class GGUFTokenizer:
         self.add_bos = bool(metadata.get("tokenizer.ggml.add_bos_token", True))
         self._prefix = " " if metadata.get(
             "tokenizer.ggml.add_space_prefix", True) else ""
+        self._byte_id_set = set(self._byte_ids.values())
 
     def _encode_piece(self, text: str) -> list[int]:
-        """Greedy SPM: chars -> repeatedly merge the best-scoring bigram."""
-        pieces = list(text)
-        while True:
-            best_i, best_score, best_merged = -1, -1e30, None
-            for i in range(len(pieces) - 1):
-                merged = pieces[i] + pieces[i + 1]
-                rank = self._rank.get(merged)
-                if rank is not None and self.scores[rank] > best_score:
-                    best_i, best_score, best_merged = i, self.scores[rank], merged
-            if best_i < 0:
-                break
-            pieces[best_i:best_i + 2] = [best_merged]
+        """SPM merge via a bigram heap (linear-log in text length): a
+        doubly-linked list of pieces; candidate merges pop best-score
+        first, are revalidated against the live list, and push the two new
+        neighbour bigrams. Identical output to the naive rescan-everything
+        greedy loop (ties broken by position, as SPM does)."""
+        import heapq
+
+        n = len(text)
+        pieces: list[Optional[str]] = list(text)
+        prev = list(range(-1, n - 1))
+        nxt = list(range(1, n + 1))
+        heap: list[tuple[float, int, int, str]] = []
+
+        def push(i: int) -> None:
+            j = nxt[i]
+            if j >= n or pieces[i] is None or pieces[j] is None:
+                return
+            merged = pieces[i] + pieces[j]
+            rank = self._rank.get(merged)
+            if rank is not None:
+                heapq.heappush(heap, (-self.scores[rank], i, j, merged))
+
+        for i in range(n - 1):
+            push(i)
+        while heap:
+            _, i, j, merged = heapq.heappop(heap)
+            # stale if either side changed since this candidate was pushed
+            if pieces[i] is None or pieces[j] is None or nxt[i] != j:
+                continue
+            if pieces[i] + pieces[j] != merged:
+                continue
+            pieces[i] = merged
+            pieces[j] = None
+            nxt[i] = nxt[j]
+            if nxt[j] < n:
+                prev[nxt[j]] = i
+            if prev[i] >= 0:
+                push(prev[i])
+            push(i)
+
         out: list[int] = []
         for p in pieces:
+            if p is None:
+                continue
             rank = self._rank.get(p)
             if rank is not None:
                 out.append(rank)
@@ -158,7 +189,7 @@ class GGUFTokenizer:
         for i in ids:
             if i in self._control or not (0 <= i < len(self.tokens)):
                 continue
-            if i in set(self._byte_ids.values()):
+            if i in self._byte_id_set:
                 tok = self.tokens[i]
                 out.append(int(tok[3:-1], 16))
             else:

@@ -160,6 +160,20 @@ def _static_window(w) -> bool:
 
 def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
                                attn_softcap=None):
+    # Context parallelism: a seq>1 mesh shards the prompt over the ring
+    # axis; the quadratic attention runs as ring attention (K/V blocks
+    # rotate via ppermute over ICI) instead of gathering the full sequence
+    # per device. Long-context prefill is exactly where this matters —
+    # SURVEY §5 noted the reference had no long-context story at all.
+    from llms_on_kubernetes_tpu.parallel.mesh import get_active_mesh, seq_parallelism
+
+    if seq_parallelism() > 1 and _static_window(sliding_window):
+        from llms_on_kubernetes_tpu.ops.ring_attention import ring_prefill_attention
+
+        return ring_prefill_attention(
+            q, k, v, lengths, get_active_mesh(), scale=scale,
+            attn_softcap=attn_softcap, sliding_window=sliding_window,
+        )
     if use_pallas_kernels() and _static_window(sliding_window):
         from llms_on_kubernetes_tpu.ops.pallas_flash import BLOCK_Q, flash_prefill_attention
 

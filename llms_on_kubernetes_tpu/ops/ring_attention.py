@@ -118,18 +118,29 @@ def ring_prefill_attention(
 ) -> jnp.ndarray:
     """Causal prefill attention with the sequence sharded over mesh axis
     'seq'. Exact (same numerics policy as ops/attention.py); tested against
-    the single-device reference on a virtual ring in tests/test_ring.py."""
+    the single-device reference on a virtual ring in tests/test_ring.py.
+
+    Composes with tensor parallelism: the head axis stays sharded over
+    'model' inside the shard_map (when it divides evenly), so CP×TP runs
+    with no head all-gather — each device owns its heads' slice of its
+    sequence chunk and only K/V blocks move, around the seq ring."""
     from jax import shard_map
 
-    seq_spec = P(None, AXIS_SEQ, None, None)
+    from llms_on_kubernetes_tpu.parallel.mesh import AXIS_MODEL
+
+    n_q, n_kv = q.shape[2], k.shape[2]
+    model_size = int(mesh.shape[AXIS_MODEL])
+    heads = (AXIS_MODEL if model_size > 1 and n_q % model_size == 0
+             and n_kv % model_size == 0 else None)
+    spec = P(None, AXIS_SEQ, heads, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=AXIS_SEQ, scale=scale,
             attn_softcap=attn_softcap, sliding_window=sliding_window,
         ),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, P()),
-        out_specs=seq_spec,
+        in_specs=(spec, spec, spec, P()),
+        out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v, lengths)

@@ -66,3 +66,32 @@ def make_mesh(
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     d = device or jax.devices()[0]
     return Mesh(np.asarray([d]).reshape(1, 1, 1, 1), MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Active-mesh context: how ops learn the engine's mesh at TRACE time.
+# The decoder's functional forward passes take no mesh argument (jit
+# signature stability); ops that need collective context — the ring
+# attention dispatch for seq>1 meshes — read it here instead. Set once by
+# the Engine at construction; trace-time-only state, never read inside a
+# compiled computation.
+# ---------------------------------------------------------------------------
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def seq_parallelism() -> int:
+    """Size of the active mesh's seq (context-parallel) axis, or 1."""
+    m = _ACTIVE_MESH
+    if m is None or AXIS_SEQ not in m.shape:
+        return 1
+    return int(m.shape[AXIS_SEQ])

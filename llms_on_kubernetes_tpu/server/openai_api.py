@@ -200,6 +200,8 @@ class OpenAIServer:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/debug/profile/start", self.profile_start)
+        app.router.add_post("/debug/profile/stop", self.profile_stop)
         app.on_startup.append(self._start_loop)
         app.on_cleanup.append(self._stop_loop)
         return app
@@ -217,6 +219,42 @@ class OpenAIServer:
 
     async def health(self, request: web.Request) -> web.Response:
         return web.Response(text="OK")
+
+    # JAX profiler hooks (SURVEY §5 tracing gap: the reference exposed no
+    # profiling at all). Traces land under the operator-configured
+    # LLMK_PROFILE_DIR (never a caller-supplied path — the endpoint is on
+    # the serving port) in the layout TensorBoard/XProf reads; start/stop
+    # so a trace can span exactly the traffic of interest.
+    async def profile_start(self, request: web.Request) -> web.Response:
+        import os
+
+        import jax
+
+        log_dir = os.environ.get("LLMK_PROFILE_DIR", "/tmp/jax-profile")
+        if getattr(self, "_profiling", False):
+            return web.json_response(
+                {"error": {"message": "profiler already running"}}, status=409)
+        try:
+            jax.profiler.start_trace(log_dir)
+        except Exception as e:  # profiler availability varies by platform
+            return web.json_response(
+                {"error": {"message": f"profiler unavailable: {e}"}}, status=501)
+        self._profiling = True
+        return web.json_response({"status": "profiling", "dir": log_dir})
+
+    async def profile_stop(self, request: web.Request) -> web.Response:
+        import jax
+
+        if not getattr(self, "_profiling", False):
+            return web.json_response(
+                {"error": {"message": "profiler not running"}}, status=409)
+        self._profiling = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"stop failed: {e}"}}, status=500)
+        return web.json_response({"status": "stopped"})
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response({

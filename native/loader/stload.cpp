@@ -82,7 +82,9 @@ bool parse_shard(const std::string& path, Handle* h) {
 
   uint64_t header_len;
   memcpy(&header_len, base, 8);  // little-endian per spec (and x86/arm64)
-  if (header_len + 8 > m.size) {
+  // compare without addition: header_len + 8 could wrap uint64 and accept
+  // a corrupt length that then reads far past the mapping
+  if (header_len > m.size - 8) {
     g_error = "corrupt safetensors header in " + path;
     return false;
   }
