@@ -60,7 +60,7 @@ def main() -> int:
             # one batched harvest read across 7 decode steps
             async_depth=int(os.environ.get("BENCH_DEPTH", "8")),
         )
-        prompt_len, gen_len = 32, int(os.environ.get("BENCH_GEN", "64"))
+        prompt_len, gen_len = 32, int(os.environ.get("BENCH_GEN", "128"))
     else:  # small-model fallback for CPU dev runs
         ecfg = EngineConfig(
             model=model, dtype="float32", max_decode_slots=8,

@@ -655,6 +655,13 @@ class Engine:
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
         )
+        try:
+            # start the first-token transfer now: it completes as soon as
+            # the prefill does, so the TTFT harvest read doesn't pay a
+            # blocking round trip
+            toks.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
         merge = {"toks": toks, "slots": {}}
         for row, (slot, req, resumed, _ptoks) in enumerate(picked):
             if resumed:

@@ -1,0 +1,134 @@
+"""True multi-process test of the multi-host SPMD serving protocol.
+
+Spawns TWO OS processes that form a jax.distributed process group over
+localhost (CPU backend, 2 virtual devices each = a 4-device global mesh).
+Process 0 runs the Engine as coordinator (multihost=True: every step's
+inputs are broadcast); process 1 runs engine/multihost.py's follower_loop
+and must mirror the same jitted computations or the collectives deadlock.
+The coordinator's greedy output is pinned against a single-process
+reference run — proving the broadcast protocol carries everything the
+followers need (SURVEY §2.4 / §5 distributed-communication backend).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import json, os, sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.engine.multihost import OP_SHUTDOWN, broadcast_header, follower_loop
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+cfg = EngineConfig(
+    model="debug-tiny", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+    multihost=True,
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+
+if pid == 0:
+    out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=8))
+    out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=6))
+    broadcast_header(OP_SHUTDOWN)
+    print("RESULT:" + json.dumps([out, out2]), flush=True)
+else:
+    follower_loop(eng)
+    print("FOLLOWER done", flush=True)
+"""
+
+REFERENCE = r"""
+import json, sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+cfg = EngineConfig(
+    model="debug-tiny", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=8))
+out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=6))
+print("RESULT:" + json.dumps([out, out2]), flush=True)
+"""
+
+
+from conftest import free_port
+
+
+def _env(n_dev: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_dev}"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # a stray kernel override from the developer's shell (e.g. pallas)
+    # would change the CPU subprocesses' attention path
+    env.pop("LLMK_ATTENTION_IMPL", None)
+    return env
+
+
+def _extract(stdout: str):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT:"):
+            return json.loads(line[len("RESULT:"):])
+    raise AssertionError(f"no RESULT line in: {stdout[-2000:]}")
+
+
+@pytest.mark.slow
+def test_two_process_spmd_serving_matches_single_process():
+    ref = subprocess.run(
+        [sys.executable, "-c", REFERENCE], env=_env(4),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    want = _extract(ref.stdout)
+
+    coord = f"127.0.0.1:{free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(pid), coord], env=_env(2),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=600)
+            assert p.returncode == 0, stderr[-2000:]
+            outs.append(stdout)
+    finally:
+        # a protocol deadlock (what this test exists to catch) must not
+        # leak spinning workers holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    got = _extract(outs[0])
+    assert "FOLLOWER done" in outs[1]
+    assert got == want, (got, want)

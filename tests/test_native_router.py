@@ -24,10 +24,7 @@ REPO = Path(__file__).resolve().parent.parent
 ROUTER_DIR = REPO / "native" / "router"
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from conftest import free_port
 
 
 class FakeBackend(http.server.BaseHTTPRequestHandler):
