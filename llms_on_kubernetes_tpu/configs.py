@@ -217,6 +217,22 @@ _register(
     "Qwen/Qwen3-8B",
 )
 
+# Text backbone family of the reference's second default model
+# (Qwen3-VL-30B, reference vllm-models/helm-chart/values.yaml:7-12): the
+# Qwen3-MoE decoder (128 experts, top-8, qk-norm). The vision tower is out
+# of scope (text-only framework — PARITY.md known gaps).
+_register(
+    ModelConfig(
+        "qwen3-30b-a3b",
+        vocab_size=151936, hidden_size=2048, intermediate_size=768,
+        num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=40960,
+        qk_norm=True, rms_norm_eps=1e-6,
+        num_experts=128, num_experts_per_tok=8,
+    ),
+    "Qwen/Qwen3-30B-A3B", "Qwen/Qwen3-30B-A3B-Instruct-2507",
+)
+
 _register(
     ModelConfig(
         "gemma-2-9b",
@@ -348,6 +364,24 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
     if model_type in ("mixtral",):
         kw["num_experts"] = int(hf.get("num_local_experts", 8))
         kw["num_experts_per_tok"] = int(hf.get("num_experts_per_tok", 2))
+    if model_type in ("qwen3_moe",):
+        # fail fast on layouts this decoder doesn't express (same policy
+        # as the rope_scaling guard above): serving them silently would
+        # produce wrong logits or a confusing mid-load KeyError
+        if not hf.get("norm_topk_prob", True):
+            raise NotImplementedError(
+                "qwen3_moe with norm_topk_prob=false is not supported "
+                "(the MoE block renormalizes top-k routing weights)")
+        if int(hf.get("decoder_sparse_step", 1)) != 1 or hf.get("mlp_only_layers"):
+            raise NotImplementedError(
+                "qwen3_moe with dense layers interleaved "
+                "(decoder_sparse_step != 1 or mlp_only_layers) is not supported")
+        kw["qk_norm"] = True
+        kw["num_experts"] = int(hf.get("num_experts", 128))
+        kw["num_experts_per_tok"] = int(hf.get("num_experts_per_tok", 8))
+        # experts use moe_intermediate_size, not the dense intermediate
+        kw["intermediate_size"] = int(
+            hf.get("moe_intermediate_size", hf["intermediate_size"]))
     if hf.get("query_pre_attn_scalar") is not None:
         kw["query_pre_attn_scalar"] = float(hf["query_pre_attn_scalar"])
     if model_type.startswith("gemma"):

@@ -118,13 +118,23 @@ def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int) -> Params:
     # --- mlp ------------------------------------------------------------
     if cfg.is_moe:
         E = cfg.num_experts
-        out["router"] = fetch(p + "block_sparse_moe.gate.weight").T  # [D, E]
         gates, ups, downs = [], [], []
-        for e in range(E):
-            ep = p + f"block_sparse_moe.experts.{e}."
-            gates.append(fetch(ep + "w1.weight").T)   # [D, F]
-            ups.append(fetch(ep + "w3.weight").T)     # [D, F]
-            downs.append(fetch(ep + "w2.weight").T)   # [F, D]
+        if (p + "block_sparse_moe.gate.weight") in fetch.loaders:
+            # Mixtral naming: block_sparse_moe.{gate, experts.N.w1/w2/w3}
+            out["router"] = fetch(p + "block_sparse_moe.gate.weight").T  # [D, E]
+            for e in range(E):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                gates.append(fetch(ep + "w1.weight").T)   # [D, F]
+                ups.append(fetch(ep + "w3.weight").T)     # [D, F]
+                downs.append(fetch(ep + "w2.weight").T)   # [F, D]
+        else:
+            # Qwen3-MoE naming: mlp.{gate, experts.N.gate/up/down_proj}
+            out["router"] = fetch(p + "mlp.gate.weight").T
+            for e in range(E):
+                ep = p + f"mlp.experts.{e}."
+                gates.append(fetch(ep + "gate_proj.weight").T)
+                ups.append(fetch(ep + "up_proj.weight").T)
+                downs.append(fetch(ep + "down_proj.weight").T)
         out["w_gate"] = np.stack(gates)
         out["w_up"] = np.stack(ups)
         out["w_down"] = np.stack(downs)

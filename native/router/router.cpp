@@ -419,6 +419,10 @@ static bool load_models_inline(const std::string& spec, Config& cfg) {
 int main(int argc, char** argv) {
   using namespace llkt;
   signal(SIGPIPE, SIG_IGN);
+  // graceful exit on SIGTERM (kubelet pod stop): normal process exit also
+  // lets LeakSanitizer run its end-of-process check in sanitizer builds
+  signal(SIGTERM, [](int) { std::exit(0); });
+  signal(SIGINT, [](int) { std::exit(0); });
 
   Config cfg;
   std::string config_file, models_inline;

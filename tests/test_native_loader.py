@@ -100,3 +100,20 @@ def test_env_kill_switch(lib, tmp_path, monkeypatch):
     monkeypatch.delenv("LLMK_NATIVE_LOADER")
     lib._lib = None
     lib._lib_tried = False
+
+
+def test_corrupt_files_rejected_not_crashed(lib, tmp_path):
+    """Truncated/garbage shards must yield a clean None (python fallback
+    handles erroring), never a crash — incl. the header-length u64 that
+    would wrap a naive bounds check."""
+    cases = {
+        "tiny.safetensors": b"\x00",                       # < 8 bytes
+        "wrap.safetensors": b"\xf8\xff\xff\xff\xff\xff\xff\xff",  # wraps +8
+        "huge.safetensors": (0xFFFF).to_bytes(8, "little") + b"{}",
+        "garbage.safetensors": (2).to_bytes(8, "little") + b"]]" + b"x" * 32,
+    }
+    for name, blob in cases.items():
+        d = tmp_path / name.split(".")[0]
+        d.mkdir()
+        (d / name).write_bytes(blob)
+        assert lib.open_native_safetensors(str(d)) is None, name

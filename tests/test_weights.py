@@ -28,7 +28,7 @@ def _prefill_logits(cfg, params, prompt):
     return np.asarray(logits)[0]
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "mixtral"])
+@pytest.mark.parametrize("family", ["llama", "qwen2", "mixtral", "qwen3_moe"])
 def test_load_hf_checkpoint_logit_parity(tmp_path, family):
     torch = pytest.importorskip("torch")
     import transformers
@@ -45,11 +45,18 @@ def test_load_hf_checkpoint_logit_parity(tmp_path, family):
     elif family == "qwen2":
         hf_cfg = transformers.Qwen2Config(**common)
         hf = transformers.Qwen2ForCausalLM(hf_cfg)
-    else:
+    elif family == "mixtral":
         hf_cfg = transformers.MixtralConfig(
             num_local_experts=4, num_experts_per_tok=2, **common
         )
         hf = transformers.MixtralForCausalLM(hf_cfg)
+    else:  # qwen3_moe: qk-norm + mlp.experts.* naming + moe_intermediate_size
+        hf_cfg = transformers.Qwen3MoeConfig(
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
+            decoder_sparse_step=1, mlp_only_layers=[], norm_topk_prob=True,
+            head_dim=8, **common
+        )
+        hf = transformers.Qwen3MoeForCausalLM(hf_cfg)
 
     torch.manual_seed(0)
     for p in hf.parameters():
