@@ -230,7 +230,7 @@ _register(
         qk_norm=True, rms_norm_eps=1e-6,
         num_experts=128, num_experts_per_tok=8,
     ),
-    "Qwen/Qwen3-30B-A3B", "Qwen/Qwen3-30B-A3B-Instruct-2507",
+    "Qwen/Qwen3-30B-A3B",  # (2507 revision has different rope/context — use its config.json)
 )
 
 _register(
@@ -368,7 +368,9 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
         # fail fast on layouts this decoder doesn't express (same policy
         # as the rope_scaling guard above): serving them silently would
         # produce wrong logits or a confusing mid-load KeyError
-        if not hf.get("norm_topk_prob", True):
+        # HF Qwen3MoeConfig DEFAULTS to False — an absent key means
+        # no renormalization, which this MoE block cannot express
+        if not hf.get("norm_topk_prob", False):
             raise NotImplementedError(
                 "qwen3_moe with norm_topk_prob=false is not supported "
                 "(the MoE block renormalizes top-k routing weights)")

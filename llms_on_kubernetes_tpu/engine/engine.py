@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import itertools
 import queue
 import threading
 import time
@@ -97,6 +96,10 @@ class Request:
     id: str
     prompt: list[int]
     params: SamplingParams
+    # resolved sampling seed (user's params.seed, or engine-drawn): the
+    # request's sampled stream is fold(base_key, seed, position) — a pure
+    # function of the request, never of batch composition or preemption
+    seed: int = 0
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     # runtime state
     output: list[int] = dataclasses.field(default_factory=list)
@@ -143,7 +146,7 @@ def _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row):
 # decode step is exactly ONE upload + ONE dispatch.
 
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
-# 5 top_p(bits), 6 step(row 0), 7 prefill_row, 8.. page_table
+# 5 top_p(bits), 6 seed, 7 prefill_row, 8.. page_table
 _DEC_COLS = 8
 
 
@@ -154,21 +157,21 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     top_ks = packed[:, 3]
     temps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
     top_ps = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
-    step = packed[0, 6]
+    seeds = packed[:, 6]
     prefill_row = packed[:, 7]
     page_table = packed[:, _DEC_COLS:]
 
     tokens = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
-    key = jax.random.fold_in(base_key, step)
     logits, k_pages, v_pages = forward_decode(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
-    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    keys = _slot_keys(base_key, seeds, lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
     return toks, logprobs, k_pages, v_pages
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
-# 4 step(row 0), 5.. page_table
+# 4 seed, 5.. page_table
 _PRE_COLS = 5
 
 
@@ -178,32 +181,44 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     top_ks = packed[:, 1]
     temps = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
     top_ps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
-    step = packed[0, 4]
+    seeds = packed[:, 4]
     page_table = packed[:, _PRE_COLS:]
 
-    key = jax.random.fold_in(base_key, step)
     logits, k_pages, v_pages = forward_prefill(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
-    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    keys = _slot_keys(base_key, seeds, lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
     return toks, logprobs, k_pages, v_pages
 
 
+def _slot_keys(base_key, seeds, lengths):
+    """Per-slot PRNG keys: fold(base, request seed, stream position). The
+    position is `lengths` — for both prefill and decode it equals the
+    sampled token's sequence position, so a preempted-and-resumed request
+    draws exactly the tokens it would have drawn uninterrupted."""
+    return jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.fold_in(base_key, s), p)
+    )(seeds, lengths)
+
+
 def _prefill_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-                  key, temps, top_ks, top_ps):
+                  base_key, seeds, temps, top_ks, top_ps):
     logits, k_pages, v_pages = forward_prefill(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
-    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    keys = _slot_keys(base_key, seeds, lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
     return toks, logprobs, k_pages, v_pages
 
 
 def _decode_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-                 key, temps, top_ks, top_ps):
+                 base_key, seeds, temps, top_ks, top_ps):
     logits, k_pages, v_pages = forward_decode(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
-    toks, logprobs = sample(logits, key, temps, top_ks, top_ps)
+    keys = _slot_keys(base_key, seeds, lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
     return toks, logprobs, k_pages, v_pages
 
 
@@ -288,8 +303,8 @@ class Engine:
         self.slot_len = np.zeros((B,), np.int64)  # tokens whose KV is cached
         self.waiting: "collections.deque[Request]" = collections.deque()
         self._key = jax.random.key(engine_config.seed)
-        self._step_counter = itertools.count()
-        self._id_counter = itertools.count()
+        self._id_counter = iter(range(2 ** 62))
+        self._seed_rng = np.random.default_rng(engine_config.seed)
         self._lock = threading.Lock()
         self.preemptions = 0  # total KV-pressure preemptions (metrics)
 
@@ -345,9 +360,13 @@ class Engine:
             params = dataclasses.replace(
                 params, max_tokens=max(1, max_len - len(prompt))
             )
+        # mask to int32 range: the seed rides in int32 device arrays, and
+        # an unchecked 64-bit client seed would OverflowError inside step()
+        seed = (params.seed if params.seed is not None
+                else int(self._seed_rng.integers(0, 2 ** 31 - 1))) & 0x7FFFFFFF
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
-            prompt=list(prompt), params=params,
+            prompt=list(prompt), params=params, seed=seed,
         )
         with self._lock:
             self.waiting.append(req)
@@ -395,17 +414,12 @@ class Engine:
                 events.append(self._finish(r, r.abort_reason))
         return events
 
-    def _next_key(self) -> jax.Array:
-        return jax.random.fold_in(self._key, next(self._step_counter))
-
     def _run_device_step(self, op: int, fn, tokens: np.ndarray,
                          lengths: np.ndarray, page_table: np.ndarray,
-                         temps: np.ndarray, top_ks: np.ndarray,
-                         top_ps: np.ndarray):
+                         seeds: np.ndarray, temps: np.ndarray,
+                         top_ks: np.ndarray, top_ps: np.ndarray):
         """Enter a jitted step — after broadcasting its inputs to follower
         processes when this engine coordinates a multi-host pod group."""
-        step = next(self._step_counter)
-        key = jax.random.fold_in(self._key, step)
         if self.config.multihost:
             from llms_on_kubernetes_tpu.engine import multihost as mh
 
@@ -415,17 +429,17 @@ class Engine:
                 {"tokens": np.asarray(tokens, np.int32),
                  "lengths": np.asarray(lengths, np.int32),
                  "page_table": np.asarray(page_table, np.int32),
+                 "seeds": np.asarray(seeds, np.int32),
                  "temps": np.asarray(temps, np.float32),
                  "top_ks": np.asarray(top_ks, np.int32),
-                 "top_ps": np.asarray(top_ps, np.float32),
-                 "step": np.asarray(step, np.int64)},
+                 "top_ps": np.asarray(top_ps, np.float32)},
                 op, bucket, tokens.shape[0], self.config.pages_per_slot,
             )
         return fn(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(lengths), self.k_pages, self.v_pages,
-            jnp.asarray(page_table), key, jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(page_table), self._key, jnp.asarray(seeds),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
         )
 
     def _free_slot(self) -> Optional[int]:
@@ -482,6 +496,7 @@ class Engine:
             OP_PREFILL, self._prefill, tokens,
             np.asarray([n], np.int32),
             self.allocator.page_tables[slot:slot + 1],
+            np.asarray([req.seed], np.int32),
             np.asarray([req.params.temperature], np.float32),
             np.asarray([req.params.top_k], np.int32),
             np.asarray([req.params.top_p], np.float32),
@@ -563,9 +578,11 @@ class Engine:
         temps = np.zeros((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
         for i, r in active:
             tokens[i] = r.pending_token
             lengths[i] = self.slot_len[i] + 1
+            seeds[i] = r.seed
             temps[i] = r.params.temperature
             top_ks[i] = r.params.top_k
             top_ps[i] = r.params.top_p
@@ -574,7 +591,7 @@ class Engine:
 
         toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
             OP_DECODE, self._decode, tokens, lengths,
-            self.allocator.page_tables, temps, top_ks, top_ps,
+            self.allocator.page_tables, seeds, temps, top_ks, top_ps,
         )
         sampled = np.asarray(toks)
 
@@ -640,7 +657,6 @@ class Engine:
         tokens = np.zeros((K, bucket), np.int32)
         packed = np.zeros((K, _PRE_COLS + pps), np.int32)
         packed[:, 3] = np.float32(1.0).view(np.int32)  # top_p disabled
-        packed[0, 4] = next(self._step_counter)
         for row, (slot, req, _resumed, ptoks) in enumerate(picked):
             n = len(ptoks)
             tokens[row, :n] = ptoks
@@ -648,6 +664,7 @@ class Engine:
             packed[row, 1] = req.params.top_k
             packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
             packed[row, 3] = np.float32(req.params.top_p).view(np.int32)
+            packed[row, 4] = req.seed
             packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
             self.slot_len[slot] = n
 
@@ -713,13 +730,13 @@ class Engine:
         packed = np.zeros((B, _DEC_COLS + pps), np.int32)
         packed[:, 1] = 1                                   # src: host value
         packed[:, 5] = np.float32(1.0).view(np.int32)      # top_p disabled
-        packed[0, 6] = next(self._step_counter)
         for i, r in active:
             need = int(self.slot_len[i]) + self._inflight_count(i) + 1
             packed[i, 0] = 0 if need > max_len else need
             packed[i, 3] = r.params.top_k
             packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
             packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
+            packed[i, 6] = r.seed
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
                 if resumed:              # resumed: host-known pending token

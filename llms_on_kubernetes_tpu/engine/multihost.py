@@ -55,20 +55,20 @@ def _payload_struct(op: int, bucket: int, batch: int, pages_per_seq: int):
             "tokens": np.zeros((batch, bucket), np.int32),
             "lengths": np.zeros((batch,), np.int32),
             "page_table": np.zeros((batch, pages_per_seq), np.int32),
+            "seeds": np.zeros((batch,), np.int32),
             "temps": np.zeros((batch,), np.float32),
             "top_ks": np.zeros((batch,), np.int32),
             "top_ps": np.zeros((batch,), np.float32),
-            "step": np.zeros((), np.int64),
         }
     if op == OP_DECODE:
         return {
             "tokens": np.zeros((batch,), np.int32),
             "lengths": np.zeros((batch,), np.int32),
             "page_table": np.zeros((batch, pages_per_seq), np.int32),
+            "seeds": np.zeros((batch,), np.int32),
             "temps": np.zeros((batch,), np.float32),
             "top_ks": np.zeros((batch,), np.int32),
             "top_ps": np.zeros((batch,), np.float32),
-            "step": np.zeros((), np.int64),
         }
     raise ValueError(f"op {op} carries no payload")
 
@@ -102,13 +102,12 @@ def follower_loop(engine: Any) -> None:
         if op == OP_IDLE:
             continue
         p = broadcast_payload(None, op, bucket, batch, pps)
-        key = jax.random.fold_in(engine._key, int(p["step"]))
         args = (
             engine.params, engine.model_config, jnp.asarray(p["tokens"]),
             jnp.asarray(p["lengths"]), engine.k_pages, engine.v_pages,
-            jnp.asarray(p["page_table"]), key,
-            jnp.asarray(p["temps"]), jnp.asarray(p["top_ks"]),
-            jnp.asarray(p["top_ps"]),
+            jnp.asarray(p["page_table"]), engine._key,
+            jnp.asarray(p["seeds"]), jnp.asarray(p["temps"]),
+            jnp.asarray(p["top_ks"]), jnp.asarray(p["top_ps"]),
         )
         if op == OP_PREFILL:
             _t, _l, engine.k_pages, engine.v_pages = engine._prefill(*args)

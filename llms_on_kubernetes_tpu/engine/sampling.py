@@ -33,12 +33,16 @@ MAX_CANDIDATES = 64
 
 def sample(
     logits: jnp.ndarray,       # [B, V] float32
-    key: jax.Array,
+    keys: jax.Array,           # [B] PRNG keys (one per slot) or one scalar key
     temperature: jnp.ndarray,  # [B] float32; 0 => greedy
     top_k: jnp.ndarray,        # [B] int32; 0 or >=V => disabled
     top_p: jnp.ndarray,        # [B] float32; 1.0 => disabled
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (tokens [B] int32, logprobs of the sampled tokens [B] f32)."""
+    """Returns (tokens [B] int32, logprobs of the sampled tokens [B] f32).
+
+    Per-slot keys make a request's sampled stream a function of its own
+    (seed, position) only — batch composition can never change what a
+    request samples (and the OpenAI ``seed`` parameter works)."""
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     C = min(MAX_CANDIDATES, V)
@@ -64,7 +68,11 @@ def sample(
 
     # --- draw ----------------------------------------------------------
     safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
-    gumbel = jax.random.gumbel(key, (B, C), jnp.float32)
+    if keys.ndim == 0:  # single key: legacy batch-wide draw
+        gumbel = jax.random.gumbel(keys, (B, C), jnp.float32)
+    else:
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (C,), jnp.float32))(keys)
     perturbed = masked / safe_temp + gumbel
     sampled_rank = jnp.argmax(perturbed, axis=-1)            # [B]
 

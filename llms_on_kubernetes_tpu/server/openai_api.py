@@ -276,12 +276,18 @@ class OpenAIServer:
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         max_tokens = body.get("max_tokens") or body.get("max_completion_tokens") or 256
         eos = tuple(self.tokenizer.eos_ids)
+        seed = body.get("seed")
+        if seed is not None:
+            if not isinstance(seed, int) or isinstance(seed, bool):
+                raise ValueError("seed must be an integer")
+            seed = seed & 0x7FFFFFFF  # engine seeds are int32
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
             top_k=int(body.get("top_k", 0)),
             max_tokens=int(max_tokens),
             stop_token_ids=eos,
+            seed=seed,
         )
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:

@@ -37,6 +37,8 @@
 // per-model backends do the heavy work). Client keep-alive is honored;
 // upstream connections are per-request, Connection: close.
 
+#include <atomic>
+#include <chrono>
 #include <cstdarg>
 #include <csignal>
 #include <cstdio>
@@ -308,8 +310,15 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
 // Connection loop
 // ---------------------------------------------------------------------------
 
+// live detached-connection count: the shutdown path waits for it to drain
+// before main returns (so workers never race Config/static destruction)
+static std::atomic<int> g_live_connections{0};
+
 static void handle_connection(const Config& cfg, int client_fd,
                               std::string client_ip) {
+  struct Live {
+    ~Live() { g_live_connections.fetch_sub(1, std::memory_order_release); }
+  } live;
   int one = 1;
   setsockopt(client_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
   SockReader reader(client_fd);
@@ -416,13 +425,26 @@ static bool load_models_inline(const std::string& spec, Config& cfg) {
 
 }  // namespace llkt
 
+namespace llkt {
+// async-signal-safe shutdown: the handler only sets a flag and closes the
+// listen socket (close(2) is signal-safe); the MAIN thread then falls out
+// of its accept loop and exits normally — so static destruction never runs
+// in signal context, and LeakSanitizer's end-of-process check still fires
+// in sanitizer builds.
+volatile sig_atomic_t g_shutdown = 0;
+int g_listen_fd = -1;
+
+extern "C" void handle_shutdown_signal(int) {
+  g_shutdown = 1;
+  if (g_listen_fd >= 0) ::close(g_listen_fd);
+}
+}  // namespace llkt
+
 int main(int argc, char** argv) {
   using namespace llkt;
   signal(SIGPIPE, SIG_IGN);
-  // graceful exit on SIGTERM (kubelet pod stop): normal process exit also
-  // lets LeakSanitizer run its end-of-process check in sanitizer builds
-  signal(SIGTERM, [](int) { std::exit(0); });
-  signal(SIGINT, [](int) { std::exit(0); });
+  signal(SIGTERM, handle_shutdown_signal);  // kubelet pod stop
+  signal(SIGINT, handle_shutdown_signal);
 
   Config cfg;
   std::string config_file, models_inline;
@@ -504,19 +526,30 @@ int main(int argc, char** argv) {
     perror("listen");
     return 1;
   }
+  g_listen_fd = listen_fd;
   fprintf(stderr, "llkt-router: listening on :%d (%zu models, default=%s%s)\n",
           cfg.port, cfg.models.size(), cfg.default_model.c_str(),
           cfg.strict ? ", strict" : "");
 
-  while (true) {
+  while (!g_shutdown) {
     struct sockaddr_in peer {};
     socklen_t plen = sizeof peer;
     int client =
         accept(listen_fd, reinterpret_cast<struct sockaddr*>(&peer), &plen);
-    if (client < 0) continue;
+    if (client < 0) continue;  // incl. EBADF after the shutdown handler
     char ip[INET_ADDRSTRLEN] = "";
     inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof ip);
+    g_live_connections.fetch_add(1, std::memory_order_acquire);
     std::thread(handle_connection, std::cref(cfg), client, std::string(ip))
         .detach();
   }
+  // drain in-flight connections (bounded — kubelet SIGKILLs after its
+  // grace period anyway) so detached workers never race Config/static
+  // destruction; then exit normally on the main thread
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  while (g_live_connections.load(std::memory_order_acquire) > 0 &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  return 0;
 }

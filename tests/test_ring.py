@@ -110,10 +110,10 @@ def test_multihost_payload_struct_roundtrip():
                                else (batch,), np.int32),
             "lengths": np.zeros((batch,), np.int32),
             "page_table": np.zeros((batch, 32), np.int32),
+            "seeds": np.zeros((batch,), np.int32),
             "temps": np.zeros((batch,), np.float32),
             "top_ks": np.zeros((batch,), np.int32),
             "top_ps": np.zeros((batch,), np.float32),
-            "step": np.asarray(7, np.int64),
         }
         assert set(follower) == set(coordinator)
         for name in follower:
