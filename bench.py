@@ -51,10 +51,17 @@ def main() -> int:
     on_tpu = jax.devices()[0].platform != "cpu"
     if model == "llama-3-8b":
         slots = int(os.environ.get("BENCH_SLOTS", "32"))
+        page = int(os.environ.get("BENCH_PAGE", "32"))
+        if page < 1 or 512 % page != 0:
+            raise SystemExit(f"BENCH_PAGE={page} must divide the 512-token "
+                             f"slot capacity")
         ecfg = EngineConfig(
             model=model, dtype="bfloat16", quantization="int8",
-            max_decode_slots=slots, page_size=32, pages_per_slot=16,
-            num_pages=slots * 16 + 1, prefill_buckets=(64,),
+            max_decode_slots=slots,
+            page_size=page,
+            pages_per_slot=512 // page,
+            num_pages=slots * (512 // page) + 1,
+            prefill_buckets=(64,),
             # deep pipeline: the driver's TPU is behind a tunnel with a
             # ~100 ms host<->device round trip; 8 in-flight steps amortize
             # one batched harvest read across 7 decode steps

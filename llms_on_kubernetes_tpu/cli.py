@@ -121,7 +121,7 @@ def main(argv: list[str] | None = None) -> int:
 
     import jax
 
-    from llms_on_kubernetes_tpu.configs import REGISTRY, from_hf_config, get_config
+    from llms_on_kubernetes_tpu.configs import from_hf_config, get_config
     from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
     from llms_on_kubernetes_tpu.engine.tokenizer import load_tokenizer
     from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir

@@ -26,7 +26,6 @@ compiled executable (XLA retraces on any shape change).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ section). Dequantization layouts follow the public ggml block formats.
 from __future__ import annotations
 
 import mmap
-import pathlib
 import struct
 from typing import Any, BinaryIO, Optional
 

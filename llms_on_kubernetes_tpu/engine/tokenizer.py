@@ -17,7 +17,7 @@ CPU path hermetic — no Hub download, mirroring the ramalama solution's
 from __future__ import annotations
 
 import os
-from typing import Iterable, Optional, Protocol, Sequence
+from typing import Optional, Protocol, Sequence
 
 
 class TokenizerLike(Protocol):

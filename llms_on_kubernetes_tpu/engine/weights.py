@@ -16,7 +16,6 @@ Supported families: llama/tinyllama/mistral (same key schema), mixtral
 
 from __future__ import annotations
 
-import json
 import os
 import pathlib
 from typing import Any, Callable, Optional

@@ -27,7 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
 from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ

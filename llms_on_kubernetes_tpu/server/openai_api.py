@@ -27,7 +27,7 @@ import json
 import threading
 import time
 import uuid
-from typing import Any, Optional
+from typing import Optional
 
 from aiohttp import web
 
@@ -338,7 +338,10 @@ class OpenAIServer:
     # ------------------------------------------------------------------
 
     async def _serve(self, request, body, prompts, *, chat: bool) -> web.StreamResponse:
-        params = self._sampling_from_body(body)
+        try:
+            params = self._sampling_from_body(body)
+        except (ValueError, TypeError) as e:  # bad seed/temperature/... -> 400
+            return web.json_response({"error": {"message": str(e)}}, status=400)
         stops = _parse_stops(body)
         reqs = []
         try:

@@ -436,7 +436,10 @@ int g_listen_fd = -1;
 
 extern "C" void handle_shutdown_signal(int) {
   g_shutdown = 1;
-  if (g_listen_fd >= 0) ::close(g_listen_fd);
+  // shutdown(2), not close(2): on Linux closing a socket does NOT wake a
+  // thread already blocked in accept() on it (the signal may have been
+  // delivered to a worker thread), but shutdown() does
+  if (g_listen_fd >= 0) ::shutdown(g_listen_fd, SHUT_RDWR);
 }
 }  // namespace llkt
 
