@@ -23,6 +23,7 @@ aiohttp event loop never blocks on device work.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import json
 import threading
 import time
@@ -342,11 +343,25 @@ class OpenAIServer:
             params = self._sampling_from_body(body)
         except (ValueError, TypeError) as e:  # bad seed/temperature/... -> 400
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        n = body.get("n", 1)
+        if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= 16:
+            return web.json_response(
+                {"error": {"message": "n must be an integer in [1, 16]"}},
+                status=400)
         stops = _parse_stops(body)
+        # n choices per prompt (prompt-major choice order, per OpenAI);
+        # usage counts each UNIQUE prompt once, not n times
         reqs = []
         try:
             for prompt_ids in prompts:
-                reqs.append(self.loop_thread.submit(prompt_ids, params))
+                for j in range(n):
+                    p = params
+                    if n > 1 and params.seed is not None and j > 0:
+                        # a fixed seed would make the n choices identical —
+                        # derive a distinct (still deterministic) seed each
+                        p = dataclasses.replace(
+                            params, seed=(params.seed + j) & 0x7FFFFFFF)
+                    reqs.append(self.loop_thread.submit(prompt_ids, p))
         except ValueError as e:
             for r in reqs:
                 self.loop_thread.abort(r)

@@ -255,3 +255,51 @@ def test_multi_prompt_streaming_interleaves_indices():
         assert finishes == {0, 1}
         assert all(per_index.values())
     with_client(body)
+
+
+def test_chat_n_choices():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6, "temperature": 0.9, "n": 3, "seed": 5,
+        })
+        assert r.status == 200
+        data = await r.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+        texts = [c["message"]["content"] for c in data["choices"]]
+        # per-choice derived seeds: deterministic but not identical
+        assert len(set(texts)) > 1
+
+        # greedy n>1: all choices identical (same argmax stream)
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6, "temperature": 0, "n": 2,
+        })
+        data = await r.json()
+        t = [c["message"]["content"] for c in data["choices"]]
+        assert t[0] == t[1]
+
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6, "n": 99,
+        })
+        assert r.status == 400
+    with_client(body)
+
+
+def test_completions_n_choices_and_usage():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc",
+            "max_tokens": 4, "temperature": 0.7, "n": 2,
+        })
+        data = await r.json()
+        assert len(data["choices"]) == 2
+        assert [c["index"] for c in data["choices"]] == [0, 1]
+        # unique prompt counted ONCE in usage even with n=2
+        assert data["usage"]["prompt_tokens"] == 3
+        assert data["usage"]["completion_tokens"] <= 8
+    with_client(body)
