@@ -124,7 +124,6 @@ def main(argv: list[str] | None = None) -> int:
     from llms_on_kubernetes_tpu.configs import from_hf_config, get_config
     from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
     from llms_on_kubernetes_tpu.engine.tokenizer import load_tokenizer
-    from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir
     from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
     from llms_on_kubernetes_tpu.server.openai_api import run_server
 
@@ -149,13 +148,28 @@ def main(argv: list[str] | None = None) -> int:
         except KeyError:
             pass
         if not args.random_weights:
+            # Missing weights are a STARTUP FAILURE: exit non-zero so the
+            # pod stays unready (the reference's 7-min readiness budget
+            # exists exactly for the first-boot download, reference
+            # model-deployments.yaml:26-70). Random weights only ever
+            # behind the explicit --random-weights flag.
+            from llms_on_kubernetes_tpu.engine.hub import ensure_model_dir
+
             try:
-                model_dir = resolve_model_dir(args.model)
-            except FileNotFoundError:
-                if model_cfg is None:
-                    raise
-                print(f"[serve] no local checkpoint for {args.model}; "
-                      f"falling back to --random-weights", file=sys.stderr)
+                model_dir = ensure_model_dir(args.model)
+            except Exception as e:
+                # FileNotFoundError/OSError cover the expected operational
+                # failures (no checkpoint, unmounted PVC, Hub HTTP/auth
+                # errors — requests' exceptions subclass OSError); anything
+                # else is a bug, so keep its traceback in the pod log.
+                if not isinstance(e, OSError):
+                    import traceback
+                    traceback.print_exc()
+                raise SystemExit(
+                    f"[serve] cannot obtain weights for {args.model!r}: {e}\n"
+                    f"[serve] (pass --random-weights explicitly to serve an "
+                    f"uninitialized model for smoke tests/benchmarks)"
+                )
         if model_cfg is None and model_dir is not None:
             cfg_path = os.path.join(model_dir, "config.json")
             model_cfg = from_hf_config(cfg_path, name=args.model)

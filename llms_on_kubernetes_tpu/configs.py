@@ -93,13 +93,40 @@ class ModelConfig:
 
 REGISTRY: dict[str, ModelConfig] = {}
 ALIASES: dict[str, str] = {}
+# registry name -> first registered HF repo id, original case (repo ids are
+# case-sensitive on the Hub; ALIASES keys are lowercased for lookup only)
+CANONICAL_HF_IDS: dict[str, str] = {}
 
 
 def _register(cfg: ModelConfig, *hf_ids: str) -> ModelConfig:
     REGISTRY[cfg.name] = cfg
     for hf_id in hf_ids:
         ALIASES[hf_id.lower()] = cfg.name
+    if hf_ids:
+        CANONICAL_HF_IDS[cfg.name] = hf_ids[0]
     return cfg
+
+
+def hf_repo_for(model_ref: str) -> Optional[str]:
+    """Canonical HF repo id for a model reference, or None.
+
+    A ref shaped like a repo id (exactly ``namespace/name``, no path
+    syntax) is returned as-is; a registry name resolves through its first
+    registered alias. Filesystem-looking refs (absolute paths, ``./``,
+    deeper nesting) return None — a missing local checkpoint must surface
+    as a mount problem, not as a bogus Hub repo-id error."""
+    import re
+
+    if model_ref.startswith((".", "/", "~")):
+        return None
+    # known aliases first, so a non-canonical-case repo id maps onto the
+    # canonical cache entry instead of re-downloading under a duplicate dir
+    key = model_ref if model_ref in REGISTRY else ALIASES.get(model_ref.lower())
+    if key:
+        return CANONICAL_HF_IDS.get(key)
+    if re.fullmatch(r"[\w.\-]+/[\w.\-]+", model_ref):
+        return model_ref
+    return None
 
 
 LLAMA3_ROPE_SCALING = {

@@ -1,0 +1,99 @@
+"""Cold-start weight acquisition from the Hugging Face Hub.
+
+The reference's model pods self-download weights on first boot into the PVC
+cache (reference vllm-models/helm-chart/templates/model-deployments.yaml:26-47),
+authenticated via the optional ``huggingface-token`` Secret exposed as
+``HUGGING_FACE_HUB_TOKEN`` (:64-70), with a 7-minute readiness budget to
+cover the download (:48-55). This module is the engine-side equivalent:
+``ensure_model_dir`` resolves a local checkpoint and, on a miss, downloads
+the snapshot (resumable — ``huggingface_hub`` keeps partial files) into the
+same ``~/.cache/huggingface`` layout the charts mount as a PVC, then
+re-resolves. A download or resolution failure propagates: ``serve`` exits
+non-zero and the pod stays unready, exactly the reference's contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Only the files the engine actually reads: sharded safetensors weights,
+# the HF config, and tokenizer artifacts. Skipping *.bin/*.pth/*.gguf keeps
+# the PVC at the chart's pvcSize for repos that ship multiple formats.
+_ALLOW_PATTERNS = (
+    "*.safetensors",
+    "*.safetensors.index.json",
+    "config.json",
+    "generation_config.json",
+    "tokenizer.json",
+    "tokenizer.model",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "vocab.json",
+    "merges.txt",
+    "added_tokens.json",
+    "chat_template.jinja",  # transformers >=4.43 saves the template standalone
+    "preprocessor_config.json",  # vision models: image processor settings
+)
+
+
+def hub_token() -> Optional[str]:
+    """Token from the mounted Secret: env var or a token file.
+
+    ``HUGGING_FACE_HUB_TOKEN`` is the name the charts set from the
+    ``huggingface-token`` Secret (templates/model-deployments.yaml); the
+    ``_FILE`` variant supports mounting the Secret as a volume instead."""
+    for var in ("HUGGING_FACE_HUB_TOKEN", "HF_TOKEN"):
+        tok = os.environ.get(var, "").strip()
+        if tok:
+            return tok
+    path = os.environ.get("HUGGING_FACE_HUB_TOKEN_FILE", "").strip()
+    if path and os.path.isfile(path):
+        with open(path) as f:
+            return f.read().strip() or None
+    return None
+
+
+def download_snapshot(repo_id: str, cache_dir: Optional[str] = None,
+                      token: Optional[str] = None) -> str:
+    """Download ``repo_id``'s current snapshot into the HF cache; return its dir.
+
+    Resumable: ``snapshot_download`` skips complete files and continues
+    partial ones, so a pod restarted mid-download (liveness probe, node
+    preemption) picks up where it left off — the PVC is the resume state."""
+    from huggingface_hub import snapshot_download
+
+    from llms_on_kubernetes_tpu.engine.weights import hf_hub_cache
+
+    # explicit cache_dir ALWAYS: resolution and download must agree on the
+    # snapshot location regardless of which HF_* env vars are set
+    return snapshot_download(
+        repo_id,
+        cache_dir=hf_hub_cache(cache_dir),
+        allow_patterns=list(_ALLOW_PATTERNS),
+        token=token if token is not None else hub_token(),
+    )
+
+
+def ensure_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
+    """Resolve a local checkpoint dir for ``model_ref``, downloading on a miss.
+
+    Resolution order mirrors the reference pod's view of the world:
+    an explicit directory wins; then a cached Hub snapshot; then a live
+    Hub download (registry names resolve through their canonical repo id).
+    Raises ``FileNotFoundError`` when the ref names no repo or the
+    download yields no safetensors — callers must treat that as a startup
+    failure, never serve random weights implicitly."""
+    from llms_on_kubernetes_tpu.configs import hf_repo_for
+    from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir
+
+    try:
+        return resolve_model_dir(model_ref, cache_dir=cache_dir)
+    except FileNotFoundError:
+        repo_id = hf_repo_for(model_ref)
+        if repo_id is None:
+            raise
+    download_snapshot(repo_id, cache_dir=cache_dir)
+    # re-resolve rather than trusting the returned path: enforces the
+    # "snapshot actually contains *.safetensors" invariant in one place
+    return resolve_model_dir(model_ref, cache_dir=cache_dir)

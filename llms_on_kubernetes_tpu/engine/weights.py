@@ -199,6 +199,54 @@ def load_hf_params(
     return params
 
 
+def hf_hub_cache(cache_dir: Optional[str] = None) -> str:
+    """The hub cache directory, shared by resolution AND download.
+
+    One helper so ``resolve_model_dir`` and ``hub.download_snapshot`` can
+    never disagree on where snapshots live: explicit ``cache_dir`` (its
+    ``hub/`` subdir, the HF layout) > ``HF_HUB_CACHE`` (points directly at
+    the hub dir) > ``HF_HOME`` > ``~/.cache/huggingface`` — the PVC mount
+    point in the charts (templates/model-deployments.yaml)."""
+    if cache_dir:
+        return os.path.join(cache_dir, "hub")
+    env = os.environ.get("HF_HUB_CACHE", "").strip()
+    if env:
+        return os.path.expanduser(env)
+    home = os.path.expanduser(os.environ.get("HF_HOME", "~/.cache/huggingface"))
+    return os.path.join(home, "hub")
+
+
+_SHARD_RE = r".*-\d{4,6}-of-\d{4,6}\.safetensors$"
+
+
+def _snapshot_complete(snap: pathlib.Path) -> bool:
+    """True when a cache snapshot holds a COMPLETE, loadable checkpoint.
+
+    A checkpoint interrupted mid-download leaves some files symlinked and
+    the rest missing; treating that as resolvable would short-circuit the
+    resume download and crash-loop the pod on load. Complete means:
+    ``config.json`` present (``from_hf_config`` needs it right after
+    resolution), and either every shard in the index's weight_map exists,
+    or — with no index downloaded yet — at least one safetensors file none
+    of which is shard-named (shard names imply an index is still coming;
+    downloads are concurrent, so file arrival order proves nothing)."""
+    import json as _json
+    import re as _re
+
+    if not (snap / "config.json").is_file():
+        return False
+    idx = snap / "model.safetensors.index.json"
+    if idx.is_file():
+        try:
+            weight_map = _json.loads(idx.read_text()).get("weight_map", {})
+        except (OSError, ValueError):
+            return False
+        shards = set(weight_map.values())
+        return bool(shards) and all((snap / s).is_file() for s in shards)
+    files = [f.name for f in snap.glob("*.safetensors")]
+    return bool(files) and not any(_re.match(_SHARD_RE, f) for f in files)
+
+
 def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
     """Resolve a local dir or a HF-cache snapshot path for ``model_ref``.
 
@@ -209,15 +257,19 @@ def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
     """
     if os.path.isdir(model_ref):
         return model_ref
-    cache = cache_dir or os.path.expanduser(
-        os.environ.get("HF_HOME", "~/.cache/huggingface")
-    )
-    repo_dir = pathlib.Path(cache) / "hub" / ("models--" + model_ref.replace("/", "--"))
-    snaps = sorted((repo_dir / "snapshots").glob("*")) if repo_dir.exists() else []
-    for snap in snaps:
-        if list(snap.glob("*.safetensors")):
-            return str(snap)
+    hub_dir = pathlib.Path(hf_hub_cache(cache_dir))
+    from llms_on_kubernetes_tpu.configs import hf_repo_for
+
+    # a registry name ("llama-3-8b") caches under its canonical repo id
+    canonical = hf_repo_for(model_ref)
+    refs = [model_ref] + ([canonical] if canonical and canonical != model_ref else [])
+    for ref in refs:
+        repo_dir = hub_dir / ("models--" + ref.replace("/", "--"))
+        snaps = sorted((repo_dir / "snapshots").glob("*")) if repo_dir.exists() else []
+        for snap in snaps:
+            if _snapshot_complete(snap):
+                return str(snap)
     raise FileNotFoundError(
         f"no local checkpoint for {model_ref!r}; expected a directory or a "
-        f"HF cache snapshot under {repo_dir}"
+        f"HF cache snapshot for one of {refs} under {hub_dir}"
     )

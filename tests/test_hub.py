@@ -1,0 +1,171 @@
+"""Cold-start weight acquisition (engine/hub.py).
+
+The reference's pods self-download weights from the HF Hub on first boot
+into the PVC cache (reference model-deployments.yaml:26-70); serving with
+no weights must be a startup FAILURE, never a silent fallback. These tests
+drive `ensure_model_dir` against a stub Hub (no egress in CI) and pin the
+`serve` exit contract.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from llms_on_kubernetes_tpu.engine import hub
+from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir
+
+
+def _fake_snapshot(cache_dir: str, repo_id: str) -> pathlib.Path:
+    """Create a complete HF-cache-layout snapshot (weights + config)."""
+    snap = (pathlib.Path(cache_dir) / "hub"
+            / ("models--" + repo_id.replace("/", "--")) / "snapshots" / "abc123")
+    snap.mkdir(parents=True)
+    (snap / "model.safetensors").write_bytes(b"\x08\x00\x00\x00\x00\x00\x00\x00{}      ")
+    (snap / "config.json").write_text("{}")
+    return snap
+
+
+def test_ensure_model_dir_downloads_on_miss(tmp_path, monkeypatch):
+    """Empty cache + stub Hub → snapshot lands in the cache and resolves."""
+    calls = []
+
+    def stub_download(repo_id, cache_dir=None, token=None):
+        calls.append((repo_id, cache_dir))
+        return str(_fake_snapshot(cache_dir, repo_id))
+
+    monkeypatch.setattr(hub, "download_snapshot", stub_download)
+    got = hub.ensure_model_dir("acme/tiny-model", cache_dir=str(tmp_path))
+    assert calls == [("acme/tiny-model", str(tmp_path))]
+    assert got == str(tmp_path / "hub" / "models--acme--tiny-model"
+                      / "snapshots" / "abc123")
+    # second call is a cache hit: no new download
+    assert hub.ensure_model_dir("acme/tiny-model", cache_dir=str(tmp_path)) == got
+    assert len(calls) == 1
+
+
+def test_ensure_model_dir_registry_name_uses_canonical_repo(tmp_path, monkeypatch):
+    """A registry name downloads via its canonical HF repo id (original case)."""
+    seen = []
+
+    def stub_download(repo_id, cache_dir=None, token=None):
+        seen.append(repo_id)
+        _fake_snapshot(cache_dir, repo_id)
+
+    monkeypatch.setattr(hub, "download_snapshot", stub_download)
+    got = hub.ensure_model_dir("llama-3-8b", cache_dir=str(tmp_path))
+    assert seen == ["meta-llama/Meta-Llama-3-8B"]
+    assert "models--meta-llama--Meta-Llama-3-8B" in got
+    # resolve_model_dir finds the canonical cache entry for the registry name
+    assert resolve_model_dir("llama-3-8b", cache_dir=str(tmp_path)) == got
+
+
+def test_ensure_model_dir_unknown_ref_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(hub, "download_snapshot",
+                        lambda *a, **k: pytest.fail("must not download"))
+    with pytest.raises(FileNotFoundError):
+        hub.ensure_model_dir("not-a-registry-name", cache_dir=str(tmp_path))
+
+
+def test_ensure_model_dir_empty_download_raises(tmp_path, monkeypatch):
+    """A snapshot without safetensors (gated/partial repo) still fails."""
+    monkeypatch.setattr(hub, "download_snapshot", lambda *a, **k: None)
+    with pytest.raises(FileNotFoundError):
+        hub.ensure_model_dir("acme/empty-model", cache_dir=str(tmp_path))
+
+
+def test_partial_sharded_snapshot_resumes_download(tmp_path, monkeypatch):
+    """An interrupted multi-shard download must NOT resolve — ensure_model_dir
+    re-downloads (resume) instead of crash-looping on missing shards."""
+    import json
+
+    snap = _fake_snapshot(str(tmp_path), "acme/sharded")
+    (snap / "model.safetensors").unlink()
+    (snap / "model.safetensors.index.json").write_text(json.dumps({
+        "weight_map": {"a": "model-00001-of-00002.safetensors",
+                       "b": "model-00002-of-00002.safetensors"}}))
+    (snap / "model-00001-of-00002.safetensors").write_bytes(b"x")  # shard 2 missing
+    with pytest.raises(FileNotFoundError):
+        resolve_model_dir("acme/sharded", cache_dir=str(tmp_path))
+
+    def finish_download(repo_id, cache_dir=None, token=None):
+        (snap / "model-00002-of-00002.safetensors").write_bytes(b"y")
+
+    monkeypatch.setattr(hub, "download_snapshot", finish_download)
+    assert hub.ensure_model_dir("acme/sharded", cache_dir=str(tmp_path)) == str(snap)
+
+
+def test_incomplete_snapshots_do_not_resolve(tmp_path):
+    """Shard files without an index, or missing config.json, = still
+    downloading (concurrent fetch order proves nothing) — must not resolve."""
+    snap = _fake_snapshot(str(tmp_path), "acme/m1")
+    (snap / "model.safetensors").rename(snap / "model-00001-of-00002.safetensors")
+    with pytest.raises(FileNotFoundError):
+        resolve_model_dir("acme/m1", cache_dir=str(tmp_path))
+
+    snap2 = _fake_snapshot(str(tmp_path), "acme/m2")
+    (snap2 / "config.json").unlink()
+    with pytest.raises(FileNotFoundError):
+        resolve_model_dir("acme/m2", cache_dir=str(tmp_path))
+
+
+def test_resolution_honors_hf_hub_cache_env(tmp_path, monkeypatch):
+    """HF_HUB_CACHE (PVC mount) must steer resolution the same as download."""
+    from llms_on_kubernetes_tpu.engine.weights import hf_hub_cache
+
+    hub_dir = tmp_path / "pvc-hub"
+    monkeypatch.setenv("HF_HUB_CACHE", str(hub_dir))
+    monkeypatch.delenv("HF_HOME", raising=False)
+    assert hf_hub_cache() == str(hub_dir)
+    snap = (hub_dir / "models--acme--cached" / "snapshots" / "s1")
+    snap.mkdir(parents=True)
+    (snap / "model.safetensors").write_bytes(b"x")
+    (snap / "config.json").write_text("{}")
+    assert resolve_model_dir("acme/cached") == str(snap)
+    # explicit cache_dir still wins over the env
+    assert hf_hub_cache(str(tmp_path / "explicit")) == str(tmp_path / "explicit" / "hub")
+
+
+def test_path_shaped_ref_never_hits_hub(tmp_path, monkeypatch):
+    """A missing local path must surface as FileNotFoundError (mount problem),
+    not be handed to the Hub as a repo id."""
+    monkeypatch.setattr(hub, "download_snapshot",
+                        lambda *a, **k: pytest.fail("must not download"))
+    for ref in ("/mnt/models/llama-3-8b", "./ckpt", "a/b/c"):
+        with pytest.raises(FileNotFoundError):
+            hub.ensure_model_dir(ref, cache_dir=str(tmp_path))
+
+
+def test_hub_token_sources(tmp_path, monkeypatch):
+    for var in ("HUGGING_FACE_HUB_TOKEN", "HF_TOKEN", "HUGGING_FACE_HUB_TOKEN_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    assert hub.hub_token() is None
+    tok = tmp_path / "token"
+    tok.write_text("hf_filetoken\n")
+    monkeypatch.setenv("HUGGING_FACE_HUB_TOKEN_FILE", str(tok))
+    assert hub.hub_token() == "hf_filetoken"
+    monkeypatch.setenv("HF_TOKEN", "hf_envtoken")
+    assert hub.hub_token() == "hf_envtoken"
+    monkeypatch.setenv("HUGGING_FACE_HUB_TOKEN", "hf_secret")
+    assert hub.hub_token() == "hf_secret"
+
+
+def test_serve_missing_weights_exits_nonzero(tmp_path):
+    """`serve` without weights and without --random-weights must exit != 0
+    (pod stays unready — the reference's readiness-budget contract)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HF_HOME"] = str(tmp_path)  # empty cache
+    env["HF_HUB_OFFLINE"] = "1"     # any real download attempt fails fast
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "llms_on_kubernetes_tpu", "serve",
+         "--model", "llama-3-8b", "--port", "0"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode != 0
+    assert "cannot obtain weights" in proc.stderr
+    assert "--random-weights" in proc.stderr
