@@ -38,7 +38,9 @@ import numpy as np
 from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
 from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
 from llms_on_kubernetes_tpu.engine.sampling import sample
-from llms_on_kubernetes_tpu.models.decoder import forward_decode, forward_prefill, init_params
+from llms_on_kubernetes_tpu.models.decoder import (
+    forward_chunk, forward_decode, forward_prefill, init_params,
+)
 
 Params = dict[str, Any]
 
@@ -192,6 +194,41 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     return toks, logprobs, k_pages, v_pages
 
 
+# packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
+# 4 top_p(bits), 5 seed, 6.. page_table. Sampling position is the TOTAL
+# length (history + chunk_len) so a chunked prompt draws exactly the
+# tokens a one-shot prefill of the same prompt would.
+_CHK_COLS = 6
+
+
+def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
+                       base_key):
+    lengths = packed[:, 0]
+    history = packed[:, 1]
+    top_ks = packed[:, 2]
+    temps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
+    seeds = packed[:, 5]
+    page_table = packed[:, _CHK_COLS:]
+
+    logits, k_pages, v_pages = forward_chunk(
+        params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
+    )
+    keys = _slot_keys(base_key, seeds, history + lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
+
+
+def _chunk_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+                base_key, seeds, temps, top_ks, top_ps, history):
+    logits, k_pages, v_pages = forward_chunk(
+        params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
+    )
+    keys = _slot_keys(base_key, seeds, history + lengths)
+    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
+    return toks, logprobs, k_pages, v_pages
+
+
 def _slot_keys(base_key, seeds, lengths):
     """Per-slot PRNG keys: fold(base, request seed, stream position). The
     position is `lengths` — for both prefill and decode it equals the
@@ -320,6 +357,12 @@ class Engine:
         self._decode_packed = jax.jit(
             _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6)
         )
+        self._chunk = jax.jit(
+            _chunk_step, static_argnums=(1,), donate_argnums=(4, 5)
+        )
+        self._chunk_packed = jax.jit(
+            _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
+        )
 
         # async scheduling state (see EngineConfig.async_scheduling)
         self._async = bool(engine_config.async_scheduling) and not engine_config.multihost
@@ -344,11 +387,10 @@ class Engine:
         max_len = self.config.max_model_len
         if len(prompt) == 0:
             raise ValueError("empty prompt")
-        if len(prompt) > max(self.config.prefill_buckets):
-            raise ValueError(
-                f"prompt of {len(prompt)} tokens exceeds the largest prefill "
-                f"bucket ({max(self.config.prefill_buckets)})"
-            )
+        # prompts longer than the largest prefill bucket are served too:
+        # admission splits them into bucket-size chunks against the paged
+        # pool (chunked prefill — forward_chunk). The only hard limit is
+        # the slot's page capacity below.
         # prompt + 1 sampled token must fit a slot's pages — a prompt that can
         # never be admitted would livelock the whole waiting queue behind it.
         if len(prompt) + 1 > max_len:
@@ -417,29 +459,40 @@ class Engine:
     def _run_device_step(self, op: int, fn, tokens: np.ndarray,
                          lengths: np.ndarray, page_table: np.ndarray,
                          seeds: np.ndarray, temps: np.ndarray,
-                         top_ks: np.ndarray, top_ps: np.ndarray):
+                         top_ks: np.ndarray, top_ps: np.ndarray,
+                         extra: Optional[dict] = None):
         """Enter a jitted step — after broadcasting its inputs to follower
-        processes when this engine coordinates a multi-host pod group."""
+        processes when this engine coordinates a multi-host pod group.
+
+        ``extra`` carries op-specific payload fields (e.g. OP_CHUNK's
+        ``history``); they ride the same broadcast and are appended as
+        trailing fn args in dict order, which must match the follower's
+        ``_payload_struct`` ordering for the op."""
         if self.config.multihost:
             from llms_on_kubernetes_tpu.engine import multihost as mh
 
             bucket = tokens.shape[1] if tokens.ndim == 2 else 0
+            payload = {
+                "tokens": np.asarray(tokens, np.int32),
+                "lengths": np.asarray(lengths, np.int32),
+                "page_table": np.asarray(page_table, np.int32),
+                "seeds": np.asarray(seeds, np.int32),
+                "temps": np.asarray(temps, np.float32),
+                "top_ks": np.asarray(top_ks, np.int32),
+                "top_ps": np.asarray(top_ps, np.float32),
+            }
+            for k, v in (extra or {}).items():
+                payload[k] = np.asarray(v)
             mh.broadcast_header(op, bucket, tokens.shape[0])
             mh.broadcast_payload(
-                {"tokens": np.asarray(tokens, np.int32),
-                 "lengths": np.asarray(lengths, np.int32),
-                 "page_table": np.asarray(page_table, np.int32),
-                 "seeds": np.asarray(seeds, np.int32),
-                 "temps": np.asarray(temps, np.float32),
-                 "top_ks": np.asarray(top_ks, np.int32),
-                 "top_ps": np.asarray(top_ps, np.float32)},
-                op, bucket, tokens.shape[0], self.config.pages_per_slot,
+                payload, op, bucket, tokens.shape[0], self.config.pages_per_slot,
             )
         return fn(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(lengths), self.k_pages, self.v_pages,
             jnp.asarray(page_table), self._key, jnp.asarray(seeds),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            *(jnp.asarray(v) for v in (extra or {}).values()),
         )
 
     def _free_slot(self) -> Optional[int]:
@@ -453,6 +506,56 @@ class Engine:
             if n <= b:
                 return b
         raise ValueError(f"no prefill bucket fits {n} tokens")
+
+    def _chunked_prefill(self, slot: int, req: Request,
+                         prefill_tokens: list[int]):
+        """Prefill an out-of-bucket prompt in bucket-size chunks against the
+        paged pool (prefill-with-history attention, forward_chunk). The
+        slot's pages for the WHOLE prompt are already allocated. Pure
+        dispatch: each chunk chains on the previous through the donated
+        page pool — no host read here, so the async pipeline stays full.
+        Returns the FINAL chunk's sampled-token device array [1] (the
+        request's first generated token)."""
+        n = len(prefill_tokens)
+        step = max(self.config.prefill_buckets)
+        pps = self.allocator.pages_per_slot
+        toks = None
+        pos = 0
+        while pos < n:
+            m = min(step, n - pos)
+            bucket = self._bucket_for(m)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :m] = prefill_tokens[pos:pos + m]
+            if self.config.multihost:
+                from llms_on_kubernetes_tpu.engine.multihost import OP_CHUNK
+
+                toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
+                    OP_CHUNK, self._chunk, tokens,
+                    np.asarray([m], np.int32),
+                    self.allocator.page_tables[slot:slot + 1],
+                    np.asarray([req.seed], np.int32),
+                    np.asarray([req.params.temperature], np.float32),
+                    np.asarray([req.params.top_k], np.int32),
+                    np.asarray([req.params.top_p], np.float32),
+                    extra={"history": np.asarray([pos], np.int32)},
+                )
+            else:
+                packed = np.zeros((1, _CHK_COLS + pps), np.int32)
+                packed[0, 0] = m
+                packed[0, 1] = pos
+                packed[0, 2] = req.params.top_k
+                packed[0, 3] = np.float32(req.params.temperature).view(np.int32)
+                packed[0, 4] = np.float32(req.params.top_p).view(np.int32)
+                packed[0, 5] = req.seed
+                packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
+                toks, _lps, self.k_pages, self.v_pages = self._chunk_packed(
+                    self.params, self.model_config, jnp.asarray(tokens),
+                    jnp.asarray(packed), self.k_pages, self.v_pages,
+                    self._key,
+                )
+            pos += m
+        self.slot_len[slot] = n
+        return toks
 
     def _admit_one(self) -> list[StepEvent]:
         """Admit + prefill at most one waiting request per iteration.
@@ -472,9 +575,8 @@ class Engine:
             resumed = bool(req.output)
             prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
             n = len(prefill_tokens)
-            if (n > max(self.config.prefill_buckets)
-                    or self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot):
-                # resumed request grew beyond prefill/page reach; end it
+            if self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot:
+                # resumed request grew beyond page reach; end it
                 # gracefully rather than livelocking the queue behind it
                 self.waiting.popleft()
                 ev = self._finish(req, "length")
@@ -486,22 +588,25 @@ class Engine:
         self.slots[slot] = req
         req.slot = slot
 
-        bucket = self._bucket_for(n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = prefill_tokens
+        if n > max(self.config.prefill_buckets):
+            toks = self._chunked_prefill(slot, req, prefill_tokens)
+        else:
+            bucket = self._bucket_for(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = prefill_tokens
 
-        from llms_on_kubernetes_tpu.engine.multihost import OP_PREFILL
+            from llms_on_kubernetes_tpu.engine.multihost import OP_PREFILL
 
-        toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
-            OP_PREFILL, self._prefill, tokens,
-            np.asarray([n], np.int32),
-            self.allocator.page_tables[slot:slot + 1],
-            np.asarray([req.seed], np.int32),
-            np.asarray([req.params.temperature], np.float32),
-            np.asarray([req.params.top_k], np.int32),
-            np.asarray([req.params.top_p], np.float32),
-        )
-        self.slot_len[slot] = n
+            toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
+                OP_PREFILL, self._prefill, tokens,
+                np.asarray([n], np.int32),
+                self.allocator.page_tables[slot:slot + 1],
+                np.asarray([req.seed], np.int32),
+                np.asarray([req.params.temperature], np.float32),
+                np.asarray([req.params.top_k], np.int32),
+                np.asarray([req.params.top_p], np.float32),
+            )
+            self.slot_len[slot] = n
         if resumed:
             req.pending_token = req.output[-1]
             return []
@@ -623,6 +728,7 @@ class Engine:
         deferred to _harvest. Returns None or a dict describing the
         admissions for the decode launch's on-device token merge."""
         picked: list[tuple[int, "Request", bool, list[int]]] = []
+        long_pick = None
         with self._lock:
             while self.waiting and len(picked) < self.config.admit_batch:
                 slot = self._free_slot()
@@ -632,11 +738,22 @@ class Engine:
                 resumed = bool(req.output)
                 prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
                 n = len(prefill_tokens)
-                if (n > max(self.config.prefill_buckets)
-                        or self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot):
+                if self.allocator.pages_needed(n + 1) > self.allocator.pages_per_slot:
                     self.waiting.popleft()
                     events.append(self._finish(req, "length"))
                     continue
+                if n > max(self.config.prefill_buckets):
+                    # out-of-bucket prompt: chunked prefill, admitted alone
+                    if picked:
+                        break  # runs by itself next iteration
+                    if not self.allocator.can_allocate(slot, n + 1):
+                        break
+                    self.waiting.popleft()
+                    self.allocator.allocate(slot, n + 1)
+                    self.slots[slot] = req
+                    req.slot = slot
+                    long_pick = (slot, req, resumed, prefill_tokens)
+                    break
                 if picked and self._bucket_for(n) != self._bucket_for(
                         len(picked[0][3])):
                     break  # next request needs a different bucket
@@ -647,6 +764,21 @@ class Engine:
                 self.slots[slot] = req
                 req.slot = slot
                 picked.append((slot, req, resumed, prefill_tokens))
+        if long_pick is not None:
+            slot, req, resumed, prefill_tokens = long_pick
+            toks = self._chunked_prefill(slot, req, prefill_tokens)
+            try:
+                toks.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            merge = {"toks": toks, "slots": {}}
+            if resumed:
+                req.pending_token = req.output[-1]
+                merge["slots"][slot] = (True, req.output[-1], 0)
+            else:
+                merge["slots"][slot] = (False, 0, 0)
+                self._pending_first.append((req, toks, 0))
+            return merge
         if not picked:
             return None
 

@@ -33,6 +33,7 @@ OP_IDLE = 0
 OP_PREFILL = 1
 OP_DECODE = 2
 OP_SHUTDOWN = 3
+OP_CHUNK = 4  # chunked prefill: prefill payload + per-row history offsets
 
 HEADER_LEN = 3  # [op, bucket, batch]
 
@@ -50,8 +51,8 @@ def broadcast_header(op: int, bucket: int = 0, batch: int = 0) -> np.ndarray:
 
 def _payload_struct(op: int, bucket: int, batch: int, pages_per_seq: int):
     """Shapes of the host-side step inputs, derivable from the header."""
-    if op == OP_PREFILL:
-        return {
+    if op in (OP_PREFILL, OP_CHUNK):
+        struct = {
             "tokens": np.zeros((batch, bucket), np.int32),
             "lengths": np.zeros((batch,), np.int32),
             "page_table": np.zeros((batch, pages_per_seq), np.int32),
@@ -60,6 +61,9 @@ def _payload_struct(op: int, bucket: int, batch: int, pages_per_seq: int):
             "top_ks": np.zeros((batch,), np.int32),
             "top_ps": np.zeros((batch,), np.float32),
         }
+        if op == OP_CHUNK:
+            struct["history"] = np.zeros((batch,), np.int32)
+        return struct
     if op == OP_DECODE:
         return {
             "tokens": np.zeros((batch,), np.int32),
@@ -111,5 +115,9 @@ def follower_loop(engine: Any) -> None:
         )
         if op == OP_PREFILL:
             _t, _l, engine.k_pages, engine.v_pages = engine._prefill(*args)
+        elif op == OP_CHUNK:
+            _t, _l, engine.k_pages, engine.v_pages = engine._chunk(
+                *args, jnp.asarray(p["history"])
+            )
         else:
             _t, _l, engine.k_pages, engine.v_pages = engine._decode(*args)

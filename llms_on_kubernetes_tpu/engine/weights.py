@@ -235,6 +235,13 @@ def _snapshot_complete(snap: pathlib.Path) -> bool:
 
     if not (snap / "config.json").is_file():
         return False
+    # at least one tokenizer artifact (all are in hub._ALLOW_PATTERNS):
+    # without this, a download killed after weights-but-before-tokenizer
+    # would resolve, never resume, and silently serve via ByteTokenizer
+    if not any((snap / t).is_file() for t in
+               ("tokenizer.json", "tokenizer.model", "tokenizer_config.json",
+                "vocab.json")):
+        return False
     idx = snap / "model.safetensors.index.json"
     if idx.is_file():
         try:

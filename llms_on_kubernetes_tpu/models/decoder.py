@@ -31,7 +31,8 @@ import numpy as np
 from llms_on_kubernetes_tpu.configs import ModelConfig
 from llms_on_kubernetes_tpu.engine.cache import write_tokens
 from llms_on_kubernetes_tpu.ops.attention import (
-    dispatch_paged_attention, dispatch_prefill_attention, softcap,
+    dispatch_chunk_attention, dispatch_paged_attention,
+    dispatch_prefill_attention, softcap,
 )
 from llms_on_kubernetes_tpu.ops.moe import moe_block
 from llms_on_kubernetes_tpu.ops.norms import rms_norm
@@ -174,6 +175,16 @@ def _layer_step(
             scale=scale, sliding_window=window,
             attn_softcap=cfg.attn_softcap,
         )
+    elif mode == "chunk":
+        # chunked prefill: queries attend to previous chunks' cached KV
+        # plus this chunk, through the page table (history = global
+        # position of the chunk's first token)
+        attn = dispatch_chunk_attention(
+            q, k_pages, v_pages, page_table,
+            positions[:, 0], lengths,
+            scale=scale, sliding_window=window,
+            attn_softcap=cfg.attn_softcap,
+        )
     else:
         attn = dispatch_paged_attention(
             q[:, 0], k_pages, v_pages, page_table, lengths,
@@ -270,6 +281,35 @@ def forward_prefill(
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _logits(params, cfg, x_last), k_pages, v_pages
+
+
+def forward_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, T] one padded CHUNK of a longer prompt
+    history: jnp.ndarray,     # [B] tokens already cached before this chunk
+    lengths: jnp.ndarray,     # [B] valid tokens in THIS chunk; 0 => idle row
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+):
+    """Chunked prefill: process one chunk of a prompt whose earlier chunks
+    are already in the paged cache. Returns the chunk's last-token logits
+    [B, V] and the updated cache. With history=0 this is semantically
+    ``forward_prefill`` (pinned by tests), but attends through the page
+    pool — the engine uses it only for out-of-bucket prompts."""
+    B, T = tokens.shape
+    offs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    positions = history[:, None] + offs
+    write_positions = jnp.where(offs < lengths[:, None], positions, -1)
+    x = _embed(params, cfg, tokens)
+    x, k_pages, v_pages = _run_layers(
+        cfg, params, x, k_pages, v_pages, page_table,
+        positions, write_positions, lengths, "chunk",
+    )
+    last = jnp.clip(lengths - 1, 0, T - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     return _logits(params, cfg, x_last), k_pages, v_pages
 
 

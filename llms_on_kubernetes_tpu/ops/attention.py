@@ -148,6 +148,61 @@ def paged_attention(
     return out.reshape(B, n_q, d).astype(q.dtype)
 
 
+def chunk_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    history: jnp.ndarray,
+    chunk_lengths: jnp.ndarray,
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Prefill-with-history attention: a prompt CHUNK against the paged pool.
+
+    The chunked-prefill path for prompts longer than the largest bucket
+    (the reference's vLLM image served arbitrary lengths up to
+    max-model-len; SURVEY §2.3 row 1): the chunk's KV has already been
+    written into the pages, so each query at global position
+    ``history + t`` attends causally to every cached key — previous
+    chunks' AND this chunk's — through the page table.
+
+    q:             [B, T, n_q, d]   — this chunk's queries
+    k/v_pages:     [n_kv, P, page, d] (one layer, head-major)
+    page_table:    [B, pages_per_seq] int32
+    history:       [B] int32 — tokens cached BEFORE this chunk
+    chunk_lengths: [B] int32 — valid tokens in this chunk (0 => idle row)
+    returns        [B, T, n_q, d]
+    """
+    B, T, n_q, d = q.shape
+    n_kv, P, page, _ = k_pages.shape
+    S = page_table.shape[1] * page
+    group = n_q // n_kv
+
+    k = k_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    v = v_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    qg = q.reshape(B, T, n_kv, group, d).astype(jnp.float32)
+
+    logits = jnp.einsum("btkgd,kbsd->bkgts", qg, k) * scale  # [B,n_kv,g,T,S]
+    logits = softcap(logits, attn_softcap)
+
+    q_pos = history[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]               # [1, 1, S]
+    mask = k_pos <= q_pos[:, :, None]                                   # causal
+    # bound reads to the written region (garbage beyond history+chunk)
+    mask = mask & (k_pos < (history + chunk_lengths)[:, None, None])
+    if sliding_window is not None:
+        mask = mask & (k_pos > q_pos[:, :, None] - sliding_window)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v)
+    return out.reshape(B, T, n_q, d).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Dispatchers (what the decoder calls)
 # ---------------------------------------------------------------------------
@@ -187,6 +242,18 @@ def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
     return prefill_attention(q, k, v, lengths, scale=scale,
                              sliding_window=sliding_window,
                              attn_softcap=attn_softcap)
+
+
+def dispatch_chunk_attention(q, k_pages, v_pages, page_table, history,
+                             chunk_lengths, *, scale, sliding_window=None,
+                             attn_softcap=None):
+    # XLA gather path everywhere for now: chunked prefill is bandwidth-bound
+    # on the page gather, which XLA fuses acceptably; a Pallas paged-flash
+    # chunk kernel is the designated upgrade path (see pallas_flash.py).
+    return chunk_attention(q, k_pages, v_pages, page_table, history,
+                           chunk_lengths, scale=scale,
+                           sliding_window=sliding_window,
+                           attn_softcap=attn_softcap)
 
 
 def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,

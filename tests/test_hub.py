@@ -25,6 +25,7 @@ def _fake_snapshot(cache_dir: str, repo_id: str) -> pathlib.Path:
     snap.mkdir(parents=True)
     (snap / "model.safetensors").write_bytes(b"\x08\x00\x00\x00\x00\x00\x00\x00{}      ")
     (snap / "config.json").write_text("{}")
+    (snap / "tokenizer_config.json").write_text("{}")
     return snap
 
 
@@ -110,6 +111,12 @@ def test_incomplete_snapshots_do_not_resolve(tmp_path):
     with pytest.raises(FileNotFoundError):
         resolve_model_dir("acme/m2", cache_dir=str(tmp_path))
 
+    # weights+config landed but no tokenizer artifact yet: still downloading
+    snap3 = _fake_snapshot(str(tmp_path), "acme/m3")
+    (snap3 / "tokenizer_config.json").unlink()
+    with pytest.raises(FileNotFoundError):
+        resolve_model_dir("acme/m3", cache_dir=str(tmp_path))
+
 
 def test_resolution_honors_hf_hub_cache_env(tmp_path, monkeypatch):
     """HF_HUB_CACHE (PVC mount) must steer resolution the same as download."""
@@ -123,6 +130,7 @@ def test_resolution_honors_hf_hub_cache_env(tmp_path, monkeypatch):
     snap.mkdir(parents=True)
     (snap / "model.safetensors").write_bytes(b"x")
     (snap / "config.json").write_text("{}")
+    (snap / "tokenizer_config.json").write_text("{}")
     assert resolve_model_dir("acme/cached") == str(snap)
     # explicit cache_dir still wins over the env
     assert hf_hub_cache(str(tmp_path / "explicit")) == str(tmp_path / "explicit" / "hub")

@@ -48,8 +48,10 @@ eng = Engine(cfg, mesh=mesh)
 if pid == 0:
     out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=8))
     out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=6))
+    # out-of-bucket prompt: exercises OP_CHUNK (chunked prefill broadcast)
+    out3 = eng.generate(list(range(1, 38)), SamplingParams(temperature=0.0, max_tokens=4))
     broadcast_header(OP_SHUTDOWN)
-    print("RESULT:" + json.dumps([out, out2]), flush=True)
+    print("RESULT:" + json.dumps([out, out2, out3]), flush=True)
 else:
     follower_loop(eng)
     print("FOLLOWER done", flush=True)
@@ -73,7 +75,8 @@ mesh = make_mesh(data=1, expert=1, model=4)
 eng = Engine(cfg, mesh=mesh)
 out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=8))
 out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=6))
-print("RESULT:" + json.dumps([out, out2]), flush=True)
+out3 = eng.generate(list(range(1, 38)), SamplingParams(temperature=0.0, max_tokens=4))
+print("RESULT:" + json.dumps([out, out2, out3]), flush=True)
 """
 
 
