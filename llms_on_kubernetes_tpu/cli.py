@@ -244,7 +244,10 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             follower_loop(engine)
             return 0
-    run_server(engine, tokenizer, served, host=args.host, port=args.port)
+    try:
+        run_server(engine, tokenizer, served, host=args.host, port=args.port)
+    finally:
+        engine.stop_followers()  # release follower pods' mirror loops
     return 0
 
 

@@ -78,8 +78,8 @@ class EngineConfig:
     # scheduling, re-done for JAX's dispatch model). Finishes/stop tokens
     # are detected one harvest late; the speculative extra step is harmless
     # (its writes land in pages that are only reused after device-ordered
-    # completion). Disabled automatically under multihost (the broadcast
-    # protocol carries host values).
+    # completion). Works under multihost too: the packed broadcast tells
+    # followers which device-resident token reference feeds each merge.
     async_scheduling: bool = True
     async_depth: int = 2
     # async admission: up to this many same-bucket waiting requests prefill
@@ -219,16 +219,6 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     return toks, logprobs, k_pages, v_pages
 
 
-def _chunk_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-                base_key, seeds, temps, top_ks, top_ps, history):
-    logits, k_pages, v_pages = forward_chunk(
-        params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
-    )
-    keys = _slot_keys(base_key, seeds, history + lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
-
-
 def _slot_keys(base_key, seeds, lengths):
     """Per-slot PRNG keys: fold(base, request seed, stream position). The
     position is `lengths` — for both prefill and decode it equals the
@@ -237,26 +227,6 @@ def _slot_keys(base_key, seeds, lengths):
     return jax.vmap(
         lambda s, p: jax.random.fold_in(jax.random.fold_in(base_key, s), p)
     )(seeds, lengths)
-
-
-def _prefill_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-                  base_key, seeds, temps, top_ks, top_ps):
-    logits, k_pages, v_pages = forward_prefill(
-        params, cfg, tokens, lengths, k_pages, v_pages, page_table
-    )
-    keys = _slot_keys(base_key, seeds, lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
-
-
-def _decode_step(params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-                 base_key, seeds, temps, top_ks, top_ps):
-    logits, k_pages, v_pages = forward_decode(
-        params, cfg, tokens, lengths, k_pages, v_pages, page_table
-    )
-    keys = _slot_keys(base_key, seeds, lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
 
 
 class Engine:
@@ -345,27 +315,27 @@ class Engine:
         self._lock = threading.Lock()
         self.preemptions = 0  # total KV-pressure preemptions (metrics)
 
-        self._prefill = jax.jit(
-            _prefill_step, static_argnums=(1,), donate_argnums=(4, 5)
-        )
-        self._decode = jax.jit(
-            _decode_step, static_argnums=(1,), donate_argnums=(4, 5)
-        )
         self._prefill_packed = jax.jit(
             _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
         )
         self._decode_packed = jax.jit(
             _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6)
         )
-        self._chunk = jax.jit(
-            _chunk_step, static_argnums=(1,), donate_argnums=(4, 5)
-        )
         self._chunk_packed = jax.jit(
             _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
         )
 
+        # multi-host: every device call is announced in one packed broadcast
+        # (engine/multihost.py). Async scheduling works across hosts — the
+        # decode merge consumes device-resident tokens, so followers never
+        # need host values.
+        if engine_config.multihost:
+            from llms_on_kubernetes_tpu.engine.multihost import ProtoShapes
+
+            self._mh_shapes = ProtoShapes.from_engine_config(engine_config)
+
         # async scheduling state (see EngineConfig.async_scheduling)
-        self._async = bool(engine_config.async_scheduling) and not engine_config.multihost
+        self._async = bool(engine_config.async_scheduling)
         self._inflight: "collections.deque[InflightStep]" = collections.deque()
         # (request, prefill toks device array, row) awaiting first-token harvest
         self._pending_first: list[tuple[Request, Any, int]] = []
@@ -456,44 +426,34 @@ class Engine:
                 events.append(self._finish(r, r.abort_reason))
         return events
 
-    def _run_device_step(self, op: int, fn, tokens: np.ndarray,
-                         lengths: np.ndarray, page_table: np.ndarray,
-                         seeds: np.ndarray, temps: np.ndarray,
-                         top_ks: np.ndarray, top_ps: np.ndarray,
-                         extra: Optional[dict] = None):
-        """Enter a jitted step — after broadcasting its inputs to follower
-        processes when this engine coordinates a multi-host pod group.
+    def _mh_send(self, op: int, **fields) -> None:
+        """Announce the next device call to follower pods (no-op single-host).
+        One packed broadcast per call — see engine/multihost.py."""
+        if not self.config.multihost:
+            return
+        from llms_on_kubernetes_tpu.engine import multihost as mh
 
-        ``extra`` carries op-specific payload fields (e.g. OP_CHUNK's
-        ``history``); they ride the same broadcast and are appended as
-        trailing fn args in dict order, which must match the follower's
-        ``_payload_struct`` ordering for the op."""
-        if self.config.multihost:
-            from llms_on_kubernetes_tpu.engine import multihost as mh
+        mh.send_message(self._mh_shapes, op, **fields)
 
-            bucket = tokens.shape[1] if tokens.ndim == 2 else 0
-            payload = {
-                "tokens": np.asarray(tokens, np.int32),
-                "lengths": np.asarray(lengths, np.int32),
-                "page_table": np.asarray(page_table, np.int32),
-                "seeds": np.asarray(seeds, np.int32),
-                "temps": np.asarray(temps, np.float32),
-                "top_ks": np.asarray(top_ks, np.int32),
-                "top_ps": np.asarray(top_ps, np.float32),
-            }
-            for k, v in (extra or {}).items():
-                payload[k] = np.asarray(v)
-            mh.broadcast_header(op, bucket, tokens.shape[0])
-            mh.broadcast_payload(
-                payload, op, bucket, tokens.shape[0], self.config.pages_per_slot,
-            )
-        return fn(
-            self.params, self.model_config, jnp.asarray(tokens),
-            jnp.asarray(lengths), self.k_pages, self.v_pages,
-            jnp.asarray(page_table), self._key, jnp.asarray(seeds),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            *(jnp.asarray(v) for v in (extra or {}).values()),
-        )
+    def stop_followers(self) -> None:
+        """Tell follower pods to exit their mirror loops (engine shutdown)."""
+        if not self.config.multihost:
+            return
+        from llms_on_kubernetes_tpu.engine import multihost as mh
+
+        from llms_on_kubernetes_tpu.parallel.distributed import is_coordinator
+
+        if is_coordinator():
+            mh.send_message(self._mh_shapes, mh.MSG_SHUTDOWN)
+
+    def _pack_prefill_row(self, packed: np.ndarray, row: int, req: Request,
+                          n: int, slot: int) -> None:
+        packed[row, 0] = n
+        packed[row, 1] = req.params.top_k
+        packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
+        packed[row, 3] = np.float32(req.params.top_p).view(np.int32)
+        packed[row, 4] = req.seed
+        packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
 
     def _free_slot(self) -> Optional[int]:
         for i, r in enumerate(self.slots):
@@ -516,6 +476,8 @@ class Engine:
         page pool — no host read here, so the async pipeline stays full.
         Returns the FINAL chunk's sampled-token device array [1] (the
         request's first generated token)."""
+        from llms_on_kubernetes_tpu.engine.multihost import MSG_CHUNK
+
         n = len(prefill_tokens)
         step = max(self.config.prefill_buckets)
         pps = self.allocator.pages_per_slot
@@ -526,33 +488,20 @@ class Engine:
             bucket = self._bucket_for(m)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :m] = prefill_tokens[pos:pos + m]
-            if self.config.multihost:
-                from llms_on_kubernetes_tpu.engine.multihost import OP_CHUNK
-
-                toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
-                    OP_CHUNK, self._chunk, tokens,
-                    np.asarray([m], np.int32),
-                    self.allocator.page_tables[slot:slot + 1],
-                    np.asarray([req.seed], np.int32),
-                    np.asarray([req.params.temperature], np.float32),
-                    np.asarray([req.params.top_k], np.int32),
-                    np.asarray([req.params.top_p], np.float32),
-                    extra={"history": np.asarray([pos], np.int32)},
-                )
-            else:
-                packed = np.zeros((1, _CHK_COLS + pps), np.int32)
-                packed[0, 0] = m
-                packed[0, 1] = pos
-                packed[0, 2] = req.params.top_k
-                packed[0, 3] = np.float32(req.params.temperature).view(np.int32)
-                packed[0, 4] = np.float32(req.params.top_p).view(np.int32)
-                packed[0, 5] = req.seed
-                packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
-                toks, _lps, self.k_pages, self.v_pages = self._chunk_packed(
-                    self.params, self.model_config, jnp.asarray(tokens),
-                    jnp.asarray(packed), self.k_pages, self.v_pages,
-                    self._key,
-                )
+            packed = np.zeros((1, _CHK_COLS + pps), np.int32)
+            packed[0, 0] = m
+            packed[0, 1] = pos
+            packed[0, 2] = req.params.top_k
+            packed[0, 3] = np.float32(req.params.temperature).view(np.int32)
+            packed[0, 4] = np.float32(req.params.top_p).view(np.int32)
+            packed[0, 5] = req.seed
+            packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
+            self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed)
+            toks, _lps, self.k_pages, self.v_pages = self._chunk_packed(
+                self.params, self.model_config, jnp.asarray(tokens),
+                jnp.asarray(packed), self.k_pages, self.v_pages,
+                self._key,
+            )
             pos += m
         self.slot_len[slot] = n
         return toks
@@ -591,20 +540,18 @@ class Engine:
         if n > max(self.config.prefill_buckets):
             toks = self._chunked_prefill(slot, req, prefill_tokens)
         else:
+            from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
+
             bucket = self._bucket_for(n)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :n] = prefill_tokens
-
-            from llms_on_kubernetes_tpu.engine.multihost import OP_PREFILL
-
-            toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
-                OP_PREFILL, self._prefill, tokens,
-                np.asarray([n], np.int32),
-                self.allocator.page_tables[slot:slot + 1],
-                np.asarray([req.seed], np.int32),
-                np.asarray([req.params.temperature], np.float32),
-                np.asarray([req.params.top_k], np.int32),
-                np.asarray([req.params.top_p], np.float32),
+            packed = np.zeros((1, _PRE_COLS + self.allocator.pages_per_slot),
+                              np.int32)
+            self._pack_prefill_row(packed, 0, req, n, slot)
+            self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
+            toks, _lps, self.k_pages, self.v_pages = self._prefill_packed(
+                self.params, self.model_config, jnp.asarray(tokens),
+                jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
             )
             self.slot_len[slot] = n
         if resumed:
@@ -677,26 +624,27 @@ class Engine:
         if not active:
             return []
 
+        from llms_on_kubernetes_tpu.engine.multihost import MSG_DECODE
+
         B = self.config.max_decode_slots
-        tokens = np.zeros((B,), np.int32)
-        lengths = np.zeros((B,), np.int32)
-        temps = np.zeros((B,), np.float32)
-        top_ks = np.zeros((B,), np.int32)
-        top_ps = np.ones((B,), np.float32)
-        seeds = np.zeros((B,), np.int32)
+        pps = self.allocator.pages_per_slot
+        packed = np.zeros((B, _DEC_COLS + pps), np.int32)
+        packed[:, 1] = 1                               # src: host value
+        packed[:, 5] = np.float32(1.0).view(np.int32)  # top_p disabled
         for i, r in active:
-            tokens[i] = r.pending_token
-            lengths[i] = self.slot_len[i] + 1
-            seeds[i] = r.seed
-            temps[i] = r.params.temperature
-            top_ks[i] = r.params.top_k
-            top_ps[i] = r.params.top_p
+            packed[i, 0] = self.slot_len[i] + 1
+            packed[i, 2] = r.pending_token
+            packed[i, 3] = r.params.top_k
+            packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
+            packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
+            packed[i, 6] = r.seed
+        packed[:, _DEC_COLS:] = self.allocator.page_tables
 
-        from llms_on_kubernetes_tpu.engine.multihost import OP_DECODE
-
-        toks, _lps, self.k_pages, self.v_pages = self._run_device_step(
-            OP_DECODE, self._decode, tokens, lengths,
-            self.allocator.page_tables, seeds, temps, top_ks, top_ps,
+        self._mh_send(MSG_DECODE, dec_packed=packed)
+        toks, _lps, self.k_pages, self.v_pages = self._decode_packed(
+            self.params, self.model_config, jnp.asarray(packed),
+            self._zeros_B, self._zeros_1, self.k_pages, self.v_pages,
+            self._key,
         )
         sampled = np.asarray(toks)
 
@@ -782,6 +730,8 @@ class Engine:
         if not picked:
             return None
 
+        from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
+
         bucket = max(self._bucket_for(len(p[3])) for p in picked)
         # pad the batch to 1 or admit_batch rows (two executables per bucket)
         K = 1 if len(picked) == 1 else self.config.admit_batch
@@ -792,14 +742,10 @@ class Engine:
         for row, (slot, req, _resumed, ptoks) in enumerate(picked):
             n = len(ptoks)
             tokens[row, :n] = ptoks
-            packed[row, 0] = n
-            packed[row, 1] = req.params.top_k
-            packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
-            packed[row, 3] = np.float32(req.params.top_p).view(np.int32)
-            packed[row, 4] = req.seed
-            packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
+            self._pack_prefill_row(packed, row, req, n, slot)
             self.slot_len[slot] = n
 
+        self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
         toks, _lps, self.k_pages, self.v_pages = self._prefill_packed(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
@@ -881,9 +827,17 @@ class Engine:
                 packed[i, 1], packed[i, 2] = 1, r.pending_token
         packed[:, _DEC_COLS:] = self.allocator.page_tables
 
+        from llms_on_kubernetes_tpu.engine.multihost import MSG_DECODE
+
         last_toks = self._inflight[-1].toks if self._inflight else self._zeros_B
         prefill_toks = admitted["toks"] if admitted is not None else self._zeros_1
 
+        # followers pick the same token references by these flags: their own
+        # newest decode output (last_valid) / newest prefill-or-chunk output
+        # (use_prefill) are the same global arrays by SPMD determinism
+        self._mh_send(MSG_DECODE, dec_packed=packed,
+                      last_valid=bool(self._inflight),
+                      use_prefill=admitted is not None)
         toks, _lps, self.k_pages, self.v_pages = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             last_toks, prefill_toks, self.k_pages, self.v_pages, self._key,

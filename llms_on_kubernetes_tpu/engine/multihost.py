@@ -6,36 +6,83 @@ all-reduces span all hosts). So the coordinator (pod 0) cannot just run
 ``Engine.step()`` by itself while followers idle — followers would never
 enter the program and the slice would deadlock.
 
-Protocol (the TPU-native stand-in for the reference's NCCL rendezvous,
-SURVEY §2.4 / §5 "Distributed communication backend"):
+Packed protocol (v2 — the TPU-native stand-in for the reference's NCCL
+rendezvous, SURVEY §2.4 / §5 "Distributed communication backend"):
 
 - The scheduler (admission, page allocation, sampling-parameter tables)
   runs ONLY on the coordinator; it is plain host Python.
-- Before every device step, the coordinator broadcasts a fixed-size int32
-  HEADER [op, bucket, batch] then the step's host inputs; followers mirror
-  the broadcast, materialize the same global arrays, and enter the same
-  jitted function. Payload shapes are derivable from the header alone, so
-  followers never need scheduler state.
-- op codes: 0 = idle tick (followers wait again), 1 = prefill(bucket),
-  2 = decode, 3 = shutdown.
+- Every device call is announced by exactly ONE
+  ``multihost_utils.broadcast_one_to_all`` of a fixed-shape int32 message
+  (control word + the same packed arrays the single-host engine already
+  builds for its packed executables). One broadcast = one DCN/ICI round
+  per step — the old header+payload protocol paid two.
+- ASYNC scheduling works across hosts: the decode input merge happens on
+  device from the previous step's sampled tokens, so followers never need
+  host values — they mirror the coordinator's call sequence and keep
+  references to their own ``last_toks`` / ``prefill_toks`` outputs, which
+  are the same global arrays by SPMD determinism. The control word's
+  ``last_valid`` / ``use_prefill`` bits tell them which reference the
+  coordinator wired into the merge.
+- Followers do no host reads and no allocation: page tables, lengths, and
+  sampling parameters all ride inside the packed arrays.
 
-``multihost_utils.broadcast_one_to_all`` carries the payload (psum under
-the hood over DCN/ICI).
+Message layout (all int32; floats ride bitcast, as in the packed steps):
+
+  ctrl[8]    = [op, k_rows, bucket, last_valid, use_prefill, 0, 0, 0]
+  pre_tokens [admit_batch, max_bucket]   prefill/chunk token ids
+  pre_packed [admit_batch, _CHK_COLS + pages_per_slot]
+  dec_packed [max_decode_slots, _DEC_COLS + pages_per_slot]
+
+Unused fields are zero; the buffers are small (tens of KB) next to a
+step's compute, and a single fixed pytree keeps the broadcast one
+compiled executable.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Optional
 
 import numpy as np
 
-OP_IDLE = 0
-OP_PREFILL = 1
-OP_DECODE = 2
-OP_SHUTDOWN = 3
-OP_CHUNK = 4  # chunked prefill: prefill payload + per-row history offsets
+MSG_IDLE = 0      # follower receive stub only; the coordinator never sends it
+MSG_PREFILL = 1
+MSG_CHUNK = 2
+MSG_DECODE = 3
+MSG_SHUTDOWN = 4
 
-HEADER_LEN = 3  # [op, bucket, batch]
+CTRL_LEN = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtoShapes:
+    """Fixed message-buffer shapes, derivable from EngineConfig on every
+    process (the config is part of the deployment spec, identical per pod)."""
+    admit_batch: int
+    max_bucket: int
+    pre_width: int     # _CHK_COLS + pages_per_slot (covers prefill's too)
+    num_slots: int
+    dec_width: int     # _DEC_COLS + pages_per_slot
+
+    @classmethod
+    def from_engine_config(cls, cfg: Any) -> "ProtoShapes":
+        from llms_on_kubernetes_tpu.engine.engine import _CHK_COLS, _DEC_COLS
+
+        return cls(
+            admit_batch=cfg.admit_batch,
+            max_bucket=max(cfg.prefill_buckets),
+            pre_width=_CHK_COLS + cfg.pages_per_slot,
+            num_slots=cfg.max_decode_slots,
+            dec_width=_DEC_COLS + cfg.pages_per_slot,
+        )
+
+    def zeros(self) -> dict:
+        return {
+            "ctrl": np.zeros((CTRL_LEN,), np.int32),
+            "pre_tokens": np.zeros((self.admit_batch, self.max_bucket), np.int32),
+            "pre_packed": np.zeros((self.admit_batch, self.pre_width), np.int32),
+            "dec_packed": np.zeros((self.num_slots, self.dec_width), np.int32),
+        }
 
 
 def _broadcast(value):
@@ -44,80 +91,77 @@ def _broadcast(value):
     return multihost_utils.broadcast_one_to_all(value)
 
 
-def broadcast_header(op: int, bucket: int = 0, batch: int = 0) -> np.ndarray:
-    hdr = np.asarray([op, bucket, batch], np.int32)
-    return np.asarray(_broadcast(hdr))
+def send_message(
+    shapes: ProtoShapes,
+    op: int,
+    *,
+    pre_tokens: Optional[np.ndarray] = None,
+    pre_packed: Optional[np.ndarray] = None,
+    dec_packed: Optional[np.ndarray] = None,
+    last_valid: bool = False,
+    use_prefill: bool = False,
+) -> None:
+    """Coordinator: announce one device call in ONE broadcast."""
+    msg = shapes.zeros()
+    k = bucket = 0
+    if pre_tokens is not None:
+        k, bucket = pre_tokens.shape
+        msg["pre_tokens"][:k, :bucket] = pre_tokens
+        msg["pre_packed"][:k, :pre_packed.shape[1]] = pre_packed
+    if dec_packed is not None:
+        msg["dec_packed"][:, :] = dec_packed
+    msg["ctrl"][:5] = (op, k, bucket, int(last_valid), int(use_prefill))
+    _broadcast(msg)
 
 
-def _payload_struct(op: int, bucket: int, batch: int, pages_per_seq: int):
-    """Shapes of the host-side step inputs, derivable from the header."""
-    if op in (OP_PREFILL, OP_CHUNK):
-        struct = {
-            "tokens": np.zeros((batch, bucket), np.int32),
-            "lengths": np.zeros((batch,), np.int32),
-            "page_table": np.zeros((batch, pages_per_seq), np.int32),
-            "seeds": np.zeros((batch,), np.int32),
-            "temps": np.zeros((batch,), np.float32),
-            "top_ks": np.zeros((batch,), np.int32),
-            "top_ps": np.zeros((batch,), np.float32),
-        }
-        if op == OP_CHUNK:
-            struct["history"] = np.zeros((batch,), np.int32)
-        return struct
-    if op == OP_DECODE:
-        return {
-            "tokens": np.zeros((batch,), np.int32),
-            "lengths": np.zeros((batch,), np.int32),
-            "page_table": np.zeros((batch, pages_per_seq), np.int32),
-            "seeds": np.zeros((batch,), np.int32),
-            "temps": np.zeros((batch,), np.float32),
-            "top_ks": np.zeros((batch,), np.int32),
-            "top_ps": np.zeros((batch,), np.float32),
-        }
-    raise ValueError(f"op {op} carries no payload")
-
-
-def broadcast_payload(payload: Optional[dict], op: int, bucket: int,
-                      batch: int, pages_per_seq: int) -> dict:
-    """Coordinator passes the real payload; followers pass None and get the
-    coordinator's values back (broadcast ignores non-zero-process input)."""
-    if payload is None:
-        payload = _payload_struct(op, bucket, batch, pages_per_seq)
-    out = _broadcast(payload)
+def receive_message(shapes: ProtoShapes) -> dict:
+    """Follower: contribute zeros, receive the coordinator's message."""
+    out = _broadcast(shapes.zeros())
     return {k: np.asarray(v) for k, v in out.items()}
 
 
 def follower_loop(engine: Any) -> None:
-    """Run on pods 1..N-1: mirror the coordinator's step sequence forever.
+    """Run on pods 1..N-1: mirror the coordinator's call sequence forever.
 
     The engine instance holds the sharded params/cache (global arrays whose
     addressable shards live on this host's chips) and the same jitted
-    step functions; this loop feeds them the broadcast inputs.
+    packed executables; this loop feeds them the broadcast inputs. By SPMD
+    determinism the follower's ``last_toks``/``prefill_toks`` outputs are
+    the same global arrays the coordinator wired into its decode merges.
     """
-    import jax
     import jax.numpy as jnp
 
+    from llms_on_kubernetes_tpu.engine.engine import _CHK_COLS, _DEC_COLS, _PRE_COLS
+
+    shapes = ProtoShapes.from_engine_config(engine.config)
     pps = engine.config.pages_per_slot
+    last_toks = engine._zeros_B
+    prefill_toks = engine._zeros_1
     while True:
-        hdr = broadcast_header(OP_IDLE)  # actually receives coordinator's hdr
-        op, bucket, batch = int(hdr[0]), int(hdr[1]), int(hdr[2])
-        if op == OP_SHUTDOWN:
+        m = receive_message(shapes)
+        op, k, bucket, last_valid, use_prefill = (int(x) for x in m["ctrl"][:5])
+        if op == MSG_SHUTDOWN:
             return
-        if op == OP_IDLE:
+        if op == MSG_IDLE:
             continue
-        p = broadcast_payload(None, op, bucket, batch, pps)
-        args = (
-            engine.params, engine.model_config, jnp.asarray(p["tokens"]),
-            jnp.asarray(p["lengths"]), engine.k_pages, engine.v_pages,
-            jnp.asarray(p["page_table"]), engine._key,
-            jnp.asarray(p["seeds"]), jnp.asarray(p["temps"]),
-            jnp.asarray(p["top_ks"]), jnp.asarray(p["top_ps"]),
-        )
-        if op == OP_PREFILL:
-            _t, _l, engine.k_pages, engine.v_pages = engine._prefill(*args)
-        elif op == OP_CHUNK:
-            _t, _l, engine.k_pages, engine.v_pages = engine._chunk(
-                *args, jnp.asarray(p["history"])
+        if op in (MSG_PREFILL, MSG_CHUNK):
+            cols = (_PRE_COLS if op == MSG_PREFILL else _CHK_COLS) + pps
+            tokens = jnp.asarray(m["pre_tokens"][:k, :bucket])
+            packed = jnp.asarray(m["pre_packed"][:k, :cols])
+            fn = engine._prefill_packed if op == MSG_PREFILL else engine._chunk_packed
+            toks, _lps, engine.k_pages, engine.v_pages = fn(
+                engine.params, engine.model_config, tokens, packed,
+                engine.k_pages, engine.v_pages, engine._key,
             )
+            prefill_toks = toks
+        elif op == MSG_DECODE:
+            packed = jnp.asarray(m["dec_packed"])
+            last = last_toks if last_valid else engine._zeros_B
+            pre = prefill_toks if use_prefill else engine._zeros_1
+            toks, _lps, engine.k_pages, engine.v_pages = engine._decode_packed(
+                engine.params, engine.model_config, packed, last, pre,
+                engine.k_pages, engine.v_pages, engine._key,
+            )
+            last_toks = toks
         else:
-            _t, _l, engine.k_pages, engine.v_pages = engine._decode(*args)
+            raise ValueError(f"unknown multihost op {op}")

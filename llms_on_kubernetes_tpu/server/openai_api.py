@@ -45,7 +45,7 @@ class EngineLoop(threading.Thread):
         self.engine = engine
         self.metrics = metrics
         self._wake = threading.Event()
-        self._stop = threading.Event()
+        self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
         self._preempt_seen = 0
 
@@ -62,7 +62,7 @@ class EngineLoop(threading.Thread):
         self._wake.set()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_evt.set()
         self._wake.set()
 
     def run(self) -> None:
@@ -76,7 +76,7 @@ class EngineLoop(threading.Thread):
 
     def _run(self) -> None:
         eng = self.engine
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             if not eng.has_work():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -213,6 +213,14 @@ class OpenAIServer:
 
     async def _stop_loop(self, app) -> None:
         self.loop_thread.stop()
+        if self.loop_thread.is_alive():
+            # join OFF the event loop so cleanup isn't blocked; the join
+            # must complete before cli.py broadcasts MSG_SHUTDOWN, or a
+            # follower could receive it interleaved with this thread's
+            # in-flight step broadcasts and desert the SPMD program
+            import asyncio
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.loop_thread.join, 60.0)
 
     # ------------------------------------------------------------------
     # endpoints

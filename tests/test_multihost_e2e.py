@@ -29,18 +29,19 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 pid = int(sys.argv[1])
 coord = sys.argv[2]
+async_sched = sys.argv[3] == "1"
 
 jax.distributed.initialize(coordinator_address=coord, num_processes=2,
                            process_id=pid)
 
 from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
-from llms_on_kubernetes_tpu.engine.multihost import OP_SHUTDOWN, broadcast_header, follower_loop
+from llms_on_kubernetes_tpu.engine.multihost import follower_loop
 from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
 
 cfg = EngineConfig(
     model="debug-tiny", dtype="float32", max_decode_slots=2,
     page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
-    multihost=True,
+    multihost=True, async_scheduling=async_sched,
 )
 mesh = make_mesh(data=1, expert=1, model=4)
 eng = Engine(cfg, mesh=mesh)
@@ -48,9 +49,9 @@ eng = Engine(cfg, mesh=mesh)
 if pid == 0:
     out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=8))
     out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=6))
-    # out-of-bucket prompt: exercises OP_CHUNK (chunked prefill broadcast)
+    # out-of-bucket prompt: exercises MSG_CHUNK (chunked prefill broadcast)
     out3 = eng.generate(list(range(1, 38)), SamplingParams(temperature=0.0, max_tokens=4))
-    broadcast_header(OP_SHUTDOWN)
+    eng.stop_followers()
     print("RESULT:" + json.dumps([out, out2, out3]), flush=True)
 else:
     follower_loop(eng)
@@ -102,7 +103,8 @@ def _extract(stdout: str):
 
 
 @pytest.mark.slow
-def test_two_process_spmd_serving_matches_single_process():
+@pytest.mark.parametrize("async_sched", ["0", "1"])
+def test_two_process_spmd_serving_matches_single_process(async_sched):
     ref = subprocess.run(
         [sys.executable, "-c", REFERENCE], env=_env(4),
         capture_output=True, text=True, timeout=600,
@@ -113,7 +115,8 @@ def test_two_process_spmd_serving_matches_single_process():
     coord = f"127.0.0.1:{free_port()}"
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, str(pid), coord], env=_env(2),
+            [sys.executable, "-c", WORKER, str(pid), coord, async_sched],
+            env=_env(2),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in (0, 1)

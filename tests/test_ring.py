@@ -98,27 +98,24 @@ def test_distributed_env_contract(monkeypatch):
         distributed_env()
 
 
-def test_multihost_payload_struct_roundtrip():
-    """Coordinator payload and follower dummy struct must match exactly —
-    that is the broadcast contract (same pytree, same shapes/dtypes)."""
+def test_multihost_message_struct_fixed_shape():
+    """Every broadcast message must have ONE fixed pytree shape derived
+    from the EngineConfig — that is the v2 protocol contract (coordinator
+    and follower build it independently; a mismatch deadlocks the psum)."""
     from llms_on_kubernetes_tpu.engine import multihost as mh
+    from llms_on_kubernetes_tpu.engine.engine import (
+        _CHK_COLS, _DEC_COLS, EngineConfig,
+    )
 
-    for op, bucket, batch in [(mh.OP_PREFILL, 256, 1), (mh.OP_DECODE, 0, 16)]:
-        follower = mh._payload_struct(op, bucket, batch, pages_per_seq=32)
-        coordinator = {
-            "tokens": np.zeros((batch, bucket) if op == mh.OP_PREFILL
-                               else (batch,), np.int32),
-            "lengths": np.zeros((batch,), np.int32),
-            "page_table": np.zeros((batch, 32), np.int32),
-            "seeds": np.zeros((batch,), np.int32),
-            "temps": np.zeros((batch,), np.float32),
-            "top_ks": np.zeros((batch,), np.int32),
-            "top_ps": np.zeros((batch,), np.float32),
-        }
-        assert set(follower) == set(coordinator)
-        for name in follower:
-            assert follower[name].shape == coordinator[name].shape, name
-            assert follower[name].dtype == coordinator[name].dtype, name
+    cfg = EngineConfig(max_decode_slots=16, pages_per_slot=32,
+                       prefill_buckets=(64, 256), admit_batch=4)
+    shapes = mh.ProtoShapes.from_engine_config(cfg)
+    z = shapes.zeros()
+    assert z["ctrl"].shape == (mh.CTRL_LEN,)
+    assert z["pre_tokens"].shape == (4, 256)
+    assert z["pre_packed"].shape == (4, _CHK_COLS + 32)
+    assert z["dec_packed"].shape == (16, _DEC_COLS + 32)
+    assert all(v.dtype == np.int32 for v in z.values())
 
 
 def test_engine_single_host_unaffected_by_multihost_flag_default():
