@@ -10,14 +10,18 @@ sampled token ids ([B] int32) come back to the host each step. Greedy is
 expressed as temperature==0 via masking, not Python branching, so one
 executable covers all modes.
 
-Top-k/top-p work on a FIXED top-MAX_CANDIDATES candidate set extracted
-with ``lax.top_k`` — a full-vocab argsort costs ~16 ms/step for a 128K
-vocab on a v5e chip (measured; it dominated the decode step), while
-top-64 is ~free. The truncation is exact for greedy and for top_k <=
-MAX_CANDIDATES, and for top-p it drops only the tail mass beyond the top
-64 tokens (negligible for real model distributions; the same candidate-set
-cap is standard in TPU serving stacks). The categorical draw uses the
-Gumbel trick on the masked, renormalized candidate logits.
+Top-k/top-p work on a FIXED top-MAX_CANDIDATES candidate set. On TPU the
+set is extracted with ``lax.approx_max_k`` (the hardware-native bucketed
+reduction; exact ``lax.top_k`` measured 2.6 ms/step for a 128K vocab on
+v5e, approx ~0) — its ~0.95 recall means a true top-i candidate can
+occasionally be replaced by the next-best one from its bucket, for BOTH
+the top_k filter and the top-p nucleus. Greedy is always exact: the
+global argmax is provably rank 0 of approx_max_k's output (it is its own
+bucket's maximum, and the cross-bucket top-k is exact). On CPU the
+extraction is exact ``lax.top_k``. The candidate-set cap itself (top_k >
+MAX_CANDIDATES clamps; top-p loses tail mass beyond 64 tokens) is the
+same tradeoff TPU serving stacks standardly make. The categorical draw
+uses the Gumbel trick on the masked, renormalized candidate logits.
 """
 
 from __future__ import annotations
@@ -48,7 +52,13 @@ def sample(
     C = min(MAX_CANDIDATES, V)
 
     # --- candidate extraction (sorted descending) ---------------------
-    cand_logits, cand_idx = jax.lax.top_k(logits, C)         # [B, C] each
+    # TPU: approx_max_k is the hardware-native bucketed reduction (exact
+    # top_k measured 2.6 ms/step at 128K vocab; approx ~free). Recall
+    # caveats and the greedy-exactness argument: module docstring.
+    if jax.default_backend() == "tpu" and V > 4 * C:
+        cand_logits, cand_idx = jax.lax.approx_max_k(logits, C)
+    else:
+        cand_logits, cand_idx = jax.lax.top_k(logits, C)     # [B, C] each
 
     rank = jnp.arange(C, dtype=jnp.int32)[None, :]           # [1, C]
     k = jnp.where(top_k <= 0, C, jnp.minimum(top_k, C))[:, None]
@@ -76,6 +86,10 @@ def sample(
     perturbed = masked / safe_temp + gumbel
     sampled_rank = jnp.argmax(perturbed, axis=-1)            # [B]
 
+    # rank 0 is the EXACT argmax even under approx_max_k: its algorithm
+    # takes per-shard maxima then an exact top-k over them, and the global
+    # maximum is always its shard's maximum — recall loss only affects
+    # lower ranks. So greedy stays exact on both extraction paths.
     greedy_rank = jnp.zeros((B,), sampled_rank.dtype)        # sorted => rank 0
     chosen_rank = jnp.where(temperature <= 0.0, greedy_rank, sampled_rank)
 

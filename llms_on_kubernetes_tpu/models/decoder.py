@@ -22,6 +22,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Optional
 
 import jax
@@ -46,6 +47,22 @@ def _act(cfg: ModelConfig):
     if cfg.hidden_act == "gelu_tanh":
         return functools.partial(jax.nn.gelu, approximate=True)
     return jax.nn.silu
+
+
+def _unroll_layers() -> bool:
+    """LLMK_UNROLL_LAYERS = auto | 1 | 0.
+
+    auto (default): unroll on TPU, rolled scan elsewhere. Why unroll: a
+    multi-GB KV pool riding a lax.scan (while-loop) carry pays a full
+    boundary copy every call on TPU (measured ~12 ms/step at 8B scale) —
+    XLA cannot alias a donated parameter into a while-loop working buffer.
+    A fully unrolled layer chain keeps the pool in straight-line DUS
+    updates, which ARE in-place. The price is larger HLO (slower first
+    compile); CPU tests and tiny models keep the rolled scan."""
+    impl = os.environ.get("LLMK_UNROLL_LAYERS", "auto")
+    if impl == "auto":
+        return jax.default_backend() == "tpu"
+    return impl not in ("0", "false", "no")
 
 
 # ---------------------------------------------------------------------------
@@ -208,9 +225,9 @@ def _run_layers(
     cfg: ModelConfig,
     params: Params,
     x: jnp.ndarray,
-    k_pages: jnp.ndarray,          # [L, KV, P, page, hd]
+    k_pages: jnp.ndarray,          # [KV, L*P, page, hd] flat pool
     v_pages: jnp.ndarray,
-    page_table: jnp.ndarray,
+    page_table: jnp.ndarray,       # [B, pages_per_seq] per-layer-LOCAL ids
     positions: jnp.ndarray,
     write_positions: jnp.ndarray,
     lengths: jnp.ndarray,
@@ -222,18 +239,26 @@ def _run_layers(
         if cfg.rope_local_theta is not None else None
     )
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    # layer l's pages live in the flat pool block [l*P, (l+1)*P)
+    pages_per_layer = k_pages.shape[1] // cfg.num_layers
 
     def body(carry, per_layer):
-        xc = carry
-        idx, lp, kp, vp = per_layer
+        xc, kp, vp = carry
+        idx, lp = per_layer
+        # pools ride the CARRY (aliased buffer -> in-place scatter), never
+        # the xs/ys path (which would rewrite the whole pool every step)
+        pt = page_table + idx * pages_per_layer
         xc, kp, vp = _layer_step(
-            cfg, inv_freq, page_table, positions, write_positions, lengths, mode,
+            cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
         )
-        return xc, (kp, vp)
+        return (xc, kp, vp), None
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        body, x, (layer_ids, params["layers"], k_pages, v_pages)
+    (x, k_pages, v_pages), _ = jax.lax.scan(
+        body, (x, k_pages, v_pages), (layer_ids, params["layers"]),
+        # full unroll on TPU: no while loop may ever carry the pool (its
+        # boundary copy costs more than the whole rest of the step)
+        unroll=cfg.num_layers if _unroll_layers() else 1,
     )
     return x, k_pages, v_pages
 
@@ -266,7 +291,7 @@ def forward_prefill(
     cfg: ModelConfig,
     tokens: jnp.ndarray,      # [B, T] padded prompt bucket
     lengths: jnp.ndarray,     # [B] true lengths (<= T); 0 => inactive row
-    k_pages: jnp.ndarray,     # [L, KV, P, page, hd]
+    k_pages: jnp.ndarray,     # [KV, L*P, page, hd] flat pool
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, pages_per_seq]
 ):

@@ -59,36 +59,53 @@ def _paged_kernel(
     h = pl.program_id(1)
     S = pages_per_seq * page_size
     length = lengths_ref[b]
+    # LENGTH-BOUNDED DMA: only pages actually covering this slot's tokens
+    # are fetched. A slot 100 tokens into a 2048-token window must not pay
+    # 20x its KV bandwidth (the full-table DMA was the decode step's
+    # biggest HBM consumer at long windows). Skipped regions of the
+    # scratch stay stale; every key beyond `length` is masked to NEG_INF
+    # before the softmax, so stale lanes never contribute.
+    n_pages = (length + page_size - 1) // page_size
 
-    # one contiguous [page, d] DMA per page per K/V; trash-page entries
-    # keep the pattern uniform
+    # one contiguous [page, d] DMA per page per K/V
     for i in range(pages_per_seq):
-        page_id = page_table_ref[b, i]
-        pltpu.make_async_copy(
-            k_hbm.at[h, page_id],
-            k_buf.at[pl.ds(i * page_size, page_size), :],
-            sems.at[0, i],
-        ).start()
-        pltpu.make_async_copy(
-            v_hbm.at[h, page_id],
-            v_buf.at[pl.ds(i * page_size, page_size), :],
-            sems.at[1, i],
-        ).start()
+        @pl.when(i < n_pages)
+        def _start(i=i):
+            page_id = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                k_hbm.at[h, page_id],
+                k_buf.at[pl.ds(i * page_size, page_size), :],
+                sems.at[0, i],
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[h, page_id],
+                v_buf.at[pl.ds(i * page_size, page_size), :],
+                sems.at[1, i],
+            ).start()
     for i in range(pages_per_seq):
-        pltpu.make_async_copy(
-            k_hbm.at[h, page_table_ref[b, i]],
-            k_buf.at[pl.ds(i * page_size, page_size), :],
-            sems.at[0, i],
-        ).wait()
-        pltpu.make_async_copy(
-            v_hbm.at[h, page_table_ref[b, i]],
-            v_buf.at[pl.ds(i * page_size, page_size), :],
-            sems.at[1, i],
-        ).wait()
+        @pl.when(i < n_pages)
+        def _wait(i=i):
+            pltpu.make_async_copy(
+                k_hbm.at[h, page_table_ref[b, i]],
+                k_buf.at[pl.ds(i * page_size, page_size), :],
+                sems.at[0, i],
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[h, page_table_ref[b, i]],
+                v_buf.at[pl.ds(i * page_size, page_size), :],
+                sems.at[1, i],
+            ).wait()
 
     q = q_ref[0, 0].astype(jnp.float32)                # [group, d]
     k = k_buf[:].astype(jnp.float32)                   # [S, d]
     v = v_buf[:].astype(jnp.float32)
+    # stale (un-DMA'd) V rows must be zeroed: the p @ v matmul multiplies
+    # masked-out (zero) probabilities by them, and 0 * NaN = NaN. K needs
+    # no fix ONLY because the mask below is a substitutive jnp.where that
+    # REPLACES garbage logits wholesale — an additive `logits + NEG_INF`
+    # formulation would let stale-K NaNs through (NaN + c = NaN).
+    v = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0) < length, v, 0.0)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),

@@ -80,7 +80,7 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
 
 def cache_specs(cfg: ModelConfig, mesh: Mesh) -> tuple[P, P]:
     m_kv = _axis(mesh, cfg.num_kv_heads, AXIS_MODEL)
-    spec = P(None, m_kv, None, None, None)  # [L, KV, P, page, hd] head-major
+    spec = P(m_kv, None, None, None)  # [KV, L*P, page, hd] flat head-major
     return spec, spec
 
 

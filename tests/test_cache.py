@@ -60,4 +60,5 @@ def test_cache_config_accounting():
     assert cc.max_seq_len == 32
     assert cc.bytes_per_page == 2 * 2 * 8 * 4 * 8 * 2  # k&v · L · page · kv · hd · bf16
     k, v = init_pages(cc)
-    assert k.shape == (2, 4, 16, 8, 8) and k.dtype == jnp.bfloat16
+    # flat layout: [KV, L*P, page, d] (layer l's block starts at l*P)
+    assert k.shape == (4, 2 * 16, 8, 8) and k.dtype == jnp.bfloat16

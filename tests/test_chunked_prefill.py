@@ -74,11 +74,14 @@ def test_forward_chunk_matches_one_shot_prefill():
 
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
-    # cache contents must match on the ALLOCATED pages (page 0 is the trash
-    # page: one-shot padding scatters garbage there, exact chunks don't)
-    np.testing.assert_allclose(np.asarray(kp)[:, :, 1:], np.asarray(kp_ref)[:, :, 1:],
+    # cache contents must match on the ALLOCATED pages. Excluded: each
+    # layer's trash page (flat index l*P) — padding/filler writes land
+    # there and legitimately differ between chunked and one-shot runs.
+    keep = np.ones(cfg.num_layers * cc.num_pages, bool)
+    keep[np.arange(cfg.num_layers) * cc.num_pages] = False
+    np.testing.assert_allclose(np.asarray(kp)[:, keep], np.asarray(kp_ref)[:, keep],
                                rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(vp)[:, :, 1:], np.asarray(vp_ref)[:, :, 1:],
+    np.testing.assert_allclose(np.asarray(vp)[:, keep], np.asarray(vp_ref)[:, keep],
                                rtol=2e-5, atol=2e-5)
 
 

@@ -116,7 +116,8 @@ def test_paged_decode_through_cache_write_path(rng):
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (2, T))
     lengths = jnp.asarray([T, 5], jnp.int32)
     write_positions = jnp.where(positions < lengths[:, None], positions, -1)
-    kp, vp = write_tokens(k_pages[0], v_pages[0], k_new, v_new, table,
+    # num_layers=1: the flat pool [KV, 1*P, page, d] IS the single layer
+    kp, vp = write_tokens(k_pages, v_pages, k_new, v_new, table,
                           write_positions)
 
     q = jnp.asarray(rng.normal(size=(2, 4, 8)), jnp.float32)

@@ -144,3 +144,30 @@ def test_seeded_request_survives_preemption_identically():
     assert tight.preemptions > 0
     for r in reqs:
         assert r.output == want, (r.output, want)
+
+
+def test_approx_extraction_branch_assumptions(monkeypatch):
+    """Pin the TPU approx_max_k branch's load-bearing assumptions (tests
+    run on CPU, so force the branch): output sorted descending, rank 0 is
+    the exact global argmax (greedy correctness), and greedy sampling
+    through sample() returns the exact argmax token."""
+    from llms_on_kubernetes_tpu.engine import sampling
+
+    monkeypatch.setattr(sampling.jax, "default_backend", lambda: "tpu")
+    rng = np.random.default_rng(0)
+    B, V = 4, 1024  # V > 4*C so the approx branch is taken
+    logits = jnp.asarray(rng.normal(size=(B, V)).astype(np.float32))
+
+    vals, idx = jax.lax.approx_max_k(logits, sampling.MAX_CANDIDATES)
+    v = np.asarray(vals)
+    assert (np.diff(v, axis=1) <= 1e-6).all(), "not sorted descending"
+    np.testing.assert_array_equal(np.asarray(idx)[:, 0],
+                                  np.argmax(np.asarray(logits), axis=1))
+
+    keys = jax.vmap(lambda s: jax.random.fold_in(jax.random.key(0), s))(
+        jnp.arange(B))
+    toks, _ = sampling.sample(
+        logits, keys, jnp.zeros((B,), jnp.float32),
+        jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.argmax(np.asarray(logits), axis=1))
