@@ -37,7 +37,7 @@ import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
 from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
-from llms_on_kubernetes_tpu.engine.sampling import sample
+from llms_on_kubernetes_tpu.engine.sampling import MAX_CANDIDATES, sample
 from llms_on_kubernetes_tpu.models.decoder import (
     forward_chunk, forward_decode, forward_prefill, init_params,
 )
@@ -45,17 +45,29 @@ from llms_on_kubernetes_tpu.models.decoder import (
 Params = dict[str, Any]
 
 
+class QueueFullError(RuntimeError):
+    """Admission rejected: the waiting queue is at max_waiting capacity.
+    The API layer maps this to HTTP 429 + Retry-After."""
+
+
 @dataclasses.dataclass
 class SamplingParams:
     temperature: float = 1.0
-    # 0 => no top-k filter. NOTE: sampling draws from a fixed top-64
-    # candidate pool regardless (sampling.MAX_CANDIDATES — a full-vocab
-    # sort is ~16 ms/step on TPU); values > 64 are effectively clamped.
+    # 0 => no top-k filter; values > sampling.MAX_CANDIDATES are rejected
+    # at submit() (the candidate pool is a hard bound — silent clamping
+    # would change the semantics the client asked for)
     top_k: int = 0
     top_p: float = 1.0
     max_tokens: int = 128
     stop_token_ids: tuple[int, ...] = ()
     seed: Optional[int] = None
+    # OpenAI penalties over the OUTPUT tokens generated so far (vLLM
+    # semantics); applied on device from the engine's per-slot counts
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # top alternatives the client asked to see per token (response shaping
+    # only — the engine always records LOGPROB_TOPK alternatives)
+    logprobs: int = 0
 
 
 @dataclasses.dataclass
@@ -86,6 +98,10 @@ class EngineConfig:
     # together in one [K, bucket] call (padded to exactly 1 or admit_batch
     # rows so each bucket compiles two executables, not one per K)
     admit_batch: int = 4
+    # admission control: submit() raises QueueFullError beyond this many
+    # waiting requests (HTTP 429 upstream) — an unbounded queue lets a
+    # burst pin memory and inflate TTFT without bound
+    max_waiting: int = 256
     seed: int = 0
 
     @property
@@ -105,6 +121,10 @@ class Request:
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     # runtime state
     output: list[int] = dataclasses.field(default_factory=list)
+    # per output token: (logprob, top_ids, top_logprobs) — recorded by
+    # _emit before the token's event is delivered, so readers may index
+    # it by token position for any delivered token
+    output_logprobs: list = dataclasses.field(default_factory=list)
     slot: int = -1
     pending_token: int = -1        # sampled but KV not yet cached
     finished: bool = False
@@ -127,9 +147,13 @@ class StepEvent:
 @dataclasses.dataclass
 class InflightStep:
     """A launched-but-unharvested decode step (async scheduling)."""
-    toks: Any                              # device array [B] int32
+    res: Any                               # device SampleResult
     active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
     prefetched: bool = False               # copy_to_host_async() issued
+
+    @property
+    def toks(self):
+        return self.res.tokens
 
 
 def _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row):
@@ -140,20 +164,58 @@ def _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row):
                      jnp.where(src == 1, vals, prefill_toks[prefill_row]))
 
 
+def _count_decode_tokens(counts, tokens, active):
+    """counts[b, tokens[b]] += active[b] — unrolled DUS (in-place; an HLO
+    scatter would copy the [B, V] buffer, see cache.write_tokens)."""
+    B = counts.shape[0]
+    active = active.astype(counts.dtype)
+    for b in range(B):
+        cur = jax.lax.dynamic_slice(counts, (b, tokens[b]), (1, 1))
+        counts = jax.lax.dynamic_update_slice(
+            counts, cur + active[b], (b, tokens[b]))
+    return counts
+
+
+def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths):
+    """Rebuild per-slot output-token counts from a prefill/chunk batch.
+
+    Row semantics: a first chunk (history==0) resets the slot's counts; a
+    continuation accumulates. Only tokens at global positions >=
+    prompt_len count (penalties cover OUTPUT tokens — vLLM semantics);
+    that's non-empty exactly for resumed (preempted) re-prefills, whose
+    prompt+output tokens replay through this path."""
+    K, T = tokens.shape
+    V = counts.shape[1]
+    t_iota = jnp.arange(T, dtype=jnp.int32)
+    for r in range(K):
+        out_mask = ((history[r] + t_iota >= prompt_len[r])
+                    & (t_iota < lengths[r])).astype(counts.dtype)
+        contrib = jnp.zeros((V,), counts.dtype).at[tokens[r]].add(
+            out_mask, mode="drop")
+        existing = jax.lax.dynamic_slice(counts, (slots[r], 0), (1, V))[0]
+        row = jnp.where(history[r] == 0, 0, existing) + contrib
+        # idle/padded rows (lengths 0) keep their slot's counts untouched
+        row = jnp.where(lengths[r] > 0, row, existing)
+        counts = jax.lax.dynamic_update_slice(
+            counts, row[None], (slots[r], 0))
+    return counts
+
+
 # --- packed single-upload step variants (async scheduling) -----------------
 # Over a remote-device tunnel every host->device transfer costs a round
-# trip; shipping the scheduler's 7 small arrays separately costs ~35 ms per
+# trip; shipping the scheduler's small arrays separately costs ~35 ms per
 # step vs ~5 ms for one packed int32 array (floats ride along bitcast).
 # The token merge and the PRNG fold_in also move inside the executable so a
 # decode step is exactly ONE upload + ONE dispatch.
 
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
-# 5 top_p(bits), 6 seed, 7 prefill_row, 8.. page_table
-_DEC_COLS = 8
+# 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
+# 9 frequency(bits), 10.. page_table
+_DEC_COLS = 10
 
 
 def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
-                        k_pages, v_pages, base_key):
+                        k_pages, v_pages, counts, base_key):
     lengths = packed[:, 0]
     src, vals = packed[:, 1], packed[:, 2]
     top_ks = packed[:, 3]
@@ -161,62 +223,101 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     top_ps = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
     seeds = packed[:, 6]
     prefill_row = packed[:, 7]
+    presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
     page_table = packed[:, _DEC_COLS:]
 
     tokens = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
+    # the input token is always a previously-sampled OUTPUT token: count
+    # it before sampling so this step's draw sees it
+    counts = _count_decode_tokens(counts, tokens, lengths > 0)
     logits, k_pages, v_pages = forward_decode(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
     keys = _slot_keys(base_key, seeds, lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
+    res = sample(logits, keys, temps, top_ks, top_ps,
+                 penalties=(presence, frequency, counts))
+    return res, k_pages, v_pages, counts
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
-# 4 seed, 5.. page_table
-_PRE_COLS = 5
+# 4 seed, 5 presence(bits), 6 frequency(bits), 7 slot, 8 prompt_len,
+# 9.. page_table
+_PRE_COLS = 9
 
 
 def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
-                         base_key):
+                         counts, base_key):
     lengths = packed[:, 0]
     top_ks = packed[:, 1]
     temps = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
     top_ps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
     seeds = packed[:, 4]
+    presence = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
+    slots = packed[:, 7]
+    prompt_len = packed[:, 8]
     page_table = packed[:, _PRE_COLS:]
 
+    counts = _rebuild_count_rows(
+        counts, tokens, slots, jnp.zeros_like(lengths), prompt_len, lengths)
     logits, k_pages, v_pages = forward_prefill(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
     keys = _slot_keys(base_key, seeds, lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
+    row_counts = counts[slots]
+    res = sample(logits, keys, temps, top_ks, top_ps,
+                 penalties=(presence, frequency, row_counts))
+    return res, k_pages, v_pages, counts
 
 
 # packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
-# 4 top_p(bits), 5 seed, 6.. page_table. Sampling position is the TOTAL
-# length (history + chunk_len) so a chunked prompt draws exactly the
-# tokens a one-shot prefill of the same prompt would.
-_CHK_COLS = 6
+# 4 top_p(bits), 5 seed, 6 presence(bits), 7 frequency(bits), 8 slot,
+# 9 prompt_len, 10.. page_table. Sampling position is the TOTAL length
+# (history + chunk_len) so a chunked prompt draws exactly the tokens a
+# one-shot prefill of the same prompt would.
+_CHK_COLS = 10
 
 
 def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
-                       base_key):
+                       counts, base_key):
     lengths = packed[:, 0]
     history = packed[:, 1]
     top_ks = packed[:, 2]
     temps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
     top_ps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
     seeds = packed[:, 5]
+    presence = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 7], jnp.float32)
+    slots = packed[:, 8]
+    prompt_len = packed[:, 9]
     page_table = packed[:, _CHK_COLS:]
 
+    counts = _rebuild_count_rows(
+        counts, tokens, slots, history, prompt_len, lengths)
     logits, k_pages, v_pages = forward_chunk(
         params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
     )
     keys = _slot_keys(base_key, seeds, history + lengths)
-    toks, logprobs = sample(logits, keys, temps, top_ks, top_ps)
-    return toks, logprobs, k_pages, v_pages
+    res = sample(logits, keys, temps, top_ks, top_ps,
+                 penalties=(presence, frequency, counts[slots]))
+    return res, k_pages, v_pages, counts
+
+
+def _start_host_copy(res) -> None:
+    """Begin async device->host transfer of a SampleResult's leaves."""
+    for leaf in (res.tokens, res.logprobs, res.top_ids, res.top_logprobs):
+        try:
+            leaf.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+
+def _lp_entry(host_res, row: int) -> tuple:
+    """(logprob, top_ids, top_logprobs) for one row of a host SampleResult."""
+    return (float(host_res.logprobs[row]),
+            host_res.top_ids[row].tolist(),
+            host_res.top_logprobs[row].tolist())
 
 
 def _slot_keys(base_key, seeds, lengths):
@@ -316,14 +417,17 @@ class Engine:
         self.preemptions = 0  # total KV-pressure preemptions (metrics)
 
         self._prefill_packed = jax.jit(
-            _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
+            _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
         )
         self._decode_packed = jax.jit(
-            _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6)
+            _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6, 7)
         )
         self._chunk_packed = jax.jit(
-            _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5)
+            _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
         )
+        # per-slot OUTPUT-token counts for presence/frequency penalties;
+        # donated through every step like the page pools
+        self.token_counts = jnp.zeros((B, cfg.vocab_size), jnp.int32)
 
         # multi-host: every device call is announced in one packed broadcast
         # (engine/multihost.py). Async scheduling works across hosts — the
@@ -357,6 +461,15 @@ class Engine:
         max_len = self.config.max_model_len
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if params.top_k > MAX_CANDIDATES:
+            raise ValueError(
+                f"top_k={params.top_k} exceeds the sampling candidate pool "
+                f"({MAX_CANDIDATES}); values above it are not supported"
+            )
+        for name in ("presence_penalty", "frequency_penalty"):
+            val = getattr(params, name)
+            if not -2.0 <= val <= 2.0:
+                raise ValueError(f"{name} must be in [-2, 2], got {val}")
         # prompts longer than the largest prefill bucket are served too:
         # admission splits them into bucket-size chunks against the paged
         # pool (chunked prefill — forward_chunk). The only hard limit is
@@ -381,6 +494,11 @@ class Engine:
             prompt=list(prompt), params=params, seed=seed,
         )
         with self._lock:
+            if len(self.waiting) >= self.config.max_waiting:
+                raise QueueFullError(
+                    f"waiting queue is full ({self.config.max_waiting} "
+                    f"requests); retry later"
+                )
             self.waiting.append(req)
         return req
 
@@ -453,6 +571,10 @@ class Engine:
         packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
         packed[row, 3] = np.float32(req.params.top_p).view(np.int32)
         packed[row, 4] = req.seed
+        packed[row, 5] = np.float32(req.params.presence_penalty).view(np.int32)
+        packed[row, 6] = np.float32(req.params.frequency_penalty).view(np.int32)
+        packed[row, 7] = slot
+        packed[row, 8] = len(req.prompt)  # output-token counting boundary
         packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
 
     def _free_slot(self) -> Optional[int]:
@@ -474,14 +596,14 @@ class Engine:
         slot's pages for the WHOLE prompt are already allocated. Pure
         dispatch: each chunk chains on the previous through the donated
         page pool — no host read here, so the async pipeline stays full.
-        Returns the FINAL chunk's sampled-token device array [1] (the
+        Returns the FINAL chunk's device SampleResult (row 0 is the
         request's first generated token)."""
         from llms_on_kubernetes_tpu.engine.multihost import MSG_CHUNK
 
         n = len(prefill_tokens)
         step = max(self.config.prefill_buckets)
         pps = self.allocator.pages_per_slot
-        toks = None
+        res = None
         pos = 0
         while pos < n:
             m = min(step, n - pos)
@@ -495,16 +617,20 @@ class Engine:
             packed[0, 3] = np.float32(req.params.temperature).view(np.int32)
             packed[0, 4] = np.float32(req.params.top_p).view(np.int32)
             packed[0, 5] = req.seed
+            packed[0, 6] = np.float32(req.params.presence_penalty).view(np.int32)
+            packed[0, 7] = np.float32(req.params.frequency_penalty).view(np.int32)
+            packed[0, 8] = slot
+            packed[0, 9] = len(req.prompt)
             packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
             self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed)
-            toks, _lps, self.k_pages, self.v_pages = self._chunk_packed(
+            res, self.k_pages, self.v_pages, self.token_counts = self._chunk_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(packed), self.k_pages, self.v_pages,
-                self._key,
+                self.token_counts, self._key,
             )
             pos += m
         self.slot_len[slot] = n
-        return toks
+        return res
 
     def _admit_one(self) -> list[StepEvent]:
         """Admit + prefill at most one waiting request per iteration.
@@ -538,7 +664,7 @@ class Engine:
         req.slot = slot
 
         if n > max(self.config.prefill_buckets):
-            toks = self._chunked_prefill(slot, req, prefill_tokens)
+            res = self._chunked_prefill(slot, req, prefill_tokens)
         else:
             from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
 
@@ -549,22 +675,27 @@ class Engine:
                               np.int32)
             self._pack_prefill_row(packed, 0, req, n, slot)
             self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
-            toks, _lps, self.k_pages, self.v_pages = self._prefill_packed(
+            res, self.k_pages, self.v_pages, self.token_counts = self._prefill_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
-                jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
+                jnp.asarray(packed), self.k_pages, self.v_pages,
+                self.token_counts, self._key,
             )
             self.slot_len[slot] = n
         if resumed:
             req.pending_token = req.output[-1]
             return []
-        first = int(np.asarray(toks)[0])
+        host = jax.device_get(res)
+        first = int(host.tokens[0])
         req.pending_token = first
         req.first_token_at = time.monotonic()
-        return self._emit(req, first)
+        return self._emit(req, first, _lp_entry(host, 0))
 
-    def _emit(self, req: Request, token: int) -> list[StepEvent]:
-        """Record a sampled token and decide whether the request finishes."""
+    def _emit(self, req: Request, token: int,
+              lp: Optional[tuple] = None) -> list[StepEvent]:
+        """Record a sampled token (+ its logprob data) and decide whether
+        the request finishes."""
         req.output.append(token)
+        req.output_logprobs.append(lp)
         reason = None
         if token in set(req.params.stop_token_ids):
             reason = "stop"
@@ -638,22 +769,24 @@ class Engine:
             packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
             packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
             packed[i, 6] = r.seed
+            packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
+            packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
         packed[:, _DEC_COLS:] = self.allocator.page_tables
 
         self._mh_send(MSG_DECODE, dec_packed=packed)
-        toks, _lps, self.k_pages, self.v_pages = self._decode_packed(
+        res, self.k_pages, self.v_pages, self.token_counts = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             self._zeros_B, self._zeros_1, self.k_pages, self.v_pages,
-            self._key,
+            self.token_counts, self._key,
         )
-        sampled = np.asarray(toks)
+        host = jax.device_get(res)
 
         events: list[StepEvent] = []
         for i, r in active:
             self.slot_len[i] += 1  # pending token's KV is now cached
-            new = int(sampled[i])
+            new = int(host.tokens[i])
             r.pending_token = new
-            events += self._emit(r, new)
+            events += self._emit(r, new, _lp_entry(host, i))
         return events
 
     # ------------------------------------------------------------------
@@ -714,18 +847,15 @@ class Engine:
                 picked.append((slot, req, resumed, prefill_tokens))
         if long_pick is not None:
             slot, req, resumed, prefill_tokens = long_pick
-            toks = self._chunked_prefill(slot, req, prefill_tokens)
-            try:
-                toks.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-            merge = {"toks": toks, "slots": {}}
+            res = self._chunked_prefill(slot, req, prefill_tokens)
+            _start_host_copy(res)
+            merge = {"toks": res.tokens, "slots": {}}
             if resumed:
                 req.pending_token = req.output[-1]
                 merge["slots"][slot] = (True, req.output[-1], 0)
             else:
                 merge["slots"][slot] = (False, 0, 0)
-                self._pending_first.append((req, toks, 0))
+                self._pending_first.append((req, res, 0))
             return merge
         if not picked:
             return None
@@ -746,18 +876,16 @@ class Engine:
             self.slot_len[slot] = n
 
         self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
-        toks, _lps, self.k_pages, self.v_pages = self._prefill_packed(
+        res, self.k_pages, self.v_pages, self.token_counts = self._prefill_packed(
             self.params, self.model_config, jnp.asarray(tokens),
-            jnp.asarray(packed), self.k_pages, self.v_pages, self._key,
+            jnp.asarray(packed), self.k_pages, self.v_pages,
+            self.token_counts, self._key,
         )
-        try:
-            # start the first-token transfer now: it completes as soon as
-            # the prefill does, so the TTFT harvest read doesn't pay a
-            # blocking round trip
-            toks.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
-        merge = {"toks": toks, "slots": {}}
+        # start the first-token transfer now: it completes as soon as the
+        # prefill does, so the TTFT harvest read doesn't pay a blocking
+        # round trip
+        _start_host_copy(res)
+        merge = {"toks": res.tokens, "slots": {}}
         for row, (slot, req, resumed, _ptoks) in enumerate(picked):
             if resumed:
                 # pending token is already host-known (the last emitted
@@ -767,7 +895,7 @@ class Engine:
                 merge["slots"][slot] = (True, req.output[-1], row)
             else:
                 merge["slots"][slot] = (False, 0, row)
-                self._pending_first.append((req, toks, row))
+                self._pending_first.append((req, res, row))
         return merge
 
     def _launch_decode_async(self, admitted, events: list[StepEvent]) -> bool:
@@ -815,6 +943,8 @@ class Engine:
             packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
             packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
             packed[i, 6] = r.seed
+            packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
+            packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
                 if resumed:              # resumed: host-known pending token
@@ -838,21 +968,19 @@ class Engine:
         self._mh_send(MSG_DECODE, dec_packed=packed,
                       last_valid=bool(self._inflight),
                       use_prefill=admitted is not None)
-        toks, _lps, self.k_pages, self.v_pages = self._decode_packed(
+        res, self.k_pages, self.v_pages, self.token_counts = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
-            last_toks, prefill_toks, self.k_pages, self.v_pages, self._key,
+            last_toks, prefill_toks, self.k_pages, self.v_pages,
+            self.token_counts, self._key,
         )
-        self._inflight.append(InflightStep(toks, active))
+        self._inflight.append(InflightStep(res, active))
         # start device->host transfers for every OLDER queued step (their
         # compute has finished or will before ours): by harvest time the
         # host copy is already local and device_get returns immediately
         for step in list(self._inflight)[:-1]:
             if not step.prefetched:
                 step.prefetched = True
-                try:
-                    step.toks.copy_to_host_async()
-                except (AttributeError, RuntimeError):
-                    pass
+                _start_host_copy(step.res)
         return True
 
     def _harvest(self, drain: bool) -> list[StepEvent]:
@@ -882,27 +1010,27 @@ class Engine:
         # ONE device->host transfer for everything harvestable this step:
         # over a remote device tunnel each read costs a full round trip
         # (~100 ms flat), so per-step reads must never be issued separately.
-        host = jax.device_get([s.toks for s in popped]
-                              + [t for _, t, _ in firsts])
+        host = jax.device_get([s.res for s in popped]
+                              + [r for _, r, _ in firsts])
 
         for (req, _, row), first in zip(firsts, host[len(popped):]):
             if req.finished:
                 continue
-            tok = int(first[row])
+            tok = int(first.tokens[row])
             req.pending_token = tok
             req.first_token_at = time.monotonic()
-            events += self._emit(req, tok)
+            events += self._emit(req, tok, _lp_entry(first, row))
 
-        for step, toks in zip(popped, host[:len(popped)]):
+        for step, res in zip(popped, host[:len(popped)]):
             for slot, req in step.active:
                 # skip slots whose request finished/aborted/was preempted
                 # after this step launched — their sampled token is garbage
                 if req.finished or req.slot != slot:
                     continue
                 self.slot_len[slot] += 1
-                tok = int(toks[slot])
+                tok = int(res.tokens[slot])
                 req.pending_token = tok
-                events += self._emit(req, tok)
+                events += self._emit(req, tok, _lp_entry(res, slot))
         return events
 
     def _drain_async(self) -> list[StepEvent]:

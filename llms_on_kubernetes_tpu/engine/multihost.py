@@ -149,19 +149,22 @@ def follower_loop(engine: Any) -> None:
             tokens = jnp.asarray(m["pre_tokens"][:k, :bucket])
             packed = jnp.asarray(m["pre_packed"][:k, :cols])
             fn = engine._prefill_packed if op == MSG_PREFILL else engine._chunk_packed
-            toks, _lps, engine.k_pages, engine.v_pages = fn(
+            res, engine.k_pages, engine.v_pages, engine.token_counts = fn(
                 engine.params, engine.model_config, tokens, packed,
-                engine.k_pages, engine.v_pages, engine._key,
+                engine.k_pages, engine.v_pages, engine.token_counts,
+                engine._key,
             )
-            prefill_toks = toks
+            prefill_toks = res.tokens
         elif op == MSG_DECODE:
             packed = jnp.asarray(m["dec_packed"])
             last = last_toks if last_valid else engine._zeros_B
             pre = prefill_toks if use_prefill else engine._zeros_1
-            toks, _lps, engine.k_pages, engine.v_pages = engine._decode_packed(
-                engine.params, engine.model_config, packed, last, pre,
-                engine.k_pages, engine.v_pages, engine._key,
-            )
-            last_toks = toks
+            res, engine.k_pages, engine.v_pages, engine.token_counts = (
+                engine._decode_packed(
+                    engine.params, engine.model_config, packed, last, pre,
+                    engine.k_pages, engine.v_pages, engine.token_counts,
+                    engine._key,
+                ))
+            last_toks = res.tokens
         else:
             raise ValueError(f"unknown multihost op {op}")

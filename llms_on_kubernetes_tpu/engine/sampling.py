@@ -18,8 +18,9 @@ occasionally be replaced by the next-best one from its bucket, for BOTH
 the top_k filter and the top-p nucleus. Greedy is always exact: the
 global argmax is provably rank 0 of approx_max_k's output (it is its own
 bucket's maximum, and the cross-bucket top-k is exact). On CPU the
-extraction is exact ``lax.top_k``. The candidate-set cap itself (top_k >
-MAX_CANDIDATES clamps; top-p loses tail mass beyond 64 tokens) is the
+extraction is exact ``lax.top_k``. The candidate-set cap itself is a
+hard bound: top_k > MAX_CANDIDATES is REJECTED at Engine.submit() (400
+at the API), and top-p loses only the tail mass beyond 64 tokens — the
 same tradeoff TPU serving stacks standardly make. The categorical draw
 uses the Gumbel trick on the masked, renormalized candidate logits.
 """
@@ -30,9 +31,15 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -2.0e38
-# Sampling candidate pool per slot. top_k values above this are clamped;
-# top-p nucleus truncation beyond it drops ~zero probability mass.
+# Sampling candidate pool per slot. top_k values above this are REJECTED
+# at the API layer (400) — silent clamping would change the sampling
+# semantics the client asked for; top-p nucleus truncation beyond the pool
+# drops ~zero probability mass.
 MAX_CANDIDATES = 64
+# Top alternatives returned per sampled token (OpenAI `logprobs`/
+# `top_logprobs` caps at 5; 8 leaves headroom and rides the same
+# device->host read as the token ids).
+LOGPROB_TOPK = 8
 
 
 def sample(
@@ -41,14 +48,25 @@ def sample(
     temperature: jnp.ndarray,  # [B] float32; 0 => greedy
     top_k: jnp.ndarray,        # [B] int32; 0 or >=V => disabled
     top_p: jnp.ndarray,        # [B] float32; 1.0 => disabled
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (tokens [B] int32, logprobs of the sampled tokens [B] f32).
+    penalties: "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray] | None" = None,
+) -> "SampleResult":
+    """Returns a SampleResult (tokens, chosen logprobs, top-K alternatives).
 
     Per-slot keys make a request's sampled stream a function of its own
     (seed, position) only — batch composition can never change what a
-    request samples (and the OpenAI ``seed`` parameter works)."""
+    request samples (and the OpenAI ``seed`` parameter works).
+
+    ``penalties`` = (presence [B], frequency [B], counts [B, V] int32):
+    OpenAI presence/frequency penalties over the OUTPUT tokens generated
+    so far (the engine maintains ``counts``). Applied to the raw logits
+    before candidate extraction, so the penalized distribution drives
+    top-k/top-p and the reported logprobs — vLLM semantics."""
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
+    if penalties is not None:
+        presence, frequency, counts = penalties
+        c = counts.astype(jnp.float32)
+        logits = logits - presence[:, None] * (c > 0) - frequency[:, None] * c
     C = min(MAX_CANDIDATES, V)
 
     # --- candidate extraction (sorted descending) ---------------------
@@ -97,7 +115,36 @@ def sample(
     chosen_logit = jnp.take_along_axis(cand_logits, chosen_rank[:, None],
                                        axis=-1)
     logprobs = (chosen_logit - lse)[:, 0]
-    return tokens.astype(jnp.int32), logprobs
+    K = min(LOGPROB_TOPK, C)
+    return SampleResult(
+        tokens=tokens.astype(jnp.int32),
+        logprobs=logprobs,
+        top_ids=cand_idx[:, :K].astype(jnp.int32),
+        top_logprobs=cand_logits[:, :K] - lse,
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+class SampleResult:
+    """Per-step sampling outputs (a pytree, so it flows through jit).
+
+    tokens [B] int32; logprobs [B] f32 (of the sampled token); top_ids /
+    top_logprobs [B, LOGPROB_TOPK] — the highest-probability alternatives
+    (sorted desc), for the OpenAI ``logprobs`` surface. All four ride one
+    device->host transfer at harvest time."""
+
+    def __init__(self, tokens, logprobs, top_ids, top_logprobs):
+        self.tokens = tokens
+        self.logprobs = logprobs
+        self.top_ids = top_ids
+        self.top_logprobs = top_logprobs
+
+    def tree_flatten(self):
+        return (self.tokens, self.logprobs, self.top_ids, self.top_logprobs), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
 
 
 def make_sampling_arrays(requests, num_slots: int):

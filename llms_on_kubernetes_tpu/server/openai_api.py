@@ -282,7 +282,7 @@ class OpenAIServer:
             content_type="text/plain", charset="utf-8",
         )
 
-    def _sampling_from_body(self, body: dict) -> SamplingParams:
+    def _sampling_from_body(self, body: dict, *, chat: bool) -> SamplingParams:
         max_tokens = body.get("max_tokens") or body.get("max_completion_tokens") or 256
         eos = tuple(self.tokenizer.eos_ids)
         seed = body.get("seed")
@@ -290,6 +290,30 @@ class OpenAIServer:
             if not isinstance(seed, int) or isinstance(seed, bool):
                 raise ValueError("seed must be an integer")
             seed = seed & 0x7FFFFFFF  # engine seeds are int32
+        # logprobs: completions takes an int (top-N per token); chat takes
+        # a bool plus top_logprobs (0-20 per OpenAI; we cap at LOGPROB_TOPK)
+        from llms_on_kubernetes_tpu.engine.sampling import LOGPROB_TOPK
+
+        if chat:
+            want = bool(body.get("logprobs", False))
+            nlp = int(body.get("top_logprobs", 0) or 0) if want else 0
+            if want and nlp == 0:
+                nlp = 1  # chat logprobs:true alone still returns the chosen
+        else:
+            raw = body.get("logprobs")
+            if raw is not None and (not isinstance(raw, int) or isinstance(raw, bool)):
+                raise ValueError("logprobs must be an integer")
+            if raw is not None and raw < 0:
+                raise ValueError("logprobs must be non-negative")
+            nlp = int(raw or 0)
+            if raw is not None:
+                nlp = max(nlp, 1)  # logprobs: 0 still returns token_logprobs
+        if nlp < 0:
+            raise ValueError("logprobs/top_logprobs must be non-negative")
+        if nlp > LOGPROB_TOPK:
+            raise ValueError(
+                f"logprobs/top_logprobs supports at most {LOGPROB_TOPK} "
+                f"alternatives, got {nlp}")
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
@@ -297,6 +321,9 @@ class OpenAIServer:
             max_tokens=int(max_tokens),
             stop_token_ids=eos,
             seed=seed,
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            logprobs=nlp,
         )
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
@@ -347,29 +374,55 @@ class OpenAIServer:
     # ------------------------------------------------------------------
 
     async def _serve(self, request, body, prompts, *, chat: bool) -> web.StreamResponse:
+        from llms_on_kubernetes_tpu.engine.engine import QueueFullError
+
         try:
-            params = self._sampling_from_body(body)
+            params = self._sampling_from_body(body, chat=chat)
         except (ValueError, TypeError) as e:  # bad seed/temperature/... -> 400
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        if not chat and body.get("suffix"):
+            return web.json_response(
+                {"error": {"message": "suffix (fill-in-middle) is not "
+                           "supported by this model server"}}, status=400)
         n = body.get("n", 1)
         if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= 16:
             return web.json_response(
                 {"error": {"message": "n must be an integer in [1, 16]"}},
                 status=400)
+        # best_of: sample that many candidates per prompt server-side,
+        # return the n highest-mean-logprob ones (non-streaming only)
+        best_of = body.get("best_of", n) if not chat else n
+        if not isinstance(best_of, int) or isinstance(best_of, bool) or best_of < n:
+            return web.json_response(
+                {"error": {"message": "best_of must be an integer >= n"}},
+                status=400)
+        if best_of > 16:
+            return web.json_response(
+                {"error": {"message": "best_of must be <= 16"}}, status=400)
+        if best_of > n and body.get("stream"):
+            return web.json_response(
+                {"error": {"message": "best_of > n cannot be streamed"}},
+                status=400)
         stops = _parse_stops(body)
-        # n choices per prompt (prompt-major choice order, per OpenAI);
-        # usage counts each UNIQUE prompt once, not n times
+        # best_of choices per prompt (prompt-major choice order, per
+        # OpenAI); usage counts each UNIQUE prompt once, not n times
         reqs = []
         try:
             for prompt_ids in prompts:
-                for j in range(n):
+                for j in range(best_of):
                     p = params
-                    if n > 1 and params.seed is not None and j > 0:
-                        # a fixed seed would make the n choices identical —
+                    if best_of > 1 and params.seed is not None and j > 0:
+                        # a fixed seed would make the choices identical —
                         # derive a distinct (still deterministic) seed each
                         p = dataclasses.replace(
                             params, seed=(params.seed + j) & 0x7FFFFFFF)
                     reqs.append(self.loop_thread.submit(prompt_ids, p))
+        except QueueFullError as e:
+            for r in reqs:
+                self.loop_thread.abort(r)
+            return web.json_response(
+                {"error": {"message": str(e), "type": "rate_limit_exceeded"}},
+                status=429, headers={"Retry-After": "1"})
         except ValueError as e:
             for r in reqs:
                 self.loop_thread.abort(r)
@@ -378,66 +431,166 @@ class OpenAIServer:
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
         if body.get("stream"):
-            return await self._stream_response(request, reqs, rid, created, chat, stops)
-        return await self._full_response(reqs, rid, created, chat, prompts, stops)
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage"))
+            return await self._stream_response(
+                request, reqs, rid, created, chat, stops, params.logprobs,
+                include_usage, prompts)
+        return await self._full_response(
+            reqs, rid, created, chat, prompts, stops, params.logprobs,
+            n, best_of, echo=bool(body.get("echo")) and not chat)
 
     async def _drain(self, req, stops):
         """Async generator over one request's events: yields
-        ``(text_delta, done, finish_reason, tokens_so_far)``.
+        ``(text_delta, done, finish_reason, tokens_so_far, lp_entries)``.
 
         Single source of truth for stop-token filtering, incremental
         detokenization, stop-sequence matching, and early abort — consumed
         by both the streaming and non-streaming paths. ``tokens_so_far``
         counts event tokens deterministically (``req.output`` may still be
-        growing on the engine thread after an abort).
+        growing on the engine thread after an abort). ``lp_entries`` pairs
+        each VISIBLE token id with its recorded (logprob, top_ids,
+        top_logprobs) tuple.
         """
         detok = IncrementalDetokenizer(self.tokenizer)
         stopper = StopChecker(stops)
         stop_ids = set(req.params.stop_token_ids)
         total = 0
+        tok_chars = 0  # cumulative decoded length of entry tokens so far
         while True:
             toks, done, reason = await _next_event(req)
+            start = total
             total += len(toks)
             # exclude trailing stop token from visible text (OpenAI behavior)
-            visible = [t for t in toks if not (done and reason == "stop" and t in stop_ids)]
+            entries = [
+                (t, req.output_logprobs[start + i]
+                 if start + i < len(req.output_logprobs) else None)
+                for i, t in enumerate(toks)
+                if not (done and reason == "stop" and t in stop_ids)
+            ]
+            visible = [t for t, _ in entries]
             text, hit = stopper.push(detok.push(visible, final=done), final=done)
             if hit:
+                # a stop SEQUENCE matched mid-event: logprob entries must
+                # stop where the text does (OpenAI truncates at the stop) —
+                # keep tokens whose decoded text starts before the cut
+                kept = []
+                for t, lp in entries:
+                    if tok_chars >= stopper.emitted:
+                        break
+                    kept.append((t, lp))
+                    tok_chars += len(self._tok_str(t))
                 self.loop_thread.abort(req)
-                yield text, True, "stop", total
+                yield text, True, "stop", total, kept
                 return
-            yield text, done, reason, total
+            for t, _ in entries:
+                tok_chars += len(self._tok_str(t))
+            yield text, done, reason, total, entries
             if done:
                 return
 
-    async def _consume(self, req, stops) -> tuple[str, Optional[str], int]:
+    async def _consume(self, req, stops) -> tuple[str, Optional[str], int, list]:
         parts: list[str] = []
         finish_reason, total = None, 0
-        async for text, done, reason, total in self._drain(req, stops):
+        entries: list = []
+        async for text, done, reason, total, evs in self._drain(req, stops):
             parts.append(text)
+            entries += evs
             if done:
                 finish_reason = reason
-        return "".join(parts), finish_reason, total
+        return "".join(parts), finish_reason, total, entries
 
-    async def _full_response(self, reqs, rid, created, chat, prompts, stops) -> web.Response:
-        choices = []
+    # -- logprob response shaping --------------------------------------
+
+    def _tok_str(self, tid: int) -> str:
+        return self.tokenizer.decode([tid])
+
+    def _chat_logprobs(self, entries, nlp: int) -> dict:
+        content = []
+        for tid, lp in entries:
+            if lp is None:
+                continue
+            chosen_lp, top_ids, top_lps = lp
+            s = self._tok_str(tid)
+            content.append({
+                "token": s,
+                "logprob": chosen_lp,
+                "bytes": list(s.encode("utf-8")),
+                "top_logprobs": [
+                    {"token": self._tok_str(i), "logprob": l,
+                     "bytes": list(self._tok_str(i).encode("utf-8"))}
+                    for i, l in zip(top_ids[:nlp], top_lps[:nlp])
+                ],
+            })
+        return {"content": content}
+
+    def _completion_logprobs(self, entries, nlp: int, base_offset: int) -> dict:
+        tokens, token_logprobs, top_logprobs, text_offset = [], [], [], []
+        offset = base_offset
+        for tid, lp in entries:
+            if lp is None:
+                continue
+            chosen_lp, top_ids, top_lps = lp
+            s = self._tok_str(tid)
+            tokens.append(s)
+            token_logprobs.append(chosen_lp)
+            top_logprobs.append(
+                {self._tok_str(i): l
+                 for i, l in zip(top_ids[:nlp], top_lps[:nlp])})
+            text_offset.append(offset)
+            offset += len(s)
+        return {"tokens": tokens, "token_logprobs": token_logprobs,
+                "top_logprobs": top_logprobs, "text_offset": text_offset}
+
+    async def _full_response(self, reqs, rid, created, chat, prompts, stops,
+                             nlp: int, n: int, best_of: int,
+                             echo: bool) -> web.Response:
+        per_prompt = best_of  # reqs are prompt-major groups of best_of
+        results = []
         completion_tokens = 0
         try:
             for i, req in enumerate(reqs):
-                text, finish_reason, ntok = await self._consume(req, stops)
+                text, finish_reason, ntok, entries = await self._consume(req, stops)
                 completion_tokens += ntok
-                if chat:
-                    choices.append({
-                        "index": i,
-                        "message": {"role": "assistant", "content": text},
-                        "finish_reason": finish_reason,
-                    })
-                else:
-                    choices.append({"index": i, "text": text, "finish_reason": finish_reason})
+                results.append((i // per_prompt, text, finish_reason, entries))
         except asyncio.CancelledError:
             # client went away mid-generation: free slots/pages now
             for r in reqs:
                 self.loop_thread.abort(r, "disconnect")
             raise
+
+        if best_of > n:
+            # keep the n best candidates per prompt by mean token logprob;
+            # a degenerate EMPTY completion must never win (its mean would
+            # otherwise score 0.0, beating every real candidate)
+            def score(entry_list):
+                lps = [lp[0] for _, lp in entry_list if lp is not None]
+                return sum(lps) / len(lps) if lps else float("-inf")
+            kept = []
+            for g in range(len(prompts)):
+                group = [r for r in results if r[0] == g]
+                group.sort(key=lambda r: score(r[3]), reverse=True)
+                kept += group[:n]
+            results = kept
+
+        choices = []
+        for i, (g, text, finish_reason, entries) in enumerate(results):
+            if chat:
+                choice = {
+                    "index": i,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason,
+                }
+                if nlp:
+                    choice["logprobs"] = self._chat_logprobs(entries, nlp)
+            else:
+                echo_text = self.tokenizer.decode(prompts[g]) if echo else ""
+                choice = {"index": i, "text": echo_text + text,
+                          "finish_reason": finish_reason}
+                if nlp:
+                    choice["logprobs"] = self._completion_logprobs(
+                        entries, nlp, len(echo_text))
+            choices.append(choice)
         prompt_tokens = sum(len(p) for p in prompts)
         usage = {
             "prompt_tokens": prompt_tokens,
@@ -450,7 +603,9 @@ class OpenAIServer:
             "choices": choices, "usage": usage,
         })
 
-    async def _stream_response(self, request, reqs, rid, created, chat, stops) -> web.StreamResponse:
+    async def _stream_response(self, request, reqs, rid, created, chat, stops,
+                               nlp: int = 0, include_usage: bool = False,
+                               prompts=None) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -462,9 +617,10 @@ class OpenAIServer:
         await resp.prepare(request)
         obj = "chat.completion.chunk" if chat else "text_completion"
         write_lock = asyncio.Lock()
+        completion_tokens = 0
 
         def chunk(index: int, delta_text: Optional[str], reason: Optional[str],
-                  role: bool = False) -> bytes:
+                  role: bool = False, entries=None, base_offset: int = 0) -> bytes:
             if chat:
                 delta: dict = {}
                 if role:
@@ -472,8 +628,13 @@ class OpenAIServer:
                 if delta_text is not None:
                     delta["content"] = delta_text
                 choice = {"index": index, "delta": delta, "finish_reason": reason}
+                if nlp and entries:
+                    choice["logprobs"] = self._chat_logprobs(entries, nlp)
             else:
                 choice = {"index": index, "text": delta_text or "", "finish_reason": reason}
+                if nlp and entries:
+                    choice["logprobs"] = self._completion_logprobs(
+                        entries, nlp, base_offset)
             payload = {
                 "id": rid, "object": obj, "created": created,
                 "model": self.model_name, "choices": [choice],
@@ -483,18 +644,34 @@ class OpenAIServer:
         async def pump(index: int, req) -> None:
             """Relay one request's tokens as SSE chunks (choices interleave
             across requests; the write lock keeps individual events intact)."""
+            nonlocal completion_tokens
             if chat:
                 async with write_lock:
                     await resp.write(chunk(index, None, None, role=True))
-            async for text, done, reason, _total in self._drain(req, stops):
+            total = 0
+            tok_chars = 0  # cumulative offsets across the WHOLE stream
+            async for text, done, reason, total, entries in self._drain(req, stops):
                 async with write_lock:
-                    if text:
-                        await resp.write(chunk(index, text, None))
+                    # a chunk is due when there is text OR logprob entries —
+                    # entries for tokens whose text is still held back
+                    # (partial UTF-8, stop-sequence window) must not be lost
+                    if text or (nlp and entries):
+                        await resp.write(chunk(index, text, None,
+                                               entries=entries,
+                                               base_offset=tok_chars))
+                        if nlp:
+                            tok_chars += sum(len(self._tok_str(t))
+                                             for t, _ in entries)
                     if done:
                         await resp.write(chunk(index, None, reason))
+            completion_tokens += total
 
         try:
             await asyncio.gather(*(pump(i, r) for i, r in enumerate(reqs)))
+            if include_usage:
+                prompt_tokens = sum(len(p) for p in (prompts or []))
+                await resp.write(
+                    f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': self.model_name, 'choices': [], 'usage': {'prompt_tokens': prompt_tokens, 'completion_tokens': completion_tokens, 'total_tokens': prompt_tokens + completion_tokens}})}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel generation so slots/pages free up now

@@ -4,7 +4,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llms_on_kubernetes_tpu.engine.sampling import sample
+from llms_on_kubernetes_tpu.engine.sampling import sample as _sample
+
+
+def sample(*args, **kw):
+    """Legacy 2-tuple view of SampleResult for these tests."""
+    res = _sample(*args, **kw)
+    return res.tokens, res.logprobs
 
 
 def _logits(rows):
@@ -166,8 +172,8 @@ def test_approx_extraction_branch_assumptions(monkeypatch):
 
     keys = jax.vmap(lambda s: jax.random.fold_in(jax.random.key(0), s))(
         jnp.arange(B))
-    toks, _ = sampling.sample(
+    res = sampling.sample(
         logits, keys, jnp.zeros((B,), jnp.float32),
         jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
-    np.testing.assert_array_equal(np.asarray(toks),
+    np.testing.assert_array_equal(np.asarray(res.tokens),
                                   np.argmax(np.asarray(logits), axis=1))

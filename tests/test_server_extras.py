@@ -1,0 +1,262 @@
+"""OpenAI surface extras: logprobs, penalties, 429 backpressure,
+stream_options usage, echo, best_of, suffix rejection, top_k cap.
+
+vLLM-parity features the reference's clients would exercise against the
+pulled image (SURVEY §2.3 row 1); VERDICT r1 items #8/#9 and weak #5.
+"""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+
+def make_server(**engine_kw):
+    defaults = dict(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=4, num_pages=256, pages_per_slot=32,
+        prefill_buckets=(32, 64),
+    )
+    defaults.update(engine_kw)
+    eng = Engine(EngineConfig(**defaults))
+    return OpenAIServer(eng, ByteTokenizer(), "debug-tiny")
+
+
+def with_client(fn, **engine_kw):
+    async def go():
+        server = make_server(**engine_kw)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_completions_logprobs_legacy_format():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "ab", "max_tokens": 4,
+            "temperature": 0, "logprobs": 3,
+        })
+        assert r.status == 200
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == 4
+        assert len(lp["token_logprobs"]) == 4
+        assert all(x <= 1e-4 for x in lp["token_logprobs"])  # <=0 up to fp eps
+        # dict-keyed by token STRING (legacy format): distinct ids that
+        # decode to the same text (byte tokenizer "?") may collide
+        assert all(1 <= len(t) <= 3 for t in lp["top_logprobs"])
+        # offsets are cumulative over the completion text
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+    with_client(body)
+
+
+def test_chat_logprobs_format():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0,
+            "logprobs": True, "top_logprobs": 2,
+        })
+        assert r.status == 200
+        content = (await r.json())["choices"][0]["logprobs"]["content"]
+        assert len(content) == 3
+        for e in content:
+            assert set(e) == {"token", "logprob", "bytes", "top_logprobs"}
+            assert len(e["top_logprobs"]) == 2
+            assert e["logprob"] <= 1e-4
+            # greedy: the chosen token is the top-1 alternative
+            assert e["top_logprobs"][0]["token"] == e["token"]
+    with_client(body)
+
+
+def test_logprobs_cap_rejected():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "a", "logprobs": 50,
+        })
+        assert r.status == 400
+        assert "at most" in (await r.json())["error"]["message"]
+    with_client(body)
+
+
+def test_top_k_above_pool_rejected():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "a", "top_k": 200,
+        })
+        assert r.status == 400
+        assert "top_k" in (await r.json())["error"]["message"]
+    with_client(body)
+
+
+def test_penalties_accepted_and_validated():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "aaaa", "max_tokens": 6,
+            "temperature": 0, "presence_penalty": 1.5,
+            "frequency_penalty": 0.5,
+        })
+        assert r.status == 200
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "a", "presence_penalty": 3.0,
+        })
+        assert r.status == 400
+    with_client(body)
+
+
+def test_queue_full_returns_429():
+    async def body(client):
+        # max_waiting=1 and a server whose engine loop is NOT running (we
+        # drive requests concurrently): flood fast enough that the queue
+        # bound trips before admission drains it
+        results = await asyncio.gather(*[
+            client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "abc", "max_tokens": 32,
+                "temperature": 0,
+            })
+            for _ in range(12)
+        ])
+        statuses = sorted(r.status for r in results)
+        assert statuses[0] == 200          # admitted requests succeed
+        assert 429 in statuses             # the flood hits the bound
+        for r in results:
+            if r.status == 429:
+                assert r.headers.get("Retry-After") == "1"
+    with_client(body, max_waiting=1, max_decode_slots=1)
+
+
+def test_stream_options_include_usage():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abcd", "max_tokens": 5,
+            "temperature": 0, "stream": True,
+            "stream_options": {"include_usage": True},
+        })
+        assert r.status == 200
+        raw = (await r.read()).decode()
+        frames = [json.loads(line[6:]) for line in raw.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        usage_frames = [f for f in frames if f.get("usage")]
+        assert len(usage_frames) == 1
+        u = usage_frames[-1]["usage"]
+        assert u["prompt_tokens"] == 4 and u["completion_tokens"] == 5
+        assert usage_frames[0]["choices"] == []
+    with_client(body)
+
+
+def test_echo_prepends_prompt():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "hello", "max_tokens": 2,
+            "temperature": 0, "echo": True,
+        })
+        assert r.status == 200
+        text = (await r.json())["choices"][0]["text"]
+        assert text.startswith("hello")
+    with_client(body)
+
+
+def test_best_of_selects_n_best():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "ab", "max_tokens": 4,
+            "temperature": 0.9, "seed": 7, "n": 2, "best_of": 5,
+            "logprobs": 1,
+        })
+        assert r.status == 200
+        data = await r.json()
+        assert len(data["choices"]) == 2
+        assert [c["index"] for c in data["choices"]] == [0, 1]
+        # best_of with stream is rejected
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "ab", "n": 1, "best_of": 3,
+            "stream": True,
+        })
+        assert r.status == 400
+        # best_of < n is invalid
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "ab", "n": 4, "best_of": 2,
+        })
+        assert r.status == 400
+    with_client(body)
+
+
+def test_suffix_rejected():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "ab", "suffix": "end",
+        })
+        assert r.status == 400
+        assert "suffix" in (await r.json())["error"]["message"]
+    with_client(body)
+
+
+def test_streaming_chat_logprobs():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "yo"}],
+            "max_tokens": 3, "temperature": 0, "stream": True,
+            "logprobs": True, "top_logprobs": 1,
+        })
+        assert r.status == 200
+        raw = (await r.read()).decode()
+        frames = [json.loads(line[6:]) for line in raw.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        lp_frames = [f for f in frames
+                     if f["choices"] and f["choices"][0].get("logprobs")]
+        assert lp_frames, "no logprobs in any stream chunk"
+        entry = lp_frames[0]["choices"][0]["logprobs"]["content"][0]
+        assert entry["logprob"] <= 1e-4 and len(entry["top_logprobs"]) == 1
+    with_client(body)
+
+
+def test_logprobs_truncated_at_stop_sequence():
+    """Entries must stop where the text does when a stop sequence matches
+    (OpenAI truncates logprobs at the stop)."""
+    async def body(client):
+        # find greedy output first, then stop on a substring of it
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc", "max_tokens": 8,
+            "temperature": 0,
+        })
+        full = (await r.json())["choices"][0]["text"]
+        if len(full) < 3:
+            return  # degenerate model output; nothing to cut
+        stop = full[1]
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc", "max_tokens": 8,
+            "temperature": 0, "stop": [stop], "logprobs": 1,
+        })
+        data = (await r.json())["choices"][0]
+        lp = data["logprobs"]
+        joined = "".join(lp["tokens"])
+        assert stop not in data["text"]
+        # no entry may start beyond the visible text
+        assert all(off <= len(data["text"]) for off in lp["text_offset"])
+        assert len(joined) <= len(data["text"]) + len(stop)
+    with_client(body)
+
+
+def test_negative_logprobs_rejected():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "x"}],
+            "logprobs": True, "top_logprobs": -1,
+        })
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "x", "logprobs": -2,
+        })
+        assert r.status == 400
+    with_client(body)
