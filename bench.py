@@ -37,6 +37,153 @@ A10G_DOLLARS_PER_H = 1.01     # AWS g5.xlarge on-demand
 V5E_DOLLARS_PER_H = 1.20      # GCP v5e per-chip on-demand
 
 
+def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
+    """Measure the BASELINE.md metric definition: client -> multi-model
+    router -> OpenAI server -> engine (the in-cluster portion of the Istio
+    gateway path). Returns {"gateway_p50_ttft_ms", "gateway_tokens_per_sec"}.
+
+    Runs the real aiohttp OpenAI server and the real Python router
+    in-process on localhost; TTFT is the client-side time to the first SSE
+    data chunk of a streaming completion, measured while the engine also
+    carries background decode load — "new request joins a busy server".
+    """
+    import http.client
+    import json as _json
+    import threading
+
+    import numpy as np
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    server = OpenAIServer(eng, ByteTokenizer(), model_name)
+    ports: dict = {}
+    ready = threading.Event()
+    stop = None
+    loop_holder: dict = {}
+
+    def run_apps():
+        import asyncio
+
+        async def main_async():
+            nonlocal stop
+            stop = asyncio.Event()
+            loop_holder["loop"] = asyncio.get_running_loop()
+            s_runner = web.AppRunner(server.make_app())
+            await s_runner.setup()
+            s_site = web.TCPSite(s_runner, "127.0.0.1", 0)
+            await s_site.start()
+            sport = s_runner.addresses[0][1]
+            router = Router({model_name: f"http://127.0.0.1:{sport}"},
+                            default_model=model_name, strict=False)
+            r_runner = web.AppRunner(router.make_app())
+            await r_runner.setup()
+            r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
+            await r_site.start()
+            ports["router"] = r_runner.addresses[0][1]
+            ready.set()
+            await stop.wait()
+            await r_runner.cleanup()
+            await s_runner.cleanup()
+
+        asyncio.new_event_loop().run_until_complete(main_async())
+
+    t = threading.Thread(target=run_apps, daemon=True)
+    t.start()
+    if not ready.wait(timeout=60):
+        raise RuntimeError("gateway bench: apps failed to start")
+    port = ports["router"]
+    rng = np.random.default_rng(1)
+
+    def body(max_tokens, stream):
+        return _json.dumps({
+            "model": model_name,
+            "prompt": [int(x) for x in rng.integers(1, vocab - 1, prompt_len)],
+            "max_tokens": max_tokens, "temperature": 0.0, "stream": stream,
+        })
+
+    def fire(max_tokens):  # warmup request (blocking, own conn)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request("POST", "/v1/completions", body(max_tokens, False),
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.close()
+
+    # warm the HTTP/engine path end-to-end
+    fire(4)
+
+    # background load: fill the decode batch during the probes (throughput
+    # through the gateway is only meaningful at capacity). ONE asyncio
+    # client thread drives all load connections — a thread per connection
+    # would measure GIL churn, not the serving path.
+    n_load = max(8, eng.config.max_decode_slots - 2)
+    gen = 48
+    load_done = threading.Event()
+    load_wall_box: dict = {}
+
+    def run_load():
+        import asyncio
+
+        import aiohttp
+
+        async def go():
+            async with aiohttp.ClientSession() as sess:
+                async def one():
+                    async with sess.post(
+                            f"http://127.0.0.1:{port}/v1/completions",
+                            data=body(gen, False),
+                            headers={"Content-Type": "application/json"},
+                    ) as r:
+                        await r.read()
+                t0 = time.monotonic()
+                await asyncio.gather(*(one() for _ in range(n_load)))
+                load_wall_box["wall"] = time.monotonic() - t0
+
+        asyncio.new_event_loop().run_until_complete(go())
+        load_done.set()
+
+    lt = threading.Thread(target=run_load, daemon=True)
+    lt.start()
+    time.sleep(0.2)  # let the load reach the decode batch
+
+    ttfts = []
+    for _ in range(4):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        t1 = time.monotonic()
+        conn.request("POST", "/v1/completions", body(8, True),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        # first decoded byte through both hops = TTFT
+        first = resp.read(1)
+        ttfts.append(time.monotonic() - t1)
+        rest = first + resp.read()
+        assert b"data:" in rest, rest[:120]
+        conn.close()
+    load_done.wait(timeout=300)
+    load_wall = load_wall_box.get("wall", float("inf"))
+
+    if stop is not None:
+        loop_holder["loop"].call_soon_threadsafe(stop.set)
+    t.join(timeout=30)
+    ttfts.sort()
+    return {
+        "gateway_p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+        "gateway_tokens_per_sec": round(n_load * gen / load_wall, 1),
+        # This dev environment reaches the TPU through a tunnel with a
+        # ~110 ms flat device->host read RTT; amortizing it needs a deep
+        # async pipeline (BENCH_DEPTH=8), and a new request's prefill
+        # queues behind those in-flight steps — which is most of the
+        # gateway TTFT. On GKE (sub-ms RTT) depth 2 suffices and the
+        # gateway TTFT converges to the engine-level number + ~2 ms of
+        # HTTP hops (the CPU run of this same bench shows the serving
+        # path itself adds only ~2.4 ms).
+        "gateway_depth_note": "tunnel RTT amortization; see bench.py",
+    }
+
+
 def main() -> int:
     import jax
 
@@ -171,6 +318,13 @@ def main() -> int:
     tok_s = decode_tokens / decode_time if decode_time > 0 else 0.0
     total_tok_s = sum(len(r.output) for r in reqs) / wall
 
+    # gateway path: the BASELINE.md metric definition measures TTFT
+    # through the router hop (client -> router -> server -> engine)
+    try:
+        gw = gateway_bench(eng, cfg.name, prompt_len, cfg.vocab_size)
+    except Exception as e:  # the engine-level numbers still stand
+        gw = {"gateway_error": str(e)[:200]}
+
     value = round(tok_s, 1)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -181,6 +335,7 @@ def main() -> int:
         "vs_baseline": round(per_dollar / baseline_per_dollar, 3),
         "p50_ttft_ms": round(p50_ttft_ms, 1),
         "aggregate_tokens_per_sec": round(total_tok_s, 1),
+        **gw,
         "batch": B,
         "quantization": ecfg.quantization,
         "platform": jax.devices()[0].platform,

@@ -134,6 +134,11 @@ class Request:
     events: "queue.SimpleQueue[tuple[list[int], bool, Optional[str]]]" = dataclasses.field(
         default_factory=queue.SimpleQueue
     )
+    # optional push delivery: called from the ENGINE thread with each
+    # event payload (the API server points this at its asyncio loop via
+    # call_soon_threadsafe — a blocking queue.get per active stream would
+    # park one executor thread per request and starve concurrency)
+    on_event: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -456,6 +461,7 @@ class Engine:
         prompt: list[int],
         params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        on_event=None,
     ) -> Request:
         params = params or SamplingParams()
         max_len = self.config.max_model_len
@@ -492,6 +498,7 @@ class Engine:
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
             prompt=list(prompt), params=params, seed=seed,
+            on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:
             if len(self.waiting) >= self.config.max_waiting:
@@ -521,7 +528,10 @@ class Engine:
             events += self._admit_one()
             events += self._decode_once()
         for ev in events:
-            ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
+            payload = (ev.new_tokens, ev.finished, ev.finish_reason)
+            ev.request.events.put(payload)
+            if ev.request.on_event is not None:
+                ev.request.on_event(payload)
         return events
 
     def abort(self, req: Request, reason: str = "abort") -> None:
@@ -1038,7 +1048,10 @@ class Engine:
         inspection / shutdown)."""
         events = self._harvest(drain=True)
         for ev in events:
-            ev.request.events.put((ev.new_tokens, ev.finished, ev.finish_reason))
+            payload = (ev.new_tokens, ev.finished, ev.finish_reason)
+            ev.request.events.put(payload)
+            if ev.request.on_event is not None:
+                ev.request.on_event(payload)
         return events
 
     # ------------------------------------------------------------------

@@ -106,8 +106,25 @@ class EngineLoop(threading.Thread):
                         self._ttft_seen.discard(r.id)
 
 
+def _event_pusher(loop: asyncio.AbstractEventLoop, q: "asyncio.Queue"):
+    """Engine-thread -> asyncio delivery without blocking threads: the
+    engine calls this with each event; it lands in the request's asyncio
+    queue via call_soon_threadsafe. (The old model — one executor thread
+    parked in a blocking queue.get per active stream — capped concurrency
+    at the thread pool size and collapsed gateway TTFT under load.)"""
+    def push(item):
+        try:
+            loop.call_soon_threadsafe(q.put_nowait, item)
+        except RuntimeError:
+            pass  # loop already closed (shutdown/disconnect)
+    return push
+
+
 async def _next_event(req: Request) -> tuple[list[int], bool, Optional[str]]:
     """Await the engine thread's next event for this request."""
+    q = getattr(req, "_aq", None)
+    if q is not None:
+        return await q.get()
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(None, req.events.get)
 
@@ -406,6 +423,7 @@ class OpenAIServer:
         stops = _parse_stops(body)
         # best_of choices per prompt (prompt-major choice order, per
         # OpenAI); usage counts each UNIQUE prompt once, not n times
+        loop = asyncio.get_running_loop()
         reqs = []
         try:
             for prompt_ids in prompts:
@@ -416,7 +434,11 @@ class OpenAIServer:
                         # derive a distinct (still deterministic) seed each
                         p = dataclasses.replace(
                             params, seed=(params.seed + j) & 0x7FFFFFFF)
-                    reqs.append(self.loop_thread.submit(prompt_ids, p))
+                    q: asyncio.Queue = asyncio.Queue()
+                    req = self.loop_thread.submit(
+                        prompt_ids, p, on_event=_event_pusher(loop, q))
+                    req._aq = q
+                    reqs.append(req)
         except QueueFullError as e:
             for r in reqs:
                 self.loop_thread.abort(r)

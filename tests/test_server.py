@@ -134,8 +134,15 @@ def test_metrics_endpoint_counts():
     async def body(client):
         await client.post("/v1/completions", json={
             "prompt": "abc", "max_tokens": 3, "temperature": 0})
-        r = await client.get("/metrics")
-        text = await r.text()
+        # the response completes on event delivery; the engine loop's
+        # metrics accounting for that step may land a moment later
+        # (Prometheus scrapes are periodic — freshness is best-effort)
+        for _ in range(50):
+            r = await client.get("/metrics")
+            text = await r.text()
+            if "llm_tokens_generated_total 3.0" in text:
+                break
+            await asyncio.sleep(0.02)
         assert "llm_requests_total 1.0" in text
         assert "llm_tokens_generated_total 3.0" in text
         assert "llm_ttft_seconds_count 1" in text
