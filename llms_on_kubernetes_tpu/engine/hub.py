@@ -87,13 +87,55 @@ def ensure_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
     from llms_on_kubernetes_tpu.configs import hf_repo_for
     from llms_on_kubernetes_tpu.engine.weights import resolve_model_dir
 
+    def grandfathered() -> Optional[str]:
+        # A weights-complete but tokenizer-less snapshot (hand-populated
+        # PVC, or one written by a pre-tokenizer-check release) still
+        # serves — via ByteTokenizer, as it always did — when no resume
+        # download can fetch the missing artifacts.
+        try:
+            path = resolve_model_dir(model_ref, cache_dir=cache_dir,
+                                     require_tokenizer=False)
+        except FileNotFoundError:
+            return None
+        import logging
+        logging.getLogger(__name__).warning(
+            "serving tokenizer-less snapshot %s (no tokenizer artifact on "
+            "disk and none could be fetched); requests will use the byte "
+            "tokenizer", path)
+        return path
+
     try:
         return resolve_model_dir(model_ref, cache_dir=cache_dir)
     except FileNotFoundError:
         repo_id = hf_repo_for(model_ref)
         if repo_id is None:
+            path = grandfathered()
+            if path is not None:
+                return path
             raise
-    download_snapshot(repo_id, cache_dir=cache_dir)
+    try:
+        download_snapshot(repo_id, cache_dir=cache_dir)
+    except Exception:
+        # offline / Hub unreachable / auth failure: fall back to a
+        # pre-existing tokenizer-less snapshot before failing startup —
+        # but log WHY the download failed first, or a degraded
+        # byte-tokenizer deployment leaves no trace of its cause
+        import logging
+        logging.getLogger(__name__).warning(
+            "snapshot download for %s failed", repo_id, exc_info=True)
+        path = grandfathered()
+        if path is not None:
+            return path
+        raise
     # re-resolve rather than trusting the returned path: enforces the
     # "snapshot actually contains *.safetensors" invariant in one place
-    return resolve_model_dir(model_ref, cache_dir=cache_dir)
+    try:
+        return resolve_model_dir(model_ref, cache_dir=cache_dir)
+    except FileNotFoundError:
+        # download succeeded but the repo itself ships no tokenizer
+        # artifact (or allow-patterns missed it): same grandfather rule as
+        # the offline path — a weights-complete snapshot still serves
+        path = grandfathered()
+        if path is not None:
+            return path
+        raise

@@ -26,10 +26,27 @@ _DTYPES = {
 }
 
 
-def _bf16_dtype():
+class UnsupportedDTypeError(ValueError):
+    """A safetensors dtype this bridge cannot map to numpy; callers fall
+    back to the Python safetensors reader for that tensor (round-2 review
+    finding: an FP8 checkpoint previously crashed with a raw KeyError)."""
+
+
+def _np_dtype(dtype_s: str) -> Optional[np.dtype]:
+    if dtype_s in _DTYPES:
+        return np.dtype(_DTYPES[dtype_s])
     import ml_dtypes  # ships with jax
 
-    return np.dtype(ml_dtypes.bfloat16)
+    ext = {
+        "BF16": ml_dtypes.bfloat16,
+        # compressed-tensors FP8 checkpoints (the reference's default
+        # gemma-3-27b-it-FP8-Dynamic, reference values.yaml:3)
+        "F8_E4M3": ml_dtypes.float8_e4m3fn,
+        "F8_E5M2": ml_dtypes.float8_e5m2,
+    }
+    if dtype_s in ext:
+        return np.dtype(ext[dtype_s])
+    return None
 
 
 _lib: Optional[ctypes.CDLL] = None
@@ -105,8 +122,11 @@ class _NativeShards:
         if ndim < 0:
             raise KeyError(self._lib.stl_error().decode())
         dtype_s = dtype_buf.value.decode()
-        np_dtype = (_bf16_dtype() if dtype_s == "BF16"
-                    else np.dtype(_DTYPES[dtype_s]))
+        np_dtype = _np_dtype(dtype_s)
+        if np_dtype is None:
+            raise UnsupportedDTypeError(
+                f"tensor {name!r} has safetensors dtype {dtype_s!r} with no "
+                f"numpy mapping")
         shp = tuple(shape[i] for i in range(ndim))
         out = np.empty(shp, np_dtype)
         assert out.nbytes == nbytes.value, (name, out.nbytes, nbytes.value)

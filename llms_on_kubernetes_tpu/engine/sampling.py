@@ -23,9 +23,18 @@ hard bound: top_k > MAX_CANDIDATES is REJECTED at Engine.submit() (400
 at the API), and top-p loses only the tail mass beyond 64 tokens — the
 same tradeoff TPU serving stacks standardly make. The categorical draw
 uses the Gumbel trick on the masked, renormalized candidate logits.
+
+Reproducibility caveat: because the TPU path extracts candidates with
+``approx_max_k`` and the CPU path with exact ``top_k``, a SEEDED non-greedy
+request is reproducible within a backend but not necessarily ACROSS
+CPU/TPU. Set ``LLMK_EXACT_SAMPLING=1`` to force exact ``lax.top_k`` on TPU
+(costs ~2.6 ms/step at 128K vocab) when cross-backend determinism matters
+more than throughput.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -73,7 +82,8 @@ def sample(
     # TPU: approx_max_k is the hardware-native bucketed reduction (exact
     # top_k measured 2.6 ms/step at 128K vocab; approx ~free). Recall
     # caveats and the greedy-exactness argument: module docstring.
-    if jax.default_backend() == "tpu" and V > 4 * C:
+    exact = os.environ.get("LLMK_EXACT_SAMPLING", "0") == "1"
+    if jax.default_backend() == "tpu" and V > 4 * C and not exact:
         cand_logits, cand_idx = jax.lax.approx_max_k(logits, C)
     else:
         cand_logits, cand_idx = jax.lax.top_k(logits, C)     # [B, C] each

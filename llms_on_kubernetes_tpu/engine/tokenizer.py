@@ -124,6 +124,14 @@ class GGUFTokenizer:
         self._prefix = " " if metadata.get(
             "tokenizer.ggml.add_space_prefix", True) else ""
         self._byte_id_set = set(self._byte_ids.values())
+        # GGUF files carry the model's own chat template
+        # (``tokenizer.chat_template``, same Jinja dialect as HF
+        # tokenizer_config.json); llama.cpp's server renders it. Without
+        # it a Phi-3 GGUF (the reference's documented local model,
+        # reference ramalama-models/README.md:102-107) would get the wrong
+        # [INST]-style format.
+        self.chat_template: Optional[str] = metadata.get(
+            "tokenizer.chat_template") or None
 
     def _encode_piece(self, text: str) -> list[int]:
         """SPM merge via a bigram heap (linear-log in text length): a
@@ -196,7 +204,44 @@ class GGUFTokenizer:
                 out += self.tokens[i].replace("▁", " ").encode("utf-8")
         return out.decode("utf-8", errors="replace")
 
+    def _encode_with_specials(self, text: str) -> list[int]:
+        """Tokenize text that may contain control-token literals (chat
+        template output like ``<|end|>``): split on the vocab's control
+        strings so each maps to its single id — the SPM merge loop cannot
+        assemble them (control pieces carry no merge scores)."""
+        import re
+
+        special_by_text = {self.tokens[i]: i for i in self._control
+                           if self.tokens[i]}
+        if not special_by_text:
+            return self._encode_piece(text.replace(" ", "▁"))
+        pattern = "(" + "|".join(
+            re.escape(s) for s in sorted(special_by_text, key=len,
+                                         reverse=True)) + ")"
+        ids: list[int] = []
+        for part in re.split(pattern, text):
+            if not part:
+                continue
+            if part in special_by_text:
+                ids.append(special_by_text[part])
+            else:
+                ids += self._encode_piece(part.replace(" ", "▁"))
+        return ids
+
     def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        if self.chat_template:
+            try:
+                return self._render_chat_template(messages)
+            except Exception:
+                # malformed template / missing jinja2: fall back to the
+                # generic format — but say WHY, once, or every chat
+                # request silently degrades with no trace of the cause
+                if not getattr(self, "_template_warned", False):
+                    self._template_warned = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "chat template rendering failed; falling back to "
+                        "the generic [INST] format", exc_info=True)
         # generic [INST]-style template (llama.cpp's default for SPM models)
         text = ""
         for m in messages:
@@ -208,6 +253,30 @@ class GGUFTokenizer:
             else:
                 text += f" {content} "
         return self.encode(text)
+
+    def _render_chat_template(self, messages: list[dict]) -> list[int]:
+        """Render ``tokenizer.chat_template`` the way HF/llama.cpp do:
+        sandboxed Jinja fed messages + bos/eos token strings +
+        add_generation_prompt=True, then tokenize with control-token
+        splitting. BOS is prepended per add_bos unless the template already
+        emitted it."""
+        from jinja2.sandbox import ImmutableSandboxedEnvironment
+
+        def raise_exception(msg):
+            raise ValueError(msg)
+
+        env = ImmutableSandboxedEnvironment(
+            trim_blocks=True, lstrip_blocks=True)
+        bos = self.tokens[self.bos_id] if 0 <= self.bos_id < len(self.tokens) else ""
+        eos = self.tokens[self.eos_id] if 0 <= self.eos_id < len(self.tokens) else ""
+        text = env.from_string(self.chat_template).render(
+            messages=messages, add_generation_prompt=True,
+            bos_token=bos, eos_token=eos, raise_exception=raise_exception,
+        )
+        ids = self._encode_with_specials(text)
+        if self.add_bos and (not ids or ids[0] != self.bos_id):
+            ids = [self.bos_id] + ids
+        return ids
 
     @property
     def eos_ids(self) -> set[int]:

@@ -35,12 +35,33 @@ def _open_safetensors(model_dir: str) -> dict[str, Callable[[], np.ndarray]]:
     Prefers the native mmap reader (native/loader/libstload.so via
     engine/native_loader.py) when built; falls back to the Python
     ``safetensors`` package."""
-    from llms_on_kubernetes_tpu.engine.native_loader import open_native_safetensors
+    from llms_on_kubernetes_tpu.engine.native_loader import (
+        UnsupportedDTypeError, open_native_safetensors,
+    )
 
     native = open_native_safetensors(model_dir)
     if native is not None:
-        return native
+        # per-tensor fallback: a dtype the ctypes bridge can't map (e.g. a
+        # future safetensors extension) drops to the Python reader for
+        # that tensor instead of failing the whole load
+        py_cache: dict = {}
 
+        def with_fallback(name, fn):
+            def load():
+                try:
+                    return fn()
+                except UnsupportedDTypeError:
+                    if not py_cache:
+                        py_cache.update(_open_py_safetensors(model_dir))
+                    return py_cache[name]()
+            load.__wrapped__ = fn
+            return load
+
+        return {name: with_fallback(name, fn) for name, fn in native.items()}
+    return _open_py_safetensors(model_dir)
+
+
+def _open_py_safetensors(model_dir: str) -> dict[str, Callable[[], np.ndarray]]:
     import safetensors
 
     loaders: dict[str, Callable[[], np.ndarray]] = {}
@@ -219,7 +240,7 @@ def hf_hub_cache(cache_dir: Optional[str] = None) -> str:
 _SHARD_RE = r".*-\d{4,6}-of-\d{4,6}\.safetensors$"
 
 
-def _snapshot_complete(snap: pathlib.Path) -> bool:
+def _snapshot_complete(snap: pathlib.Path, require_tokenizer: bool = True) -> bool:
     """True when a cache snapshot holds a COMPLETE, loadable checkpoint.
 
     A checkpoint interrupted mid-download leaves some files symlinked and
@@ -237,10 +258,14 @@ def _snapshot_complete(snap: pathlib.Path) -> bool:
         return False
     # at least one tokenizer artifact (all are in hub._ALLOW_PATTERNS):
     # without this, a download killed after weights-but-before-tokenizer
-    # would resolve, never resume, and silently serve via ByteTokenizer
-    if not any((snap / t).is_file() for t in
-               ("tokenizer.json", "tokenizer.model", "tokenizer_config.json",
-                "vocab.json")):
+    # would resolve, never resume, and silently serve via ByteTokenizer.
+    # ``require_tokenizer=False`` grandfathers pre-existing weights-only
+    # snapshots (hand-populated PVC, or one written by an older release)
+    # when a resume download is impossible — see hub.ensure_model_dir.
+    if require_tokenizer and not any(
+            (snap / t).is_file() for t in
+            ("tokenizer.json", "tokenizer.model", "tokenizer_config.json",
+             "vocab.json")):
         return False
     idx = snap / "model.safetensors.index.json"
     if idx.is_file():
@@ -254,7 +279,8 @@ def _snapshot_complete(snap: pathlib.Path) -> bool:
     return bool(files) and not any(_re.match(_SHARD_RE, f) for f in files)
 
 
-def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
+def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None,
+                      require_tokenizer: bool = True) -> str:
     """Resolve a local dir or a HF-cache snapshot path for ``model_ref``.
 
     Mirrors the reference's PVC cache convention: weights live under
@@ -274,7 +300,7 @@ def resolve_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:
         repo_dir = hub_dir / ("models--" + ref.replace("/", "--"))
         snaps = sorted((repo_dir / "snapshots").glob("*")) if repo_dir.exists() else []
         for snap in snaps:
-            if _snapshot_complete(snap):
+            if _snapshot_complete(snap, require_tokenizer=require_tokenizer):
                 return str(snap)
     raise FileNotFoundError(
         f"no local checkpoint for {model_ref!r}; expected a directory or a "

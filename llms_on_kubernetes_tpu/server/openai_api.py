@@ -168,21 +168,51 @@ class StopChecker:
         self.emitted = 0
 
     def push(self, delta: str, final: bool = False) -> tuple[str, bool]:
-        prev_len = len(self.text)
         self.text += delta
+        # earliest occurrence IN THE TEXT wins, not list order: with
+        # stop=["b", "a"] and text "a...b" output truncates at "a"
+        # (OpenAI semantics). Scanning from ``emitted`` (nothing earlier
+        # can be truncated anyway) keeps the scan O(holdback + delta) and
+        # re-finds matches deferred by the partial-prefix rule below.
+        best = -1
         for s in self.stops:
-            # only the window a NEW match could occupy needs scanning
-            # (earlier positions were covered by previous pushes)
-            idx = self.text.find(s, max(0, prev_len - len(s) + 1))
-            if idx != -1:
-                out = self.text[self.emitted:idx]
-                self.emitted = idx
-                return out, True
+            idx = self.text.find(s, self.emitted)
+            if idx != -1 and (best == -1 or idx < best):
+                best = idx
+        if best != -1 and not final:
+            # a LONGER stop that started before ``best`` may still be
+            # completing (its remainder arrives in a later delta); firing
+            # now would truncate at the later match. Defer: emit up to the
+            # earliest such candidate start and wait for the next delta.
+            pend = self._pending_start_before(best)
+            if pend is not None:
+                cut = max(self.emitted, pend)
+                out = self.text[self.emitted:cut]
+                self.emitted = cut
+                return out, False
+        if best != -1:
+            out = self.text[self.emitted:best]
+            self.emitted = best
+            return out, True
         cut = len(self.text) if final or not self.stops else max(
             self.emitted, len(self.text) - self.holdback)
         out = self.text[self.emitted:cut]
         self.emitted = cut
         return out, False
+
+    def _pending_start_before(self, limit: int) -> Optional[int]:
+        """Earliest position < ``limit`` where some stop has matched a
+        proper prefix that runs off the end of the text (i.e. could still
+        complete), or None."""
+        n = len(self.text)
+        earliest = None
+        for s in self.stops:
+            for i in range(max(self.emitted, n - len(s) + 1), min(limit, n)):
+                if s.startswith(self.text[i:]):  # i + len(s) > n by range
+                    if earliest is None or i < earliest:
+                        earliest = i
+                    break
+        return earliest
 
 
 def _parse_stops(body: dict) -> list[str]:
@@ -401,6 +431,15 @@ class OpenAIServer:
             return web.json_response(
                 {"error": {"message": "suffix (fill-in-middle) is not "
                            "supported by this model server"}}, status=400)
+        if not chat and body.get("echo") and params.logprobs:
+            # OpenAI echo+logprobs includes PROMPT-token logprobs (first
+            # entry null); this engine does not capture prefill logits, so
+            # reject explicitly rather than return a silently partial
+            # logprobs block (round-2 advisor finding)
+            return web.json_response(
+                {"error": {"message": "echo with logprobs is not supported: "
+                           "prompt-token logprobs are not captured"}},
+                status=400)
         n = body.get("n", 1)
         if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= 16:
             return web.json_response(

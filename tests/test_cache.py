@@ -54,6 +54,47 @@ def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     assert (kn[:, 1] == 0).all() and (kn[:, 3] == 0).all()
 
 
+def test_write_tokens_scatter_fallback_matches_dus_path():
+    """Chunks spanning > _MAX_RMW_PAGES pages take the HLO-scatter fallback
+    (round-2 advisor finding: previously unreachable in any tested config).
+    page_size=1 with a 64-token chunk forces n_touch=65 > 33; the scatter
+    result must match the per-page DUS path bit for bit."""
+    from llms_on_kubernetes_tpu.engine.cache import _MAX_RMW_PAGES
+
+    P, page, KV, d = 80, 1, 2, 3
+    B, T = 2, 64
+    assert (T - 1) // page + 2 > _MAX_RMW_PAGES  # scatter path engaged
+    rng = np.random.default_rng(0)
+    k = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+    # row 0: full chunk from position 3; row 1: 10 valid tokens, rest padding
+    pt = np.zeros((B, 70), np.int32)
+    pt[0] = rng.permutation(np.arange(1, 71))
+    pt[1] = rng.permutation(np.arange(1, 80))[:70]
+    positions = np.full((B, T), -1, np.int32)
+    positions[0] = np.arange(3, 3 + T)
+    positions[1, :10] = np.arange(10)
+    pt_j, pos_j = jnp.asarray(pt), jnp.asarray(positions)
+
+    kp0 = jnp.zeros((KV, P, page, d))
+    vp0 = jnp.zeros((KV, P, page, d))
+    ks, vs = write_tokens(kp0, vp0, k, v, pt_j, pos_j)  # scatter (n_touch>33)
+
+    # reference: same writes through the small-chunk DUS path, one
+    # page-sized (=1-token) sub-chunk at a time
+    kd, vd = kp0, vp0
+    for b in range(B):
+        for t in range(T):
+            if positions[b, t] < 0:
+                continue
+            kd, vd = write_tokens(
+                kd, vd, k[b:b + 1, t:t + 1], v[b:b + 1, t:t + 1],
+                pt_j[b:b + 1], pos_j[b:b + 1, t:t + 1])
+    # trash page 0 may differ (padding lands there); compare real pages
+    np.testing.assert_array_equal(np.asarray(ks)[:, 1:], np.asarray(kd)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(vs)[:, 1:], np.asarray(vd)[:, 1:])
+
+
 def test_cache_config_accounting():
     cc = CacheConfig(num_layers=2, num_kv_heads=4, head_dim=8,
                      num_pages=16, page_size=8, pages_per_slot=4, dtype="bfloat16")

@@ -333,6 +333,65 @@ def test_gguf_tokenizer_spm_semantics():
     assert tok.eos_ids == {2}
 
 
+def test_gguf_chat_template_from_metadata():
+    """``tokenizer.chat_template`` GGUF metadata drives chat formatting
+    (Phi-3-style <|user|>/<|end|>/<|assistant|> markers — the reference's
+    documented local model, reference ramalama-models/README.md:102-107);
+    control-token literals in the rendered text map to their single vocab
+    ids rather than being SPM-merged or byte-fallback-mangled."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import GGUFTokenizer
+
+    tokens = ["<unk>", "<s>", "</s>", "<|user|>", "<|assistant|>", "<|end|>"]
+    scores = [0.0] * len(tokens)
+    types = [2, 3, 3, 3, 3, 3]
+    for b in range(256):
+        tokens.append(f"<0x{b:02X}>")
+        scores.append(0.0)
+        types.append(6)
+    for t, s in [("h", -10.0), ("i", -10.0), ("hi", -1.0), ("▁", -5.0)]:
+        tokens.append(t)
+        scores.append(s)
+        types.append(1)
+    phi3_template = (
+        "{% for message in messages %}"
+        "{{'<|' + message['role'] + '|>' + '\n' + message['content'] + "
+        "'<|end|>' + '\n'}}{% endfor %}"
+        "{% if add_generation_prompt %}{{ '<|assistant|>\n' }}{% endif %}"
+    )
+    md = {
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.scores": scores,
+        "tokenizer.ggml.token_type": types,
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+        "tokenizer.chat_template": phi3_template,
+    }
+    tok = GGUFTokenizer(md)
+    ids = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    t = [tok.tokens[i] for i in ids]
+    assert t[0] == "<s>"                      # add_bos prepends exactly once
+    assert t[1] == "<|user|>"                 # control literal -> single id
+    assert "hi" in t                          # content still SPM-merged
+    assert t[-1] == "<0x0A>"                  # trailing newline of the prompt
+    assert t[-2] == "<|assistant|>"           # generation prompt appended
+    assert t.count("<|end|>") == 1
+
+    # without the metadata key the generic [INST] fallback still works
+    md2 = dict(md)
+    del md2["tokenizer.chat_template"]
+    tok2 = GGUFTokenizer(md2)
+    ids2 = tok2.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert "[INST]" in tok2.decode(ids2)
+
+    # a malformed template falls back instead of failing the request
+    md3 = dict(md)
+    md3["tokenizer.chat_template"] = "{% bogus syntax %}"
+    tok3 = GGUFTokenizer(md3)
+    ids3 = tok3.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert "[INST]" in tok3.decode(ids3)
+
+
 def test_gguf_tokenizer_loaded_from_file(tmp_path):
     """A GGUF file with embedded vocab yields a working tokenizer via
     load_tokenizer(path.gguf)."""

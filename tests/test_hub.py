@@ -118,6 +118,52 @@ def test_incomplete_snapshots_do_not_resolve(tmp_path):
         resolve_model_dir("acme/m3", cache_dir=str(tmp_path))
 
 
+def test_tokenizerless_snapshot_grandfathered_when_offline(tmp_path, monkeypatch):
+    """A weights-complete but tokenizer-less snapshot (hand-populated PVC,
+    or one written by a pre-tokenizer-check release) must still serve when
+    no download can fetch the missing artifacts (round-2 advisor finding:
+    it previously failed startup offline). When the Hub IS reachable the
+    resume download still runs and wins."""
+    snap = _fake_snapshot(str(tmp_path), "acme/old-pvc")
+    (snap / "tokenizer_config.json").unlink()
+
+    # Hub unreachable: grandfathered with a warning
+    def offline(*a, **k):
+        raise OSError("no egress")
+
+    monkeypatch.setattr(hub, "download_snapshot", offline)
+    assert hub.ensure_model_dir("acme/old-pvc", cache_dir=str(tmp_path)) == str(snap)
+
+    # Hub reachable: the resume download completes the snapshot instead
+    def finish(repo_id, cache_dir=None, token=None):
+        (snap / "tokenizer_config.json").write_text("{}")
+
+    monkeypatch.setattr(hub, "download_snapshot", finish)
+    assert hub.ensure_model_dir("acme/old-pvc", cache_dir=str(tmp_path)) == str(snap)
+    assert (snap / "tokenizer_config.json").is_file()
+
+    # a ref that maps to no repo id (plain dir-style name) also serves a
+    # grandfathered snapshot rather than raising
+    snap2 = _fake_snapshot(str(tmp_path), "not-a-registry-name")
+    (snap2 / "tokenizer_config.json").unlink()
+    monkeypatch.setattr(hub, "download_snapshot",
+                        lambda *a, **k: pytest.fail("must not download"))
+    assert hub.ensure_model_dir("not-a-registry-name",
+                                cache_dir=str(tmp_path)) == str(snap2)
+
+
+def test_tokenizerless_repo_grandfathered_when_hub_reachable(tmp_path, monkeypatch):
+    """Hub ONLINE but the repo itself ships no tokenizer artifact: the
+    download is a no-op and the weights-complete snapshot must still serve
+    (same grandfather rule as offline — a reachable Hub must not make a
+    deployment fail that works with egress cut)."""
+    snap = _fake_snapshot(str(tmp_path), "acme/no-tok-repo")
+    (snap / "tokenizer_config.json").unlink()
+    monkeypatch.setattr(hub, "download_snapshot", lambda *a, **k: None)
+    assert hub.ensure_model_dir("acme/no-tok-repo",
+                                cache_dir=str(tmp_path)) == str(snap)
+
+
 def test_resolution_honors_hf_hub_cache_env(tmp_path, monkeypatch):
     """HF_HUB_CACHE (PVC mount) must steer resolution the same as download."""
     from llms_on_kubernetes_tpu.engine.weights import hf_hub_cache

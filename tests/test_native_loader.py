@@ -84,10 +84,44 @@ def test_weights_py_uses_native_path(lib, tmp_path, monkeypatch):
     want = _write_checkpoint(tmp_path)
     loaders = _open_safetensors(str(tmp_path))
     # native loaders close over _NativeShards; python ones over safe_open
-    sample = next(iter(loaders.values()))
+    sample = next(iter(loaders.values())).__wrapped__
     assert type(sample.__defaults__[0]).__name__ == "_NativeShards"
     got = loaders["model.embed.weight"]()
     np.testing.assert_array_equal(got, want["model.embed.weight"])
+
+
+def test_native_reads_fp8_tensors(lib, tmp_path):
+    """F8_E4M3 safetensors (compressed-tensors FP8 checkpoints, the
+    reference's default gemma-3 FP8-Dynamic model) read natively —
+    round-2 review finding: previously a raw KeyError."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((8, 16)).astype(ml_dtypes.float8_e4m3fn)
+    s = rng.standard_normal((8, 1)).astype(np.float32)
+    save_file({"w": w, "s": s}, str(tmp_path / "model.safetensors"))
+    loaders = lib.open_native_safetensors(str(tmp_path))
+    got = loaders["w"]()
+    assert got.dtype == np.dtype(ml_dtypes.float8_e4m3fn)
+    np.testing.assert_array_equal(got.view(np.uint8), w.view(np.uint8))
+
+
+def test_unknown_dtype_falls_back_to_python(lib, tmp_path, monkeypatch):
+    """A dtype the native bridge can't map must drop to the Python reader
+    for that tensor, not fail the whole load."""
+    from llms_on_kubernetes_tpu.engine import native_loader as nl
+    from llms_on_kubernetes_tpu.engine.weights import _open_safetensors
+
+    want = _write_checkpoint(tmp_path)
+    # simulate an unmappable dtype by blanking the F32 mapping
+    monkeypatch.setattr(nl, "_DTYPES",
+                        {k: v for k, v in nl._DTYPES.items() if k != "F32"})
+    loaders = _open_safetensors(str(tmp_path))
+    got = loaders["model.embed.weight"]()  # F32 -> python fallback
+    np.testing.assert_array_equal(got, want["model.embed.weight"])
+    got2 = loaders["model.layers.0.w.weight"]()  # F16 still native
+    np.testing.assert_array_equal(got2, want["model.layers.0.w.weight"])
 
 
 def test_env_kill_switch(lib, tmp_path, monkeypatch):

@@ -207,6 +207,49 @@ def test_stop_sequence_streaming():
     with_client(body)
 
 
+def test_stop_checker_earliest_match_wins():
+    """With stop=["b","a"] and text "a...b", output truncates at "a" — the
+    EARLIEST occurrence in the text, not the first stop in list order
+    (OpenAI semantics; round-2 review finding)."""
+    from llms_on_kubernetes_tpu.server.openai_api import StopChecker
+
+    sc = StopChecker(["b", "a"])
+    out, hit = sc.push("xya__b", final=True)
+    assert hit and out == "xy"
+
+    # same rule when the earlier-in-text stop arrives in an earlier delta
+    sc = StopChecker(["bb", "aa"])
+    out1, hit1 = sc.push("zzaa")
+    assert hit1 and out1 == "zz"
+
+    # and when both land in ONE delta with overlapping holdback windows
+    sc = StopChecker(["cd", "ab"])
+    out, hit = sc.push("__abcd")
+    assert hit and out == "__"
+
+    # cross-delta: a short stop completing first must NOT preempt a
+    # longer stop that started earlier and completes in the next delta
+    sc = StopChecker(["abc", "b"])
+    out1, hit1 = sc.push("ab")
+    assert not hit1 and out1 == ""          # deferred, nothing emitted
+    out2, hit2 = sc.push("c")
+    assert hit2 and out1 + out2 == ""       # truncated at "abc" (idx 0)
+
+    # ...but when the longer candidate fails to complete, the short stop
+    # fires at its own (earliest actual) index
+    sc = StopChecker(["abc", "b"])
+    sc.push("ab")
+    out, hit = sc.push("x")
+    assert hit and out == "a"               # truncated at "b" (idx 1)
+
+    # ...and at final, a pending prefix can no longer complete: the
+    # completed match wins
+    sc = StopChecker(["abc", "b"])
+    sc.push("ab")
+    out, hit = sc.push("", final=True)
+    assert hit and out == "a"
+
+
 def test_completions_list_of_prompts():
     """A list of string prompts yields one indexed choice per prompt
     (review finding: previously dropped all but the first)."""

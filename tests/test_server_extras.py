@@ -162,6 +162,15 @@ def test_echo_prepends_prompt():
         assert r.status == 200
         text = (await r.json())["choices"][0]["text"]
         assert text.startswith("hello")
+        # echo+logprobs would need prompt-token logprobs (OpenAI includes
+        # them, first entry null); rejected explicitly rather than
+        # returning a silently partial logprobs block
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "hello", "max_tokens": 2,
+            "temperature": 0, "echo": True, "logprobs": 1,
+        })
+        assert r.status == 400
+        assert "echo with logprobs" in (await r.json())["error"]["message"]
     with_client(body)
 
 
