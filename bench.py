@@ -149,8 +149,25 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     lt.start()
     time.sleep(0.2)  # let the load reach the decode batch
 
-    ttfts = []
+    # instrument the engine side of each probe: wrap submit so the probe's
+    # Request object (submitted_at / first_token_at) is observable — the
+    # client-vs-engine TTFT split says whether latency is the scheduler or
+    # the HTTP/asyncio path
+    probe_reqs = []
+    real_submit = server.loop_thread.submit
+
+    def tracking_submit(*a, **kw):
+        req = real_submit(*a, **kw)
+        # background-load submissions arrive concurrently while this hook
+        # is installed; only the probe (stream, max_tokens=8) counts
+        if req.params.max_tokens == 8:
+            probe_reqs.append(req)
+        return req
+
+    ttfts, engine_ttfts = [], []
     for _ in range(4):
+        server.loop_thread.submit = tracking_submit
+        probe_reqs.clear()
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
         t1 = time.monotonic()
         conn.request("POST", "/v1/completions", body(8, True),
@@ -159,9 +176,13 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         # first decoded byte through both hops = TTFT
         first = resp.read(1)
         ttfts.append(time.monotonic() - t1)
+        server.loop_thread.submit = real_submit
         rest = first + resp.read()
         assert b"data:" in rest, rest[:120]
         conn.close()
+        for r in probe_reqs:
+            if r.first_token_at:
+                engine_ttfts.append(r.first_token_at - r.submitted_at)
     load_done.wait(timeout=300)
     load_wall = load_wall_box.get("wall", float("inf"))
 
@@ -169,8 +190,14 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         loop_holder["loop"].call_soon_threadsafe(stop.set)
     t.join(timeout=30)
     ttfts.sort()
+    engine_ttfts.sort()
     return {
         "gateway_p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+        # the same probes measured inside the engine (submit -> first
+        # token); the difference to the number above is the HTTP/asyncio
+        # delivery path
+        "gateway_engine_p50_ttft_ms": round(
+            1000 * engine_ttfts[len(engine_ttfts) // 2], 1) if engine_ttfts else None,
         "gateway_tokens_per_sec": round(n_load * gen / load_wall, 1),
         # This dev environment reaches the TPU through a tunnel with a
         # ~110 ms flat device->host read RTT; amortizing it needs a deep
@@ -213,9 +240,13 @@ def main() -> int:
             num_pages=slots * (512 // page) + 1,
             prefill_buckets=(64,),
             # deep pipeline: the driver's TPU is behind a tunnel with a
-            # ~100 ms host<->device round trip; 8 in-flight steps amortize
-            # one batched harvest read across 7 decode steps
+            # ~100 ms host<->device round trip; 8 in-flight steps keep the
+            # device fed while the harvester threads wait out the reads
             async_depth=int(os.environ.get("BENCH_DEPTH", "8")),
+            # device-queue pacing (opt-in experiment; 0 = off — the
+            # busy-until estimate feeds back through the completion-rate
+            # EMA and can stall the pipeline when reads are the bottleneck)
+            pace_target_steps=float(os.environ.get("BENCH_PACE", "0")),
         )
         prompt_len, gen_len = 32, int(os.environ.get("BENCH_GEN", "128"))
     else:  # small-model fallback for CPU dev runs

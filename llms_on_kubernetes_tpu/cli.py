@@ -37,8 +37,14 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--sequence-parallel-size", "--sp", type=int, default=1,
                    help="context-parallel ring size for long prompts "
                         "(prefill runs ring attention over the 'seq' axis)")
-    p.add_argument("--quantization", choices=["int8"], default=None,
-                   help="weight-only int8 (FP8/AWQ-checkpoint parity path)")
+    p.add_argument("--quantization", choices=["int8", "fp8", "awq"],
+                   default=None,
+                   help="int8: weight-only quantize a bf16 checkpoint; "
+                        "fp8/awq: assert the checkpoint is that pre-"
+                        "quantized format (auto-detected otherwise)")
+    p.add_argument("--no-prefix-caching", dest="prefix_caching",
+                   action="store_false", default=True,
+                   help="disable page-level reuse of shared prompt prefixes")
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -197,6 +203,7 @@ def main(argv: list[str] | None = None) -> int:
         pages_per_slot=args.pages_per_slot,
         prefill_buckets=tuple(int(x) for x in args.prefill_buckets.split(",")),
         quantization=args.quantization,
+        prefix_caching=args.prefix_caching,
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
     )

@@ -60,6 +60,15 @@ class ModelConfig:
     # excluded from __hash__ (dicts are unhashable; configs are jit static args)
     rope_scaling: Optional[dict] = dataclasses.field(default=None, hash=False)
     dtype: str = "bfloat16"
+    # multimodal (gemma-3-style): a vision tower + projector produce
+    # `vision.mm_tokens_per_image` soft tokens per image, substituted at
+    # `image_token_id` positions in the prompt; the chat server splices
+    # boi -> [boi, soft*N, eoi] (models/vision.py). Frozen dataclass, so
+    # the config stays hashable for jit static args.
+    vision: "Optional[Any]" = None          # models.vision.VisionConfig
+    image_token_id: Optional[int] = None    # the soft-token placeholder id
+    boi_token_id: Optional[int] = None      # begin-of-image marker
+    eoi_token_id: Optional[int] = None      # end-of-image marker
 
     @property
     def q_dim(self) -> int:
@@ -330,6 +339,24 @@ _register(
 )
 
 
+def _debug_mm() -> ModelConfig:
+    from llms_on_kubernetes_tpu.models.vision import VisionConfig
+
+    return ModelConfig(
+        "debug-mm",
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512,
+        vision=VisionConfig(hidden_size=16, intermediate_size=32,
+                            num_layers=1, num_heads=2, image_size=16,
+                            patch_size=4, mm_tokens_per_image=4),
+        image_token_id=260, boi_token_id=258, eoi_token_id=259,
+    )
+
+
+_register(_debug_mm())
+
+
 def get_config(name: str) -> ModelConfig:
     key = name if name in REGISTRY else ALIASES.get(name.lower(), name)
     if key not in REGISTRY:
@@ -349,6 +376,7 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
     if isinstance(hf, str):
         with open(hf) as f:
             hf = json.load(f)
+    outer = hf  # multimodal wrappers keep vision/image-token info out here
     # gemma3 wraps the text config
     if "text_config" in hf and isinstance(hf["text_config"], dict):
         merged = dict(hf["text_config"])
@@ -430,4 +458,23 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
             kw["qk_norm"] = True
             kw["sliding_window_pattern"] = int(hf.get("sliding_window_pattern", 6))
             kw["rope_local_theta"] = float(hf.get("rope_local_base_freq", 10000.0))
+    # multimodal wrapper (gemma3): vision tower + image token ids
+    vc = outer.get("vision_config")
+    if isinstance(vc, dict) and outer.get("model_type") == "gemma3":
+        from llms_on_kubernetes_tpu.models.vision import VisionConfig
+
+        kw["vision"] = VisionConfig(
+            hidden_size=int(vc.get("hidden_size", 1152)),
+            intermediate_size=int(vc.get("intermediate_size", 4304)),
+            num_layers=int(vc.get("num_hidden_layers", 27)),
+            num_heads=int(vc.get("num_attention_heads", 16)),
+            image_size=int(vc.get("image_size", 896)),
+            patch_size=int(vc.get("patch_size", 14)),
+            num_channels=int(vc.get("num_channels", 3)),
+            layer_norm_eps=float(vc.get("layer_norm_eps", 1e-6)),
+            mm_tokens_per_image=int(outer.get("mm_tokens_per_image", 256)),
+        )
+        kw["image_token_id"] = int(outer.get("image_token_index", 262144))
+        kw["boi_token_id"] = int(outer.get("boi_token_index", 255999))
+        kw["eoi_token_id"] = int(outer.get("eoi_token_index", 256000))
     return ModelConfig(**kw)

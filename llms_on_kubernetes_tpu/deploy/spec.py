@@ -105,7 +105,7 @@ class ModelSpec:
                                            # deadlock, SURVEY §5 Checkpoint)
     tpu: Optional[TPUSpec] = dataclasses.field(default_factory=TPUSpec)
     sharding: ShardingSpec = dataclasses.field(default_factory=ShardingSpec)
-    quantization: Optional[str] = None     # None | int8
+    quantization: Optional[str] = None     # None | int8 | fp8 | awq
     max_model_len: int = 4096
     engine_args: tuple[str, ...] = ()      # passthrough (reference gap)
     # free-form k8s resources for CPU/local models (the ramalama chart's
@@ -125,7 +125,7 @@ class ModelSpec:
             )
         if self.replicas < 1:
             raise SpecError(f"model {self.model_name}: replicas must be >= 1")
-        if self.quantization not in (None, "int8"):
+        if self.quantization not in (None, "int8", "fp8", "awq"):
             raise SpecError(
                 f"model {self.model_name}: unknown quantization "
                 f"{self.quantization!r}"

@@ -196,35 +196,74 @@ def _write_tokens_scatter(k_pages, v_pages, k, v, page_table, positions):
 
 
 class PageAllocator:
-    """Host-side free-list allocator over the physical page pool.
+    """Host-side refcounting allocator over the physical page pool, with
+    optional hash-chained PREFIX CACHING (the vLLM-image capability the
+    reference relied on, SURVEY §2.3 row 1).
 
     Page 0 is reserved (trash). ``allocate`` grows a slot's page list to
-    cover ``num_tokens``; ``free`` returns a slot's pages to the pool.
+    cover ``num_tokens``; ``free`` releases a slot's references.
+
+    Prefix caching: each FULL page of a prompt gets a digest chained over
+    every token up to and including that page (sha256 — exact-match, no
+    collision handling needed at 2^-128). ``match_prefix`` finds the
+    longest cached chain; ``adopt_prefix`` maps those shared pages into a
+    slot's table (read-only — the adopting request writes only at
+    positions past the cached prefix, which land in later, private
+    pages); ``register_prefix`` publishes a slot's freshly written prompt
+    pages. Pages keep their content after the last reference drops: they
+    move to an LRU of evictable cached pages and are reclaimed only when
+    the free list runs dry.
     """
 
-    def __init__(self, num_pages: int, page_size: int, num_slots: int, pages_per_slot: int):
+    def __init__(self, num_pages: int, page_size: int, num_slots: int,
+                 pages_per_slot: int, prefix_caching: bool = False):
         self.page_size = page_size
         self.pages_per_slot = pages_per_slot
         self.num_slots = num_slots
+        self.prefix_caching = prefix_caching
         self.free_pages: list[int] = list(range(num_pages - 1, 0, -1))  # page 0 reserved
         # page_tables[s] is the authoritative host copy; unused entries point
         # at the trash page 0 (never read thanks to length masking).
         self.page_tables = np.zeros((num_slots, pages_per_slot), dtype=np.int32)
         self.slot_pages: list[list[int]] = [[] for _ in range(num_slots)]
+        self.refcount: dict[int, int] = {}
+        self._prefix_map: dict[bytes, int] = {}   # digest -> page id
+        self._page_digest: dict[int, bytes] = {}  # page id -> digest
+        # refcount-0 pages whose content is still a valid cached prefix,
+        # oldest-released first (python dicts preserve insertion order)
+        self._lru: dict[int, None] = {}
+        self.hit_tokens_total = 0  # metrics: prompt tokens served from cache
 
     @property
     def num_free_pages(self) -> int:
         return len(self.free_pages)
+
+    @property
+    def num_evictable_pages(self) -> int:
+        return len(self._lru)
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
 
     def can_allocate(self, slot: int, num_tokens: int) -> bool:
         need = self.pages_needed(num_tokens) - len(self.slot_pages[slot])
-        return need <= len(self.free_pages) and self.pages_needed(num_tokens) <= self.pages_per_slot
+        return (need <= len(self.free_pages) + len(self._lru)
+                and self.pages_needed(num_tokens) <= self.pages_per_slot)
+
+    def _take_page(self) -> int:
+        if self.free_pages:
+            return self.free_pages.pop()
+        if self._lru:  # evict the oldest cached page
+            p = next(iter(self._lru))
+            del self._lru[p]
+            d = self._page_digest.pop(p, None)
+            if d is not None and self._prefix_map.get(d) == p:
+                del self._prefix_map[d]
+            return p
+        raise MemoryError("KV page pool exhausted")
 
     def allocate(self, slot: int, num_tokens: int) -> None:
-        """Ensure the slot owns enough pages to hold num_tokens tokens."""
+        """Ensure the slot holds enough pages to cover num_tokens tokens."""
         need = self.pages_needed(num_tokens)
         if need > self.pages_per_slot:
             raise ValueError(
@@ -233,14 +272,97 @@ class PageAllocator:
             )
         have = len(self.slot_pages[slot])
         for i in range(have, need):
-            if not self.free_pages:
-                raise MemoryError("KV page pool exhausted")
-            p = self.free_pages.pop()
+            p = self._take_page()
+            self.refcount[p] = 1
             self.slot_pages[slot].append(p)
             self.page_tables[slot, i] = p
 
     def free(self, slot: int) -> None:
         for p in self.slot_pages[slot]:
-            self.free_pages.append(p)
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                del self.refcount[p]
+                if p in self._page_digest:
+                    self._lru[p] = None  # cached: evictable, content kept
+                else:
+                    self.free_pages.append(p)
         self.slot_pages[slot] = []
         self.page_tables[slot, :] = 0
+
+    # -- prefix caching ----------------------------------------------------
+
+    def _digests(self, tokens) -> list[bytes]:
+        """Chained digest per FULL page of ``tokens``."""
+        import hashlib
+
+        out = []
+        prev = b""
+        for i in range(len(tokens) // self.page_size):
+            chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
+            h = hashlib.sha256(prev)
+            h.update(np.asarray(chunk, np.int64).tobytes())
+            prev = h.digest()
+            out.append(prev)
+        return out
+
+    def _match_digests(self, tokens) -> list[int]:
+        """Page ids of the longest cached prefix — ONE incremental pass
+        with early stop at the first miss (an EMA of full-prompt sha256
+        passes per admission attempt would be pure waste: a blocked
+        admission retries every engine iteration). Capped so at least one
+        token remains to prefill (its logits seed sampling)."""
+        if not self.prefix_caching or len(tokens) <= self.page_size:
+            return []
+        import hashlib
+
+        cap_pages = (len(tokens) - 1) // self.page_size
+        pages: list[int] = []
+        prev = b""
+        for i in range(cap_pages):
+            chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
+            h = hashlib.sha256(prev)
+            h.update(np.asarray(chunk, np.int64).tobytes())
+            prev = h.digest()
+            p = self._prefix_map.get(prev)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def match_prefix(self, tokens) -> int:
+        """Longest cached prefix of ``tokens`` in TOKENS."""
+        return len(self._match_digests(tokens)) * self.page_size
+
+    def adopt_prefix(self, slot: int, tokens) -> int:
+        """Map the longest cached prefix into ``slot``'s table (increfs the
+        shared pages). Must be called before ``allocate`` grows the slot.
+        Returns the number of cached tokens adopted."""
+        pages = self._match_digests(tokens)
+        if not pages:
+            return 0
+        assert not self.slot_pages[slot], "adopt_prefix on a non-empty slot"
+        for i, p in enumerate(pages):
+            self.refcount[p] = self.refcount.get(p, 0) + 1
+            self._lru.pop(p, None)  # referenced again: not evictable
+            self.slot_pages[slot].append(p)
+            self.page_tables[slot, i] = p
+        hit = len(pages) * self.page_size
+        self.hit_tokens_total += hit
+        return hit
+
+    def register_prefix(self, slot: int, tokens) -> None:
+        """Publish ``slot``'s pages holding full pages of ``tokens`` so
+        later prompts with the same prefix can adopt them."""
+        if not self.prefix_caching:
+            return
+        for i, d in enumerate(self._digests(tokens)):
+            if i >= len(self.slot_pages[slot]):
+                break
+            if d in self._prefix_map:
+                continue  # identical prefix already cached (dedup)
+            p = self.slot_pages[slot][i]
+            old = self._page_digest.get(p)
+            if old is not None and old != d:
+                continue  # page already published under another digest
+            self._prefix_map[d] = p
+            self._page_digest[p] = d

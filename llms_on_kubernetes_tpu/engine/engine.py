@@ -85,15 +85,26 @@ class EngineConfig:
     multihost: bool = False
     # async (pipelined) scheduling: keep up to async_depth decode steps in
     # flight, feeding each step's on-device sampled tokens straight into the
-    # next launch and harvesting host copies afterwards — hides the
-    # host<->device round trip behind device compute (vLLM-style async
-    # scheduling, re-done for JAX's dispatch model). Finishes/stop tokens
-    # are detected one harvest late; the speculative extra step is harmless
-    # (its writes land in pages that are only reused after device-ordered
-    # completion). Works under multihost too: the packed broadcast tells
-    # followers which device-resident token reference feeds each merge.
+    # next launch; host copies are read by a dedicated harvester thread in
+    # batched device_gets, so the ENGINE thread never blocks on device
+    # work except for backpressure at full depth — admissions and their
+    # prefills dispatch immediately (vLLM-style async scheduling, re-done
+    # for JAX's dispatch model). Finishes/stop tokens are detected a
+    # transfer-latency late; the speculative extra steps are harmless
+    # (their writes land in pages that are only reused after
+    # device-ordered completion). Works under multihost too: the packed
+    # broadcast tells followers which device-resident token reference
+    # feeds each merge.
     async_scheduling: bool = True
     async_depth: int = 2
+    # device-queue pacing (0 = off): don't dispatch a decode step when the
+    # estimated undone device work already exceeds this many step-times.
+    # The pipeline cap (async_depth) bounds SPECULATION; this bounds the
+    # DEVICE QUEUE — the thing a newly admitted request's prefill waits
+    # behind. Set to ~(one-way dispatch latency / step time) + 1..2: big
+    # enough that the device never starves, small enough that TTFT ≈ a
+    # couple of step times + prefill + read latency.
+    pace_target_steps: float = 0.0
     # async admission: up to this many same-bucket waiting requests prefill
     # together in one [K, bucket] call (padded to exactly 1 or admit_batch
     # rows so each bucket compiles two executables, not one per K)
@@ -102,6 +113,14 @@ class EngineConfig:
     # waiting requests (HTTP 429 upstream) — an unbounded queue lets a
     # burst pin memory and inflate TTFT without bound
     max_waiting: int = 256
+    # prefix caching: full pages of a prompt already computed by an earlier
+    # request are adopted instead of re-prefilled (page-level hash-chained
+    # reuse — the vLLM-image capability, SURVEY §2.3 row 1); the remainder
+    # prefills through the chunk path with history = the cached length
+    prefix_caching: bool = True
+    # multimodal: images per request the mm-prefill executable is compiled
+    # for (requests with more are rejected at submit)
+    max_images_per_request: int = 1
     seed: int = 0
 
     @property
@@ -114,6 +133,9 @@ class Request:
     id: str
     prompt: list[int]
     params: SamplingParams
+    # multimodal: preprocessed pixels [n_images, H, W, C] float32; the
+    # prompt carries matching image-soft-token runs (cfg.image_token_id)
+    images: Optional[Any] = None
     # resolved sampling seed (user's params.seed, or engine-drawn): the
     # request's sampled stream is fold(base_key, seed, position) — a pure
     # function of the request, never of batch composition or preemption
@@ -130,6 +152,7 @@ class Request:
     finished: bool = False
     finish_reason: Optional[str] = None
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
+    admitted_at: Optional[float] = None  # prefill dispatched (TTFT breakdown)
     first_token_at: Optional[float] = None
     events: "queue.SimpleQueue[tuple[list[int], bool, Optional[str]]]" = dataclasses.field(
         default_factory=queue.SimpleQueue
@@ -154,11 +177,146 @@ class InflightStep:
     """A launched-but-unharvested decode step (async scheduling)."""
     res: Any                               # device SampleResult
     active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
-    prefetched: bool = False               # copy_to_host_async() issued
+    seq: int = -1                          # harvester sequence number
 
     @property
     def toks(self):
         return self.res.tokens
+
+
+class _Harvester(threading.Thread):
+    """Off-thread device->host reader for async scheduling.
+
+    The engine thread pushes device SampleResults in dispatch order; this
+    thread reads them with batched ``jax.device_get`` calls (one tunnel
+    round trip amortized over everything completed) and marks them done.
+    The engine thread polls ``is_done``/``get`` without ever blocking on
+    device work — so a newly submitted request is admitted and its prefill
+    dispatched IMMEDIATELY, instead of queueing behind a blocking read of
+    ``async_depth`` in-flight decode steps (the round-2 gateway-TTFT
+    finding). All engine state stays on the engine thread; this thread
+    touches only device arrays and the results dict.
+
+    Two classes of work:
+    - decode steps (non-negative dense seqs): read oldest-first in small
+      batches; done-ness is monotone (``is_done(s)`` implies every earlier
+      step is done), so the engine harvests a strict prefix each step.
+    - PRIORITY items (prefill results carrying first tokens, negative
+      keys): jump the read queue and are read in their own small batches —
+      a new request's TTFT must not wait behind a batch of decode-step
+      reads it doesn't depend on."""
+
+    def __init__(self, readers: Optional[int] = None,
+                 batch: Optional[int] = None):
+        import os
+        super().__init__(daemon=True, name="engine-harvester")
+        self._cv = threading.Condition()
+        self._pending: "collections.deque[tuple[int, Any]]" = collections.deque()
+        self._prio: "collections.deque[tuple[int, Any]]" = collections.deque()
+        self._staged: dict[int, Any] = {}   # read but predecessors not done
+        self._done: dict[int, Any] = {}     # steps (dense prefix) + priority
+        self._done_upto = -1
+        self._next_seq = 0                  # next step seq to mark done
+        self._stopping = False
+        # small batches + overlapped readers: one huge batched read would
+        # couple every completion to the newest dispatch and mark done in
+        # lumps; overlapping 2+ reads pipelines the tunnel RTT instead
+        self._batch = batch if batch is not None else int(
+            os.environ.get("LLMK_HARVEST_BATCH", "4"))
+        self._readers = readers if readers is not None else int(
+            os.environ.get("LLMK_HARVEST_READERS", "2"))
+        self._extra: list[threading.Thread] = []
+
+    def start(self) -> None:  # type: ignore[override]
+        super().start()
+        for i in range(self._readers - 1):
+            t = threading.Thread(target=self.run, daemon=True,
+                                 name=f"engine-harvester-{i + 1}")
+            t.start()
+            self._extra.append(t)
+
+    def push(self, key: int, res: Any, priority: bool = False) -> None:
+        _start_host_copy(res)  # transfer overlaps with device compute
+        with self._cv:
+            (self._prio if priority else self._pending).append((key, res))
+            self._cv.notify_all()
+
+    def run(self) -> None:
+        while True:
+            with self._cv:
+                while not (self._pending or self._prio) and not self._stopping:
+                    self._cv.wait()
+                if self._stopping and not (self._pending or self._prio):
+                    return
+                if self._prio:
+                    batch = list(self._prio)
+                    self._prio.clear()
+                    priority = True
+                else:
+                    n = min(max(1, self._batch), len(self._pending))
+                    batch = [self._pending.popleft() for _ in range(n)]
+                    priority = False
+            host = jax.device_get([r for _, r in batch])
+            with self._cv:
+                if priority:
+                    for (key, _), h in zip(batch, host):
+                        self._done[key] = h
+                else:
+                    for (seq, _), h in zip(batch, host):
+                        self._staged[seq] = h
+                    # done-ness stays a dense seq prefix even with
+                    # overlapped readers finishing out of order
+                    while self._next_seq in self._staged:
+                        self._done[self._next_seq] = self._staged.pop(
+                            self._next_seq)
+                        self._done_upto = self._next_seq
+                        self._next_seq += 1
+                self._cv.notify_all()
+
+    def is_done(self, seq: int) -> bool:
+        return seq <= self._done_upto
+
+    def key_done(self, key: int) -> bool:
+        return key in self._done
+
+    def get(self, key: int) -> Any:
+        with self._cv:
+            return self._done[key]
+
+    def wait_done(self, seq: int, wake: Optional[threading.Event] = None) -> None:
+        """Block until step ``seq`` is done — or, if ``wake`` is given,
+        until it is set (a new submission wants admission NOW — submit()
+        pokes this cv; the caller re-enters its loop and the next step()
+        admits before waiting again)."""
+        with self._cv:
+            while self._done_upto < seq:
+                if wake is not None and wake.is_set():
+                    return
+                self._cv.wait()
+
+    def poke(self) -> None:
+        """Wake any wait_done(wake=...) waiter (called from submit())."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def wait_key(self, key: int) -> None:
+        with self._cv:
+            while key not in self._done:
+                self._cv.wait()
+
+    def discard_upto(self, seq: int) -> None:
+        with self._cv:
+            for s in [s for s in self._done if 0 <= s <= seq]:
+                del self._done[s]
+
+    def discard_key(self, key: int) -> None:
+        with self._cv:
+            self._done.pop(key, None)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
 
 
 def _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row):
@@ -181,10 +339,12 @@ def _count_decode_tokens(counts, tokens, active):
     return counts
 
 
-def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths):
+def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths,
+                        reset):
     """Rebuild per-slot output-token counts from a prefill/chunk batch.
 
-    Row semantics: a first chunk (history==0) resets the slot's counts; a
+    Row semantics: a request's FIRST chunk (reset[r] != 0 — history may be
+    nonzero when a cached prefix was adopted) resets the slot's counts; a
     continuation accumulates. Only tokens at global positions >=
     prompt_len count (penalties cover OUTPUT tokens — vLLM semantics);
     that's non-empty exactly for resumed (preempted) re-prefills, whose
@@ -198,7 +358,7 @@ def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths):
         contrib = jnp.zeros((V,), counts.dtype).at[tokens[r]].add(
             out_mask, mode="drop")
         existing = jax.lax.dynamic_slice(counts, (slots[r], 0), (1, V))[0]
-        row = jnp.where(history[r] == 0, 0, existing) + contrib
+        row = jnp.where(reset[r] != 0, 0, existing) + contrib
         # idle/padded rows (lengths 0) keep their slot's counts untouched
         row = jnp.where(lengths[r] > 0, row, existing)
         counts = jax.lax.dynamic_update_slice(
@@ -251,6 +411,37 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
 _PRE_COLS = 9
 
 
+def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
+                            k_pages, v_pages, counts, base_key):
+    """Multimodal prefill ([1, bucket]): image soft-token embeddings are
+    substituted inside forward_prefill_mm; sampling/penalties identical
+    to the text prefill."""
+    from llms_on_kubernetes_tpu.models.decoder import forward_prefill_mm
+
+    lengths = packed[:, 0]
+    top_ks = packed[:, 1]
+    temps = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
+    seeds = packed[:, 4]
+    presence = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
+    slots = packed[:, 7]
+    prompt_len = packed[:, 8]
+    page_table = packed[:, _PRE_COLS:]
+
+    counts = _rebuild_count_rows(
+        counts, tokens, slots, jnp.zeros_like(lengths), prompt_len, lengths,
+        jnp.ones_like(lengths))
+    logits, k_pages, v_pages = forward_prefill_mm(
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+        img_embeds,
+    )
+    keys = _slot_keys(base_key, seeds, lengths)
+    res = sample(logits, keys, temps, top_ks, top_ps,
+                 penalties=(presence, frequency, counts[slots]))
+    return res, k_pages, v_pages, counts
+
+
 def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
                          counts, base_key):
     lengths = packed[:, 0]
@@ -265,7 +456,8 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     page_table = packed[:, _PRE_COLS:]
 
     counts = _rebuild_count_rows(
-        counts, tokens, slots, jnp.zeros_like(lengths), prompt_len, lengths)
+        counts, tokens, slots, jnp.zeros_like(lengths), prompt_len, lengths,
+        jnp.ones_like(lengths))
     logits, k_pages, v_pages = forward_prefill(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table
     )
@@ -278,10 +470,11 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
 
 # packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
 # 4 top_p(bits), 5 seed, 6 presence(bits), 7 frequency(bits), 8 slot,
-# 9 prompt_len, 10.. page_table. Sampling position is the TOTAL length
-# (history + chunk_len) so a chunked prompt draws exactly the tokens a
-# one-shot prefill of the same prompt would.
-_CHK_COLS = 10
+# 9 prompt_len, 10 reset (first chunk of the request — history may be
+# nonzero when a cached prefix was adopted), 11.. page_table. Sampling
+# position is the TOTAL length (history + chunk_len) so a chunked prompt
+# draws exactly the tokens a one-shot prefill of the same prompt would.
+_CHK_COLS = 11
 
 
 def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
@@ -296,10 +489,11 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     frequency = jax.lax.bitcast_convert_type(packed[:, 7], jnp.float32)
     slots = packed[:, 8]
     prompt_len = packed[:, 9]
+    reset = packed[:, 10]
     page_table = packed[:, _CHK_COLS:]
 
     counts = _rebuild_count_rows(
-        counts, tokens, slots, history, prompt_len, lengths)
+        counts, tokens, slots, history, prompt_len, lengths, reset)
     logits, k_pages, v_pages = forward_chunk(
         params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
     )
@@ -383,7 +577,9 @@ class Engine:
         else:  # random weights (tests / benchmarks)
             self.params = init_params(cfg, jax.random.key(engine_config.seed),
                                       dtype=engine_config.dtype)
-            if engine_config.quantization == "int8":
+            if engine_config.quantization is not None:
+                # random weights have no checkpoint format: every
+                # quantization mode serves weight-only int8 (smoke tests)
                 from llms_on_kubernetes_tpu.ops.quant import quantize_params
                 self.params = quantize_params(self.params)
             if mesh is not None:
@@ -411,6 +607,7 @@ class Engine:
         self.allocator = PageAllocator(
             engine_config.num_pages, engine_config.page_size, B,
             engine_config.pages_per_slot,
+            prefix_caching=engine_config.prefix_caching,
         )
         self.slots: list[Optional[Request]] = [None] * B
         self.slot_len = np.zeros((B,), np.int64)  # tokens whose KV is cached
@@ -430,6 +627,13 @@ class Engine:
         self._chunk_packed = jax.jit(
             _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
         )
+        if cfg.vision is not None:
+            from llms_on_kubernetes_tpu.models.vision import encode_images
+
+            self._mm_prefill_packed = jax.jit(
+                _prefill_mm_packed_step, static_argnums=(1,),
+                donate_argnums=(5, 6, 7))
+            self._encode_images = jax.jit(encode_images, static_argnums=(1,))
         # per-slot OUTPUT-token counts for presence/frequency penalties;
         # donated through every step like the page pools
         self.token_counts = jnp.zeros((B, cfg.vocab_size), jnp.int32)
@@ -446,11 +650,28 @@ class Engine:
         # async scheduling state (see EngineConfig.async_scheduling)
         self._async = bool(engine_config.async_scheduling)
         self._inflight: "collections.deque[InflightStep]" = collections.deque()
-        # (request, prefill toks device array, row) awaiting first-token harvest
-        self._pending_first: list[tuple[Request, Any, int]] = []
+        # (request, priority key, row) awaiting a first-token read
+        self._pending_first: list[tuple[Request, int, int]] = []
+        self._seq_counter = iter(range(2 ** 62))     # decode steps (dense)
+        self._first_counter = iter(range(2 ** 62))   # priority prefill reads
+        # set by submit(): breaks the backpressure wait so admission (and
+        # the new request's prefill dispatch) never waits out a read
+        self._admit_wake = threading.Event()
+        self._harvester: Optional[_Harvester] = None
+        if self._async:
+            self._harvester = _Harvester()
+            self._harvester.start()
+            import weakref
+            weakref.finalize(self, self._harvester.stop)
         # device-resident zero vectors for the packed steps (uploaded once)
         self._zeros_B = jnp.zeros((B,), jnp.int32)
         self._zeros_1 = jnp.zeros((1,), jnp.int32)
+        # pacing state: EMA of the device step time (measured from harvest
+        # completion spacing — in steady state the loop is device-paced)
+        # and the estimated wall time when all dispatched work completes
+        self._est_step = 0.02
+        self._busy_until = 0.0
+        self._last_harvest_t: Optional[float] = None
 
     # ------------------------------------------------------------------
     # submission
@@ -462,11 +683,14 @@ class Engine:
         params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         on_event=None,
+        images=None,
     ) -> Request:
         params = params or SamplingParams()
         max_len = self.config.max_model_len
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if images is not None:
+            params = self._validate_images(prompt, params, images)
         if params.top_k > MAX_CANDIDATES:
             raise ValueError(
                 f"top_k={params.top_k} exceeds the sampling candidate pool "
@@ -497,7 +721,7 @@ class Engine:
                 else int(self._seed_rng.integers(0, 2 ** 31 - 1))) & 0x7FFFFFFF
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
-            prompt=list(prompt), params=params, seed=seed,
+            prompt=list(prompt), params=params, seed=seed, images=images,
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:
@@ -507,7 +731,47 @@ class Engine:
                     f"requests); retry later"
                 )
             self.waiting.append(req)
+        if self._harvester is not None:
+            self._admit_wake.set()
+            self._harvester.poke()  # break any backpressure wait: admit NOW
         return req
+
+    def _validate_images(self, prompt: list[int],
+                         params: SamplingParams, images) -> SamplingParams:
+        """Multimodal admission contract: the prompt's image-soft-token
+        count must match the images, the whole prompt must fit one prefill
+        bucket (the chunk path has no embedding substitution), and
+        generation is capped so a preempted resume re-prefills in-bucket."""
+        cfg = self.model_config
+        if cfg.vision is None:
+            raise ValueError(
+                f"model {cfg.name!r} has no vision tower; images are not "
+                f"supported")
+        if self.config.multihost:
+            raise ValueError("images are not supported under multi-host "
+                             "serving yet (pixels are not in the broadcast "
+                             "step protocol)")
+        n = len(images)
+        if n < 1 or n > self.config.max_images_per_request:
+            raise ValueError(
+                f"{n} images; this engine serves 1.."
+                f"{self.config.max_images_per_request} per request")
+        t_img = cfg.vision.mm_tokens_per_image
+        soft = sum(1 for t in prompt if t == cfg.image_token_id)
+        if soft != n * t_img:
+            raise ValueError(
+                f"prompt has {soft} image soft tokens; {n} images need "
+                f"{n * t_img}")
+        bucket = max(self.config.prefill_buckets)
+        if len(prompt) > bucket:
+            raise ValueError(
+                f"multimodal prompt of {len(prompt)} tokens exceeds the "
+                f"largest prefill bucket ({bucket})")
+        # keep prompt+output re-prefillable in one bucket after preemption
+        if len(prompt) + params.max_tokens - 1 > bucket:
+            params = dataclasses.replace(
+                params, max_tokens=max(1, bucket - len(prompt) + 1))
+        return params
 
     def has_work(self) -> bool:
         return (bool(self.waiting) or any(r is not None for r in self.slots)
@@ -522,8 +786,13 @@ class Engine:
         events += self._reap_aborted()
         if self._async:
             admitted = self._admit_async(events)
-            launched = self._launch_decode_async(admitted, events)
-            events += self._harvest(drain=not launched)
+            status = self._launch_decode_async(admitted, events)
+            events += self._harvest(drain=status == "idle")
+            if status == "paced" and not events and not self.waiting:
+                # nothing to do until device work completes; a bounded nap
+                # keeps the loop from burning the GIL the harvester needs
+                # (admissions arriving mid-nap wait <= 1 ms)
+                time.sleep(0.001)
         else:
             events += self._admit_one()
             events += self._decode_once()
@@ -576,6 +845,7 @@ class Engine:
 
     def _pack_prefill_row(self, packed: np.ndarray, row: int, req: Request,
                           n: int, slot: int) -> None:
+        req.admitted_at = time.monotonic()
         packed[row, 0] = n
         packed[row, 1] = req.params.top_k
         packed[row, 2] = np.float32(req.params.temperature).view(np.int32)
@@ -600,21 +870,23 @@ class Engine:
         raise ValueError(f"no prefill bucket fits {n} tokens")
 
     def _chunked_prefill(self, slot: int, req: Request,
-                         prefill_tokens: list[int]):
-        """Prefill an out-of-bucket prompt in bucket-size chunks against the
-        paged pool (prefill-with-history attention, forward_chunk). The
-        slot's pages for the WHOLE prompt are already allocated. Pure
-        dispatch: each chunk chains on the previous through the donated
-        page pool — no host read here, so the async pipeline stays full.
-        Returns the FINAL chunk's device SampleResult (row 0 is the
-        request's first generated token)."""
+                         prefill_tokens: list[int], start: int = 0):
+        """Prefill a prompt in bucket-size chunks against the paged pool
+        (prefill-with-history attention, forward_chunk), beginning at
+        position ``start`` (> 0 when a cached prefix was adopted — those
+        positions' KV is already in the slot's pages). The slot's pages
+        for the WHOLE prompt are already allocated/adopted. Pure dispatch:
+        each chunk chains on the previous through the donated page pool —
+        no host read here, so the async pipeline stays full. Returns the
+        FINAL chunk's device SampleResult (row 0 is the request's first
+        generated token)."""
         from llms_on_kubernetes_tpu.engine.multihost import MSG_CHUNK
 
         n = len(prefill_tokens)
         step = max(self.config.prefill_buckets)
         pps = self.allocator.pages_per_slot
         res = None
-        pos = 0
+        pos = start
         while pos < n:
             m = min(step, n - pos)
             bucket = self._bucket_for(m)
@@ -631,6 +903,7 @@ class Engine:
             packed[0, 7] = np.float32(req.params.frequency_penalty).view(np.int32)
             packed[0, 8] = slot
             packed[0, 9] = len(req.prompt)
+            packed[0, 10] = 1 if pos == start else 0  # first chunk: reset counts
             packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
             self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed)
             res, self.k_pages, self.v_pages, self.token_counts = self._chunk_packed(
@@ -639,6 +912,34 @@ class Engine:
                 self.token_counts, self._key,
             )
             pos += m
+        self.slot_len[slot] = n
+        return res
+
+    def _dispatch_mm_prefill(self, slot: int, req: Request,
+                             prefill_tokens: list[int]):
+        """Encode the request's images and dispatch the multimodal prefill
+        (single row; substitution happens inside the executable). Returns
+        the device SampleResult."""
+        cfg = self.model_config
+        pixels = jnp.asarray(np.asarray(req.images, np.float32))
+        embeds = self._encode_images(self.params["vision"], cfg.vision, pixels)
+        n_max = self.config.max_images_per_request
+        if embeds.shape[0] < n_max:  # pad image count to the compiled shape
+            pad = jnp.zeros((n_max - embeds.shape[0],) + embeds.shape[1:],
+                            embeds.dtype)
+            embeds = jnp.concatenate([embeds, pad])
+        n = len(prefill_tokens)
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prefill_tokens
+        packed = np.zeros((1, _PRE_COLS + self.allocator.pages_per_slot),
+                          np.int32)
+        self._pack_prefill_row(packed, 0, req, n, slot)
+        res, self.k_pages, self.v_pages, self.token_counts = self._mm_prefill_packed(
+            self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
+            embeds[None], self.k_pages, self.v_pages, self.token_counts,
+            self._key,
+        )
         self.slot_len[slot] = n
         return res
 
@@ -666,15 +967,29 @@ class Engine:
                 self.waiting.popleft()
                 ev = self._finish(req, "length")
                 return [ev]
+            # adopt any cached prefix FIRST so can_allocate counts only the
+            # private pages still needed; roll back if they don't fit yet.
+            # Multimodal prompts skip the cache entirely: image soft tokens
+            # have identical ids across different images, so token-hash
+            # matching (and registration) would alias distinct images.
+            hit = (0 if req.images is not None else
+                   self.allocator.adopt_prefix(
+                       slot, prefill_tokens[:len(req.prompt)]))
             if not self.allocator.can_allocate(slot, n + 1):
+                if hit:
+                    self.allocator.free(slot)
                 return []  # wait for pages to free up
             self.waiting.popleft()
         self.allocator.allocate(slot, n + 1)
         self.slots[slot] = req
         req.slot = slot
 
-        if n > max(self.config.prefill_buckets):
-            res = self._chunked_prefill(slot, req, prefill_tokens)
+        if req.images is not None:
+            res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
+        elif hit > 0 or n > max(self.config.prefill_buckets):
+            # cache-hit admissions run the chunk path: prefill-with-history
+            # attention over the remainder, history = the adopted prefix
+            res = self._chunked_prefill(slot, req, prefill_tokens, start=hit)
         else:
             from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
 
@@ -691,6 +1006,10 @@ class Engine:
                 self.token_counts, self._key,
             )
             self.slot_len[slot] = n
+        # the dispatched prefill writes these pages; device order makes
+        # them valid for any later-dispatched adopter
+        if req.images is None:
+            self.allocator.register_prefix(slot, req.prompt)
         if resumed:
             req.pending_token = req.output[-1]
             return []
@@ -753,14 +1072,15 @@ class Engine:
         # grow page tables; preempt on exhaustion
         for i, r in list(active):
             while True:
+                if self.slots[i] is not r:
+                    # r was preempted by an earlier iteration's MemoryError;
+                    # allocating would leak a page into the vacated slot
+                    break
                 try:
                     self.allocator.allocate(i, int(self.slot_len[i]) + 1)
                     break
                 except MemoryError:
                     self._preempt_youngest()
-                    active = [(j, rr) for j, rr in enumerate(self.slots) if rr is not None]
-                    if (i, r) not in active:
-                        break
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return []
@@ -818,6 +1138,10 @@ class Engine:
         same-bucket requests in ONE padded call; first-token reads are
         deferred to _harvest. Returns None or a dict describing the
         admissions for the decode launch's on-device token merge."""
+        # clear BEFORE scanning: a submit after this point re-sets the flag
+        # (at worst a spurious backpressure wakeup), while anything already
+        # queued is handled right here
+        self._admit_wake.clear()
         picked: list[tuple[int, "Request", bool, list[int]]] = []
         long_pick = None
         with self._lock:
@@ -833,17 +1157,24 @@ class Engine:
                     self.waiting.popleft()
                     events.append(self._finish(req, "length"))
                     continue
-                if n > max(self.config.prefill_buckets):
-                    # out-of-bucket prompt: chunked prefill, admitted alone
-                    if picked:
-                        break  # runs by itself next iteration
-                    if not self.allocator.can_allocate(slot, n + 1):
-                        break
+                # multimodal prompts skip the prefix cache (soft-token ids
+                # alias across different images) and are admitted solo
+                hit = (0 if req.images is not None else
+                       self.allocator.adopt_prefix(
+                           slot, prefill_tokens[:len(req.prompt)]))
+                if (hit > 0 or req.images is not None
+                        or n > max(self.config.prefill_buckets)):
+                    # cache-hit / multimodal / out-of-bucket prompt: runs
+                    # alone (chunk path or mm prefill)
+                    if picked or not self.allocator.can_allocate(slot, n + 1):
+                        if hit:
+                            self.allocator.free(slot)  # roll back adoption
+                        break  # runs by itself next iteration / wait
                     self.waiting.popleft()
                     self.allocator.allocate(slot, n + 1)
                     self.slots[slot] = req
                     req.slot = slot
-                    long_pick = (slot, req, resumed, prefill_tokens)
+                    long_pick = (slot, req, resumed, prefill_tokens, hit)
                     break
                 if picked and self._bucket_for(n) != self._bucket_for(
                         len(picked[0][3])):
@@ -856,16 +1187,27 @@ class Engine:
                 req.slot = slot
                 picked.append((slot, req, resumed, prefill_tokens))
         if long_pick is not None:
-            slot, req, resumed, prefill_tokens = long_pick
-            res = self._chunked_prefill(slot, req, prefill_tokens)
-            _start_host_copy(res)
+            slot, req, resumed, prefill_tokens, hit = long_pick
+            if req.images is not None:
+                res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
+                n_chunks = 2  # image encode + prefill
+            else:
+                res = self._chunked_prefill(slot, req, prefill_tokens,
+                                            start=hit)
+                self.allocator.register_prefix(slot, req.prompt)
+                n_chunks = -(-(len(prefill_tokens) - hit)
+                             // max(self.config.prefill_buckets))
+            self._busy_until = (max(time.monotonic(), self._busy_until)
+                                + 2.0 * n_chunks * self._est_step)
             merge = {"toks": res.tokens, "slots": {}}
             if resumed:
                 req.pending_token = req.output[-1]
                 merge["slots"][slot] = (True, req.output[-1], 0)
             else:
+                key = -1 - next(self._first_counter)
+                self._harvester.push(key, res, priority=True)
                 merge["slots"][slot] = (False, 0, 0)
-                self._pending_first.append((req, res, 0))
+                self._pending_first.append((req, key, 0))
             return merge
         if not picked:
             return None
@@ -891,10 +1233,15 @@ class Engine:
             jnp.asarray(packed), self.k_pages, self.v_pages,
             self.token_counts, self._key,
         )
-        # start the first-token transfer now: it completes as soon as the
-        # prefill does, so the TTFT harvest read doesn't pay a blocking
-        # round trip
-        _start_host_copy(res)
+        self._busy_until = (max(time.monotonic(), self._busy_until)
+                            + 2.0 * self._est_step)  # prefill ≈ 2 steps
+        for slot, req, _resumed, _ptoks in picked:
+            self.allocator.register_prefix(slot, req.prompt)
+        key = None
+        if any(not resumed for _, _, resumed, _ in picked):
+            # priority read: first tokens jump the decode-read queue
+            key = -1 - next(self._first_counter)
+            self._harvester.push(key, res, priority=True)
         merge = {"toks": res.tokens, "slots": {}}
         for row, (slot, req, resumed, _ptoks) in enumerate(picked):
             if resumed:
@@ -905,16 +1252,26 @@ class Engine:
                 merge["slots"][slot] = (True, req.output[-1], row)
             else:
                 merge["slots"][slot] = (False, 0, row)
-                self._pending_first.append((req, res, row))
+                self._pending_first.append((req, key, row))
         return merge
 
-    def _launch_decode_async(self, admitted, events: list[StepEvent]) -> bool:
+    def _launch_decode_async(self, admitted, events: list[StepEvent]) -> str:
         """Launch one decode step whose input tokens are assembled ON DEVICE
         from the newest in-flight step's output (continuing slots), host
         values (slots with no step in flight), and this step's prefill
-        (just-admitted slots). Returns True iff a step was launched."""
+        (just-admitted slots). Returns "launched", "paced" (deliberately
+        deferred — the device queue is deep enough), or "idle"."""
         B = self.config.max_decode_slots
         max_len = self.config.max_model_len
+
+        pace = self.config.pace_target_steps
+        if pace > 0 and admitted is None and self._inflight:
+            # pacing: dispatching now would only deepen the queue a new
+            # request's prefill has to wait behind. (A step that just
+            # admitted always launches — its decode merges the prefill's
+            # sampled tokens.)
+            if self._busy_until - time.monotonic() > pace * self._est_step:
+                return "paced"
 
         # grow page tables; drain in-flight work, then preempt, on exhaustion
         i = 0
@@ -940,7 +1297,7 @@ class Engine:
 
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
-            return False
+            return "idle"
 
         pps = self.allocator.pages_per_slot
         packed = np.zeros((B, _DEC_COLS + pps), np.int32)
@@ -983,65 +1340,146 @@ class Engine:
             last_toks, prefill_toks, self.k_pages, self.v_pages,
             self.token_counts, self._key,
         )
-        self._inflight.append(InflightStep(res, active))
-        # start device->host transfers for every OLDER queued step (their
-        # compute has finished or will before ours): by harvest time the
-        # host copy is already local and device_get returns immediately
-        for step in list(self._inflight)[:-1]:
-            if not step.prefetched:
-                step.prefetched = True
-                _start_host_copy(step.res)
-        return True
+        seq = next(self._seq_counter)
+        step = InflightStep(res, active, seq)
+        self._inflight.append(step)
+        self._harvester.push(seq, res)
+        now = time.monotonic()
+        self._busy_until = max(now, self._busy_until) + self._est_step
+        return "launched"
 
     def _harvest(self, drain: bool) -> list[StepEvent]:
-        """Read host copies of completed device work: prefill first tokens
-        (always — the prefill finished before anything launched after it)
-        and in-flight decode steps beyond the pipeline depth (all of them
-        when draining). The np.asarray calls overlap with whatever is still
-        executing on device."""
+        """Consume host copies of completed device work from the harvester
+        thread, in dispatch order, WITHOUT blocking on device execution.
+
+        The engine thread blocks in exactly two cases: ``drain`` (state
+        inspection / shutdown / memory pressure needs every result), and
+        backpressure (the pipeline holds ``async_depth`` unharvested decode
+        steps — launching more would speculate unboundedly). Everything
+        else — including admission of new requests and their prefill
+        dispatch — proceeds while the harvester waits out the device and
+        the tunnel round trip. This is what bounds gateway TTFT: a new
+        request's prefill no longer queues behind a blocking batched read
+        of the whole pipeline."""
         events: list[StepEvent] = []
-        # Hysteresis: start harvesting only when the pipeline is full, then
-        # pop down to HALF depth in one batched read. The device->host round
-        # trip is a flat cost per read no matter how much it carries, so
-        # reading steps one-by-one would pay it every step; reading
-        # depth/2 steps at once amortizes it across that many tokens/slot.
-        popped: list[InflightStep] = []
-        if drain:
-            while self._inflight:
-                popped.append(self._inflight.popleft())
-        elif len(self._inflight) >= max(1, self.config.async_depth):
-            low = max(1, self.config.async_depth // 2)
-            while len(self._inflight) > low:
-                popped.append(self._inflight.popleft())
-        firsts, self._pending_first = self._pending_first, []
-
-        if not popped and not firsts:
+        if not self._inflight and not self._pending_first:
             return events
-        # ONE device->host transfer for everything harvestable this step:
-        # over a remote device tunnel each read costs a full round trip
-        # (~100 ms flat), so per-step reads must never be issued separately.
-        host = jax.device_get([s.res for s in popped]
-                              + [r for _, r, _ in firsts])
+        depth = max(1, self.config.async_depth)
+        n_steps = 0
+        while True:
+            n_steps += self._collect_ready(events)
+            if drain:
+                if not self._inflight and not self._pending_first:
+                    break
+            elif len(self._inflight) < depth:
+                break
+            # blocked: wait for whatever gates the head. If the oldest
+            # step's request still awaits its FIRST token (its priority
+            # read hasn't landed), wait for that key — consuming the step
+            # early would let a stale first overwrite pending_token later
+            # and feed the model a wrong input token.
+            key = self._head_blocking_first()
+            if key is not None:
+                self._harvester.wait_key(key)
+                continue
+            if self._inflight:
+                k = (len(self._inflight) if drain
+                     else len(self._inflight) - (depth - 1))
+                self._harvester.wait_done(
+                    self._inflight[k - 1].seq,
+                    wake=None if drain else self._admit_wake)
+                if not drain and self._admit_wake.is_set():
+                    # a submission wants admission NOW; collect whatever
+                    # completed and hand control back (pipeline may sit
+                    # one step over depth for one iteration)
+                    n_steps += self._collect_ready(events)
+                    break
+                continue
+            # drain with only firsts left
+            self._harvester.wait_key(self._pending_first[0][1])
+        # pacing calibration: completion spacing per decode step bounds the
+        # device step time from ABOVE (reads add latency, never remove it),
+        # so track the MINIMUM with slow upward drift. A mean/EMA here is
+        # unstable: when reads are the bottleneck the spacing reflects the
+        # read path, the estimate inflates, pacing launches slower, spacing
+        # confirms the inflated estimate, and the pipeline starves
+        # (observed: 4x throughput collapse).
+        if n_steps > 0:
+            now = time.monotonic()
+            if self._last_harvest_t is not None:
+                gap = (now - self._last_harvest_t) / n_steps
+                if 0.0 < gap < 0.5:
+                    if gap < self._est_step:
+                        self._est_step = gap
+                    else:
+                        self._est_step = min(self._est_step * 1.02, gap)
+            self._last_harvest_t = now
+        elif not self._inflight:
+            self._last_harvest_t = None  # idle: next spacing sample invalid
+        return events
 
-        for (req, _, row), first in zip(firsts, host[len(popped):]):
+    def _head_blocking_first(self) -> Optional[int]:
+        """The pending-first key gating the OLDEST in-flight step, or None.
+
+        A decode step must not be consumed before its request's first
+        token: processing it early advances slot_len/pending_token, and
+        the late first would then rewind pending_token to the prompt's
+        sampled token — feeding a stale input to the next host-value
+        decode launch (observed as diverged generations)."""
+        if not self._inflight or not self._harvester.is_done(
+                self._inflight[0].seq):
+            return None
+        waiting = {id(r): k for r, k, _ in self._pending_first}
+        for slot, req in self._inflight[0].active:
+            if not req.finished and req.slot == slot and id(req) in waiting:
+                return waiting[id(req)]
+        return None
+
+    def _collect_ready(self, events: list[StepEvent]) -> int:
+        """Non-blocking: consume every completed result whose ordering
+        constraints are satisfied — firsts in FIFO order, then steps in
+        dispatch order while not gated by a pending first. Returns the
+        number of decode steps consumed (pacing calibration)."""
+        done_i = 0
+        while done_i < len(self._pending_first):
+            req, key, row = self._pending_first[done_i]
+            if not self._harvester.key_done(key):
+                break  # priority reads are FIFO: later keys aren't done either
+            host = self._harvester.get(key)
+            done_i += 1
             if req.finished:
                 continue
-            tok = int(first.tokens[row])
+            tok = int(host.tokens[row])
             req.pending_token = tok
             req.first_token_at = time.monotonic()
-            events += self._emit(req, tok, _lp_entry(first, row))
+            events += self._emit(req, tok, _lp_entry(host, row))
+        if done_i:
+            finished_keys = {k for _, k, _ in self._pending_first[:done_i]}
+            self._pending_first = self._pending_first[done_i:]
+            for k in finished_keys - {k for _, k, _ in self._pending_first}:
+                self._harvester.discard_key(k)
 
-        for step, res in zip(popped, host[:len(popped)]):
+        processed = -1
+        n_steps = 0
+        while self._inflight and self._harvester.is_done(self._inflight[0].seq):
+            if self._head_blocking_first() is not None:
+                break  # the step's request still awaits its first token
+            step = self._inflight.popleft()
+            host = self._harvester.get(step.seq)
+            processed = step.seq
+            n_steps += 1
             for slot, req in step.active:
                 # skip slots whose request finished/aborted/was preempted
                 # after this step launched — their sampled token is garbage
                 if req.finished or req.slot != slot:
                     continue
                 self.slot_len[slot] += 1
-                tok = int(res.tokens[slot])
+                tok = int(host.tokens[slot])
                 req.pending_token = tok
-                events += self._emit(req, tok, _lp_entry(res, slot))
-        return events
+                events += self._emit(req, tok, _lp_entry(host, slot))
+        if processed >= 0:
+            self._harvester.discard_upto(processed)
+        return n_steps
 
     def _drain_async(self) -> list[StepEvent]:
         """Synchronize: harvest everything in flight (used before state

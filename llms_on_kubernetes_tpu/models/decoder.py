@@ -118,6 +118,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[str] = None) -
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = init(D, V, scale=D ** -0.5)
+    if cfg.vision is not None:
+        from llms_on_kubernetes_tpu.models.vision import init_vision_params
+
+        params["vision"] = init_vision_params(
+            cfg.vision, D, next(keys), dtype=dt)
     return params
 
 
@@ -170,6 +175,7 @@ def _layer_step(
     v_pages: jnp.ndarray,
     layer_idx: "jnp.ndarray | None" = None,
     inv_freq_local: "jnp.ndarray | None" = None,
+    mm_groups: "jnp.ndarray | None" = None,
 ):
     scale = (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
     # Gemma-2/3 interleaved attention: layer is global iff (i+1) % pattern == 0;
@@ -190,7 +196,7 @@ def _layer_step(
         attn = dispatch_prefill_attention(
             q, k, v, lengths,
             scale=scale, sliding_window=window,
-            attn_softcap=cfg.attn_softcap,
+            attn_softcap=cfg.attn_softcap, mm_groups=mm_groups,
         )
     elif mode == "chunk":
         # chunked prefill: queries attend to previous chunks' cached KV
@@ -232,6 +238,7 @@ def _run_layers(
     write_positions: jnp.ndarray,
     lengths: jnp.ndarray,
     mode: str,
+    mm_groups: "jnp.ndarray | None" = None,
 ):
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling))
     inv_freq_local = (
@@ -251,6 +258,7 @@ def _run_layers(
         xc, kp, vp = _layer_step(
             cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
+            mm_groups=mm_groups,
         )
         return (xc, kp, vp), None
 
@@ -306,6 +314,46 @@ def forward_prefill(
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _logits(params, cfg, x_last), k_pages, v_pages
+
+
+def forward_prefill_mm(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, T]: image runs hold cfg.image_token_id
+    lengths: jnp.ndarray,     # [B]
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    img_embeds: jnp.ndarray,  # [B, n_img_max, tokens_per_image, D] projected
+):
+    """Multimodal prefill: image soft tokens' embeddings are substituted at
+    ``image_token_id`` positions (row-major across the prompt's images),
+    and soft tokens of the same image attend bidirectionally (gemma-3
+    semantics). Everything else matches ``forward_prefill``."""
+    B, T = tokens.shape
+    n_img, t_img = img_embeds.shape[1], img_embeds.shape[2]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    write_positions = jnp.where(positions < lengths[:, None], positions, -1)
+    x = _embed(params, cfg, tokens)
+
+    is_img = tokens == cfg.image_token_id                       # [B, T]
+    # row-major soft-token index -> (image, offset); image features are
+    # NOT scaled by the embedding multiplier (HF gemma3 scales only the
+    # text embeddings before the masked scatter)
+    idx = jnp.clip(jnp.cumsum(is_img.astype(jnp.int32), axis=1) - 1,
+                   0, n_img * t_img - 1)
+    flat = img_embeds.reshape(B, n_img * t_img, -1)
+    gathered = jnp.take_along_axis(flat, idx[:, :, None], axis=1)
+    x = jnp.where(is_img[:, :, None], gathered.astype(x.dtype), x)
+    mm_groups = jnp.where(is_img, idx // t_img, -1)
+
+    x, k_pages, v_pages = _run_layers(
+        cfg, params, x, k_pages, v_pages, page_table,
+        positions, write_positions, lengths, "prefill", mm_groups=mm_groups,
+    )
+    last = jnp.clip(lengths - 1, 0, T - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     return _logits(params, cfg, x_last), k_pages, v_pages
 
 

@@ -60,6 +60,7 @@ def prefill_attention(
     scale: float,
     sliding_window: Optional[int] = None,
     attn_softcap: Optional[float] = None,
+    mm_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Causal self-attention over a (padded) prompt chunk.
 
@@ -67,6 +68,10 @@ def prefill_attention(
     k, v:    [B, T, n_kv, d]
     lengths: [B] int32 — true prompt lengths (<= T); keys at or beyond a
              sequence's length are masked out.
+    mm_groups: optional [B, T] int32 — image-group id per position (-1 for
+             text). Soft tokens of the SAME image attend bidirectionally
+             to each other (gemma-3 semantics: the image-block override
+             ORs over both the causal and the sliding-window constraint).
     returns  [B, T, n_q, d]
     """
     B, T, n_q, d = q.shape
@@ -86,9 +91,14 @@ def prefill_attention(
     mask = k_pos <= q_pos                             # causal
     if sliding_window is not None:
         mask = mask & (k_pos > q_pos - sliding_window)
+    mask = jnp.broadcast_to(mask[None], (B, T, T))
+    if mm_groups is not None:
+        same_image = ((mm_groups[:, :, None] >= 0)
+                      & (mm_groups[:, :, None] == mm_groups[:, None, :]))
+        mask = mask | same_image
     # pad mask: key beyond the sequence's true length
     valid = k_pos < lengths[:, None, None]            # [B, 1, T]
-    mask = mask[None] & valid                          # [B, T, T]
+    mask = mask & valid                               # [B, T, T]
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)
 
     probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
@@ -214,7 +224,15 @@ def _static_window(w) -> bool:
 
 
 def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
-                               attn_softcap=None):
+                               attn_softcap=None, mm_groups=None):
+    if mm_groups is not None:
+        # multimodal prompts take the XLA reference path: the image-block
+        # bidirectional mask is a [B, T, T] override the flash/ring
+        # kernels don't express (yet)
+        return prefill_attention(q, k, v, lengths, scale=scale,
+                                 sliding_window=sliding_window,
+                                 attn_softcap=attn_softcap,
+                                 mm_groups=mm_groups)
     # Context parallelism: a seq>1 mesh shards the prompt over the ring
     # axis; the quadratic attention runs as ring attention (K/V blocks
     # rotate via ppermute over ICI) instead of gathering the full sequence

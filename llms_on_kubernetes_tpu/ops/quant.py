@@ -108,10 +108,74 @@ def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Pre-quantized checkpoint formats (reference values.yaml:2-12: the default
+# models are gemma-3-27b-it-FP8-Dynamic, a compressed-tensors FP8
+# checkpoint, and Qwen3-VL-...-AWQ, an AWQ checkpoint). Both are
+# dequantized tensor-by-tensor at load and re-quantized to the TPU-native
+# serving format (weight-only int8 per-output-channel, streamed at
+# 1 byte/param — same on-device footprint as the source format); accuracy
+# is pinned by logit-tolerance tests against a full-precision dequant.
+# ---------------------------------------------------------------------------
+
+# AutoAWQ's nibble interleave: bits [4k, 4k+4) of a packed int32 hold the
+# quantized value for output channel 8*j + _AWQ_ORDER[k].
+_AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
+
+
+def awq_dequantize(qweight: "np.ndarray", qzeros: "np.ndarray",
+                   scales: "np.ndarray", bits: int = 4) -> "np.ndarray":
+    """AWQ GEMM-format dequant -> float32 [in, out].
+
+    qweight int32 [in, out*bits/32], qzeros int32 [n_groups, out*bits/32],
+    scales f16/f32 [n_groups, out]; group_size = in / n_groups;
+    w[i, o] = (q[i, o] - z[g(i), o]) * s[g(i), o].
+    """
+    import numpy as np
+
+    if bits not in (4, 8):
+        raise ValueError(f"unsupported AWQ bits={bits}")
+    pack = 32 // bits
+    mask = (1 << bits) - 1
+
+    def unpack(arr):  # [r, c] int32 -> [r, c*pack] with AWQ interleave
+        r, c = arr.shape
+        out = np.empty((r, c * pack), np.int32)
+        order = _AWQ_ORDER if bits == 4 else range(pack)
+        for k, o in enumerate(order):
+            out[:, o::pack] = (arr >> (bits * k)) & mask
+        return out
+
+    q = unpack(qweight.astype(np.int32))           # [in, out]
+    z = unpack(qzeros.astype(np.int32))            # [n_groups, out]
+    n_groups = z.shape[0]
+    group = q.shape[0] // n_groups
+    zf = np.repeat(z, group, axis=0).astype(np.float32)
+    sf = np.repeat(scales.astype(np.float32), group, axis=0)
+    return (q.astype(np.float32) - zf) * sf        # [in, out]
+
+
+def fp8_dequantize(weight: "np.ndarray", weight_scale) -> "np.ndarray":
+    """compressed-tensors FP8 dequant -> float32 [out, in].
+
+    weight float8_e4m3fn [out, in]; weight_scale f32 — scalar (per-tensor)
+    or [out, 1] (per-channel).
+    """
+    import numpy as np
+
+    w = weight.astype(np.float32)
+    s = np.asarray(weight_scale, np.float32)
+    if s.ndim == 1:  # [out] -> per-channel column
+        s = s[:, None]
+    return w * s
+
+
+# ---------------------------------------------------------------------------
 # Whole-model quantization
 # ---------------------------------------------------------------------------
 
-SUPPORTED_QUANTIZATIONS = (None, "int8")
+# "fp8" / "awq" declare the CHECKPOINT's format (validated against its
+# quantization_config at load); serving still streams weight-only int8.
+SUPPORTED_QUANTIZATIONS = (None, "int8", "fp8", "awq")
 
 # Weight name -> contraction (input) axes of the PER-LAYER slice, offset by
 # +1 for the stacked layer axis. wq [L, D, H, hd] contracts over D -> (1,).

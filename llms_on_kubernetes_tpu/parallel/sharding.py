@@ -99,6 +99,9 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     from llms_on_kubernetes_tpu.ops.quant import QTensor, scale_spec
 
     specs = param_specs(cfg, mesh)
+    if "vision" in params:
+        # the vision tower is small relative to the decoder: replicate
+        specs["vision"] = jax.tree.map(lambda _: P(), params["vision"])
 
     def put(x, s):
         if isinstance(x, QTensor):

@@ -133,4 +133,7 @@ def engine_metrics(registry: Registry) -> dict:
             "llm_kv_pages_used", "KV pages allocated", registry),
         "waiting": Gauge(
             "llm_waiting_requests", "Requests queued for admission", registry),
+        "prefix_hit_tokens": Gauge(
+            "llm_prefix_cache_hit_tokens_total",
+            "Prompt tokens served from the prefix cache", registry),
     }

@@ -94,6 +94,7 @@ class EngineLoop(threading.Thread):
                 m["kv_pages_used"].set(
                     eng.config.num_pages - 1 - eng.allocator.num_free_pages)
                 m["waiting"].set(len(eng.waiting))
+                m["prefix_hit_tokens"].set(eng.allocator.hit_tokens_total)
                 for ev in events:
                     m["tokens_generated"].inc(len(ev.new_tokens))
                     if ev.finished:
@@ -241,8 +242,14 @@ class OpenAIServer:
 
     # ------------------------------------------------------------------
 
+    # request body cap: base64 image_url parts inflate images by 4/3, so
+    # aiohttp's 1 MiB default would reject most real photos before the
+    # handler even runs (multimodal requests with a few images fit well
+    # under this)
+    MAX_BODY_BYTES = 32 * 1024 * 1024
+
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(client_max_size=self.MAX_BODY_BYTES)
         app.router.add_get("/health", self.health)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/metrics", self.prometheus)
@@ -373,6 +380,66 @@ class OpenAIServer:
             logprobs=nlp,
         )
 
+    def _extract_images(self, messages: list) -> tuple[list, list]:
+        """OpenAI multimodal content parts -> (template-ready messages,
+        decoded images). ``image_url`` parts accept data: URLs (base64);
+        remote http(s) URLs are rejected — the serving pod must not fetch
+        arbitrary URLs. Image parts become {"type": "image"} placeholders
+        the model's chat template renders as its begin-of-image marker."""
+        import base64
+
+        out, images = [], []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                out.append(m)
+                continue
+            parts = []
+            for part in content:
+                ptype = part.get("type") if isinstance(part, dict) else None
+                if ptype == "image_url":
+                    url = (part.get("image_url") or {}).get("url", "")
+                    if not url.startswith("data:"):
+                        raise ValueError(
+                            "image_url must be a data: URL (base64); the "
+                            "server does not fetch remote images")
+                    b64 = url.split(",", 1)[-1]
+                    import binascii
+                    import io
+
+                    from PIL import Image
+                    try:
+                        img = Image.open(io.BytesIO(base64.b64decode(b64)))
+                        img.load()  # force decode NOW: bad bytes -> 400,
+                        # not a 500 later in preprocessing
+                    except (OSError, binascii.Error, SyntaxError) as e:
+                        raise ValueError(f"undecodable image_url data: {e}")
+                    images.append(img)
+                    parts.append({"type": "image"})
+                else:
+                    parts.append(part)
+            out.append({**m, "content": parts})
+        return out, images
+
+    def _splice_image_tokens(self, ids: list[int], n_images: int) -> list[int]:
+        """Expand each begin-of-image marker into the soft-token run the
+        engine substitutes embeddings at: boi -> [boi, soft * N, eoi]."""
+        cfg = self.engine.model_config
+        t_img = cfg.vision.mm_tokens_per_image
+        out, found = [], 0
+        for t in ids:
+            out.append(t)
+            if t == cfg.boi_token_id:
+                found += 1
+                out += [cfg.image_token_id] * t_img
+                if cfg.eoi_token_id is not None:
+                    out.append(cfg.eoi_token_id)
+        if found != n_images:
+            raise ValueError(
+                f"chat template produced {found} image markers for "
+                f"{n_images} images")
+        return out
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -383,10 +450,33 @@ class OpenAIServer:
             return web.json_response(
                 {"error": {"message": "messages must be a non-empty list"}}, status=400)
         try:
+            messages, images = self._extract_images(messages)
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        if images and self.engine.model_config.vision is None:
+            return web.json_response(
+                {"error": {"message": f"model {self.model_name!r} does not "
+                           f"accept images"}}, status=400)
+        try:
             prompt_ids = self.tokenizer.apply_chat_template(messages)
+            if images:
+                prompt_ids = self._splice_image_tokens(prompt_ids, len(images))
         except Exception as e:  # bad roles/content shape
             return web.json_response({"error": {"message": f"bad messages: {e}"}}, status=400)
-        return await self._serve(request, body, [prompt_ids], chat=True)
+        pixels = None
+        if images:
+            import numpy as np
+
+            from llms_on_kubernetes_tpu.models.vision import preprocess_image
+
+            size = self.engine.model_config.vision.image_size
+            try:
+                pixels = np.stack([preprocess_image(im, size) for im in images])
+            except Exception as e:  # undecodable/degenerate image -> 400
+                return web.json_response(
+                    {"error": {"message": f"bad image: {e}"}}, status=400)
+        return await self._serve(request, body, [prompt_ids], chat=True,
+                                 images=pixels)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         """Supports every OpenAI ``prompt`` form: a string, a token-id list,
@@ -420,7 +510,8 @@ class OpenAIServer:
 
     # ------------------------------------------------------------------
 
-    async def _serve(self, request, body, prompts, *, chat: bool) -> web.StreamResponse:
+    async def _serve(self, request, body, prompts, *, chat: bool,
+                     images=None) -> web.StreamResponse:
         from llms_on_kubernetes_tpu.engine.engine import QueueFullError
 
         try:
@@ -475,7 +566,8 @@ class OpenAIServer:
                             params, seed=(params.seed + j) & 0x7FFFFFFF)
                     q: asyncio.Queue = asyncio.Queue()
                     req = self.loop_thread.submit(
-                        prompt_ids, p, on_event=_event_pusher(loop, q))
+                        prompt_ids, p, on_event=_event_pusher(loop, q),
+                        images=images)
                     req._aq = q
                     reqs.append(req)
         except QueueFullError as e:

@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <chrono>
 #include <cstring>
 #include <map>
 #include <optional>
@@ -26,6 +27,10 @@
 #include <vector>
 
 namespace llkt {
+
+// Why a request read failed (drives 408/431/413/400 vs silent close).
+enum class ReadErr { None, Eof, Timeout, TimeoutIdle, TooLarge, BodyTooLarge,
+                     Malformed };
 
 inline std::string lower(std::string s) {
   std::transform(s.begin(), s.end(), s.begin(),
@@ -63,6 +68,19 @@ struct Headers {
 class SockReader {
  public:
   explicit SockReader(int fd) : fd_(fd) {}
+
+  // Total-wall-clock read deadline (slowloris defense): each subsequent
+  // recv gets SO_RCVTIMEO = remaining budget, so trickling one byte per
+  // interval cannot extend the deadline the way a fixed per-recv timeout
+  // could. Cleared by set_deadline(nullopt).
+  void set_deadline(
+      std::optional<std::chrono::steady_clock::time_point> deadline) {
+    deadline_ = deadline;
+    timed_out_ = false;
+  }
+  bool timed_out() const { return timed_out_; }
+  bool consumed_any() const { return consumed_any_; }
+  void reset_consumed() { consumed_any_ = false; }
 
   // Reads until "\r\n" (tolerates bare "\n"); returns false on EOF/error.
   bool read_line(std::string& line, size_t max_len = 64 * 1024) {
@@ -106,16 +124,37 @@ class SockReader {
 
  private:
   bool fill() {
+    if (deadline_) {
+      auto remaining = *deadline_ - std::chrono::steady_clock::now();
+      auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                    remaining).count();
+      if (us <= 0) {
+        timed_out_ = true;
+        return false;
+      }
+      struct timeval tv {
+        static_cast<time_t>(us / 1000000),
+        static_cast<suseconds_t>(us % 1000000)
+      };
+      setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    }
     ssize_t n = ::recv(fd_, buf_, sizeof buf_, 0);
-    if (n <= 0) return false;
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) timed_out_ = true;
+      return false;
+    }
     pos_ = 0;
     len_ = static_cast<size_t>(n);
+    consumed_any_ = true;
     return true;
   }
 
   int fd_;
   char buf_[16 * 1024];
   size_t pos_ = 0, len_ = 0;
+  std::optional<std::chrono::steady_clock::time_point> deadline_;
+  bool timed_out_ = false;
+  bool consumed_any_ = false;
 };
 
 inline bool send_all(int fd, const char* data, size_t n) {
@@ -148,22 +187,40 @@ struct ResponseHead {
 
 // Parses request line + headers + body (Content-Length or chunked; chunked
 // request bodies are de-chunked so they can be re-framed upstream with a
-// plain Content-Length). Returns false on EOF/malformed/oversized.
+// plain Content-Length). Returns false on EOF/timeout/malformed/oversized;
+// ``err`` (optional) says which, so the caller can answer 408/431/400
+// instead of silently closing.
 inline bool read_request(SockReader& r, Request& req,
-                         size_t max_body = 64 * 1024 * 1024) {
+                         size_t max_body = 64 * 1024 * 1024,
+                         ReadErr* err = nullptr, size_t max_headers = 256) {
+  ReadErr scratch;
+  ReadErr& e = err ? *err : scratch;
+  e = ReadErr::None;
+  r.reset_consumed();
+  auto fail = [&](ReadErr kind) {
+    if (r.timed_out())
+      e = r.consumed_any() ? ReadErr::Timeout : ReadErr::TimeoutIdle;
+    else
+      e = kind;
+    return false;
+  };
   std::string line;
-  if (!r.read_line(line) || line.empty()) return false;
+  if (!r.read_line(line) || line.empty())
+    return fail(r.consumed_any() ? ReadErr::Malformed : ReadErr::Eof);
   size_t sp1 = line.find(' ');
   size_t sp2 = line.rfind(' ');
-  if (sp1 == std::string::npos || sp2 == sp1) return false;
+  if (sp1 == std::string::npos || sp2 == sp1) return fail(ReadErr::Malformed);
   req.method = line.substr(0, sp1);
   req.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
   req.version = line.substr(sp2 + 1);
 
-  while (r.read_line(line)) {
+  while (true) {
+    if (!r.read_line(line)) return fail(ReadErr::Malformed);
     if (line.empty()) break;
+    if (req.headers.items.size() >= max_headers)
+      return fail(ReadErr::TooLarge);  // header bomb -> 431
     size_t colon = line.find(':');
-    if (colon == std::string::npos) return false;
+    if (colon == std::string::npos) return fail(ReadErr::Malformed);
     std::string name = line.substr(0, colon);
     size_t vstart = line.find_first_not_of(" \t", colon + 1);
     req.headers.add(name, vstart == std::string::npos ? "" : line.substr(vstart));
@@ -181,32 +238,32 @@ inline bool read_request(SockReader& r, Request& req,
   if (te && lower(*te).find("chunked") != std::string::npos) {
     // de-chunk into req.body
     while (true) {
-      if (!r.read_line(line)) return false;
+      if (!r.read_line(line)) return fail(ReadErr::Malformed);
       size_t semi = line.find(';');
       unsigned long sz = 0;
       try {
         sz = std::stoul(line.substr(0, semi), nullptr, 16);
       } catch (...) {
-        return false;
+        return fail(ReadErr::Malformed);
       }
       if (sz == 0) {
         // trailers until blank line
         while (r.read_line(line) && !line.empty()) {}
         break;
       }
-      if (req.body.size() + sz > max_body) return false;
-      if (!r.read_exact(req.body, sz)) return false;
-      if (!r.read_line(line)) return false;  // CRLF after chunk
+      if (req.body.size() + sz > max_body) return fail(ReadErr::BodyTooLarge);
+      if (!r.read_exact(req.body, sz)) return fail(ReadErr::Malformed);
+      if (!r.read_line(line)) return fail(ReadErr::Malformed);  // chunk CRLF
     }
   } else if (const std::string* cl = req.headers.get("content-length")) {
     unsigned long n = 0;
     try {
       n = std::stoul(*cl);
     } catch (...) {
-      return false;
+      return fail(ReadErr::Malformed);
     }
-    if (n > max_body) return false;
-    if (!r.read_exact(req.body, n)) return false;
+    if (n > max_body) return fail(ReadErr::BodyTooLarge);
+    if (!r.read_exact(req.body, n)) return fail(ReadErr::Malformed);
   }
   return true;
 }

@@ -63,6 +63,9 @@ struct Config {
   std::string default_model;
   bool strict = false;
   int upstream_timeout_s = 300;
+  // total budget for reading one client request (slowloris defense, see
+  // SockReader::set_deadline); also the keep-alive idle timeout
+  int client_timeout_s = 75;
   int port = 8080;
   bool quiet = false;
 
@@ -193,6 +196,17 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
       } catch (...) {
         return false;
       }
+      if (sz == 0) {
+        // after the 0 chunk: zero or more HTTP trailer lines, then a
+        // blank line — forward them verbatim (reading a fixed 2 bytes
+        // here desynced keep-alive framing when trailers were present,
+        // a round-1 review finding)
+        while (true) {
+          if (!r.read_line(line)) return false;
+          if (!send_all(client_fd, line + "\r\n")) return false;
+          if (line.empty()) return true;
+        }
+      }
       unsigned long left = sz + 2;  // chunk data + trailing CRLF
       while (left > 0) {
         ssize_t n = r.read_some(buf, std::min(left, sizeof buf));
@@ -200,7 +214,6 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
         if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
         left -= static_cast<unsigned long>(n);
       }
-      if (sz == 0) return true;  // final chunk (trailers folded into CRLF)
     }
   }
   if (const std::string* cl = head.headers.get("content-length")) {
@@ -321,10 +334,50 @@ static void handle_connection(const Config& cfg, int client_fd,
   } live;
   int one = 1;
   setsockopt(client_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  // a stalled/slow-reading client must not pin this thread on send either
+  struct timeval snd_tv {cfg.client_timeout_s, 0};
+  setsockopt(client_fd, SOL_SOCKET, SO_SNDTIMEO, &snd_tv, sizeof snd_tv);
   SockReader reader(client_fd);
   while (true) {
     Request req;
-    if (!read_request(reader, req)) break;
+    reader.set_deadline(std::chrono::steady_clock::now() +
+                        std::chrono::seconds(cfg.client_timeout_s));
+    ReadErr err;
+    if (!read_request(reader, req, 64 * 1024 * 1024, &err)) {
+      // idle keep-alive timeout / clean EOF: close silently (nginx
+      // keepalive_timeout semantics); mid-request failures get a status
+      if (err == ReadErr::Timeout) {
+        send_all(client_fd,
+                 simple_response(408, "Request Timeout", "application/json",
+                                 error_json("request read timed out",
+                                            "invalid_request_error"),
+                                 false));
+        logf(cfg, "-> 408 (slow client)");
+      } else if (err == ReadErr::TooLarge) {
+        send_all(client_fd,
+                 simple_response(431, "Request Header Fields Too Large",
+                                 "application/json",
+                                 error_json("too many headers",
+                                            "invalid_request_error"),
+                                 false));
+        logf(cfg, "-> 431 (header bomb)");
+      } else if (err == ReadErr::BodyTooLarge) {
+        send_all(client_fd,
+                 simple_response(413, "Payload Too Large", "application/json",
+                                 error_json("request body too large",
+                                            "invalid_request_error"),
+                                 false));
+        logf(cfg, "-> 413 (oversized body)");
+      } else if (err == ReadErr::Malformed) {
+        send_all(client_fd,
+                 simple_response(400, "Bad Request", "application/json",
+                                 error_json("malformed request",
+                                            "invalid_request_error"),
+                                 false));
+      }
+      break;
+    }
+    reader.set_deadline(std::nullopt);  // streaming responses may outlive it
 
     std::string path = req.target.substr(0, req.target.find('?'));
     bool keep = false;
@@ -404,6 +457,9 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("upstream_timeout_s");
       t && t->type == Json::Type::Number)
     cfg.upstream_timeout_s = static_cast<int>(t->number);
+  if (const Json* t = root->get("client_timeout_s");
+      t && t->type == Json::Type::Number)
+    cfg.client_timeout_s = static_cast<int>(t->number);
   return true;
 }
 
@@ -482,11 +538,15 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       cfg.upstream_timeout_s = atoi(v);
+    } else if (a == "--client-timeout") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.client_timeout_s = atoi(v);
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url,...) "
               "[--port P] [--default NAME] [--strict] [--quiet] "
-              "[--upstream-timeout S]\n");
+              "[--upstream-timeout S] [--client-timeout S]\n");
       return 2;
     }
   }

@@ -62,6 +62,21 @@ class FakeBackend(http.server.BaseHTTPRequestHandler):
                 time.sleep(0.25)
             self.wfile.write(b"0\r\n\r\n")
             return
+        if self.path == "/v1/trailers":
+            # chunked response with HTTP trailers after the 0-chunk: the
+            # relay must forward them verbatim and keep the connection
+            # framing intact (round-1 review finding: exactly 2 bytes were
+            # read after the 0 line, desyncing keep-alive)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("Trailer", "X-Checksum")
+            self.end_headers()
+            data = f"data: {self.name}-t\n\n".encode()
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            self.wfile.write(b"0\r\nX-Checksum: abc123\r\n\r\n")
+            self.wfile.flush()
+            return
         if self.path == "/v1/stream-eof":
             # EOF-framed: no Content-Length, no chunking, close at the end
             self.protocol_version = "HTTP/1.0"
@@ -104,11 +119,12 @@ def binary():
 
 
 class RouterProc:
-    def __init__(self, binary, backends: dict[str, int], strict=False):
+    def __init__(self, binary, backends: dict[str, int], strict=False,
+                 extra_args=()):
         self.port = free_port()
         spec = ",".join(f"{n}=http://127.0.0.1:{p}" for n, p in backends.items())
         args = [str(binary), "--models", spec, "--port", str(self.port),
-                "--quiet"]
+                "--quiet", *extra_args]
         if strict:
             args.append("--strict")
         self.proc = subprocess.Popen(args, stderr=subprocess.PIPE)
@@ -238,6 +254,104 @@ def test_streaming_is_not_buffered(stack, path):
     assert first_latency is not None and first_latency < 0.2, (
         f"first chunk took {first_latency}s (buffered?)")
     assert total > 0.4  # the later events really were delayed
+
+
+def test_trailers_forwarded_and_keepalive_intact(stack):
+    """Trailers after the final 0-chunk are relayed verbatim, and the SAME
+    client connection serves a following request (framing not desynced).
+    Raw socket: http.client hides trailer bytes from the caller."""
+    def send_req(s, path, body):
+        payload = json.dumps(body).encode()
+        s.sendall((f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+                   f"Content-Type: application/json\r\n"
+                   f"Content-Length: {len(payload)}\r\n\r\n").encode() + payload)
+
+    def recv_until(s, marker, deadline=5):
+        data = b""
+        end = time.monotonic() + deadline
+        while marker not in data and time.monotonic() < end:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        return data
+
+    s = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+    send_req(s, "/v1/trailers", {"model": "modelB"})
+    raw = recv_until(s, b"0\r\nX-Checksum: abc123\r\n\r\n")
+    assert b"modelB-t" in raw
+    assert raw.endswith(b"0\r\nX-Checksum: abc123\r\n\r\n")  # trailer verbatim
+
+    # keep-alive framing survived: reuse the socket for a normal request
+    send_req(s, "/v1/chat/completions", {"model": "modelA"})
+    raw2 = recv_until(s, b"modelA")
+    assert raw2.startswith(b"HTTP/1.1 200")
+    assert b'"served_by": "modelA"' in raw2
+    s.close()
+
+
+def test_slowloris_client_gets_408(binary):
+    """A client trickling headers past the read budget gets 408 and its
+    thread is released (round-1 review finding: pinned forever)."""
+    backend = start_backend("modelA")
+    router = RouterProc(binary, {"modelA": backend.server_address[1]},
+                        extra_args=("--client-timeout", "1"))
+    try:
+        s = socket.create_connection(("127.0.0.1", router.port), timeout=10)
+        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\nHost: x\r\n")
+        t0 = time.monotonic()
+        s.settimeout(10)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        elapsed = time.monotonic() - t0
+        assert b"408" in data.split(b"\r\n", 1)[0], data[:100]
+        assert elapsed < 5, f"408 took {elapsed}s"
+        s.close()
+
+        # an IDLE connection (nothing sent) is closed silently — no 408
+        s2 = socket.create_connection(("127.0.0.1", router.port), timeout=10)
+        s2.settimeout(10)
+        assert s2.recv(4096) == b""  # clean close, no response bytes
+        s2.close()
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_oversized_body_gets_413(stack):
+    s = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+    s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 268435456\r\n\r\n")  # 256 MiB > 64 MiB cap
+    data = b""
+    s.settimeout(10)
+    while b"\r\n\r\n" not in data:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+    assert b"413" in data.split(b"\r\n", 1)[0], data[:100]
+    s.close()
+
+
+def test_header_bomb_gets_431(stack):
+    s = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+    req = b"GET /health HTTP/1.1\r\nHost: x\r\n"
+    req += b"".join(b"X-H%d: v\r\n" % i for i in range(300))
+    req += b"\r\n"
+    s.sendall(req)
+    data = b""
+    s.settimeout(10)
+    while b"\r\n\r\n" not in data:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+    assert b"431" in data.split(b"\r\n", 1)[0], data[:100]
+    s.close()
 
 
 def test_upstream_down_returns_502(binary):

@@ -1,0 +1,87 @@
+"""Vision tower parity vs HF SigLIP + Gemma3 projector (tiny configs)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from llms_on_kubernetes_tpu.models.vision import (
+    VisionConfig, encode_images, init_vision_params, load_vision_params,
+    preprocess_image,
+)
+
+
+def _tiny_hf_vision(torch):
+    import transformers
+    from transformers.models.gemma3.modeling_gemma3 import (
+        Gemma3MultiModalProjector,
+    )
+
+    vcfg = transformers.SiglipVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=24, patch_size=4,
+        num_channels=3, layer_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
+    )
+    tower = transformers.SiglipVisionModel(vcfg).eval()
+    tower.set_attn_implementation("eager")
+    g_cfg = transformers.Gemma3Config(
+        text_config=transformers.Gemma3TextConfig(
+            vocab_size=64, hidden_size=48, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+            head_dim=8),
+        # dict, not the instance: Gemma3Config would mutate the shared
+        # config's _attn_implementation under the tower's feet
+        vision_config=vcfg.to_dict(), mm_tokens_per_image=9,
+    )
+    proj = Gemma3MultiModalProjector(g_cfg).eval()
+    torch.manual_seed(0)
+    for p in list(tower.parameters()) + list(proj.parameters()):
+        torch.nn.init.normal_(p, std=0.05)
+    return vcfg, tower, proj
+
+
+def test_vision_encode_matches_hf(tmp_path):
+    torch = pytest.importorskip("torch")
+    hf_vcfg, tower, proj = _tiny_hf_vision(torch)
+
+    vcfg = VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=2, num_heads=4,
+        image_size=24, patch_size=4, mm_tokens_per_image=9,
+    )
+    # state dicts -> a fetch-like callable over HF names
+    sd = {("vision_tower.vision_model." + k): v.detach().numpy()
+          for k, v in tower.vision_model.state_dict().items()}
+    sd["multi_modal_projector.mm_soft_emb_norm.weight"] = (
+        proj.mm_soft_emb_norm.weight.detach().numpy())
+    sd["multi_modal_projector.mm_input_projection_weight"] = (
+        proj.mm_input_projection_weight.detach().numpy())
+    params = load_vision_params(vcfg, lambda n: sd[n])
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((2, 24, 24, 3)).astype(np.float32)
+    got = np.asarray(encode_images(params, vcfg, jnp.asarray(pixels)))
+
+    with torch.no_grad():
+        pt = torch.tensor(pixels.transpose(0, 3, 1, 2))  # NCHW
+        hidden = tower(pixel_values=pt).last_hidden_state
+        want = proj(hidden).numpy()
+    assert got.shape == want.shape == (2, 9, 48)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_init_and_preprocess_shapes():
+    import jax
+
+    vcfg = VisionConfig(hidden_size=16, intermediate_size=32, num_layers=1,
+                        num_heads=2, image_size=16, patch_size=4,
+                        mm_tokens_per_image=4)
+    params = init_vision_params(vcfg, text_hidden=24, key=jax.random.key(0))
+    out = encode_images(params, vcfg,
+                        jnp.zeros((1, 16, 16, 3), jnp.float32))
+    assert out.shape == (1, 4, 24)
+    assert np.isfinite(np.asarray(out)).all()
+
+    img = (np.arange(10 * 12 * 3) % 255).reshape(10, 12, 3).astype(np.uint8)
+    x = preprocess_image(img, 16)
+    assert x.shape == (16, 16, 3)
+    assert -1.0 <= x.min() and x.max() <= 1.0
