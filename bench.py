@@ -247,6 +247,13 @@ def main() -> int:
             # busy-until estimate feeds back through the completion-rate
             # EMA and can stall the pipeline when reads are the bottleneck)
             pace_target_steps=float(os.environ.get("BENCH_PACE", "0")),
+            # int8 KV cache (opt-in: BENCH_KV=int8, with BENCH_PAGE=128 for
+            # the Mosaic-aligned kernel path): halves decode-attention HBM
+            # traffic and doubles token capacity. At THIS bench's short
+            # contexts the step floor is elsewhere, so the headline runs
+            # bf16 KV; int8 is the long-context/capacity configuration.
+            kv_cache_dtype=("int8" if os.environ.get("BENCH_KV") == "int8"
+                            else None),
         )
         prompt_len, gen_len = 32, int(os.environ.get("BENCH_GEN", "128"))
     else:  # small-model fallback for CPU dev runs

@@ -45,6 +45,9 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--no-prefix-caching", dest="prefix_caching",
                    action="store_false", default=True,
                    help="disable page-level reuse of shared prompt prefixes")
+    p.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
+                   help="store KV quantized (halved decode HBM traffic, "
+                        "2x token capacity; ~1/127 per-element error)")
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -204,6 +207,7 @@ def main(argv: list[str] | None = None) -> int:
         prefill_buckets=tuple(int(x) for x in args.prefill_buckets.split(",")),
         quantization=args.quantization,
         prefix_caching=args.prefix_caching,
+        kv_cache_dtype=args.kv_cache_dtype,
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
     )

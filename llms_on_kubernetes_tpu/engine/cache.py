@@ -36,6 +36,7 @@ compiled executable (XLA retraces on any shape change).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,10 @@ class CacheConfig:
     page_size: int = 64
     pages_per_slot: int = 32
     dtype: str = "bfloat16"
+    # "int8": per-token symmetric KV quantization (scale per (head, page,
+    # token) stored beside the data) — halves decode-attention HBM traffic
+    # and doubles token capacity per chip. None => KV stored in `dtype`.
+    kv_dtype: "Optional[str]" = None
 
     @property
     def max_seq_len(self) -> int:
@@ -58,17 +63,76 @@ class CacheConfig:
 
     @property
     def bytes_per_page(self) -> int:
-        itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.page_size * self.num_kv_heads * self.head_dim * itemsize
+        if self.kv_dtype == "int8":
+            per_tok = self.num_kv_heads * (self.head_dim + 4)  # data + scale
+        else:
+            per_tok = (self.num_kv_heads * self.head_dim
+                       * jnp.dtype(self.dtype).itemsize)
+        return 2 * self.num_layers * self.page_size * per_tok
 
 
-def init_pages(cfg: CacheConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+@jax.tree_util.register_pytree_node_class
+class KVPool:
+    """One side (K or V) of the paged cache: flat head-major ``data``
+    [n_kv, L*P, page, d] plus, when int8-quantized, a per-token ``scale``
+    [n_kv, L*P, page] float32. A pytree, so it rides jit arguments,
+    donation, lax.scan carries, and device_put shardings like the plain
+    array it replaces."""
+
+    def __init__(self, data: jnp.ndarray, scale: Optional[jnp.ndarray] = None):
+        self.data = data
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale is not None
+
+    def tree_flatten(self):
+        if self.scale is None:
+            return (self.data,), False
+        return (self.data, self.scale), True
+
+    @classmethod
+    def tree_unflatten(cls, has_scale, children):
+        return cls(*children) if has_scale else cls(children[0])
+
+    def __repr__(self):
+        return (f"KVPool(shape={tuple(self.data.shape)}, "
+                f"dtype={self.data.dtype}, quantized={self.quantized})")
+
+
+def init_pages(cfg: CacheConfig) -> tuple[KVPool, KVPool]:
     """Flat head-major pools [n_kv, L * P, page, d] (layer l's block starts
     at l * P; see module docstring for why the layer axis is folded in)."""
     shape = (cfg.num_kv_heads, cfg.num_layers * cfg.num_pages,
              cfg.page_size, cfg.head_dim)
+    if cfg.kv_dtype == "int8":
+        def one():
+            return KVPool(jnp.zeros(shape, jnp.int8),
+                          jnp.zeros(shape[:3], jnp.float32))
+        return one(), one()
+    if cfg.kv_dtype is not None:
+        raise ValueError(f"unsupported kv_dtype {cfg.kv_dtype!r} "
+                         f"(None or 'int8')")
     dt = jnp.dtype(cfg.dtype)
-    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+    return KVPool(jnp.zeros(shape, dt)), KVPool(jnp.zeros(shape, dt))
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token symmetric int8: x [..., d] -> (int8 data, f32 scale [...])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    data = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return data, scale
 
 
 # Page updates are unrolled per (slot, touched page); beyond this many
@@ -81,16 +145,17 @@ _MAX_RMW_PAGES = 33
 
 
 def write_tokens(
-    k_pages: jnp.ndarray,
-    v_pages: jnp.ndarray,
+    k_pages: "KVPool",
+    v_pages: "KVPool",
     k: jnp.ndarray,
     v: jnp.ndarray,
     page_table: jnp.ndarray,
     positions: jnp.ndarray,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+) -> tuple["KVPool", "KVPool"]:
     """Write new KV for one layer into the page pool IN PLACE.
 
-    k_pages/v_pages: [n_kv, P_total, page, d] (flat head-major pool)
+    k_pages/v_pages: KVPool — data [n_kv, P_total, page, d] (flat
+                     head-major pool) + optional per-token int8 scale
     k, v:            [B, T, n_kv, d]
     page_table:      [B, pages_per_seq] int32 — GLOBAL page ids (the layer
                      body has already added its l*P block offset)
@@ -99,6 +164,10 @@ def write_tokens(
                      (padding). Row-contiguity holds for every caller:
                      decode writes one token, prefill/chunk write a
                      front-packed chunk.
+
+    Quantized pools write int8 data + per-token scale with the same DUS
+    pattern (the quantization is per token, so an append never has to
+    rescale previously written tokens).
 
     Implementation note (measured on v5e): HLO scatter never updates a
     multi-GB pool in place — it materializes a full copy per call — and a
@@ -112,7 +181,20 @@ def write_tokens(
     B, T, n_kv, d = k.shape
     page = k_pages.shape[2]
     pps = page_table.shape[1]
-    dt = k_pages.dtype
+    quant = k_pages.quantized
+    if quant:
+        kq, ks = quantize_kv(k)   # [B, T, n_kv] scale
+        vq, vs = quantize_kv(v)
+        k, v = kq, vq
+        dt = jnp.int8
+    else:
+        ks = vs = None
+        dt = k_pages.dtype
+    kd, vd = k_pages.data, v_pages.data
+    ksc, vsc = k_pages.scale, v_pages.scale
+
+    def rewrap():
+        return (KVPool(kd, ksc), KVPool(vd, vsc))
 
     if T == 1:
         pos = positions[:, 0]
@@ -125,16 +207,19 @@ def write_tokens(
         for b in range(B):
             upd_k = k[b, 0].astype(dt)[:, None, None, :]   # [n_kv, 1, 1, d]
             upd_v = v[b, 0].astype(dt)[:, None, None, :]
-            k_pages = jax.lax.dynamic_update_slice(
-                k_pages, upd_k, (0, pid[b], off[b], 0))
-            v_pages = jax.lax.dynamic_update_slice(
-                v_pages, upd_v, (0, pid[b], off[b], 0))
-        return k_pages, v_pages
+            kd = jax.lax.dynamic_update_slice(kd, upd_k, (0, pid[b], off[b], 0))
+            vd = jax.lax.dynamic_update_slice(vd, upd_v, (0, pid[b], off[b], 0))
+            if quant:
+                ksc = jax.lax.dynamic_update_slice(
+                    ksc, ks[b, 0][:, None, None], (0, pid[b], off[b]))
+                vsc = jax.lax.dynamic_update_slice(
+                    vsc, vs[b, 0][:, None, None], (0, pid[b], off[b]))
+        return rewrap()
 
     n_touch = (T - 1) // page + 2  # max pages a T-token contiguous run spans
     if n_touch > _MAX_RMW_PAGES:
-        return _write_tokens_scatter(k_pages, v_pages, k, v, page_table,
-                                     positions)
+        return _write_tokens_scatter(k_pages, v_pages, k, v, ks, vs,
+                                     page_table, positions)
 
     valid = positions >= 0                       # [B, T]
     # rows are front-packed: entry 0 is the first (lowest) position, or -1
@@ -155,6 +240,9 @@ def write_tokens(
             t_c = jnp.clip(t_idx, 0, T - 1)
             new_k = jnp.take(kb, t_c, axis=0).transpose(1, 0, 2)  # [n_kv, page, d]
             new_v = jnp.take(vb, t_c, axis=0).transpose(1, 0, 2)
+            if quant:
+                new_ks = jnp.take(ks[b], t_c, axis=0).T  # [n_kv, page]
+                new_vs = jnp.take(vs[b], t_c, axis=0).T
             if j == 0:
                 # head page may hold a PREVIOUS chunk's tokens below pos0:
                 # read-merge-write. Every later page is append-territory —
@@ -165,20 +253,31 @@ def write_tokens(
                 in_chunk = (t_idx >= 0) & (t_idx < T)
                 mask = in_chunk & valid[b, t_c]  # [page]
                 cur_k = jax.lax.dynamic_slice(
-                    k_pages, (0, pid, 0, 0), (n_kv, 1, page, d))[:, 0]
+                    kd, (0, pid, 0, 0), (n_kv, 1, page, d))[:, 0]
                 cur_v = jax.lax.dynamic_slice(
-                    v_pages, (0, pid, 0, 0), (n_kv, 1, page, d))[:, 0]
+                    vd, (0, pid, 0, 0), (n_kv, 1, page, d))[:, 0]
                 m = mask[None, :, None]
                 new_k = jnp.where(m, new_k, cur_k)
                 new_v = jnp.where(m, new_v, cur_v)
-            k_pages = jax.lax.dynamic_update_slice(
-                k_pages, new_k[:, None], (0, pid, 0, 0))
-            v_pages = jax.lax.dynamic_update_slice(
-                v_pages, new_v[:, None], (0, pid, 0, 0))
-    return k_pages, v_pages
+                if quant:
+                    cur_ks = jax.lax.dynamic_slice(
+                        ksc, (0, pid, 0), (n_kv, 1, page))[:, 0]
+                    cur_vs = jax.lax.dynamic_slice(
+                        vsc, (0, pid, 0), (n_kv, 1, page))[:, 0]
+                    new_ks = jnp.where(mask[None, :], new_ks, cur_ks)
+                    new_vs = jnp.where(mask[None, :], new_vs, cur_vs)
+            kd = jax.lax.dynamic_update_slice(kd, new_k[:, None], (0, pid, 0, 0))
+            vd = jax.lax.dynamic_update_slice(vd, new_v[:, None], (0, pid, 0, 0))
+            if quant:
+                ksc = jax.lax.dynamic_update_slice(
+                    ksc, new_ks[:, None], (0, pid, 0))
+                vsc = jax.lax.dynamic_update_slice(
+                    vsc, new_vs[:, None], (0, pid, 0))
+    return rewrap()
 
 
-def _write_tokens_scatter(k_pages, v_pages, k, v, page_table, positions):
+def _write_tokens_scatter(k_pages, v_pages, k, v, ks, vs, page_table,
+                          positions):
     """HLO-scatter fallback for huge chunks (costs one pool copy)."""
     page = k_pages.shape[2]
     trash = positions < 0
@@ -190,9 +289,17 @@ def _write_tokens_scatter(k_pages, v_pages, k, v, page_table, positions):
     # adjacent advanced indices on dims (1, 2): result [n_kv, B, T, d]
     kh = jnp.moveaxis(k, 2, 0)
     vh = jnp.moveaxis(v, 2, 0)
-    k_pages = k_pages.at[:, page_ids, offs].set(kh, mode="drop")
-    v_pages = v_pages.at[:, page_ids, offs].set(vh, mode="drop")
-    return k_pages, v_pages
+    kd = k_pages.data.at[:, page_ids, offs].set(
+        kh.astype(k_pages.dtype), mode="drop")
+    vd = v_pages.data.at[:, page_ids, offs].set(
+        vh.astype(v_pages.dtype), mode="drop")
+    ksc, vsc = k_pages.scale, v_pages.scale
+    if k_pages.quantized:
+        ksc = ksc.at[:, page_ids, offs].set(
+            jnp.moveaxis(ks, 2, 0), mode="drop")
+        vsc = vsc.at[:, page_ids, offs].set(
+            jnp.moveaxis(vs, 2, 0), mode="drop")
+    return KVPool(kd, ksc), KVPool(vd, vsc)
 
 
 class PageAllocator:

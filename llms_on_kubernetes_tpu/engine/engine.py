@@ -121,6 +121,10 @@ class EngineConfig:
     # multimodal: images per request the mm-prefill executable is compiled
     # for (requests with more are rejected at submit)
     max_images_per_request: int = 1
+    # KV cache storage dtype: None => engine dtype; "int8" => per-token
+    # quantized KV (halved decode-attention HBM traffic, doubled token
+    # capacity; accuracy pinned by logit-tolerance tests)
+    kv_cache_dtype: Optional[str] = None
     seed: int = 0
 
     @property
@@ -594,14 +598,22 @@ class Engine:
             page_size=engine_config.page_size,
             pages_per_slot=engine_config.pages_per_slot,
             dtype=engine_config.dtype,
+            kv_dtype=engine_config.kv_cache_dtype,
         )
         self.k_pages, self.v_pages = init_pages(self.cache_config)
+        if (engine_config.kv_cache_dtype == "int8"
+                and engine_config.page_size % 128 != 0
+                and jax.default_backend() == "tpu"):
+            import logging
+            logging.getLogger(__name__).warning(
+                "kv_cache_dtype=int8 with page_size=%d: the Pallas int8 "
+                "decode kernel needs a 128-multiple page size (Mosaic lane "
+                "tiling); decode attention falls back to the slower XLA "
+                "gather path", engine_config.page_size)
         if mesh is not None:
-            from jax.sharding import NamedSharding
-            from llms_on_kubernetes_tpu.parallel.sharding import cache_specs
-            ks, vs = cache_specs(cfg, mesh)
-            self.k_pages = jax.device_put(self.k_pages, NamedSharding(mesh, ks))
-            self.v_pages = jax.device_put(self.v_pages, NamedSharding(mesh, vs))
+            from llms_on_kubernetes_tpu.parallel.sharding import shard_pool
+            self.k_pages = shard_pool(self.k_pages, cfg, mesh)
+            self.v_pages = shard_pool(self.v_pages, cfg, mesh)
 
         B = engine_config.max_decode_slots
         self.allocator = PageAllocator(

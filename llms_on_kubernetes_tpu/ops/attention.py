@@ -51,6 +51,19 @@ def softcap(logits: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
     return cap * jnp.tanh(logits / cap)
 
 
+def _gather_pool(pool, page_table, B: int, S: int, d: int) -> jnp.ndarray:
+    """Materialize a pool's logical KV [n_kv, B, S, d] f32 through the page
+    table, dequantizing per token when the pool is int8 (engine/cache.py
+    KVPool)."""
+    data = getattr(pool, "data", pool)   # raw arrays accepted (tests)
+    n_kv = data.shape[0]
+    x = data[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    if getattr(pool, "quantized", False):
+        s = pool.scale[:, page_table].reshape(n_kv, B, S)
+        x = x * s[..., None]
+    return x
+
+
 def prefill_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -138,8 +151,8 @@ def paged_attention(
     S = pages_per_seq * page
     group = n_q // n_kv
 
-    k = k_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
-    v = v_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    k = _gather_pool(k_pages, page_table, B, S, d)
+    v = _gather_pool(v_pages, page_table, B, S, d)
     qg = q.reshape(B, n_kv, group, d).astype(jnp.float32)
 
     logits = jnp.einsum("bkgd,kbsd->bkgs", qg, k) * scale   # [B, n_kv, g, S]
@@ -191,8 +204,8 @@ def chunk_attention(
     S = page_table.shape[1] * page
     group = n_q // n_kv
 
-    k = k_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
-    v = v_pages[:, page_table].reshape(n_kv, B, S, d).astype(jnp.float32)
+    k = _gather_pool(k_pages, page_table, B, S, d)
+    v = _gather_pool(v_pages, page_table, B, S, d)
     qg = q.reshape(B, T, n_kv, group, d).astype(jnp.float32)
 
     logits = jnp.einsum("btkgd,kbsd->bkgts", qg, k) * scale  # [B,n_kv,g,T,S]
@@ -281,11 +294,35 @@ def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
     # slices); d=64/96 models (TinyLlama, Phi-3) take the XLA gather path.
     d_ok = q.shape[-1] % 128 == 0 or jax.default_backend() == "cpu"
     if use_pallas_kernels() and _static_window(sliding_window) and d_ok:
+        if getattr(k_pages, "quantized", False):
+            # the int8 kernel's scale DMAs land at lane offset i*page_size,
+            # which Mosaic only accepts 128-aligned: off-TPU (interpret)
+            # any page works, on TPU page_size must be a 128 multiple
+            # (engine warns at startup otherwise and this falls back to
+            # the XLA gather path)
+            page_ok = (k_pages.data.shape[2] % 128 == 0
+                       or jax.default_backend() == "cpu")
+            if page_ok:
+                from llms_on_kubernetes_tpu.ops.pallas_paged import (
+                    pallas_paged_attention_int8,
+                )
+
+                return pallas_paged_attention_int8(
+                    q, k_pages.data, k_pages.scale, v_pages.data,
+                    v_pages.scale, page_table, lengths, scale=scale,
+                    sliding_window=sliding_window, attn_softcap=attn_softcap,
+                    interpret=jax.default_backend() == "cpu",
+                )
+            return paged_attention(q, k_pages, v_pages, page_table, lengths,
+                                   scale=scale, sliding_window=sliding_window,
+                                   attn_softcap=attn_softcap)
         from llms_on_kubernetes_tpu.ops.pallas_paged import pallas_paged_attention
 
         return pallas_paged_attention(
-            q, k_pages, v_pages, page_table, lengths, scale=scale,
-            sliding_window=sliding_window, attn_softcap=attn_softcap,
+            q, getattr(k_pages, "data", k_pages),
+            getattr(v_pages, "data", v_pages), page_table, lengths,
+            scale=scale, sliding_window=sliding_window,
+            attn_softcap=attn_softcap,
             interpret=jax.default_backend() == "cpu",
         )
     return paged_attention(q, k_pages, v_pages, page_table, lengths,

@@ -41,12 +41,12 @@ from llms_on_kubernetes_tpu.ops.attention import NEG_INF, softcap
 def _paged_kernel(
     page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
     lengths_ref,      # SMEM [B]                (scalar prefetch)
-    q_ref,            # VMEM [1, 1, group, d]
+    q_ref,            # VMEM [1, n_kv, group, d]
     k_hbm,            # ANY  [n_kv, P, page, d] (head-major pool)
     v_hbm,            # ANY  [n_kv, P, page, d]
-    o_ref,            # VMEM [1, 1, group, d]
-    k_buf,            # VMEM [S, d] scratch
-    v_buf,            # VMEM [S, d] scratch
+    o_ref,            # VMEM [1, n_kv, group, d]
+    k_buf,            # VMEM [n_kv, S, d] scratch
+    v_buf,            # VMEM [n_kv, S, d] scratch
     sems,             # DMA semaphores [2, pages_per_seq]
     *,
     scale: float,
@@ -55,8 +55,16 @@ def _paged_kernel(
     page_size: int,
     pages_per_seq: int,
 ):
+    """Grid is (B,): ONE program per slot computes ALL kv heads.
+
+    A (B, n_kv) grid ran B*n_kv tiny sequential programs (a v5e chip has a
+    single TensorCore — grid steps serialize), and per-program overhead
+    (DMA issue/wait, matmul setup) dominated: measured ~2 ms per LAYER at
+    B=64, ~13 ms of a 33 ms decode step. Batching the head dimension into
+    one program amortizes that overhead 8x: each page DMA moves the
+    [n_kv, page, d] strided block for every head at once, and the two MXU
+    contractions run batched over heads."""
     b = pl.program_id(0)
-    h = pl.program_id(1)
     S = pages_per_seq * page_size
     length = lengths_ref[b]
     # LENGTH-BOUNDED DMA: only pages actually covering this slot's tokens
@@ -67,54 +75,54 @@ def _paged_kernel(
     # before the softmax, so stale lanes never contribute.
     n_pages = (length + page_size - 1) // page_size
 
-    # one contiguous [page, d] DMA per page per K/V
+    # one strided [n_kv, page, d] DMA per page per K/V (covers all heads)
     for i in range(pages_per_seq):
         @pl.when(i < n_pages)
         def _start(i=i):
             page_id = page_table_ref[b, i]
             pltpu.make_async_copy(
-                k_hbm.at[h, page_id],
-                k_buf.at[pl.ds(i * page_size, page_size), :],
+                k_hbm.at[:, page_id],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
                 sems.at[0, i],
             ).start()
             pltpu.make_async_copy(
-                v_hbm.at[h, page_id],
-                v_buf.at[pl.ds(i * page_size, page_size), :],
+                v_hbm.at[:, page_id],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
                 sems.at[1, i],
             ).start()
     for i in range(pages_per_seq):
         @pl.when(i < n_pages)
         def _wait(i=i):
             pltpu.make_async_copy(
-                k_hbm.at[h, page_table_ref[b, i]],
-                k_buf.at[pl.ds(i * page_size, page_size), :],
+                k_hbm.at[:, page_table_ref[b, i]],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
                 sems.at[0, i],
             ).wait()
             pltpu.make_async_copy(
-                v_hbm.at[h, page_table_ref[b, i]],
-                v_buf.at[pl.ds(i * page_size, page_size), :],
+                v_hbm.at[:, page_table_ref[b, i]],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
                 sems.at[1, i],
             ).wait()
 
-    q = q_ref[0, 0].astype(jnp.float32)                # [group, d]
-    k = k_buf[:].astype(jnp.float32)                   # [S, d]
+    q = q_ref[0].astype(jnp.float32)                   # [n_kv, group, d]
+    k = k_buf[:].astype(jnp.float32)                   # [n_kv, S, d]
     v = v_buf[:].astype(jnp.float32)
+    n_kv, group, d = q.shape
     # stale (un-DMA'd) V rows must be zeroed: the p @ v matmul multiplies
     # masked-out (zero) probabilities by them, and 0 * NaN = NaN. K needs
     # no fix ONLY because the mask below is a substitutive jnp.where that
     # REPLACES garbage logits wholesale — an additive `logits + NEG_INF`
     # formulation would let stale-K NaNs through (NaN + c = NaN).
     v = jnp.where(
-        jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0) < length, v, 0.0)
+        jax.lax.broadcasted_iota(jnp.int32, (n_kv, S, 1), 1) < length, v, 0.0)
 
     logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q, k, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
-    ) * scale                                          # [group, S]
+    ) * scale                                          # [n_kv, group, S]
     logits = softcap(logits, attn_softcap)
 
-    group = q.shape[0]
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, (group, S), 1)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (n_kv, group, S), 2)
     mask = k_pos < length
     if sliding_window is not None:
         mask &= k_pos > (length - 1) - sliding_window
@@ -124,10 +132,190 @@ def _paged_kernel(
     p = jnp.exp(logits - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)
     o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p, v, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     ) / denom
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _paged_kernel_int8(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    lengths_ref,      # SMEM [B]                (scalar prefetch)
+    q_ref,            # VMEM [1, n_kv, group, d]
+    k_hbm,            # ANY  [n_kv, P, page, d] int8 (head-major pool)
+    ks_hbm,           # ANY  [n_kv, P, page] f32 per-token scales
+    v_hbm,            # ANY  [n_kv, P, page, d] int8
+    vs_hbm,           # ANY  [n_kv, P, page] f32
+    o_ref,            # VMEM [1, n_kv, group, d]
+    k_buf,            # VMEM [n_kv, S, d] int8 scratch
+    v_buf,            # VMEM [n_kv, S, d] int8 scratch
+    ks_buf,           # VMEM [n_kv, S] f32 scratch
+    vs_buf,           # VMEM [n_kv, S] f32 scratch
+    sems,             # DMA semaphores [4, pages_per_seq]
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    attn_softcap: Optional[float],
+    page_size: int,
+    pages_per_seq: int,
+):
+    """int8 decode attention, head-batched like _paged_kernel (one program
+    per slot — see that kernel's grid rationale): the page DMA moves
+    1-byte KV plus a per-token scale vector, and the dequantize folds
+    into LANE-dim multiplies — decode attention HBM traffic is halved vs
+    bf16.
+
+    Layout trick: a per-KEY-token scale can be applied to the LOGITS
+    column instead of to K rows (q·(k·s) == (q·k)·s), and a per-VALUE
+    scale to the probability column instead of V rows. Both are [*, S]
+    lane-dim broadcasts, so no sublane-broadcast/transpose of the [S]
+    scale vector is ever needed — and the scale DMAs land at lane offsets
+    i*page_size, which Mosaic accepts only when page_size is a multiple
+    of the 128-lane tile (enforced by the dispatcher)."""
+    b = pl.program_id(0)
+    S = pages_per_seq * page_size
+    length = lengths_ref[b]
+    n_pages = (length + page_size - 1) // page_size
+
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _start(i=i):
+            page_id = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                k_hbm.at[:, page_id],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i],
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[:, page_id],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i],
+            ).start()
+            pltpu.make_async_copy(
+                ks_hbm.at[:, page_id],
+                ks_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[2, i],
+            ).start()
+            pltpu.make_async_copy(
+                vs_hbm.at[:, page_id],
+                vs_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[3, i],
+            ).start()
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _wait(i=i):
+            pid = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                k_hbm.at[:, pid],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[:, pid],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i]).wait()
+            pltpu.make_async_copy(
+                ks_hbm.at[:, pid],
+                ks_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[2, i]).wait()
+            pltpu.make_async_copy(
+                vs_hbm.at[:, pid],
+                vs_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[3, i]).wait()
+
+    q = q_ref[0].astype(jnp.float32)                   # [n_kv, group, d]
+    k = k_buf[:].astype(jnp.float32)                   # [n_kv, S, d] UNSCALED
+    v = v_buf[:].astype(jnp.float32)
+    n_kv, group, d = q.shape
+    sc_k = ks_buf[:][:, None, :]                       # [n_kv, 1, S]
+    sc_v = vs_buf[:][:, None, :]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (n_kv, group, S), 2)
+    valid = k_pos < length
+
+    logits = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [n_kv, group, S]
+    # per-key dequant folded into the logits column; stale lanes (beyond
+    # length) can hold garbage scales — substitutive masking below removes
+    # them wholesale, and sc_v is zeroed there so p@v never sees them
+    logits = logits * sc_k
+    logits = softcap(logits, attn_softcap)
+
+    mask = valid
+    if sliding_window is not None:
+        mask &= k_pos > (length - 1) - sliding_window
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    # per-value dequant folded into the probability column
+    p = p * jnp.where(valid[:, :1], sc_v, 0.0)
+    o = jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) / denom
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
+)
+def pallas_paged_attention_int8(
+    q: jnp.ndarray,            # [B, n_q, d]
+    k_data: jnp.ndarray,       # [n_kv, P, page, d] int8
+    k_scale: jnp.ndarray,      # [n_kv, P, page] f32
+    v_data: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    lengths: jnp.ndarray,      # [B] int32 (incl. current token)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, n_q, d = q.shape
+    n_kv, P, page_size, _ = k_data.shape
+    pages_per_seq = page_table.shape[1]
+    S = pages_per_seq * page_size
+    group = n_q // n_kv
+
+    kernel = functools.partial(
+        _paged_kernel_int8,
+        scale=scale, sliding_window=sliding_window,
+        attn_softcap=attn_softcap,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+    )
+    qg = q.reshape(B, n_kv, group, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, S, d), k_data.dtype),
+            pltpu.VMEM((n_kv, S, d), v_data.dtype),
+            pltpu.VMEM((n_kv, S), jnp.float32),
+            pltpu.VMEM((n_kv, S), jnp.float32),
+            pltpu.SemaphoreType.DMA((4, pages_per_seq)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_data, k_scale, v_data, v_scale)
+    return out.reshape(B, n_q, d)
 
 
 @functools.partial(
@@ -164,16 +352,16 @@ def pallas_paged_attention(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, n_kv),
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1, group, d), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, d), lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((S, d), k_pages.dtype),
-            pltpu.VMEM((S, d), v_pages.dtype),
+            pltpu.VMEM((n_kv, S, d), k_pages.dtype),
+            pltpu.VMEM((n_kv, S, d), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, pages_per_seq)),
         ],
     )

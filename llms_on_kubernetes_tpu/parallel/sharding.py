@@ -84,6 +84,19 @@ def cache_specs(cfg: ModelConfig, mesh: Mesh) -> tuple[P, P]:
     return spec, spec
 
 
+def shard_pool(pool, cfg: ModelConfig, mesh: Mesh):
+    """Device_put a KVPool onto the mesh: every leaf (int8 data AND the
+    per-token scales) shards its leading kv-head axis over the model axis,
+    so each TP shard keeps its own heads' pages and scales local."""
+    m_kv = _axis(mesh, cfg.num_kv_heads, AXIS_MODEL)
+
+    def put(x):
+        spec = P(m_kv, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, pool)
+
+
 def batch_spec() -> P:
     return P(AXIS_DATA)
 

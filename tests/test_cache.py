@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 from llms_on_kubernetes_tpu.engine.cache import (
-    CacheConfig, PageAllocator, init_pages, write_tokens,
+    CacheConfig, KVPool, PageAllocator, init_pages, write_tokens,
 )
 
 
@@ -36,8 +36,8 @@ def test_allocator_exhaustion_and_overflow():
 
 def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     P, page, KV, d = 5, 4, 2, 3
-    k_pages = jnp.zeros((KV, P, page, d))
-    v_pages = jnp.zeros((KV, P, page, d))
+    k_pages = KVPool(jnp.zeros((KV, P, page, d)))
+    v_pages = KVPool(jnp.zeros((KV, P, page, d)))
     B, T = 1, 6
     k = jnp.arange(B * T * KV * d, dtype=jnp.float32).reshape(B, T, KV, d) + 1
     v = -k
@@ -45,11 +45,11 @@ def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     # positions 0..4 valid, position 5 is padding (-1 => trash page 0)
     positions = jnp.asarray([[0, 1, 2, 3, 4, -1]], jnp.int32)
     k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, positions)
-    kn = np.asarray(k_pages)  # [KV, P, page, d]
+    kn = np.asarray(k_pages.data)  # [KV, P, page, d]
     np.testing.assert_allclose(kn[:, 2, 0], np.asarray(k)[0, 0])
     np.testing.assert_allclose(kn[:, 2, 3], np.asarray(k)[0, 3])
     np.testing.assert_allclose(kn[:, 4, 0], np.asarray(k)[0, 4])
-    assert np.asarray(v_pages)[0, 2, 1, 0] == -np.asarray(k)[0, 1, 0, 0]
+    assert np.asarray(v_pages.data)[0, 2, 1, 0] == -np.asarray(k)[0, 1, 0, 0]
     # pages other than 2, 4 and trash are untouched
     assert (kn[:, 1] == 0).all() and (kn[:, 3] == 0).all()
 
@@ -76,8 +76,8 @@ def test_write_tokens_scatter_fallback_matches_dus_path():
     positions[1, :10] = np.arange(10)
     pt_j, pos_j = jnp.asarray(pt), jnp.asarray(positions)
 
-    kp0 = jnp.zeros((KV, P, page, d))
-    vp0 = jnp.zeros((KV, P, page, d))
+    kp0 = KVPool(jnp.zeros((KV, P, page, d)))
+    vp0 = KVPool(jnp.zeros((KV, P, page, d)))
     ks, vs = write_tokens(kp0, vp0, k, v, pt_j, pos_j)  # scatter (n_touch>33)
 
     # reference: same writes through the small-chunk DUS path, one
@@ -91,8 +91,8 @@ def test_write_tokens_scatter_fallback_matches_dus_path():
                 kd, vd, k[b:b + 1, t:t + 1], v[b:b + 1, t:t + 1],
                 pt_j[b:b + 1], pos_j[b:b + 1, t:t + 1])
     # trash page 0 may differ (padding lands there); compare real pages
-    np.testing.assert_array_equal(np.asarray(ks)[:, 1:], np.asarray(kd)[:, 1:])
-    np.testing.assert_array_equal(np.asarray(vs)[:, 1:], np.asarray(vd)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(ks.data)[:, 1:], np.asarray(kd.data)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(vs.data)[:, 1:], np.asarray(vd.data)[:, 1:])
 
 
 def test_cache_config_accounting():
@@ -103,3 +103,13 @@ def test_cache_config_accounting():
     k, v = init_pages(cc)
     # flat layout: [KV, L*P, page, d] (layer l's block starts at l*P)
     assert k.shape == (4, 2 * 16, 8, 8) and k.dtype == jnp.bfloat16
+    assert not k.quantized
+
+    cq = CacheConfig(num_layers=2, num_kv_heads=4, head_dim=8,
+                     num_pages=16, page_size=8, pages_per_slot=4,
+                     dtype="bfloat16", kv_dtype="int8")
+    kq, vq = init_pages(cq)
+    assert kq.quantized and kq.dtype == jnp.int8
+    assert kq.scale.shape == (4, 2 * 16, 8)
+    # int8 halves the per-page bytes vs bf16 (scale adds 4B per token)
+    assert cq.bytes_per_page < cc.bytes_per_page

@@ -79,9 +79,11 @@ def test_forward_chunk_matches_one_shot_prefill():
     # there and legitimately differ between chunked and one-shot runs.
     keep = np.ones(cfg.num_layers * cc.num_pages, bool)
     keep[np.arange(cfg.num_layers) * cc.num_pages] = False
-    np.testing.assert_allclose(np.asarray(kp)[:, keep], np.asarray(kp_ref)[:, keep],
+    np.testing.assert_allclose(np.asarray(kp.data)[:, keep],
+                               np.asarray(kp_ref.data)[:, keep],
                                rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(vp)[:, keep], np.asarray(vp_ref)[:, keep],
+    np.testing.assert_allclose(np.asarray(vp.data)[:, keep],
+                               np.asarray(vp_ref.data)[:, keep],
                                rtol=2e-5, atol=2e-5)
 
 

@@ -122,8 +122,8 @@ def test_paged_decode_through_cache_write_path(rng):
 
     q = jnp.asarray(rng.normal(size=(2, 4, 8)), jnp.float32)
     ref = paged_attention(q, kp, vp, table, lengths, scale=8 ** -0.5)
-    out = pallas_paged_attention(q, kp, vp, table, lengths, scale=8 ** -0.5,
-                                 interpret=True)
+    out = pallas_paged_attention(q, kp.data, vp.data, table, lengths,
+                                 scale=8 ** -0.5, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 

@@ -145,7 +145,7 @@ def test_ring_attention_integrated_in_prefill_forward():
     from llms_on_kubernetes_tpu.parallel.mesh import (
         make_mesh, set_active_mesh,
     )
-    from llms_on_kubernetes_tpu.parallel.sharding import cache_specs, shard_params
+    from llms_on_kubernetes_tpu.parallel.sharding import shard_params, shard_pool
 
     cfg = get_config("debug-tiny")
     params = init_params(cfg, jax.random.key(0), dtype="float32")
@@ -165,9 +165,8 @@ def test_ring_attention_integrated_in_prefill_forward():
     try:
         set_active_mesh(mesh)
         sp = shard_params(params, cfg, mesh)
-        ks, vs = cache_specs(cfg, mesh)
-        kp_s = jax.device_put(kp, NamedSharding(mesh, ks))
-        vp_s = jax.device_put(vp, NamedSharding(mesh, vs))
+        kp_s = shard_pool(kp, cfg, mesh)
+        vp_s = shard_pool(vp, cfg, mesh)
         got_logits, got_kp, _ = jax.jit(forward_prefill, static_argnums=(1,))(
             sp, cfg, toks, lens, kp_s, vp_s, pt)
     finally:
@@ -176,7 +175,7 @@ def test_ring_attention_integrated_in_prefill_forward():
     np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
                                rtol=2e-4, atol=2e-4)
     # KV cache written identically (global positions, same pages)
-    np.testing.assert_allclose(np.asarray(got_kp), np.asarray(ref_kp),
+    np.testing.assert_allclose(np.asarray(got_kp.data), np.asarray(ref_kp.data),
                                rtol=2e-4, atol=2e-4)
 
 

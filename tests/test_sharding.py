@@ -13,7 +13,7 @@ from llms_on_kubernetes_tpu.configs import get_config
 from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
 from llms_on_kubernetes_tpu.models.decoder import forward_decode, forward_prefill, init_params
 from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
-from llms_on_kubernetes_tpu.parallel.sharding import cache_specs, shard_params
+from llms_on_kubernetes_tpu.parallel.sharding import shard_params, shard_pool
 
 
 def _setup(name, dtype="float32"):
@@ -54,9 +54,8 @@ def test_sharded_forward_matches_unsharded(name, mesh_dims):
 
     mesh = make_mesh(**mesh_dims)
     sp = shard_params(params, cfg, mesh)
-    ks, vs = cache_specs(cfg, mesh)
-    kp_s = jax.device_put(kp, NamedSharding(mesh, ks))
-    vp_s = jax.device_put(vp, NamedSharding(mesh, vs))
+    kp_s = shard_pool(kp, cfg, mesh)
+    vp_s = shard_pool(vp, cfg, mesh)
 
     got_logits, got_kp, got_vp = jax.jit(
         forward_prefill, static_argnums=(1,)
