@@ -201,9 +201,16 @@ def write_tokens(
         safe = jnp.maximum(pos, 0)
         logical = safe // page
         pid = jnp.take_along_axis(page_table, logical[:, None], axis=1)[:, 0]
-        # padding -> trash page 0 (never read; keeps the DUS unconditional)
+        # padding -> trash page 0 (never read; keeps the write unconditional)
         pid = jnp.where(pos < 0, 0, pid)
         off = jnp.where(pos < 0, 0, safe % page)
+        # NOTE(measured, round 3): the unrolled per-slot DUS below costs
+        # ~3 ms/step at B=64 (4096 tiny ops). A batched Pallas write kernel
+        # (group read-merge-write per slot, all slots in one program) was
+        # prototyped and is bit-exact on TPU, but its unrolled DMA body
+        # made the STEP's Mosaic compile blow up at B=64 (64 kernel
+        # instances x 3*B DMA ops), and a grid/fori variant's per-slot
+        # serialization lands near DUS cost anyway — so DUS stays.
         for b in range(B):
             upd_k = k[b, 0].astype(dt)[:, None, None, :]   # [n_kv, 1, 1, d]
             upd_v = v[b, 0].astype(dt)[:, None, None, :]

@@ -608,37 +608,63 @@ class OpenAIServer:
         detok = IncrementalDetokenizer(self.tokenizer)
         stopper = StopChecker(stops)
         stop_ids = set(req.params.stop_token_ids)
+        nlp = req.params.logprobs
         total = 0
-        tok_chars = 0  # cumulative decoded length of entry tokens so far
+        pending: list = []   # entries whose text the stopper still holds back
+        released_chars = 0   # emitted chars covered by released entries
         while True:
             toks, done, reason = await _next_event(req)
             start = total
             total += len(toks)
             # exclude trailing stop token from visible text (OpenAI behavior)
-            entries = [
+            raw_entries = [
                 (t, req.output_logprobs[start + i]
                  if start + i < len(req.output_logprobs) else None)
                 for i, t in enumerate(toks)
                 if not (done and reason == "stop" and t in stop_ids)
             ]
-            visible = [t for t, _ in entries]
-            text, hit = stopper.push(detok.push(visible, final=done), final=done)
+            if nlp == 0:
+                # no logprobs wanted: one batched detok push per event (the
+                # per-token variant below re-decodes the id list per token)
+                text, hit = stopper.push(
+                    detok.push([t for t, _ in raw_entries], final=done),
+                    final=done)
+                if hit:
+                    self.loop_thread.abort(req)
+                    yield text, True, "stop", total, []
+                    return
+                yield text, done, reason, total, []
+                if done:
+                    return
+                continue
+            # logprobs path. Per-token text comes from the detokenizer's
+            # ACTUAL emitted deltas (one id pushed at a time), not from
+            # decode([tid]) in isolation — a mid-UTF-8/BPE token decodes to
+            # a replacement char alone, which would drift the stop-cut and
+            # text_offset accounting (round-2 advisor finding). Entries are
+            # RELEASED only once the stopper emits their text, so streamed
+            # logprobs never outrun a stop truncation that lands later.
+            # entries: (token_id, logprob_data, emitted_text_piece)
+            delta_parts = []
+            for i, (t, lp) in enumerate(raw_entries):
+                piece = detok.push([t], final=done and i == len(raw_entries) - 1)
+                delta_parts.append(piece)
+                pending.append((t, lp, piece))
+            if done and not raw_entries:
+                delta_parts.append(detok.push([], final=True))
+            text, hit = stopper.push("".join(delta_parts), final=done)
+            released = []
+            while pending:
+                t, lp, piece = pending[0]
+                if released_chars + len(piece) > stopper.emitted:
+                    break  # text still held back (or beyond a stop cut)
+                released.append(pending.pop(0))
+                released_chars += len(piece)
             if hit:
-                # a stop SEQUENCE matched mid-event: logprob entries must
-                # stop where the text does (OpenAI truncates at the stop) —
-                # keep tokens whose decoded text starts before the cut
-                kept = []
-                for t, lp in entries:
-                    if tok_chars >= stopper.emitted:
-                        break
-                    kept.append((t, lp))
-                    tok_chars += len(self._tok_str(t))
                 self.loop_thread.abort(req)
-                yield text, True, "stop", total, kept
+                yield text, True, "stop", total, released
                 return
-            for t, _ in entries:
-                tok_chars += len(self._tok_str(t))
-            yield text, done, reason, total, entries
+            yield text, done, reason, total, released
             if done:
                 return
 
@@ -659,16 +685,18 @@ class OpenAIServer:
         return self.tokenizer.decode([tid])
 
     def _chat_logprobs(self, entries, nlp: int) -> dict:
+        # the chosen token's text is its EMITTED piece (self-consistent
+        # with the response text even across multi-byte/BPE merges);
+        # alternatives can only be decoded in isolation
         content = []
-        for tid, lp in entries:
+        for tid, lp, piece in entries:
             if lp is None:
                 continue
             chosen_lp, top_ids, top_lps = lp
-            s = self._tok_str(tid)
             content.append({
-                "token": s,
+                "token": piece,
                 "logprob": chosen_lp,
-                "bytes": list(s.encode("utf-8")),
+                "bytes": list(piece.encode("utf-8")),
                 "top_logprobs": [
                     {"token": self._tok_str(i), "logprob": l,
                      "bytes": list(self._tok_str(i).encode("utf-8"))}
@@ -680,18 +708,22 @@ class OpenAIServer:
     def _completion_logprobs(self, entries, nlp: int, base_offset: int) -> dict:
         tokens, token_logprobs, top_logprobs, text_offset = [], [], [], []
         offset = base_offset
-        for tid, lp in entries:
+        # token strings and text_offset both come from each token's
+        # EMITTED piece (the detokenizer's actual delta), so
+        # response_text[text_offset[i]:][:len(tokens[i])] == tokens[i]
+        # holds exactly, even across multi-byte/BPE merges
+        for tid, lp, piece in entries:
             if lp is None:
+                offset += len(piece)
                 continue
             chosen_lp, top_ids, top_lps = lp
-            s = self._tok_str(tid)
-            tokens.append(s)
+            tokens.append(piece)
             token_logprobs.append(chosen_lp)
             top_logprobs.append(
                 {self._tok_str(i): l
                  for i, l in zip(top_ids[:nlp], top_lps[:nlp])})
             text_offset.append(offset)
-            offset += len(s)
+            offset += len(piece)
         return {"tokens": tokens, "token_logprobs": token_logprobs,
                 "top_logprobs": top_logprobs, "text_offset": text_offset}
 
@@ -717,7 +749,7 @@ class OpenAIServer:
             # a degenerate EMPTY completion must never win (its mean would
             # otherwise score 0.0, beating every real candidate)
             def score(entry_list):
-                lps = [lp[0] for _, lp in entry_list if lp is not None]
+                lps = [lp[0] for _, lp, _ in entry_list if lp is not None]
                 return sum(lps) / len(lps) if lps else float("-inf")
             kept = []
             for g in range(len(prompts)):
@@ -813,8 +845,7 @@ class OpenAIServer:
                                                entries=entries,
                                                base_offset=tok_chars))
                         if nlp:
-                            tok_chars += sum(len(self._tok_str(t))
-                                             for t, _ in entries)
+                            tok_chars += sum(len(p) for _, _, p in entries)
                     if done:
                         await resp.write(chunk(index, None, reason))
             completion_tokens += total

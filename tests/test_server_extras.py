@@ -67,14 +67,21 @@ def test_chat_logprobs_format():
             "logprobs": True, "top_logprobs": 2,
         })
         assert r.status == 200
-        content = (await r.json())["choices"][0]["logprobs"]["content"]
+        choice = (await r.json())["choices"][0]
+        content = choice["logprobs"]["content"]
         assert len(content) == 3
         for e in content:
             assert set(e) == {"token", "logprob", "bytes", "top_logprobs"}
             assert len(e["top_logprobs"]) == 2
             assert e["logprob"] <= 1e-4
-            # greedy: the chosen token is the top-1 alternative
-            assert e["top_logprobs"][0]["token"] == e["token"]
+            # greedy: the chosen token IS the top-1 alternative (compare
+            # logprobs: the chosen "token" string is the EMITTED piece,
+            # which may be held back ("") for a mid-UTF-8 byte while the
+            # isolated-decoded alternative shows a replacement char)
+            assert e["top_logprobs"][0]["logprob"] == e["logprob"]
+        # emitted pieces concatenate exactly to the message text
+        assert "".join(e["token"] for e in content) == \
+            choice["message"]["content"]
     with_client(body)
 
 
