@@ -69,6 +69,9 @@ class ModelConfig:
     image_token_id: Optional[int] = None    # the soft-token placeholder id
     boi_token_id: Optional[int] = None      # begin-of-image marker
     eoi_token_id: Optional[int] = None      # end-of-image marker
+    # Qwen3-VL interleaved multimodal RoPE: per-axis (t, h, w) frequency
+    # channel counts; None => standard 1-D rope
+    mrope_section: Optional[tuple] = None
 
     @property
     def q_dim(self) -> int:
@@ -255,8 +258,9 @@ _register(
 
 # Text backbone family of the reference's second default model
 # (Qwen3-VL-30B, reference vllm-models/helm-chart/values.yaml:7-12): the
-# Qwen3-MoE decoder (128 experts, top-8, qk-norm). The vision tower is out
-# of scope (text-only framework — PARITY.md known gaps).
+# Qwen3-MoE decoder (128 experts, top-8, qk-norm). Deploying the FULL
+# Qwen3-VL (vision tower + deepstack + mrope) goes through its
+# config.json via from_hf_config (model_type qwen3_vl_moe).
 _register(
     ModelConfig(
         "qwen3-30b-a3b",
@@ -357,6 +361,29 @@ def _debug_mm() -> ModelConfig:
 _register(_debug_mm())
 
 
+def _debug_qwen_mm() -> ModelConfig:
+    from llms_on_kubernetes_tpu.models.vision import VisionConfig
+
+    return ModelConfig(
+        "debug-qwen-mm",
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_layers=3, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512, qk_norm=True,
+        mrope_section=(3, 3, 2),
+        vision=VisionConfig(hidden_size=16, intermediate_size=32,
+                            num_layers=2, num_heads=2, image_size=16,
+                            patch_size=4, family="qwen3vl",
+                            temporal_patch_size=2, spatial_merge_size=2,
+                            out_hidden_size=64, num_grid_per_side=4,
+                            deepstack_indexes=(0,),
+                            mm_tokens_per_image=4),
+        image_token_id=260, boi_token_id=258, eoi_token_id=259,
+    )
+
+
+_register(_debug_qwen_mm())
+
+
 def get_config(name: str) -> ModelConfig:
     key = name if name in REGISTRY else ALIASES.get(name.lower(), name)
     if key not in REGISTRY:
@@ -404,9 +431,19 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
     scaling = hf.get("rope_scaling")
     if isinstance(scaling, dict):
         kind = scaling.get("rope_type", scaling.get("type"))
-        if kind in ("llama3", "linear"):
+        if "mrope_section" in scaling:
+            # Qwen3-VL multimodal rope: unscaled frequencies + interleaved
+            # 3-axis application (ops/rope.py apply_mrope). A SCALING
+            # scheme riding alongside (yarn long-context variants) is not
+            # expressed — fail fast like every other dropped scheme.
+            if kind not in (None, "default"):
+                raise NotImplementedError(
+                    f"rope_scaling type {kind!r} combined with "
+                    f"mrope_section is not supported yet")
+            kw["mrope_section"] = tuple(int(x) for x in scaling["mrope_section"])
+        elif kind in ("llama3", "linear"):
             kw["rope_scaling"] = scaling
-        elif kind is not None:
+        elif kind is not None and kind != "default":
             # fail fast: serving with a dropped scaling scheme (yarn,
             # longrope, ...) silently produces wrong positions
             raise NotImplementedError(
@@ -414,12 +451,12 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
             )
     if model_type in ("qwen2",):
         kw["attention_bias"] = True
-    if model_type in ("qwen3",):
+    if model_type in ("qwen3", "qwen3_vl", "qwen3_vl_text"):
         kw["qk_norm"] = True
     if model_type in ("mixtral",):
         kw["num_experts"] = int(hf.get("num_local_experts", 8))
         kw["num_experts_per_tok"] = int(hf.get("num_experts_per_tok", 2))
-    if model_type in ("qwen3_moe",):
+    if model_type in ("qwen3_moe", "qwen3_vl_moe", "qwen3_vl_moe_text"):
         # fail fast on layouts this decoder doesn't express (same policy
         # as the rope_scaling guard above): serving them silently would
         # produce wrong logits or a confusing mid-load KeyError
@@ -458,8 +495,39 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
             kw["qk_norm"] = True
             kw["sliding_window_pattern"] = int(hf.get("sliding_window_pattern", 6))
             kw["rope_local_theta"] = float(hf.get("rope_local_base_freq", 10000.0))
-    # multimodal wrapper (gemma3): vision tower + image token ids
+    # multimodal wrapper (qwen3_vl): dynamic-resolution ViT + deepstack.
+    # Serving needs static shapes, so images are resized to a fixed
+    # square (the interpolated position grid handles any size).
     vc = outer.get("vision_config")
+    if isinstance(vc, dict) and outer.get("model_type") in (
+            "qwen3_vl", "qwen3_vl_moe"):
+        from llms_on_kubernetes_tpu.models.vision import VisionConfig
+
+        patch = int(vc.get("patch_size", 16))
+        merge = int(vc.get("spatial_merge_size", 2))
+        image_size = int(vc.get("image_size") or 768)
+        image_size -= image_size % (patch * merge)
+        kw["vision"] = VisionConfig(
+            hidden_size=int(vc.get("hidden_size", 1152)),
+            intermediate_size=int(vc.get("intermediate_size", 4304)),
+            num_layers=int(vc.get("depth", 27)),
+            num_heads=int(vc.get("num_heads", 16)),
+            image_size=image_size,
+            patch_size=patch,
+            num_channels=int(vc.get("in_channels", 3)),
+            family="qwen3vl",
+            temporal_patch_size=int(vc.get("temporal_patch_size", 2)),
+            spatial_merge_size=merge,
+            out_hidden_size=int(vc.get("out_hidden_size", hidden)),
+            num_grid_per_side=int(
+                round(vc.get("num_position_embeddings", 2304) ** 0.5)),
+            deepstack_indexes=tuple(vc.get("deepstack_visual_indexes", ())),
+            mm_tokens_per_image=(image_size // (patch * merge)) ** 2,
+        )
+        kw["image_token_id"] = int(outer.get("image_token_id", 151655))
+        kw["boi_token_id"] = int(outer.get("vision_start_token_id", 151652))
+        kw["eoi_token_id"] = int(outer.get("vision_end_token_id", 151653))
+    # multimodal wrapper (gemma3): vision tower + image token ids
     if isinstance(vc, dict) and outer.get("model_type") == "gemma3":
         from llms_on_kubernetes_tpu.models.vision import VisionConfig
 

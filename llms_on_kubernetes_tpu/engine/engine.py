@@ -140,6 +140,9 @@ class Request:
     # multimodal: preprocessed pixels [n_images, H, W, C] float32; the
     # prompt carries matching image-soft-token runs (cfg.image_token_id)
     images: Optional[Any] = None
+    # mrope models (Qwen3-VL): rope position = token index + this delta
+    # for text continuation after images (set at mm admission)
+    mrope_delta: int = 0
     # resolved sampling seed (user's params.seed, or engine-drawn): the
     # request's sampled stream is fold(base_key, seed, position) — a pure
     # function of the request, never of batch composition or preemption
@@ -379,8 +382,8 @@ def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths,
 
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
 # 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
-# 9 frequency(bits), 10.. page_table
-_DEC_COLS = 10
+# 9 frequency(bits), 10 pos_delta (mrope), 11.. page_table
+_DEC_COLS = 11
 
 
 def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
@@ -394,6 +397,7 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     prefill_row = packed[:, 7]
     presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
     frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
+    pos_delta = packed[:, 10]
     page_table = packed[:, _DEC_COLS:]
 
     tokens = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
@@ -401,7 +405,8 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     # it before sampling so this step's draw sees it
     counts = _count_decode_tokens(counts, tokens, lengths > 0)
     logits, k_pages, v_pages = forward_decode(
-        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+        pos_delta=pos_delta,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
@@ -416,10 +421,12 @@ _PRE_COLS = 9
 
 
 def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
-                            k_pages, v_pages, counts, base_key):
+                            deepstack, pos3, k_pages, v_pages, counts,
+                            base_key):
     """Multimodal prefill ([1, bucket]): image soft-token embeddings are
     substituted inside forward_prefill_mm; sampling/penalties identical
-    to the text prefill."""
+    to the text prefill. ``deepstack``/``pos3`` are None for gemma-3 and
+    carry the DeepStack features / 3-axis mrope positions for Qwen3-VL."""
     from llms_on_kubernetes_tpu.models.decoder import forward_prefill_mm
 
     lengths = packed[:, 0]
@@ -438,7 +445,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
         jnp.ones_like(lengths))
     logits, k_pages, v_pages = forward_prefill_mm(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-        img_embeds,
+        img_embeds, deepstack=deepstack, pos3=pos3,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
@@ -640,12 +647,16 @@ class Engine:
             _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
         )
         if cfg.vision is not None:
-            from llms_on_kubernetes_tpu.models.vision import encode_images
+            from llms_on_kubernetes_tpu.models.vision import (
+                encode_images, encode_images_qwen3vl,
+            )
 
             self._mm_prefill_packed = jax.jit(
                 _prefill_mm_packed_step, static_argnums=(1,),
-                donate_argnums=(5, 6, 7))
-            self._encode_images = jax.jit(encode_images, static_argnums=(1,))
+                donate_argnums=(7, 8, 9))
+            enc = (encode_images_qwen3vl if cfg.vision.family == "qwen3vl"
+                   else encode_images)
+            self._encode_images = jax.jit(enc, static_argnums=(1,))
         # per-slot OUTPUT-token counts for presence/frequency penalties;
         # donated through every step like the page pools
         self.token_counts = jnp.zeros((B, cfg.vocab_size), jnp.int32)
@@ -774,6 +785,23 @@ class Engine:
             raise ValueError(
                 f"prompt has {soft} image soft tokens; {n} images need "
                 f"{n * t_img}")
+        # soft tokens must form contiguous runs of exactly t_img (the
+        # substitution/positions math assumes it; validating HERE keeps a
+        # malformed prompt a 400, not an engine-thread exception later)
+        i = 0
+        while i < len(prompt):
+            if prompt[i] == cfg.image_token_id:
+                run = 0
+                while (i < len(prompt)
+                       and prompt[i] == cfg.image_token_id):
+                    run += 1
+                    i += 1
+                if run != t_img:
+                    raise ValueError(
+                        f"image soft tokens must form runs of exactly "
+                        f"{t_img}; found a run of {run}")
+            else:
+                i += 1
         bucket = max(self.config.prefill_buckets)
         if len(prompt) > bucket:
             raise ValueError(
@@ -933,13 +961,19 @@ class Engine:
         (single row; substitution happens inside the executable). Returns
         the device SampleResult."""
         cfg = self.model_config
+        qwen = cfg.vision.family == "qwen3vl"
         pixels = jnp.asarray(np.asarray(req.images, np.float32))
-        embeds = self._encode_images(self.params["vision"], cfg.vision, pixels)
+        out = self._encode_images(self.params["vision"], cfg.vision, pixels)
+        embeds, deep = out if qwen else (out, None)
         n_max = self.config.max_images_per_request
         if embeds.shape[0] < n_max:  # pad image count to the compiled shape
             pad = jnp.zeros((n_max - embeds.shape[0],) + embeds.shape[1:],
                             embeds.dtype)
             embeds = jnp.concatenate([embeds, pad])
+            if deep is not None:
+                dpad = jnp.zeros(deep.shape[:1] + (n_max - deep.shape[1],)
+                                 + deep.shape[2:], deep.dtype)
+                deep = jnp.concatenate([deep, dpad], axis=1)
         n = len(prefill_tokens)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -947,10 +981,24 @@ class Engine:
         packed = np.zeros((1, _PRE_COLS + self.allocator.pages_per_slot),
                           np.int32)
         self._pack_prefill_row(packed, 0, req, n, slot)
+        pos3 = None
+        if qwen:
+            from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
+
+            p3, delta = qwen_mrope_positions(
+                prefill_tokens, cfg.image_token_id,
+                cfg.vision.mm_tokens_per_image)
+            req.mrope_delta = delta
+            full = np.zeros((1, 3, bucket), np.int32)
+            full[0, :, :n] = p3
+            pos3 = jnp.asarray(full)
+            if deep is not None:  # configs without deepstack taps: None
+                # flatten per row: [n_taps, 1(row), n_img_max*t_img, D]
+                deep = deep.reshape(deep.shape[0], -1, deep.shape[-1])[:, None]
         res, self.k_pages, self.v_pages, self.token_counts = self._mm_prefill_packed(
             self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
-            embeds[None], self.k_pages, self.v_pages, self.token_counts,
-            self._key,
+            embeds[None], deep, pos3, self.k_pages, self.v_pages,
+            self.token_counts, self._key,
         )
         self.slot_len[slot] = n
         return res
@@ -1113,6 +1161,7 @@ class Engine:
             packed[i, 6] = r.seed
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
+            packed[i, 10] = r.mrope_delta
         packed[:, _DEC_COLS:] = self.allocator.page_tables
 
         self._mh_send(MSG_DECODE, dec_packed=packed)
@@ -1324,6 +1373,7 @@ class Engine:
             packed[i, 6] = r.seed
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
+            packed[i, 10] = r.mrope_delta
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
                 if resumed:              # resumed: host-known pending token

@@ -344,9 +344,13 @@ def load_hf_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = fetch.linear("lm_head.weight").astype(dt)
     if cfg.vision is not None:
-        from llms_on_kubernetes_tpu.models.vision import load_vision_params
+        from llms_on_kubernetes_tpu.models.vision import (
+            load_qwen3vl_vision_params, load_vision_params,
+        )
 
-        params["vision"] = load_vision_params(cfg.vision, fetch, dtype=dt)
+        loader = (load_qwen3vl_vision_params
+                  if cfg.vision.family == "qwen3vl" else load_vision_params)
+        params["vision"] = loader(cfg.vision, fetch, dtype=dt)
 
     if mesh is not None:
         from llms_on_kubernetes_tpu.parallel.sharding import shard_params

@@ -119,10 +119,16 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[str] = None) -
     if not cfg.tie_word_embeddings:
         params["lm_head"] = init(D, V, scale=D ** -0.5)
     if cfg.vision is not None:
-        from llms_on_kubernetes_tpu.models.vision import init_vision_params
+        from llms_on_kubernetes_tpu.models.vision import (
+            init_qwen3vl_vision_params, init_vision_params,
+        )
 
-        params["vision"] = init_vision_params(
-            cfg.vision, D, next(keys), dtype=dt)
+        if cfg.vision.family == "qwen3vl":
+            params["vision"] = init_qwen3vl_vision_params(
+                cfg.vision, next(keys), dtype=dt)
+        else:
+            params["vision"] = init_vision_params(
+                cfg.vision, D, next(keys), dtype=dt)
     return params
 
 
@@ -176,6 +182,7 @@ def _layer_step(
     layer_idx: "jnp.ndarray | None" = None,
     inv_freq_local: "jnp.ndarray | None" = None,
     mm_groups: "jnp.ndarray | None" = None,
+    mm_pos3: "jnp.ndarray | None" = None,  # [B, 3, T] qwen3vl mrope
 ):
     scale = (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
     # Gemma-2/3 interleaved attention: layer is global iff (i+1) % pattern == 0;
@@ -189,7 +196,15 @@ def _layer_step(
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     q, k, v = _qkv(lp, cfg, h)
-    q, k = apply_rope(q, k, positions, inv_freq)
+    if mm_pos3 is not None:
+        # multimodal prompt on an mrope model (Qwen3-VL): interleaved
+        # 3-axis rotary; for text-only rows all three axes are equal and
+        # this matches apply_rope exactly
+        from llms_on_kubernetes_tpu.ops.rope import apply_mrope
+
+        q, k = apply_mrope(q, k, mm_pos3, inv_freq, cfg.mrope_section)
+    else:
+        q, k = apply_rope(q, k, positions, inv_freq)
     k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, write_positions)
 
     if mode == "prefill":
@@ -239,6 +254,10 @@ def _run_layers(
     lengths: jnp.ndarray,
     mode: str,
     mm_groups: "jnp.ndarray | None" = None,
+    mm_pos3: "jnp.ndarray | None" = None,
+    deepstack: "jnp.ndarray | None" = None,   # [n_taps, B, n_img*t_img, D]
+    mm_idx: "jnp.ndarray | None" = None,      # [B, T] soft-token index
+    mm_is_img: "jnp.ndarray | None" = None,   # [B, T] image-token mask
 ):
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling))
     inv_freq_local = (
@@ -258,8 +277,17 @@ def _run_layers(
         xc, kp, vp = _layer_step(
             cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
-            mm_groups=mm_groups,
+            mm_groups=mm_groups, mm_pos3=mm_pos3,
         )
+        if deepstack is not None:
+            # DeepStack (Qwen3-VL): intermediate vision features are ADDED
+            # to the first n_taps decoder layers' outputs at image-token
+            # positions
+            n_taps = deepstack.shape[0]
+            tap = jnp.take(deepstack, jnp.clip(idx, 0, n_taps - 1), axis=0)
+            gathered = jnp.take_along_axis(tap, mm_idx[:, :, None], axis=1)
+            inject = mm_is_img[:, :, None] & (idx < n_taps)
+            xc = xc + jnp.where(inject, gathered.astype(xc.dtype), 0)
         return (xc, kp, vp), None
 
     (x, k_pages, v_pages), _ = jax.lax.scan(
@@ -326,11 +354,15 @@ def forward_prefill_mm(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     img_embeds: jnp.ndarray,  # [B, n_img_max, tokens_per_image, D] projected
+    deepstack: "jnp.ndarray | None" = None,  # [n_taps, B, n_img*t_img, D]
+    pos3: "jnp.ndarray | None" = None,       # [B, 3, T] qwen3vl mrope
 ):
     """Multimodal prefill: image soft tokens' embeddings are substituted at
     ``image_token_id`` positions (row-major across the prompt's images),
-    and soft tokens of the same image attend bidirectionally (gemma-3
-    semantics). Everything else matches ``forward_prefill``."""
+    and soft tokens of the same image attend bidirectionally. Qwen3-VL
+    additionally passes ``pos3`` (3-axis mrope positions) and
+    ``deepstack`` features added to the first decoder layers at image
+    positions. Everything else matches ``forward_prefill``."""
     B, T = tokens.shape
     n_img, t_img = img_embeds.shape[1], img_embeds.shape[2]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
@@ -347,10 +379,14 @@ def forward_prefill_mm(
     gathered = jnp.take_along_axis(flat, idx[:, :, None], axis=1)
     x = jnp.where(is_img[:, :, None], gathered.astype(x.dtype), x)
     mm_groups = jnp.where(is_img, idx // t_img, -1)
+    # bidirectional attention within an image block is a GEMMA-3 semantic;
+    # Qwen3-VL keeps plain causal attention over image tokens
+    bidir = mm_groups if cfg.vision.family == "siglip" else None
 
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
-        positions, write_positions, lengths, "prefill", mm_groups=mm_groups,
+        positions, write_positions, lengths, "prefill", mm_groups=bidir,
+        mm_pos3=pos3, deepstack=deepstack, mm_idx=idx, mm_is_img=is_img,
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
@@ -394,13 +430,22 @@ def forward_decode(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
+    pos_delta: "jnp.ndarray | None" = None,  # [B] mrope position offset
 ):
-    """One decode step for every active slot; returns (logits [B, V], cache)."""
+    """One decode step for every active slot; returns (logits [B, V], cache).
+
+    ``pos_delta`` shifts the ROTARY position only (Qwen3-VL mrope: an
+    image's soft tokens advance the position index by its merged grid
+    side, not by its token count, so text continuation positions lag the
+    token index by a per-request delta). Cache write positions stay
+    token-indexed."""
     positions = jnp.maximum(lengths - 1, 0)[:, None]                   # [B, 1]
     write_positions = jnp.where(lengths[:, None] > 0, positions, -1)
+    rope_positions = (positions if pos_delta is None
+                      else positions + pos_delta[:, None])
     x = _embed(params, cfg, tokens[:, None])
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
-        positions, write_positions, lengths, "decode",
+        rope_positions, write_positions, lengths, "decode",
     )
     return _logits(params, cfg, x[:, 0]), k_pages, v_pages

@@ -42,6 +42,15 @@ class VisionConfig:
     # projector (gemma3): avg-pool patches to mm_tokens_per_image, RMSNorm
     # (gemma style, zero-centered weight), project to the text width
     mm_tokens_per_image: int = 256
+    # family: "siglip" (gemma-3) | "qwen3vl" (Qwen3-VL: conv3d patch embed
+    # with duplicated frames, bilinearly interpolated learned positions,
+    # 2D rotary attention, spatial-merge patch merger, deepstack taps)
+    family: str = "siglip"
+    temporal_patch_size: int = 2          # qwen3vl
+    spatial_merge_size: int = 2           # qwen3vl
+    out_hidden_size: int = 0              # qwen3vl: text width after merger
+    num_grid_per_side: int = 48           # qwen3vl: learned pos-embed grid
+    deepstack_indexes: tuple = ()         # qwen3vl: tap layers
 
     @property
     def patches_per_side(self) -> int:
@@ -210,3 +219,280 @@ def preprocess_image(img, image_size: int) -> np.ndarray:
                                     Image.Resampling.BICUBIC)
     x = np.asarray(img, np.float32) / 255.0
     return (x - 0.5) / 0.5
+
+
+# ---------------------------------------------------------------------------
+# Qwen3-VL vision tower (the reference's default model #2,
+# vllm-models/helm-chart/values.yaml:7-12). Structure per the public
+# architecture: conv3d patch embed over duplicated frames, bilinearly
+# interpolated learned positions, full-attention pre-LN blocks with 2D
+# rotary embeddings, a spatial-merge MLP merger into the text width, and
+# "deepstack" mergers tapping intermediate layers (their features are
+# added to early DECODER layers at image positions).
+# ---------------------------------------------------------------------------
+
+def _qwen_patchify(pixels: jnp.ndarray, vcfg: VisionConfig) -> jnp.ndarray:
+    """pixels [N, H, W, C] -> patch features [N, T, C*tp*p*p] in the
+    block-merge token order (hb, wb, i, j) with per-patch feature order
+    (channel, temporal, ph, pw) — the Qwen image-processor layout the
+    pretrained weights expect (single frames are duplicated across the
+    temporal patch dim, exactly like the processor does)."""
+    N = pixels.shape[0]
+    p, m = vcfg.patch_size, vcfg.spatial_merge_size
+    S = vcfg.image_size // p           # patches per side
+    hb = S // m
+    x = pixels.transpose(0, 3, 1, 2)   # [N, C, H, W]
+    x = x.reshape(N, vcfg.num_channels, hb, m, p, hb, m, p)
+    x = x.transpose(0, 2, 5, 3, 6, 1, 4, 7)  # [N, hb, wb, i, j, C, p, p]
+    x = x.reshape(N, S * S, vcfg.num_channels, 1, p, p)
+    x = jnp.broadcast_to(
+        x[:, :, :, :1], (N, S * S, vcfg.num_channels,
+                         vcfg.temporal_patch_size, p, p))
+    return x.reshape(N, S * S, -1)
+
+
+def _qwen_pos_embed(params: Params, vcfg: VisionConfig) -> jnp.ndarray:
+    """Bilinearly interpolate the learned [grid^2, D] position table to the
+    S x S patch grid, in block-merge order (static shapes: numpy host
+    math for the indices/weights)."""
+    S = vcfg.image_size // vcfg.patch_size
+    m = vcfg.spatial_merge_size
+    g = vcfg.num_grid_per_side
+    idxs = np.linspace(0, g - 1, S)
+    lo = idxs.astype(np.int32)
+    hi = np.clip(lo + 1, None, g - 1)
+    frac = (idxs - lo).astype(np.float32)
+    pe = params["pos_emb"]             # [g*g, D]
+
+    def gather(hi_or_lo_h, hi_or_lo_w):
+        ids = (hi_or_lo_h[:, None] * g + hi_or_lo_w[None, :]).reshape(-1)
+        return pe[jnp.asarray(ids)]
+    w00 = ((1 - frac)[:, None] * (1 - frac)[None, :]).reshape(-1, 1)
+    w01 = ((1 - frac)[:, None] * frac[None, :]).reshape(-1, 1)
+    w10 = (frac[:, None] * (1 - frac)[None, :]).reshape(-1, 1)
+    w11 = (frac[:, None] * frac[None, :]).reshape(-1, 1)
+    pos = (gather(lo, lo) * w00 + gather(lo, hi) * w01
+           + gather(hi, lo) * w10 + gather(hi, hi) * w11)   # [S*S, D] (h, w)
+    D = pos.shape[-1]
+    pos = pos.reshape(S // m, m, S // m, m, D).transpose(0, 2, 1, 3, 4)
+    return pos.reshape(S * S, D)       # block-merge order
+
+
+def _qwen_rope_cos_sin(vcfg: VisionConfig, head_dim: int):
+    """2D rotary tables [T, head_dim] in block-merge token order."""
+    S = vcfg.image_size // vcfg.patch_size
+    m = vcfg.spatial_merge_size
+    dim = head_dim // 4                # freqs per spatial axis
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim, dtype=np.float32) / dim))
+    hb = np.arange(S // m)
+    row = (hb[:, None, None, None] * m
+           + np.arange(m)[None, None, :, None])          # [hb, 1, m, 1]
+    col = (hb[None, :, None, None] * m
+           + np.arange(m)[None, None, None, :])          # [1, wb, 1, m]
+    row = np.broadcast_to(row, (S // m, S // m, m, m)).reshape(-1)
+    col = np.broadcast_to(col, (S // m, S // m, m, m)).reshape(-1)
+    freqs = np.concatenate([row[:, None] * inv[None, :],
+                            col[:, None] * inv[None, :]], axis=1)
+    emb = np.concatenate([freqs, freqs], axis=1)         # [T, head_dim]
+    return jnp.asarray(np.cos(emb)), jnp.asarray(np.sin(emb))
+
+
+def _rotate_half(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-b, a], axis=-1)
+
+
+def _qwen_merger(x: jnp.ndarray, mp: Params, m2: int, eps: float,
+                 postshuffle: bool) -> jnp.ndarray:
+    """Spatial-merge MLP: group m^2 consecutive (block-ordered) tokens.
+    The main merger layer-norms per token BEFORE the merge; deepstack
+    mergers ('postshuffle') norm the merged vector."""
+    N, T, D = x.shape
+    if postshuffle:
+        x = x.reshape(N, T // m2, m2 * D)
+        x = _layer_norm(x, mp["norm_w"], mp["norm_b"], eps)
+    else:
+        x = _layer_norm(x, mp["norm_w"], mp["norm_b"], eps)
+        x = x.reshape(N, T // m2, m2 * D)
+    h = x @ mp["fc1_w"] + mp["fc1_b"]
+    h = jax.nn.gelu(h, approximate=False)   # nn.GELU() default: erf-exact
+    return h @ mp["fc2_w"] + mp["fc2_b"]
+
+
+def encode_images_qwen3vl(params: Params, vcfg: VisionConfig,
+                          pixels: jnp.ndarray):
+    """Qwen3-VL encode: pixels [N, H, W, C] (normalized) ->
+    (soft tokens [N, T_merged, out_hidden],
+     deepstack [n_taps, N, T_merged, out_hidden])."""
+    N = pixels.shape[0]
+    D = vcfg.hidden_size
+    eps = 1e-6
+    nh = vcfg.num_heads
+    hd = D // nh
+    m2 = vcfg.spatial_merge_size ** 2
+
+    x = _qwen_patchify(pixels, vcfg) @ params["patch_w"] + params["patch_b"]
+    x = x + _qwen_pos_embed(params, vcfg)[None].astype(x.dtype)
+    cos, sin = _qwen_rope_cos_sin(vcfg, hd)
+    cos = cos[None, :, None, :].astype(jnp.float32)
+    sin = sin[None, :, None, :].astype(jnp.float32)
+    scale = hd ** -0.5
+
+    # lax.scan over the stacked layers (one compiled block, like the
+    # SigLIP tower); tap layers' hidden states accumulate into a small
+    # [n_taps, ...] carry selected by static layer-index compares
+    n_taps = len(vcfg.deepstack_indexes)
+    taps0 = jnp.zeros((max(n_taps, 1),) + x.shape, x.dtype)
+    layer_ids = jnp.arange(vcfg.num_layers, dtype=jnp.int32)
+
+    def layer(carry, per_layer):
+        x, taps = carry
+        li, lp = per_layer
+        h = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], eps)
+        qkv = h @ lp["qkv_w"] + lp["qkv_b"]              # [N, T, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(N, -1, nh, hd).astype(jnp.float32)
+        k = k.reshape(N, -1, nh, hd).astype(jnp.float32)
+        v = v.reshape(N, -1, nh, hd)
+        q = q * cos + _rotate_half(q) * sin
+        k = k * cos + _rotate_half(k) * sin
+        logits = jnp.einsum("nqhd,nkhd->nhqk", q, k) * scale
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("nhqk,nkhd->nqhd", probs, v).reshape(N, -1, D)
+        x = x + (attn @ lp["proj_w"] + lp["proj_b"])
+        h = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], eps)
+        h = jax.nn.gelu(h @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
+        x = x + (h @ lp["fc2_w"] + lp["fc2_b"])
+        for t, tap_layer in enumerate(vcfg.deepstack_indexes):
+            taps = taps.at[t].set(jnp.where(li == tap_layer, x, taps[t]))
+        return (x, taps), None
+
+    (x, taps), _ = jax.lax.scan(layer, (x, taps0),
+                                (layer_ids, params["layers"]))
+
+    soft = _qwen_merger(x, params["merger"], m2, eps, postshuffle=False)
+    if n_taps == 0:
+        return soft, None
+    deepstack = jnp.stack([
+        _qwen_merger(taps[t], params["deepstack"][t], m2, eps,
+                     postshuffle=True)
+        for t in range(n_taps)])
+    return soft, deepstack
+
+
+def init_qwen3vl_vision_params(vcfg: VisionConfig, key: jax.Array,
+                               dtype="float32") -> Params:
+    dt = jnp.dtype(dtype)
+    D, I, L = vcfg.hidden_size, vcfg.intermediate_size, vcfg.num_layers
+    feat = vcfg.num_channels * vcfg.temporal_patch_size * vcfg.patch_size ** 2
+    m2 = vcfg.spatial_merge_size ** 2
+    out = vcfg.out_hidden_size
+    keys = iter(jax.random.split(key, 64))
+
+    def init(*shape, scale=0.02):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dt)
+
+    def merger(postshuffle):
+        norm_dim = m2 * D if postshuffle else D
+        return {"norm_w": jnp.ones((norm_dim,), dt),
+                "norm_b": jnp.zeros((norm_dim,), dt),
+                "fc1_w": init(m2 * D, m2 * D), "fc1_b": jnp.zeros((m2 * D,), dt),
+                "fc2_w": init(m2 * D, out), "fc2_b": jnp.zeros((out,), dt)}
+
+    return {
+        "patch_w": init(feat, D),
+        "patch_b": jnp.zeros((D,), dt),
+        "pos_emb": init(vcfg.num_grid_per_side ** 2, D),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+            "qkv_w": init(L, D, 3 * D), "qkv_b": jnp.zeros((L, 3 * D), dt),
+            "proj_w": init(L, D, D), "proj_b": jnp.zeros((L, D), dt),
+            "ln2_w": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+            "fc1_w": init(L, D, I), "fc1_b": jnp.zeros((L, I), dt),
+            "fc2_w": init(L, I, D), "fc2_b": jnp.zeros((L, D), dt),
+        },
+        "merger": merger(False),
+        "deepstack": [merger(True) for _ in vcfg.deepstack_indexes],
+    }
+
+
+def load_qwen3vl_vision_params(vcfg: VisionConfig, fetch,
+                               dtype="float32") -> Params:
+    """Map HF `model.visual.*` tensors (Qwen3-VL layout) to ours."""
+    dt = jnp.dtype(dtype)
+    pre = "model.visual."
+
+    def get(name):
+        return np.asarray(fetch(pre + name)).astype(dt)
+
+    L = vcfg.num_layers
+    keys = ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+            "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+    per = {k: [] for k in keys}
+    for i in range(L):
+        p = f"blocks.{i}."
+        per["ln1_w"].append(get(p + "norm1.weight"))
+        per["ln1_b"].append(get(p + "norm1.bias"))
+        per["qkv_w"].append(get(p + "attn.qkv.weight").T)
+        per["qkv_b"].append(get(p + "attn.qkv.bias"))
+        per["proj_w"].append(get(p + "attn.proj.weight").T)
+        per["proj_b"].append(get(p + "attn.proj.bias"))
+        per["ln2_w"].append(get(p + "norm2.weight"))
+        per["ln2_b"].append(get(p + "norm2.bias"))
+        per["fc1_w"].append(get(p + "mlp.linear_fc1.weight").T)
+        per["fc1_b"].append(get(p + "mlp.linear_fc1.bias"))
+        per["fc2_w"].append(get(p + "mlp.linear_fc2.weight").T)
+        per["fc2_b"].append(get(p + "mlp.linear_fc2.bias"))
+
+    def merger(prefix):
+        return {"norm_w": get(prefix + "norm.weight"),
+                "norm_b": get(prefix + "norm.bias"),
+                "fc1_w": get(prefix + "linear_fc1.weight").T,
+                "fc1_b": get(prefix + "linear_fc1.bias"),
+                "fc2_w": get(prefix + "linear_fc2.weight").T,
+                "fc2_b": get(prefix + "linear_fc2.bias")}
+
+    # conv3d weight [D, C, tp, p, p] -> flat [C*tp*p*p, D] matching the
+    # (channel, temporal, ph, pw) patch feature order
+    conv = get("patch_embed.proj.weight")
+    return {
+        "patch_w": conv.reshape(conv.shape[0], -1).T,
+        "patch_b": get("patch_embed.proj.bias"),
+        "pos_emb": get("pos_embed.weight"),
+        "layers": {k: np.stack(v) for k, v in per.items()},
+        "merger": merger("merger."),
+        "deepstack": [merger(f"deepstack_merger_list.{i}.")
+                      for i in range(len(vcfg.deepstack_indexes))],
+    }
+
+
+def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int):
+    """Qwen3-VL 3-axis rope positions for a prompt with image runs.
+
+    Text tokens advance all three axes together; an image's soft tokens
+    share the temporal position and spread (h, w) over the merged grid,
+    advancing the running position by the grid SIDE (not the token
+    count). Returns (pos3 [3, T] int32, delta) where delta is the offset
+    decode continuations must add to their token index (vLLM's
+    mrope_position_delta).
+    """
+    g = int(round(tokens_per_image ** 0.5))
+    T = len(tokens)
+    pos = np.zeros((3, T), np.int32)
+    cur = 0
+    i = 0
+    while i < T:
+        if tokens[i] == image_token_id:
+            base = cur
+            for r in range(g):
+                for c in range(g):
+                    if i >= T or tokens[i] != image_token_id:
+                        raise ValueError("truncated image soft-token run")
+                    pos[0, i], pos[1, i], pos[2, i] = base, base + r, base + c
+                    i += 1
+            cur = base + g
+        else:
+            pos[:, i] = cur
+            cur += 1
+            i += 1
+    return pos, cur - T

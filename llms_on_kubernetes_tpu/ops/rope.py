@@ -83,3 +83,45 @@ def apply_rope(
         return out.astype(x.dtype)
 
     return rot(q), rot(k)
+
+
+def apply_mrope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    pos3: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+    mrope_section: tuple,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Interleaved multimodal RoPE (Qwen3-VL text decoder).
+
+    pos3 [..., 3, T]: (temporal, height, width) position per token — all
+    three equal for text tokens (then this reduces EXACTLY to apply_rope),
+    spatially varying for image soft tokens. The per-axis frequency
+    channels interleave as [T,H,W,T,H,W,...] up to 3*section[i] then fall
+    back to the temporal axis — matching the public Qwen3-VL scheme.
+    """
+    angles3 = pos3[..., :, :, None].astype(jnp.float32) * inv_freq
+    # [..., 3, T, hd/2] -> interleaved combined [..., T, hd/2]. The
+    # channel->axis assignment is STATIC, so plain where-selects fold into
+    # the surrounding fusion (no gather).
+    half = inv_freq.shape[-1]
+    axis_sel = np.zeros((half,), np.int32)           # default: temporal
+    for dim, offset in ((1, 1), (2, 2)):             # H, W
+        idx = np.arange(offset, 3 * mrope_section[dim], 3)
+        axis_sel[idx[idx < half]] = dim
+    angles = angles3[..., 0, :, :]
+    for dim in (1, 2):
+        angles = jnp.where(jnp.asarray(axis_sel == dim),
+                           angles3[..., dim, :, :], angles)
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+
+    def rot(x: jnp.ndarray) -> jnp.ndarray:
+        half_d = x.shape[-1] // 2
+        x1 = x[..., :half_d].astype(jnp.float32)
+        x2 = x[..., half_d:].astype(jnp.float32)
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)

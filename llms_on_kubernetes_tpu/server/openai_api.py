@@ -423,15 +423,25 @@ class OpenAIServer:
 
     def _splice_image_tokens(self, ids: list[int], n_images: int) -> list[int]:
         """Expand each begin-of-image marker into the soft-token run the
-        engine substitutes embeddings at: boi -> [boi, soft * N, eoi]."""
+        engine substitutes embeddings at: boi -> [boi, soft * N, eoi].
+        Placeholder soft tokens or an eoi the template already emitted
+        after the marker are consumed (Qwen templates render
+        <|vision_start|><|image_pad|><|vision_end|>; gemma templates
+        render the begin marker alone)."""
         cfg = self.engine.model_config
         t_img = cfg.vision.mm_tokens_per_image
-        out, found = [], 0
-        for t in ids:
+        out, found, i = [], 0, 0
+        while i < len(ids):
+            t = ids[i]
             out.append(t)
+            i += 1
             if t == cfg.boi_token_id:
                 found += 1
                 out += [cfg.image_token_id] * t_img
+                while i < len(ids) and ids[i] == cfg.image_token_id:
+                    i += 1  # template's own placeholder(s): replaced
+                if i < len(ids) and ids[i] == cfg.eoi_token_id:
+                    i += 1
                 if cfg.eoi_token_id is not None:
                     out.append(cfg.eoi_token_id)
         if found != n_images:

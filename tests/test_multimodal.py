@@ -88,6 +88,12 @@ def test_mm_submit_validation():
     with pytest.raises(ValueError, match="vision"):
         text_eng.submit([1, 2, 3], SamplingParams(max_tokens=4),
                         images=_image())
+    # fragmented soft-token runs are rejected at submit (engine-thread
+    # position math assumes contiguous runs of exactly t_img)
+    frag = [CFG.image_token_id, 5, CFG.image_token_id,
+            CFG.image_token_id, CFG.image_token_id]
+    with pytest.raises(ValueError, match="runs of exactly"):
+        eng.submit(frag, SamplingParams(max_tokens=4), images=_image())
 
 
 def test_mm_prefill_matches_hf_gemma3(tmp_path):
@@ -279,6 +285,174 @@ def test_images_rejected_on_text_model():
             })
             assert r.status == 400
             assert "does not accept images" in (await r.json())["error"]["message"]
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Qwen3-VL: mrope + deepstack + vision tower, end to end
+# ---------------------------------------------------------------------------
+
+def test_qwen_mm_engine_generates_and_text_path_unaffected():
+    eng = Engine(EngineConfig(
+        model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=64, pages_per_slot=8, prefill_buckets=(32,)))
+    qcfg = eng.model_config
+    run = [qcfg.boi_token_id] + [qcfg.image_token_id] * 4 + [qcfg.eoi_token_id]
+    prompt = [1, 2] + run + [40, 41]
+    img = np.random.default_rng(0).standard_normal((1, 16, 16, 3)).astype(np.float32)
+    a = _run(eng, prompt, img)
+    b = _run(eng, prompt, img)
+    assert a.output == b.output and len(a.output) == 6
+    assert a.mrope_delta < 0  # 4 soft tokens advance positions by only 2
+    c = _run(eng, prompt, np.ascontiguousarray(img * -1.0))
+    assert c.output != a.output  # image content reaches the logits
+
+    # text-only request on the same engine: plain rope path, delta 0
+    t = eng.submit([5, 6, 7], SamplingParams(temperature=0.0, max_tokens=4))
+    while not t.finished:
+        eng.step()
+    assert t.mrope_delta == 0 and len(t.output) == 4
+
+
+def test_qwen3vl_full_model_parity(tmp_path):
+    """Our loader + mm prefill (vision tower, soft-token substitution,
+    interleaved mrope, DeepStack layer injection) vs HF
+    Qwen3VLForConditionalGeneration on one tiny checkpoint."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from llms_on_kubernetes_tpu.configs import from_hf_config
+    from llms_on_kubernetes_tpu.engine.weights import load_hf_params
+    from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
+
+    g_cfg = transformers.Qwen3VLConfig(
+        text_config=dict(
+            vocab_size=128, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+            rope_scaling={"rope_type": "default", "mrope_section": [3, 3, 2],
+                          "mrope_interleaved": True},
+        ),
+        vision_config=dict(
+            hidden_size=32, intermediate_size=64, depth=2, num_heads=2,
+            patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+            out_hidden_size=48, num_position_embeddings=16,
+            deepstack_visual_indexes=[0, 1], in_channels=3,
+            hidden_act="gelu_pytorch_tanh", image_size=16,
+        ),
+        image_token_id=96, vision_start_token_id=97, vision_end_token_id=98,
+    )
+    hf = transformers.Qwen3VLForConditionalGeneration(g_cfg)
+    torch.manual_seed(0)
+    for p in hf.parameters():
+        torch.nn.init.normal_(p, std=0.05)
+    hf = hf.eval().to(torch.float32)
+    hf.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    cfg = from_hf_config(json.loads((tmp_path / "config.json").read_text()),
+                         name="qwen-mm-tiny")
+    assert cfg.vision.family == "qwen3vl"
+    assert cfg.mrope_section == (3, 3, 2)
+    assert cfg.vision.mm_tokens_per_image == 4
+    params = load_hf_params(cfg, str(tmp_path), dtype="float32")
+    assert "vision" in params and "deepstack" in params["vision"]
+
+    rng = np.random.default_rng(3)
+    pixels = rng.standard_normal((1, 16, 16, 3)).astype(np.float32)
+    prompt = [2, 5, 97] + [96] * 4 + [98, 11, 12, 13]
+
+    import jax.numpy as jnp
+
+    from llms_on_kubernetes_tpu.engine.cache import (
+        CacheConfig, PageAllocator, init_pages,
+    )
+    from llms_on_kubernetes_tpu.models.decoder import forward_prefill_mm
+    from llms_on_kubernetes_tpu.models.vision import encode_images_qwen3vl
+
+    cc = CacheConfig(num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+                     head_dim=cfg.head_dim, num_pages=32, page_size=4,
+                     pages_per_slot=8, dtype="float32")
+    kp, vp = init_pages(cc)
+    al = PageAllocator(cc.num_pages, cc.page_size, 1, cc.pages_per_slot)
+    al.allocate(0, len(prompt))
+    soft, deep = encode_images_qwen3vl(params["vision"], cfg.vision,
+                                       jnp.asarray(pixels))
+    pos3, delta = qwen_mrope_positions(prompt, 96, 4)
+    assert delta == -2
+    logits, _, _ = forward_prefill_mm(
+        params, cfg, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), kp, vp,
+        jnp.asarray(al.page_tables), soft[None],
+        deepstack=deep.reshape(deep.shape[0], 1, -1, deep.shape[-1]),
+        pos3=jnp.asarray(pos3[None]),
+    )
+    got = np.asarray(logits)[0]
+
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.tensor(np.asarray(
+                __import__("llms_on_kubernetes_tpu.models.vision",
+                           fromlist=["_qwen_patchify"])._qwen_patchify(
+                    jnp.asarray(pixels), cfg.vision))[0]),
+            image_grid_thw=torch.tensor([[1, 4, 4]]),
+        ).logits[0, -1].numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+def test_chat_completions_with_image_qwen_e2e():
+    """Same HTTP flow on the Qwen3-VL-style debug model: the template
+    emits <vision_start><image_pad><vision_end>; the server splice
+    replaces the placeholder run with the full soft-token run."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    qcfg = get_config("debug-qwen-mm")
+
+    class QwenMMTokenizer(MMTestTokenizer):
+        def apply_chat_template(self, messages):
+            ids = [257]
+            for m in messages:
+                content = m.get("content", "")
+                if isinstance(content, list):
+                    for part in content:
+                        if part.get("type") == "image":
+                            # qwen-style: start + ONE placeholder + end
+                            ids += [qcfg.boi_token_id, qcfg.image_token_id,
+                                    qcfg.eoi_token_id]
+                        else:
+                            ids += self.encode(part.get("text", ""))
+                else:
+                    ids += self.encode(content)
+            return ids
+
+    eng = Engine(EngineConfig(
+        model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=64, pages_per_slot=8, prefill_buckets=(32,)))
+    server = OpenAIServer(eng, QwenMMTokenizer(), "debug-qwen-mm")
+
+    async def go():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-qwen-mm",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "see "},
+                    {"type": "image_url",
+                     "image_url": {"url": _png_data_url()}},
+                ]}],
+                "max_tokens": 5, "temperature": 0,
+            })
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            # bos + "see " + [start, 4 soft, end]: template's own
+            # placeholder was consumed by the splice, not duplicated
+            assert data["usage"]["prompt_tokens"] == 1 + 4 + 6
         finally:
             await client.close()
 

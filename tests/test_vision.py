@@ -85,3 +85,67 @@ def test_init_and_preprocess_shapes():
     x = preprocess_image(img, 16)
     assert x.shape == (16, 16, 3)
     assert -1.0 <= x.min() and x.max() <= 1.0
+
+
+def test_qwen3vl_vision_encode_matches_hf():
+    """Qwen3-VL vision tower + mergers + deepstack taps vs the HF
+    implementation, fed the SAME processor-ordered patches."""
+    torch = pytest.importorskip("torch")
+    import transformers
+    import numpy as np
+
+    from llms_on_kubernetes_tpu.models.vision import (
+        VisionConfig, _qwen_patchify, encode_images_qwen3vl,
+        load_qwen3vl_vision_params,
+    )
+
+    from transformers.models.qwen3_vl.configuration_qwen3_vl import (
+        Qwen3VLVisionConfig,
+    )
+
+    hf_vcfg = Qwen3VLVisionConfig(
+        hidden_size=32, intermediate_size=64, depth=3, num_heads=2,
+        patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+        out_hidden_size=48, num_position_embeddings=16,  # 4x4 grid
+        deepstack_visual_indexes=[0, 1], in_channels=3,
+        hidden_act="gelu_pytorch_tanh", initializer_range=0.05,
+    )
+    tower = transformers.models.qwen3_vl.modeling_qwen3_vl.Qwen3VLVisionModel(
+        hf_vcfg).eval()
+    tower.set_attn_implementation("eager")
+    torch.manual_seed(0)
+    for p in tower.parameters():
+        torch.nn.init.normal_(p, std=0.05)
+
+    vcfg = VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=3, num_heads=2,
+        image_size=16, patch_size=4, family="qwen3vl",
+        temporal_patch_size=2, spatial_merge_size=2, out_hidden_size=48,
+        num_grid_per_side=4, deepstack_indexes=(0, 1),
+        mm_tokens_per_image=4,  # (16/4/2)^2 merged tokens
+    )
+    sd = {"model.visual." + k: v.detach().numpy()
+          for k, v in tower.state_dict().items()}
+    params = load_qwen3vl_vision_params(vcfg, lambda n: sd[n])
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+    soft, deep = encode_images_qwen3vl(params, vcfg, jnp.asarray(pixels))
+    assert soft.shape == (2, 4, 48)
+    assert deep.shape == (2, 2, 4, 48)
+
+    # HF consumes processor-ordered flat patches + grid_thw. One image per
+    # call: the HF eager path only separates concatenated images via
+    # cu_seqlens under flash-attention, so a batched call would let images
+    # attend to each other — our per-image batching is the correct
+    # reference semantics.
+    flat = np.asarray(_qwen_patchify(jnp.asarray(pixels), vcfg))
+    for n in range(2):
+        with torch.no_grad():
+            want_soft, want_deep = tower(torch.tensor(flat[n]),
+                                         grid_thw=torch.tensor([[1, 4, 4]]))
+        np.testing.assert_allclose(np.asarray(soft)[n], want_soft.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        for j, wd in enumerate(want_deep):
+            np.testing.assert_allclose(np.asarray(deep)[j, n], wd.numpy(),
+                                       rtol=2e-4, atol=2e-4)
