@@ -440,6 +440,10 @@ def from_hf_config(hf: dict | str, name: str = "hf-model") -> ModelConfig:
                 raise NotImplementedError(
                     f"rope_scaling type {kind!r} combined with "
                     f"mrope_section is not supported yet")
+            if not scaling.get("mrope_interleaved", True):
+                raise NotImplementedError(
+                    "non-interleaved (sectioned) mrope is not supported "
+                    "yet; only mrope_interleaved=true")
             kw["mrope_section"] = tuple(int(x) for x in scaling["mrope_section"])
         elif kind in ("llama3", "linear"):
             kw["rope_scaling"] = scaling

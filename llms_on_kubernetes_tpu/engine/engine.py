@@ -445,7 +445,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
         jnp.ones_like(lengths))
     logits, k_pages, v_pages = forward_prefill_mm(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-        img_embeds, deepstack=deepstack, pos3=pos3,
+        img_embeds, deepstack=deepstack, pos3=pos3, prompt_len=prompt_len,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
@@ -987,7 +987,8 @@ class Engine:
 
             p3, delta = qwen_mrope_positions(
                 prefill_tokens, cfg.image_token_id,
-                cfg.vision.mm_tokens_per_image)
+                cfg.vision.mm_tokens_per_image,
+                prompt_len=len(req.prompt))
             req.mrope_delta = delta
             full = np.zeros((1, 3, bucket), np.int32)
             full[0, :, :n] = p3

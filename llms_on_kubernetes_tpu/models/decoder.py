@@ -356,6 +356,7 @@ def forward_prefill_mm(
     img_embeds: jnp.ndarray,  # [B, n_img_max, tokens_per_image, D] projected
     deepstack: "jnp.ndarray | None" = None,  # [n_taps, B, n_img*t_img, D]
     pos3: "jnp.ndarray | None" = None,       # [B, 3, T] qwen3vl mrope
+    prompt_len: "jnp.ndarray | None" = None,  # [B] image-region bound
 ):
     """Multimodal prefill: image soft tokens' embeddings are substituted at
     ``image_token_id`` positions (row-major across the prompt's images),
@@ -370,6 +371,12 @@ def forward_prefill_mm(
     x = _embed(params, cfg, tokens)
 
     is_img = tokens == cfg.image_token_id                       # [B, T]
+    if prompt_len is not None:
+        # only the PROMPT region holds real image runs: a resumed
+        # (preempted) request replays its generated tokens through this
+        # path, and a SAMPLED id that collides with the placeholder must
+        # stay ordinary text
+        is_img = is_img & (positions < prompt_len[:, None])
     # row-major soft-token index -> (image, offset); image features are
     # NOT scaled by the embedding multiplier (HF gemma3 scales only the
     # text embeddings before the masked scatter)

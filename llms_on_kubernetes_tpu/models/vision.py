@@ -466,7 +466,8 @@ def load_qwen3vl_vision_params(vcfg: VisionConfig, fetch,
     }
 
 
-def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int):
+def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int,
+                         prompt_len: "Optional[int]" = None):
     """Qwen3-VL 3-axis rope positions for a prompt with image runs.
 
     Text tokens advance all three axes together; an image's soft tokens
@@ -475,14 +476,21 @@ def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int):
     count). Returns (pos3 [3, T] int32, delta) where delta is the offset
     decode continuations must add to their token index (vLLM's
     mrope_position_delta).
+
+    ``prompt_len`` bounds the image-run region: tokens at or past it are
+    GENERATED text and always advance as text even if a sampled id
+    happens to collide with the image placeholder (resumed preempted
+    requests replay prompt + output through this path).
     """
     g = int(round(tokens_per_image ** 0.5))
     T = len(tokens)
+    if prompt_len is None:
+        prompt_len = T
     pos = np.zeros((3, T), np.int32)
     cur = 0
     i = 0
     while i < T:
-        if tokens[i] == image_token_id:
+        if i < prompt_len and tokens[i] == image_token_id:
             base = cur
             for r in range(g):
                 for c in range(g):

@@ -18,7 +18,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# LLMK_TEST_TPU=1 keeps the real accelerator visible — used by
+# tests/test_tpu_hardware.py to pin kernel lowering on actual hardware
+# (everything else skips itself or tolerates the platform).
+if os.environ.get("LLMK_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

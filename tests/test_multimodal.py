@@ -457,3 +457,24 @@ def test_chat_completions_with_image_qwen_e2e():
             await client.close()
 
     asyncio.run(go())
+
+
+def test_qwen_mrope_positions_output_region_is_text():
+    """A generated token that collides with the image placeholder id must
+    not be parsed as an image run when a preempted request replays
+    prompt + output (round-3 review finding: engine-thread crash)."""
+    from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
+
+    prompt = [1, 260, 260, 260, 260, 7]      # one real image run
+    out = [260, 9]                           # sampled collision + text
+    pos, delta = qwen_mrope_positions(prompt + out, 260, 4,
+                                      prompt_len=len(prompt))
+    assert delta == -2
+    # output tokens advance as plain text from the running position
+    assert pos[0, -2] == pos[0, -3] + 1 and pos[0, -1] == pos[0, -2] + 1
+    assert (pos[:, -2] == pos[0, -2]).all()  # all three axes equal
+
+    # without prompt_len bounding, the same stream must raise (fragmented
+    # run) — proving the bound is what protects the resume path
+    with pytest.raises(ValueError):
+        qwen_mrope_positions(prompt + out, 260, 4)

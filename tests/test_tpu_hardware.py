@@ -1,0 +1,86 @@
+"""Real-TPU kernel pinning (skipped off-TPU).
+
+The interpret-mode tests in tests/test_pallas.py / test_kv_int8.py pin
+kernel SEMANTICS; these pin the actual Mosaic LOWERING on hardware —
+a kernel that regresses only on-device (tiling, DMA alignment, MXU
+precision) should fail here before a bench run discovers it
+(round-2 review recommendation).
+
+Run on a machine with a TPU attached (LLMK_TEST_TPU=1 stops the
+suite-wide conftest from forcing the CPU platform):
+
+    LLMK_TEST_TPU=1 python -m pytest tests/test_tpu_hardware.py -v
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+on_tpu = jax.default_backend() == "tpu"
+pytestmark = pytest.mark.skipif(not on_tpu, reason="needs a real TPU")
+
+
+def _fill_pools(rng, KV, page, d, B, pps, kv_dtype):
+    from llms_on_kubernetes_tpu.engine.cache import (
+        CacheConfig, init_pages, write_tokens,
+    )
+
+    P = B * pps + 1
+    T = pps * page - 3
+    cc = CacheConfig(num_layers=1, num_kv_heads=KV, head_dim=d, num_pages=P,
+                     page_size=page, pages_per_slot=pps, dtype="float32",
+                     kv_dtype=kv_dtype)
+    kp, vp = init_pages(cc)
+    k = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, KV, d)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(B * pps).reshape(B, pps), jnp.int32)
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T))
+    kp, vp = write_tokens(kp, vp, k, v, pt, jnp.asarray(positions))
+    lengths = jnp.asarray(rng.integers(T // 2, T + 1, B), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, KV * 4, d)), jnp.float32)
+    return kp, vp, pt, lengths, q
+
+
+def test_paged_decode_kernel_matches_xla_on_tpu():
+    from llms_on_kubernetes_tpu.ops.attention import paged_attention
+    from llms_on_kubernetes_tpu.ops.pallas_paged import pallas_paged_attention
+
+    rng = np.random.default_rng(0)
+    kp, vp, pt, lengths, q = _fill_pools(rng, 8, 32, 128, 4, 8, None)
+    want = np.asarray(paged_attention(q, kp, vp, pt, lengths, scale=0.09))
+    got = np.asarray(pallas_paged_attention(
+        q, kp.data, vp.data, pt, lengths, scale=0.09, interpret=False))
+    # MXU f32 matmuls run at bf16-ish precision on TPU
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_paged_decode_int8_kernel_matches_xla_on_tpu():
+    from llms_on_kubernetes_tpu.ops.attention import paged_attention
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_attention_int8,
+    )
+
+    rng = np.random.default_rng(1)
+    kp, vp, pt, lengths, q = _fill_pools(rng, 8, 128, 128, 4, 3, "int8")
+    want = np.asarray(paged_attention(q, kp, vp, pt, lengths, scale=0.09))
+    got = np.asarray(pallas_paged_attention_int8(
+        q, kp.data, kp.scale, vp.data, vp.scale, pt, lengths,
+        scale=0.09, interpret=False))
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+def test_flash_prefill_kernel_matches_xla_on_tpu():
+    from llms_on_kubernetes_tpu.ops.attention import prefill_attention
+    from llms_on_kubernetes_tpu.ops.pallas_flash import flash_prefill_attention
+
+    rng = np.random.default_rng(2)
+    B, T, n_kv, group, d = 2, 256, 8, 4, 128
+    q = jnp.asarray(rng.normal(size=(B, T, n_kv * group, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray([T, T - 57], jnp.int32)
+    want = np.asarray(prefill_attention(q, k, v, lengths, scale=0.09))
+    got = np.asarray(flash_prefill_attention(
+        q, k, v, lengths, scale=0.09, interpret=False))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
