@@ -405,12 +405,12 @@ class PageAllocator:
 
     # -- prefix caching ----------------------------------------------------
 
-    def _digests(self, tokens) -> list[bytes]:
+    def _digests(self, tokens, salt: bytes = b"") -> list[bytes]:
         """Chained digest per FULL page of ``tokens``."""
         import hashlib
 
         out = []
-        prev = b""
+        prev = salt
         for i in range(len(tokens) // self.page_size):
             chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
             h = hashlib.sha256(prev)
@@ -419,19 +419,24 @@ class PageAllocator:
             out.append(prev)
         return out
 
-    def _match_digests(self, tokens) -> list[int]:
+    def _match_digests(self, tokens, salt: bytes = b"") -> list[int]:
         """Page ids of the longest cached prefix — ONE incremental pass
         with early stop at the first miss (an EMA of full-prompt sha256
         passes per admission attempt would be pure waste: a blocked
         admission retries every engine iteration). Capped so at least one
-        token remains to prefill (its logits seed sampling)."""
+        token remains to prefill (its logits seed sampling).
+
+        ``salt`` seeds the digest chain — multimodal prompts mix a hash
+        of their image BYTES in, so identical token streams carrying
+        different images (image soft tokens share one placeholder id)
+        can never alias."""
         if not self.prefix_caching or len(tokens) <= self.page_size:
             return []
         import hashlib
 
         cap_pages = (len(tokens) - 1) // self.page_size
         pages: list[int] = []
-        prev = b""
+        prev = salt
         for i in range(cap_pages):
             chunk = tokens[i * self.page_size:(i + 1) * self.page_size]
             h = hashlib.sha256(prev)
@@ -443,15 +448,15 @@ class PageAllocator:
             pages.append(p)
         return pages
 
-    def match_prefix(self, tokens) -> int:
+    def match_prefix(self, tokens, salt: bytes = b"") -> int:
         """Longest cached prefix of ``tokens`` in TOKENS."""
-        return len(self._match_digests(tokens)) * self.page_size
+        return len(self._match_digests(tokens, salt)) * self.page_size
 
-    def adopt_prefix(self, slot: int, tokens) -> int:
+    def adopt_prefix(self, slot: int, tokens, salt: bytes = b"") -> int:
         """Map the longest cached prefix into ``slot``'s table (increfs the
         shared pages). Must be called before ``allocate`` grows the slot.
         Returns the number of cached tokens adopted."""
-        pages = self._match_digests(tokens)
+        pages = self._match_digests(tokens, salt)
         if not pages:
             return 0
         assert not self.slot_pages[slot], "adopt_prefix on a non-empty slot"
@@ -464,12 +469,12 @@ class PageAllocator:
         self.hit_tokens_total += hit
         return hit
 
-    def register_prefix(self, slot: int, tokens) -> None:
+    def register_prefix(self, slot: int, tokens, salt: bytes = b"") -> None:
         """Publish ``slot``'s pages holding full pages of ``tokens`` so
         later prompts with the same prefix can adopt them."""
         if not self.prefix_caching:
             return
-        for i, d in enumerate(self._digests(tokens)):
+        for i, d in enumerate(self._digests(tokens, salt)):
             if i >= len(self.slot_pages[slot]):
                 break
             if d in self._prefix_map:

@@ -143,6 +143,9 @@ class Request:
     # mrope models (Qwen3-VL): rope position = token index + this delta
     # for text continuation after images (set at mm admission)
     mrope_delta: int = 0
+    # prefix-cache digest salt, computed ONCE at submit: b"" for text,
+    # an image-bytes hash for cacheable multimodal prompts, None = skip
+    cache_salt: Optional[bytes] = b""
     # resolved sampling seed (user's params.seed, or engine-drawn): the
     # request's sampled stream is fold(base_key, seed, position) — a pure
     # function of the request, never of batch composition or preemption
@@ -745,6 +748,7 @@ class Engine:
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
             prompt=list(prompt), params=params, seed=seed, images=images,
+            cache_salt=self._cache_salt_for(images),
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:
@@ -955,6 +959,45 @@ class Engine:
         self.slot_len[slot] = n
         return res
 
+    def _cache_salt_for(self, images) -> Optional[bytes]:
+        """Prefix-cache digest salt, computed ONCE at submit (a blocked
+        admission retries every engine iteration — re-hashing megabytes of
+        pixels there, under the lock, would stall the scheduler).
+
+        Text requests use the empty salt. Multimodal prompts mix a hash
+        of the IMAGE BYTES into the chain (soft tokens share one
+        placeholder id, so token-only hashing would alias different
+        images); a cache hit is then only usable when it covers the whole
+        image region, because the remainder replays through the TEXT
+        chunk path (enforced at admission). mrope models skip the cache
+        (None): the chunk path cannot carry their position delta yet."""
+        if images is None:
+            return b""
+        if self.model_config.mrope_section is not None:
+            return None
+        import hashlib
+
+        return hashlib.sha256(
+            np.asarray(images, np.float32).tobytes()).digest()
+
+    def _adopt_cached_prefix(self, slot: int, req: Request,
+                             prefill_tokens: list[int]) -> int:
+        """Adopt the longest usable cached prefix for an admission attempt
+        (shared by the sync and async paths). A multimodal hit must cover
+        every image token — the remainder prefills via forward_chunk,
+        which has no embedding substitution — else it is rolled back."""
+        if req.cache_salt is None:
+            return 0
+        hit = self.allocator.adopt_prefix(
+            slot, prefill_tokens[:len(req.prompt)], salt=req.cache_salt)
+        if hit and req.images is not None:
+            last_img = max(i for i, t in enumerate(req.prompt)
+                           if t == self.model_config.image_token_id)
+            if hit <= last_img:
+                self.allocator.free(slot)
+                return 0
+        return hit
+
     def _dispatch_mm_prefill(self, slot: int, req: Request,
                              prefill_tokens: list[int]):
         """Encode the request's images and dispatch the multimodal prefill
@@ -1029,13 +1072,8 @@ class Engine:
                 ev = self._finish(req, "length")
                 return [ev]
             # adopt any cached prefix FIRST so can_allocate counts only the
-            # private pages still needed; roll back if they don't fit yet.
-            # Multimodal prompts skip the cache entirely: image soft tokens
-            # have identical ids across different images, so token-hash
-            # matching (and registration) would alias distinct images.
-            hit = (0 if req.images is not None else
-                   self.allocator.adopt_prefix(
-                       slot, prefill_tokens[:len(req.prompt)]))
+            # private pages still needed; roll back if they don't fit yet
+            hit = self._adopt_cached_prefix(slot, req, prefill_tokens)
             if not self.allocator.can_allocate(slot, n + 1):
                 if hit:
                     self.allocator.free(slot)
@@ -1045,11 +1083,12 @@ class Engine:
         self.slots[slot] = req
         req.slot = slot
 
-        if req.images is not None:
+        if req.images is not None and hit == 0:
             res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
         elif hit > 0 or n > max(self.config.prefill_buckets):
             # cache-hit admissions run the chunk path: prefill-with-history
             # attention over the remainder, history = the adopted prefix
+            # (for a multimodal hit the remainder is pure text)
             res = self._chunked_prefill(slot, req, prefill_tokens, start=hit)
         else:
             from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
@@ -1069,8 +1108,9 @@ class Engine:
             self.slot_len[slot] = n
         # the dispatched prefill writes these pages; device order makes
         # them valid for any later-dispatched adopter
-        if req.images is None:
-            self.allocator.register_prefix(slot, req.prompt)
+        if req.cache_salt is not None:
+            self.allocator.register_prefix(slot, req.prompt,
+                                           salt=req.cache_salt)
         if resumed:
             req.pending_token = req.output[-1]
             return []
@@ -1219,11 +1259,7 @@ class Engine:
                     self.waiting.popleft()
                     events.append(self._finish(req, "length"))
                     continue
-                # multimodal prompts skip the prefix cache (soft-token ids
-                # alias across different images) and are admitted solo
-                hit = (0 if req.images is not None else
-                       self.allocator.adopt_prefix(
-                           slot, prefill_tokens[:len(req.prompt)]))
+                hit = self._adopt_cached_prefix(slot, req, prefill_tokens)
                 if (hit > 0 or req.images is not None
                         or n > max(self.config.prefill_buckets)):
                     # cache-hit / multimodal / out-of-bucket prompt: runs
@@ -1250,15 +1286,19 @@ class Engine:
                 picked.append((slot, req, resumed, prefill_tokens))
         if long_pick is not None:
             slot, req, resumed, prefill_tokens, hit = long_pick
-            if req.images is not None:
+            if req.images is not None and hit == 0:
                 res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
                 n_chunks = 2  # image encode + prefill
             else:
+                # cache-hit remainder (pure text for multimodal hits) or
+                # an out-of-bucket text prompt
                 res = self._chunked_prefill(slot, req, prefill_tokens,
                                             start=hit)
-                self.allocator.register_prefix(slot, req.prompt)
                 n_chunks = -(-(len(prefill_tokens) - hit)
                              // max(self.config.prefill_buckets))
+            if req.cache_salt is not None:
+                self.allocator.register_prefix(slot, req.prompt,
+                                               salt=req.cache_salt)
             self._busy_until = (max(time.monotonic(), self._busy_until)
                                 + 2.0 * n_chunks * self._est_step)
             merge = {"toks": res.tokens, "slots": {}}

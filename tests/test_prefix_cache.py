@@ -213,3 +213,65 @@ def test_penalties_correct_on_cache_hit():
     assert eng.allocator.hit_tokens_total == 16
     assert cold.output == ref_out.output
     assert hot.output == ref_out.output
+
+
+def test_mm_prefix_caching_image_aware():
+    """Multimodal prompts (gemma-3 path) reuse cached prefixes only for
+    the SAME image bytes; different images with identical token streams
+    never alias (the digest chain is salted with the pixel hash)."""
+    from llms_on_kubernetes_tpu.configs import get_config
+
+    mcfg = get_config("debug-mm")
+    run = ([mcfg.boi_token_id] + [mcfg.image_token_id] * 4
+           + [mcfg.eoi_token_id])
+    # image run first, then enough text that full pages cover the run
+    prompt = run + list(range(1, 21))
+    rng = np.random.default_rng(0)
+    size = mcfg.vision.image_size
+    img_a = rng.standard_normal((1, size, size, 3)).astype(np.float32)
+    img_b = rng.standard_normal((1, size, size, 3)).astype(np.float32)
+
+    def mk():
+        return Engine(EngineConfig(
+            model="debug-mm", dtype="float32", max_decode_slots=2,
+            page_size=8, num_pages=64, pages_per_slot=8,
+            prefill_buckets=(32,)))
+
+    def run_req(eng, img):
+        req = eng.submit(list(prompt), SamplingParams(
+            temperature=0.0, max_tokens=5), images=img)
+        steps = 0
+        while not req.finished:
+            eng.step()
+            steps += 1
+            assert steps < 10_000
+        return req
+
+    eng = mk()
+    cold = run_req(eng, img_a)
+    assert eng.allocator.hit_tokens_total == 0
+    hot = run_req(eng, img_a)               # same image: cache hit
+    assert eng.allocator.hit_tokens_total > 0
+    assert hot.output == cold.output
+
+    hits_after_a = eng.allocator.hit_tokens_total
+    other = run_req(eng, img_b)             # different image: NO aliasing
+    assert eng.allocator.hit_tokens_total == hits_after_a  # salt diverged
+    ref = run_req(mk(), img_b)
+    assert other.output == ref.output
+
+    # qwen mm requests skip the cache (mrope delta not expressible in the
+    # chunk remainder path yet)
+    qcfg = get_config("debug-qwen-mm")
+    qeng = Engine(EngineConfig(
+        model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=64, pages_per_slot=8, prefill_buckets=(32,)))
+    qrun = ([qcfg.boi_token_id] + [qcfg.image_token_id] * 4
+            + [qcfg.eoi_token_id])
+    qprompt = qrun + list(range(1, 21))
+    for _ in range(2):
+        r = qeng.submit(list(qprompt), SamplingParams(
+            temperature=0.0, max_tokens=4), images=img_a)
+        while not r.finished:
+            qeng.step()
+    assert qeng.allocator.hit_tokens_total == 0
