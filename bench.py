@@ -1,13 +1,14 @@
 """Benchmark: Llama-3-8B serving throughput on one TPU chip.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 What it measures — the BASELINE.json metric ("tokens/sec/chip + p50 TTFT,
 Llama-3-8B"): steady-state decode throughput of the continuous-batching
 engine (engine/engine.py) running Llama-3-8B with int8 weights (the config
 that fits a single 16 GB v5e chip) at a full decode batch, plus p50 TTFT
-measured through the engine's scheduler. Weights are pattern-filled
+measured through the engine's scheduler AND through the full gateway path
+(client -> router -> OpenAI server -> engine). Weights are pattern-filled
 (ops/quant.py:random_quantized_params) — decode cost is weight-streaming +
 attention, independent of weight values.
 
@@ -17,6 +18,17 @@ serving throughput for Llama-3-8B is ~600 tok/s aggregate; an A10G
 (g5.xlarge) is ~$1.01/h on-demand, a v5e chip ~$1.20/h. So the bar is
 600/1.01 = 594 tok/s/$ and vs_baseline = (value / 1.20) / 594 — >= 1.0
 beats the A10G bar. Assumptions recorded here so the judge can re-derive.
+
+Robustness contract (round-3 verdict item 2): the dev TPU sits behind a
+tunnel whose transport can drop mid-read (`remote_compile: read body:
+response body closed`), and one such flake must never turn the round's
+artifact into rc=1 with no numbers. Every phase (engine measure, gateway
+measure) runs under ``with_retries`` — bounded retries on the
+transient/transport error class only, a FRESH engine per attempt (a failed
+device read leaves the old engine's pipeline state unknown) — and the JSON
+line is emitted with whatever completed plus an ``"errors"`` field on
+partial failure. Exit code is 0 whenever at least one phase produced a
+number.
 
 Smaller fallback model (env BENCH_MODEL, e.g. debug-tiny) exists so the
 bench also runs on CPU-only dev machines.
@@ -35,6 +47,176 @@ import numpy as np
 A10G_TOKENS_PER_SEC = 600.0   # public vLLM Llama-3-8B A10G aggregate decode
 A10G_DOLLARS_PER_H = 1.01     # AWS g5.xlarge on-demand
 V5E_DOLLARS_PER_H = 1.20      # GCP v5e per-chip on-demand
+
+
+# ---------------------------------------------------------------------------
+# transient-failure handling
+# ---------------------------------------------------------------------------
+
+# Error-text markers of the transport/availability class (tunnel drops,
+# PJRT plugin hiccups). Anything else — shape errors, OOM, assertion
+# failures — is a real bug and is NOT retried (it would just fail again
+# and mask the signal), only recorded.
+TRANSIENT_MARKERS = (
+    "INTERNAL", "UNAVAILABLE", "DEADLINE_EXCEEDED", "read body",
+    "connection", "Connection", "remote_compile", "transport",
+    "Socket closed",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True for the retryable transport/availability error class.
+
+    JaxRuntimeError subclasses RuntimeError; match on the type NAME (the
+    class moved modules across jax versions) plus the message markers, so
+    a plain Python RuntimeError("assert failed") is never retried.
+    """
+    names = {t.__name__ for t in type(exc).__mro__}
+    if not ({"JaxRuntimeError", "XlaRuntimeError"} & names):
+        return False
+    msg = str(exc)
+    return any(m in msg for m in TRANSIENT_MARKERS)
+
+
+def with_retries(phase: str, fn, errors: list, attempts: int = 3,
+                 backoff_s: float = 5.0, sleep=time.sleep):
+    """Run ``fn()`` with bounded retries on the transient error class.
+
+    Returns ``fn``'s result, or None when every attempt failed (transient)
+    or the failure was non-transient. Every failure is appended to
+    ``errors`` as "phase: attempt N: message" so a partial JSON line still
+    tells the judge exactly what broke.
+    """
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — partial emission by design
+            errors.append(f"{phase}: attempt {attempt}: "
+                          f"{type(e).__name__}: {str(e)[:300]}")
+            if not is_transient(e) or attempt == attempts:
+                return None
+            # drop the failed attempt's device buffers before building a
+            # fresh engine — two engines at once OOM the 16 GB chip
+            import gc
+            gc.collect()
+            sleep(backoff_s * attempt)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
+
+def build_engine(ecfg, cfg):
+    import jax
+
+    from llms_on_kubernetes_tpu.engine.engine import Engine
+    from llms_on_kubernetes_tpu.ops.quant import random_quantized_params
+
+    params = None
+    if ecfg.quantization == "int8":
+        params = random_quantized_params(cfg, jax.random.key(0))
+    return Engine(ecfg, model_config=cfg, params=params)
+
+
+def warm_engine(eng, cfg, prompt_len, rng):
+    """Compile every executable the measured run will hit BEFORE the timed
+    window: the single-row prefill, the admit_batch-row prefill, and the
+    decode step (first compile of each is 20-40 s on the tunneled TPU and
+    must never land inside a measurement)."""
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    w = eng.submit(list(rng.integers(1, 100, prompt_len)),
+                   SamplingParams(temperature=0.0, max_tokens=4))
+    while not w.finished:
+        eng.step()
+    warm = [eng.submit(list(rng.integers(1, 100, prompt_len)),
+                       SamplingParams(temperature=0.0, max_tokens=4))
+            for _ in range(max(2, getattr(eng.config, "admit_batch", 4)))]
+    while any(not r.finished for r in warm):
+        eng.step()
+
+
+def measure_engine(eng, cfg, prompt_len, gen_len, rng) -> dict:
+    """Full-batch steady-state decode throughput + probe TTFT.
+
+    Steady-state is measured as a WINDOW (first to last full-occupancy
+    event), not a sum of event-bearing steps' durations: with async
+    scheduling most step() calls only launch and emit nothing, so
+    per-step attribution would drop their wall time and over-report.
+    TTFT is measured on PROBE requests submitted once the batch is in
+    steady decode — "new request joins a busy server", the serving
+    metric — not on the synthetic 100%-cold-burst arrival the batch
+    submission creates (that mostly measures queueing of the burst).
+    """
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    B = eng.config.max_decode_slots
+    if B < 2:
+        raise SystemExit("bench needs max_decode_slots >= 2 "
+                         "(one slot is probe headroom)")
+    # one slot of headroom so TTFT probes measure prefill-under-load,
+    # not slot starvation of a saturated batch
+    reqs = [
+        eng.submit(
+            list(rng.integers(1, cfg.vocab_size - 1, prompt_len)),
+            SamplingParams(temperature=0.0, max_tokens=gen_len),
+        )
+        for _ in range(B - 1)
+    ]
+    t0 = time.monotonic()
+    main_wall = None   # wall time when the main batch drained
+    window_start = window_end = None
+    tokens_at_start = tokens_at_end = 0
+    total_tokens = 0
+    probes = []
+    probe_budget = 6
+    while any(not r.finished for r in reqs) or any(not p.finished for p in probes):
+        events = eng.step()
+        now = time.monotonic()
+        step_tokens = sum(len(ev.new_tokens) for ev in events)
+        total_tokens += step_tokens
+        active = sum(r is not None for r in eng.slots)
+        if step_tokens and active >= B - 1:
+            if window_start is None:
+                window_start, tokens_at_start = now, total_tokens
+            window_end, tokens_at_end = now, total_tokens
+        if main_wall is None and all(r.finished for r in reqs):
+            main_wall = now - t0
+        # steady state reached: drip the TTFT probes in one at a time
+        # (previous probe fully done, mains still decoding) so each
+        # measures admission into a busy batch — not slot starvation of a
+        # saturated one, nor prefill into an already-drained server
+        if (window_start is not None and probe_budget > 0
+                and all(p.finished for p in probes)
+                and any(not r.finished for r in reqs)):
+            probes.append(eng.submit(
+                list(rng.integers(1, cfg.vocab_size - 1, prompt_len)),
+                SamplingParams(temperature=0.0, max_tokens=8),
+            ))
+            probe_budget -= 1
+    wall = main_wall if main_wall is not None else time.monotonic() - t0
+    decode_tokens = tokens_at_end - tokens_at_start
+    decode_time = (window_end - window_start) if window_start is not None else 0.0
+
+    pool = probes if any(p.first_token_at for p in probes) else reqs
+    ttfts = sorted(p.first_token_at - p.submitted_at
+                   for p in pool if p.first_token_at)
+    # TTFT breakdown: submit -> prefill dispatched (admission latency,
+    # host-side) vs dispatch -> first token (device queue + prefill +
+    # read RTT). Says whether latency lives in the scheduler or the
+    # device-queue depth.
+    admits = sorted(p.admitted_at - p.submitted_at
+                    for p in pool if p.admitted_at)
+    tok_s = decode_tokens / decode_time if decode_time > 0 else 0.0
+    return {
+        "tokens_per_sec": round(tok_s, 1),
+        "p50_ttft_ms": round(1000.0 * ttfts[len(ttfts) // 2], 1),
+        "p50_admit_ms": (round(1000.0 * admits[len(admits) // 2], 1)
+                         if admits else None),
+        "aggregate_tokens_per_sec": round(
+            sum(len(r.output) for r in reqs) / wall, 1),
+    }
 
 
 def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
@@ -165,7 +347,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         return req
 
     ttfts, engine_ttfts = [], []
-    for _ in range(4):
+    for _ in range(6):
         server.loop_thread.submit = tracking_submit
         probe_reqs.clear()
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
@@ -199,30 +381,17 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         "gateway_engine_p50_ttft_ms": round(
             1000 * engine_ttfts[len(engine_ttfts) // 2], 1) if engine_ttfts else None,
         "gateway_tokens_per_sec": round(n_load * gen / load_wall, 1),
-        # This dev environment reaches the TPU through a tunnel with a
-        # ~110 ms flat device->host read RTT; amortizing it needs a deep
-        # async pipeline (BENCH_DEPTH=8), and a new request's prefill
-        # queues behind those in-flight steps — which is most of the
-        # gateway TTFT. On GKE (sub-ms RTT) depth 2 suffices and the
-        # gateway TTFT converges to the engine-level number + ~2 ms of
-        # HTTP hops (the CPU run of this same bench shows the serving
-        # path itself adds only ~2.4 ms).
-        "gateway_depth_note": "tunnel RTT amortization; see bench.py",
     }
 
 
-def main() -> int:
-    import jax
+# ---------------------------------------------------------------------------
 
-    # honor an explicit CPU request even when a preloaded sitecustomize
-    # already registered a hardware platform (env alone is too late then)
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
 
-    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+def make_configs():
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
 
     model = os.environ.get("BENCH_MODEL", "llama-3-8b")
-    on_tpu = jax.devices()[0].platform != "cpu"
     if model == "llama-3-8b":
         # 64 slots: decode is weight-streaming-bound, so tokens/s scales
         # near-linearly with batch until the KV pool (4.3 GB at 64x512
@@ -239,14 +408,17 @@ def main() -> int:
             pages_per_slot=512 // page,
             num_pages=slots * (512 // page) + 1,
             prefill_buckets=(64,),
-            # deep pipeline: the driver's TPU is behind a tunnel with a
-            # ~100 ms host<->device round trip; 8 in-flight steps keep the
-            # device fed while the harvester threads wait out the reads
+            # deep READ pipeline: the driver's TPU is behind a tunnel with
+            # a ~100 ms host<->device round trip; 8 unharvested steps keep
+            # reads overlapped while the harvester threads wait them out
             async_depth=int(os.environ.get("BENCH_DEPTH", "8")),
-            # device-queue pacing (opt-in experiment; 0 = off — the
-            # busy-until estimate feeds back through the completion-rate
-            # EMA and can stall the pipeline when reads are the bottleneck)
-            pace_target_steps=float(os.environ.get("BENCH_PACE", "0")),
+            # device-queue pacing: bounds the work a new request's prefill
+            # dispatch waits behind — the round-3 TTFT regression was an
+            # unbounded device queue at depth 8. The READ pipeline
+            # (async_depth) stays deep; only the dispatch gets deferred
+            # when the device already holds this many step-times of undone
+            # work. Default tuned on the v5e: see BENCH_r04 sweep.
+            pace_target_steps=float(os.environ.get("BENCH_PACE", "3")),
             # int8 KV cache (opt-in: BENCH_KV=int8, with BENCH_PAGE=128 for
             # the Mosaic-aligned kernel path): halves decode-attention HBM
             # traffic and doubles token capacity. At THIS bench's short
@@ -263,107 +435,58 @@ def main() -> int:
             prefill_buckets=(32,),
         )
         prompt_len, gen_len = 8, 32
+    return ecfg, get_config(model), prompt_len, gen_len
 
-    from llms_on_kubernetes_tpu.configs import get_config
-    from llms_on_kubernetes_tpu.ops.quant import random_quantized_params
 
-    cfg = get_config(ecfg.model)
-    params = None
-    if ecfg.quantization == "int8":
-        params = random_quantized_params(cfg, jax.random.key(0))
-    eng = Engine(ecfg, model_config=cfg, params=params)
+def main() -> int:
+    import jax
 
-    rng = np.random.default_rng(0)
-    B = ecfg.max_decode_slots
+    # honor an explicit CPU request even when a preloaded sitecustomize
+    # already registered a hardware platform (env alone is too late then)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
-    def submit_batch():
-        # one slot of headroom so TTFT probes measure prefill-under-load,
-        # not slot starvation of a saturated batch
-        return [
-            eng.submit(
-                list(rng.integers(1, cfg.vocab_size - 1, prompt_len)),
-                SamplingParams(temperature=0.0, max_tokens=gen_len),
-            )
-            for _ in range(B - 1)
-        ]
+    ecfg, cfg, prompt_len, gen_len = make_configs()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    errors: list[str] = []
 
-    # warmup: compiles every executable the measured run will hit — the
-    # single-row prefill, the admit_batch-row prefill, and the decode step
-    w = eng.submit(list(rng.integers(1, 100, prompt_len)),
-                   SamplingParams(temperature=0.0, max_tokens=4))
-    while not w.finished:
-        eng.step()
-    warm = [eng.submit(list(rng.integers(1, 100, prompt_len)),
-                       SamplingParams(temperature=0.0, max_tokens=4))
-            for _ in range(max(2, getattr(ecfg, "admit_batch", 4)))]
-    while any(not r.finished for r in warm):
-        eng.step()
+    # --- phase 1: engine-level measure (fresh engine per attempt: a
+    # failed device read leaves the old pipeline state unknown) ---------
+    def engine_phase():
+        eng = build_engine(ecfg, cfg)
+        rng = np.random.default_rng(0)
+        warm_engine(eng, cfg, prompt_len, rng)
+        out = measure_engine(eng, cfg, prompt_len, gen_len, rng)
+        return eng, out
 
-    # measured run: full batch, TTFT + steady-state decode throughput.
-    # Steady-state is measured as a WINDOW (first to last full-occupancy
-    # event), not a sum of event-bearing steps' durations: with async
-    # scheduling most step() calls only launch and emit nothing, so
-    # per-step attribution would drop their wall time and over-report.
-    # TTFT is measured on PROBE requests submitted once the batch is in
-    # steady decode — "new request joins a busy server", the serving
-    # metric — not on the synthetic 100%-cold-burst arrival the batch
-    # submission creates (that mostly measures queueing of the burst).
-    if B < 2:
-        raise SystemExit("bench needs max_decode_slots >= 2 "
-                         "(one slot is probe headroom)")
-    reqs = submit_batch()
-    t0 = time.monotonic()
-    main_wall = None   # wall time when the main batch drained
-    window_start = window_end = None
-    tokens_at_start = tokens_at_end = 0
-    total_tokens = 0
-    probes = []
-    probe_budget = 4
-    while any(not r.finished for r in reqs) or any(not p.finished for p in probes):
-        events = eng.step()
-        now = time.monotonic()
-        step_tokens = sum(len(ev.new_tokens) for ev in events)
-        total_tokens += step_tokens
-        active = sum(r is not None for r in eng.slots)
-        if step_tokens and active >= B - 1:
-            if window_start is None:
-                window_start, tokens_at_start = now, total_tokens
-            window_end, tokens_at_end = now, total_tokens
-        if main_wall is None and all(r.finished for r in reqs):
-            main_wall = now - t0
-        # steady state reached: drip the TTFT probes in one at a time
-        # (previous probe fully done, mains still decoding) so each
-        # measures admission into a busy batch — not slot starvation of a
-        # saturated one, nor prefill into an already-drained server
-        if (window_start is not None and probe_budget > 0
-                and all(p.finished for p in probes)
-                and any(not r.finished for r in reqs)):
-            probes.append(eng.submit(
-                list(rng.integers(1, cfg.vocab_size - 1, prompt_len)),
-                SamplingParams(temperature=0.0, max_tokens=8),
-            ))
-            probe_budget -= 1
-    wall = main_wall if main_wall is not None else time.monotonic() - t0
-    decode_tokens = tokens_at_end - tokens_at_start
-    decode_time = (window_end - window_start) if window_start is not None else 0.0
+    eng_out = with_retries("engine", engine_phase, errors)
+    eng, engine_stats = eng_out if eng_out is not None else (None, {})
 
-    ttfts = sorted(p.first_token_at - p.submitted_at
-                   for p in probes if p.first_token_at)
-    if not ttfts:  # tiny CPU runs may finish before any probe lands
-        ttfts = sorted(r.first_token_at - r.submitted_at
-                       for r in reqs if r.first_token_at)
-    p50_ttft_ms = 1000.0 * ttfts[len(ttfts) // 2]
-    tok_s = decode_tokens / decode_time if decode_time > 0 else 0.0
-    total_tok_s = sum(len(r.output) for r in reqs) / wall
+    # --- phase 2: gateway path (reuses the warmed engine; on a fresh
+    # retry the engine is rebuilt since the failure class is transport) --
+    gw = {}
+    if eng is not None:
+        def gateway_phase():
+            return gateway_bench(eng, cfg.name, prompt_len, cfg.vocab_size)
 
-    # gateway path: the BASELINE.md metric definition measures TTFT
-    # through the router hop (client -> router -> server -> engine)
-    try:
-        gw = gateway_bench(eng, cfg.name, prompt_len, cfg.vocab_size)
-    except Exception as e:  # the engine-level numbers still stand
-        gw = {"gateway_error": str(e)[:200]}
+        def gateway_phase_fresh():
+            e2 = build_engine(ecfg, cfg)
+            warm_engine(e2, cfg, prompt_len, np.random.default_rng(0))
+            return gateway_bench(e2, cfg.name, prompt_len, cfg.vocab_size)
 
-    value = round(tok_s, 1)
+        gw = with_retries("gateway", gateway_phase, errors, attempts=1)
+        if gw is None:
+            # release the old engine BEFORE building the fresh one: two
+            # llama-3-8b engines (weights + KV pool each) cannot coexist
+            # on one 16 GB chip
+            import gc
+            eng = None
+            gc.collect()
+            gw = with_retries("gateway-fresh", gateway_phase_fresh, errors,
+                              attempts=2)
+        gw = gw or {}
+
+    value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
     result = {
@@ -371,21 +494,24 @@ def main() -> int:
         "value": value,
         "unit": "tokens/s",
         "vs_baseline": round(per_dollar / baseline_per_dollar, 3),
-        "p50_ttft_ms": round(p50_ttft_ms, 1),
-        "aggregate_tokens_per_sec": round(total_tok_s, 1),
+        **{k: v for k, v in engine_stats.items() if k != "tokens_per_sec"},
         **gw,
-        "batch": B,
+        "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
+        "pace_target_steps": ecfg.pace_target_steps,
+        "async_depth": ecfg.async_depth,
         "platform": jax.devices()[0].platform,
         "on_tpu": on_tpu,
     }
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
     sys.stdout.flush()
     # Hard-exit: experimental PJRT plugins (the driver's tunneled TPU) can
     # panic in their teardown hooks AFTER results are out, turning a
     # successful bench into exit 134. The JSON line above is the contract;
     # skip interpreter teardown entirely.
-    os._exit(0)
+    os._exit(0 if value or gw else 1)
 
 
 if __name__ == "__main__":

@@ -347,6 +347,11 @@ class PageAllocator:
         # oldest-released first (python dicts preserve insertion order)
         self._lru: dict[int, None] = {}
         self.hit_tokens_total = 0  # metrics: prompt tokens served from cache
+        # pre-adoption LRU order per slot, kept until commit/rollback: a
+        # blocked cache-hit admission retries every engine iteration, and
+        # each retry must neither count the hit nor refresh the adopted
+        # pages' LRU recency (round-3 advisor finding)
+        self._adopt_snapshot: dict[int, list[int]] = {}
 
     @property
     def num_free_pages(self) -> int:
@@ -455,19 +460,44 @@ class PageAllocator:
     def adopt_prefix(self, slot: int, tokens, salt: bytes = b"") -> int:
         """Map the longest cached prefix into ``slot``'s table (increfs the
         shared pages). Must be called before ``allocate`` grows the slot.
-        Returns the number of cached tokens adopted."""
+        Returns the number of cached tokens adopted.
+
+        The adoption is PROVISIONAL: the caller either commits it
+        (``commit_adopt`` — counts the hit in ``hit_tokens_total``) once
+        the admission goes through, or rolls it back (``rollback_adopt``)
+        when allocation/validation fails — so a blocked admission
+        retrying every engine iteration neither inflates the hit metric
+        nor churns the LRU recency of the adopted pages."""
         pages = self._match_digests(tokens, salt)
         if not pages:
             return 0
         assert not self.slot_pages[slot], "adopt_prefix on a non-empty slot"
+        self._adopt_snapshot[slot] = list(self._lru)
         for i, p in enumerate(pages):
             self.refcount[p] = self.refcount.get(p, 0) + 1
             self._lru.pop(p, None)  # referenced again: not evictable
             self.slot_pages[slot].append(p)
             self.page_tables[slot, i] = p
-        hit = len(pages) * self.page_size
-        self.hit_tokens_total += hit
-        return hit
+        return len(pages) * self.page_size
+
+    def commit_adopt(self, slot: int, hit_tokens: int) -> None:
+        """The adoption's admission succeeded: count the cache hit."""
+        self._adopt_snapshot.pop(slot, None)
+        self.hit_tokens_total += hit_tokens
+
+    def rollback_adopt(self, slot: int) -> None:
+        """Undo a provisional ``adopt_prefix``: decref the pages and
+        restore the pre-adoption LRU order (a plain ``free`` would
+        re-insert the cached pages at the NEWEST recency position, so a
+        retrying admission would skew eviction order every iteration)."""
+        snap = self._adopt_snapshot.pop(slot, None)
+        self.free(slot)
+        if snap is not None:
+            restored: dict[int, None] = {
+                p: None for p in snap if p in self._lru}
+            for p in self._lru:  # anything newer keeps its relative order
+                restored.setdefault(p, None)
+            self._lru = restored
 
     def register_prefix(self, slot: int, tokens, salt: bytes = b"") -> None:
         """Publish ``slot``'s pages holding full pages of ``tokens`` so

@@ -68,6 +68,10 @@ class SamplingParams:
     # top alternatives the client asked to see per token (response shaping
     # only — the engine always records LOGPROB_TOPK alternatives)
     logprobs: int = 0
+    # OpenAI logit_bias: ((token_id, bias), ...) added to the raw logits
+    # on device every step; at most LOGIT_BIAS_SLOTS entries (rejected at
+    # submit beyond that — the packed-row column budget is a hard bound)
+    logit_bias: tuple = ()
 
 
 @dataclasses.dataclass
@@ -119,8 +123,9 @@ class EngineConfig:
     # prefills through the chunk path with history = the cached length
     prefix_caching: bool = True
     # multimodal: images per request the mm-prefill executable is compiled
-    # for (requests with more are rejected at submit)
-    max_images_per_request: int = 1
+    # for (requests with more are rejected at submit); the embeds buffer
+    # is padded to this count, so raising it costs only prefill-input HBM
+    max_images_per_request: int = 4
     # KV cache storage dtype: None => engine dtype; "int8" => per-token
     # quantized KV (halved decode-attention HBM traffic, doubled token
     # capacity; accuracy pinned by logit-tolerance tests)
@@ -228,6 +233,11 @@ class _Harvester(threading.Thread):
         self._done_upto = -1
         self._next_seq = 0                  # next step seq to mark done
         self._stopping = False
+        # a device_get failure (tunnel drop, OOM surfacing on the read)
+        # must surface on the ENGINE thread, not silently kill a reader —
+        # otherwise every wait_done/wait_key blocks forever (observed as a
+        # bench hang). First error wins; all waiters re-raise it.
+        self._error: Optional[BaseException] = None
         # small batches + overlapped readers: one huge batched read would
         # couple every completion to the newest dispatch and mark done in
         # lumps; overlapping 2+ reads pipelines the tunnel RTT instead
@@ -266,7 +276,14 @@ class _Harvester(threading.Thread):
                     n = min(max(1, self._batch), len(self._pending))
                     batch = [self._pending.popleft() for _ in range(n)]
                     priority = False
-            host = jax.device_get([r for _, r in batch])
+            try:
+                host = jax.device_get([r for _, r in batch])
+            except BaseException as e:  # noqa: BLE001 — must not die silent
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+                    self._cv.notify_all()
+                return
             with self._cv:
                 if priority:
                     for (key, _), h in zip(batch, host):
@@ -283,10 +300,19 @@ class _Harvester(threading.Thread):
                         self._next_seq += 1
                 self._cv.notify_all()
 
+    def _check_error(self) -> None:
+        if self._error is not None:
+            # re-raise the ORIGINAL exception (same type): callers up the
+            # stack classify transient transport errors by type+message
+            # (bench.py retries JaxRuntimeError INTERNAL/UNAVAILABLE)
+            raise self._error
+
     def is_done(self, seq: int) -> bool:
+        self._check_error()
         return seq <= self._done_upto
 
     def key_done(self, key: int) -> bool:
+        self._check_error()
         return key in self._done
 
     def get(self, key: int) -> Any:
@@ -300,6 +326,7 @@ class _Harvester(threading.Thread):
         admits before waiting again)."""
         with self._cv:
             while self._done_upto < seq:
+                self._check_error()
                 if wake is not None and wake.is_set():
                     return
                 self._cv.wait()
@@ -312,6 +339,7 @@ class _Harvester(threading.Thread):
     def wait_key(self, key: int) -> None:
         with self._cv:
             while key not in self._done:
+                self._check_error()
                 self._cv.wait()
 
     def discard_upto(self, seq: int) -> None:
@@ -383,10 +411,35 @@ def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths,
 # The token merge and the PRNG fold_in also move inside the executable so a
 # decode step is exactly ONE upload + ONE dispatch.
 
+# OpenAI logit_bias: per-request (token id, bias) pairs ride the packed
+# rows as LOGIT_BIAS_SLOTS id columns + LOGIT_BIAS_SLOTS value columns
+# (float bits), padding id -1 => dropped by the on-device scatter. Maps
+# with more entries are rejected at submit() (400 upstream) — the column
+# budget is a hard bound, like MAX_CANDIDATES for top_k.
+LOGIT_BIAS_SLOTS = 32
+
+
+def _unpack_bias(packed, base: int):
+    ids = packed[:, base:base + LOGIT_BIAS_SLOTS]
+    vals = jax.lax.bitcast_convert_type(
+        packed[:, base + LOGIT_BIAS_SLOTS:base + 2 * LOGIT_BIAS_SLOTS],
+        jnp.float32)
+    return ids, vals
+
+
+def _pack_bias(packed: np.ndarray, row: int, base: int, params) -> None:
+    packed[row, base:base + LOGIT_BIAS_SLOTS] = -1
+    for j, (tid, bv) in enumerate(params.logit_bias):
+        packed[row, base + j] = tid
+        packed[row, base + LOGIT_BIAS_SLOTS + j] = np.float32(bv).view(np.int32)
+
+
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
 # 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
-# 9 frequency(bits), 10 pos_delta (mrope), 11.. page_table
-_DEC_COLS = 11
+# 9 frequency(bits), 10 pos_delta (mrope), 11.. logit_bias ids/vals,
+# then page_table
+_BIAS_DEC = 11
+_DEC_COLS = _BIAS_DEC + 2 * LOGIT_BIAS_SLOTS
 
 
 def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
@@ -401,6 +454,7 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
     frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
     pos_delta = packed[:, 10]
+    bias = _unpack_bias(packed, _BIAS_DEC)
     page_table = packed[:, _DEC_COLS:]
 
     tokens = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
@@ -413,14 +467,15 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts))
+                 penalties=(presence, frequency, counts), bias=bias)
     return res, k_pages, v_pages, counts
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
 # 4 seed, 5 presence(bits), 6 frequency(bits), 7 slot, 8 prompt_len,
-# 9.. page_table
-_PRE_COLS = 9
+# 9.. logit_bias ids/vals, then page_table
+_BIAS_PRE = 9
+_PRE_COLS = _BIAS_PRE + 2 * LOGIT_BIAS_SLOTS
 
 
 def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
@@ -441,6 +496,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
     frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
     slots = packed[:, 7]
     prompt_len = packed[:, 8]
+    bias = _unpack_bias(packed, _BIAS_PRE)
     page_table = packed[:, _PRE_COLS:]
 
     counts = _rebuild_count_rows(
@@ -452,7 +508,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts[slots]))
+                 penalties=(presence, frequency, counts[slots]), bias=bias)
     return res, k_pages, v_pages, counts
 
 
@@ -467,6 +523,7 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
     slots = packed[:, 7]
     prompt_len = packed[:, 8]
+    bias = _unpack_bias(packed, _BIAS_PRE)
     page_table = packed[:, _PRE_COLS:]
 
     counts = _rebuild_count_rows(
@@ -478,17 +535,21 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     keys = _slot_keys(base_key, seeds, lengths)
     row_counts = counts[slots]
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, row_counts))
+                 penalties=(presence, frequency, row_counts), bias=bias)
     return res, k_pages, v_pages, counts
 
 
 # packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
 # 4 top_p(bits), 5 seed, 6 presence(bits), 7 frequency(bits), 8 slot,
 # 9 prompt_len, 10 reset (first chunk of the request — history may be
-# nonzero when a cached prefix was adopted), 11.. page_table. Sampling
-# position is the TOTAL length (history + chunk_len) so a chunked prompt
-# draws exactly the tokens a one-shot prefill of the same prompt would.
-_CHK_COLS = 11
+# nonzero when a cached prefix was adopted), 11 pos_delta (mrope: a
+# cache-hit Qwen3-VL remainder replays through this path with rope
+# positions shifted by the request's mrope delta), 12.. logit_bias
+# ids/vals, then page_table. Sampling position is the TOTAL length
+# (history + chunk_len) so a chunked prompt draws exactly the tokens a
+# one-shot prefill of the same prompt would.
+_BIAS_CHK = 12
+_CHK_COLS = _BIAS_CHK + 2 * LOGIT_BIAS_SLOTS
 
 
 def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
@@ -504,16 +565,19 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     slots = packed[:, 8]
     prompt_len = packed[:, 9]
     reset = packed[:, 10]
+    pos_delta = packed[:, 11]
+    bias = _unpack_bias(packed, _BIAS_CHK)
     page_table = packed[:, _CHK_COLS:]
 
     counts = _rebuild_count_rows(
         counts, tokens, slots, history, prompt_len, lengths, reset)
     logits, k_pages, v_pages = forward_chunk(
-        params, cfg, tokens, history, lengths, k_pages, v_pages, page_table
+        params, cfg, tokens, history, lengths, k_pages, v_pages, page_table,
+        pos_delta=pos_delta,
     )
     keys = _slot_keys(base_key, seeds, history + lengths)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts[slots]))
+                 penalties=(presence, frequency, counts[slots]), bias=bias)
     return res, k_pages, v_pages, counts
 
 
@@ -716,6 +780,10 @@ class Engine:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if images is not None:
+            # normalize to a LIST of [H, W, C] float32 arrays — dynamic
+            # resolution (Qwen3-VL) allows per-image grids, so one request
+            # may carry differently-shaped images
+            images = [np.asarray(im, np.float32) for im in images]
             params = self._validate_images(prompt, params, images)
         if params.top_k > MAX_CANDIDATES:
             raise ValueError(
@@ -726,6 +794,15 @@ class Engine:
             val = getattr(params, name)
             if not -2.0 <= val <= 2.0:
                 raise ValueError(f"{name} must be in [-2, 2], got {val}")
+        if len(params.logit_bias) > LOGIT_BIAS_SLOTS:
+            raise ValueError(
+                f"logit_bias supports at most {LOGIT_BIAS_SLOTS} entries, "
+                f"got {len(params.logit_bias)}")
+        for tid, _bv in params.logit_bias:
+            if not 0 <= tid < self.model_config.vocab_size:
+                raise ValueError(
+                    f"logit_bias token id {tid} outside the vocabulary "
+                    f"(size {self.model_config.vocab_size})")
         # prompts longer than the largest prefill bucket are served too:
         # admission splits them into bucket-size chunks against the paged
         # pool (chunked prefill — forward_chunk). The only hard limit is
@@ -745,9 +822,21 @@ class Engine:
         # an unchecked 64-bit client seed would OverflowError inside step()
         seed = (params.seed if params.seed is not None
                 else int(self._seed_rng.integers(0, 2 ** 31 - 1))) & 0x7FFFFFFF
+        # mrope delta is a pure function of the prompt: compute it ONCE at
+        # submit so a cache-hit admission (which skips the mm prefill that
+        # used to derive it) still decodes at the right rotary positions
+        mrope_delta = 0
+        if images is not None and self.model_config.mrope_section is not None:
+            from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
+
+            _, mrope_delta = qwen_mrope_positions(
+                list(prompt), self.model_config.image_token_id,
+                self.model_config.vision.mm_tokens_per_image,
+                grids=self._mm_grids(images))
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
             prompt=list(prompt), params=params, seed=seed, images=images,
+            mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
@@ -783,6 +872,30 @@ class Engine:
             raise ValueError(
                 f"{n} images; this engine serves 1.."
                 f"{self.config.max_images_per_request} per request")
+        v = cfg.vision
+        S2 = (v.image_size // v.patch_size) ** 2
+        for im in images:
+            if im.ndim != 3 or im.shape[2] != v.num_channels:
+                raise ValueError(
+                    f"each image must be [H, W, {v.num_channels}]; got "
+                    f"{tuple(im.shape)}")
+            sh, sw = im.shape[0] // v.patch_size, im.shape[1] // v.patch_size
+            if v.family == "qwen3vl":
+                # dynamic resolution: any grid with the fixed patch budget
+                # whose sides divide into merge blocks (the preprocessor
+                # only produces these; validate so raw submit()s get 400s)
+                m = v.spatial_merge_size
+                if (sh * v.patch_size != im.shape[0]
+                        or sw * v.patch_size != im.shape[1]
+                        or sh % m or sw % m or sh * sw != S2):
+                    raise ValueError(
+                        f"image {im.shape[0]}x{im.shape[1]} is not an "
+                        f"allowed dynamic-resolution grid ({S2} patches, "
+                        f"sides divisible by {m * v.patch_size})")
+            elif im.shape[:2] != (v.image_size, v.image_size):
+                raise ValueError(
+                    f"{cfg.name} images must be {v.image_size}x"
+                    f"{v.image_size}; got {im.shape[0]}x{im.shape[1]}")
         t_img = cfg.vision.mm_tokens_per_image
         soft = sum(1 for t in prompt if t == cfg.image_token_id)
         if soft != n * t_img:
@@ -899,6 +1012,7 @@ class Engine:
         packed[row, 6] = np.float32(req.params.frequency_penalty).view(np.int32)
         packed[row, 7] = slot
         packed[row, 8] = len(req.prompt)  # output-token counting boundary
+        _pack_bias(packed, row, _BIAS_PRE, req.params)
         packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
 
     def _free_slot(self) -> Optional[int]:
@@ -948,6 +1062,8 @@ class Engine:
             packed[0, 8] = slot
             packed[0, 9] = len(req.prompt)
             packed[0, 10] = 1 if pos == start else 0  # first chunk: reset counts
+            packed[0, 11] = req.mrope_delta
+            _pack_bias(packed, 0, _BIAS_CHK, req.params)
             packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
             self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed)
             res, self.k_pages, self.v_pages, self.token_counts = self._chunk_packed(
@@ -969,16 +1085,18 @@ class Engine:
         placeholder id, so token-only hashing would alias different
         images); a cache hit is then only usable when it covers the whole
         image region, because the remainder replays through the TEXT
-        chunk path (enforced at admission). mrope models skip the cache
-        (None): the chunk path cannot carry their position delta yet."""
+        chunk path (enforced at admission). mrope (Qwen3-VL) prompts are
+        cacheable too: the chunk path carries the request's position
+        delta (packed col 11), computed at submit."""
         if images is None:
             return b""
-        if self.model_config.mrope_section is not None:
-            return None
         import hashlib
 
-        return hashlib.sha256(
-            np.asarray(images, np.float32).tobytes()).digest()
+        h = hashlib.sha256()
+        for im in images:  # shape first: same bytes at a different grid
+            h.update(np.asarray(im.shape, np.int64).tobytes())
+            h.update(im.tobytes())
+        return h.digest()
 
     def _adopt_cached_prefix(self, slot: int, req: Request,
                              prefill_tokens: list[int]) -> int:
@@ -994,9 +1112,37 @@ class Engine:
             last_img = max(i for i, t in enumerate(req.prompt)
                            if t == self.model_config.image_token_id)
             if hit <= last_img:
-                self.allocator.free(slot)
+                self.allocator.rollback_adopt(slot)
                 return 0
         return hit
+
+    def _mm_grids(self, images) -> list[tuple[int, int]]:
+        """Per-image MERGED grids (rows, cols) from the pixel shapes."""
+        v = self.model_config.vision
+        d = v.patch_size * v.spatial_merge_size
+        return [(im.shape[0] // d, im.shape[1] // d) for im in images]
+
+    def _encode_request_images(self, images):
+        """Encode each image through the vision tower (one jitted call per
+        image — dynamic resolution means per-image pixel shapes, each grid
+        compiling once). Returns (embeds [n, t_img, D],
+        deepstack [n_taps, n, t_img, D] | None)."""
+        cfg = self.model_config
+        qwen = cfg.vision.family == "qwen3vl"
+        embeds_l, deep_l = [], []
+        for im in images:
+            out = self._encode_images(self.params["vision"], cfg.vision,
+                                      jnp.asarray(im)[None])
+            if qwen:
+                e, d = out
+                deep_l.append(None if d is None else d[:, 0])
+            else:
+                e = out
+            embeds_l.append(e[0])
+        embeds = jnp.stack(embeds_l)
+        deep = (jnp.stack(deep_l, axis=1)
+                if qwen and deep_l and deep_l[0] is not None else None)
+        return embeds, deep
 
     def _dispatch_mm_prefill(self, slot: int, req: Request,
                              prefill_tokens: list[int]):
@@ -1005,9 +1151,7 @@ class Engine:
         the device SampleResult."""
         cfg = self.model_config
         qwen = cfg.vision.family == "qwen3vl"
-        pixels = jnp.asarray(np.asarray(req.images, np.float32))
-        out = self._encode_images(self.params["vision"], cfg.vision, pixels)
-        embeds, deep = out if qwen else (out, None)
+        embeds, deep = self._encode_request_images(req.images)
         n_max = self.config.max_images_per_request
         if embeds.shape[0] < n_max:  # pad image count to the compiled shape
             pad = jnp.zeros((n_max - embeds.shape[0],) + embeds.shape[1:],
@@ -1028,11 +1172,14 @@ class Engine:
         if qwen:
             from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
 
-            p3, delta = qwen_mrope_positions(
+            # delta is NOT re-assigned here: submit() already derived it
+            # (the authoritative value — cache-hit admissions skip this
+            # dispatch entirely and still need it for decode)
+            p3, _delta = qwen_mrope_positions(
                 prefill_tokens, cfg.image_token_id,
                 cfg.vision.mm_tokens_per_image,
-                prompt_len=len(req.prompt))
-            req.mrope_delta = delta
+                prompt_len=len(req.prompt),
+                grids=self._mm_grids(req.images))
             full = np.zeros((1, 3, bucket), np.int32)
             full[0, :, :n] = p3
             pos3 = jnp.asarray(full)
@@ -1076,10 +1223,12 @@ class Engine:
             hit = self._adopt_cached_prefix(slot, req, prefill_tokens)
             if not self.allocator.can_allocate(slot, n + 1):
                 if hit:
-                    self.allocator.free(slot)
+                    self.allocator.rollback_adopt(slot)
                 return []  # wait for pages to free up
             self.waiting.popleft()
         self.allocator.allocate(slot, n + 1)
+        if hit:
+            self.allocator.commit_adopt(slot, hit)
         self.slots[slot] = req
         req.slot = slot
 
@@ -1203,6 +1352,7 @@ class Engine:
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             packed[i, 10] = r.mrope_delta
+            _pack_bias(packed, i, _BIAS_DEC, r.params)
         packed[:, _DEC_COLS:] = self.allocator.page_tables
 
         self._mh_send(MSG_DECODE, dec_packed=packed)
@@ -1266,10 +1416,12 @@ class Engine:
                     # alone (chunk path or mm prefill)
                     if picked or not self.allocator.can_allocate(slot, n + 1):
                         if hit:
-                            self.allocator.free(slot)  # roll back adoption
+                            self.allocator.rollback_adopt(slot)
                         break  # runs by itself next iteration / wait
                     self.waiting.popleft()
                     self.allocator.allocate(slot, n + 1)
+                    if hit:
+                        self.allocator.commit_adopt(slot, hit)
                     self.slots[slot] = req
                     req.slot = slot
                     long_pick = (slot, req, resumed, prefill_tokens, hit)
@@ -1415,6 +1567,7 @@ class Engine:
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             packed[i, 10] = r.mrope_delta
+            _pack_bias(packed, i, _BIAS_DEC, r.params)
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
                 if resumed:              # resumed: host-known pending token
@@ -1543,23 +1696,28 @@ class Engine:
         constraints are satisfied — firsts in FIFO order, then steps in
         dispatch order while not gated by a pending first. Returns the
         number of decode steps consumed (pacing calibration)."""
-        done_i = 0
-        while done_i < len(self._pending_first):
-            req, key, row = self._pending_first[done_i]
-            if not self._harvester.key_done(key):
-                break  # priority reads are FIFO: later keys aren't done either
-            host = self._harvester.get(key)
-            done_i += 1
+        # firsts are per-request-independent results: with overlapped
+        # harvester readers (LLMK_HARVEST_READERS >= 2) a LATER priority
+        # batch can land before an earlier in-flight one, so release every
+        # completed entry rather than stopping at the first not-done key —
+        # a FIFO prefix scan would couple independent requests' TTFT
+        # (round-3 advisor finding)
+        done_entries, still = [], []
+        for entry in self._pending_first:
+            (done_entries if self._harvester.key_done(entry[1])
+             else still).append(entry)
+        for req, key, row in done_entries:
             if req.finished:
                 continue
+            host = self._harvester.get(key)
             tok = int(host.tokens[row])
             req.pending_token = tok
             req.first_token_at = time.monotonic()
             events += self._emit(req, tok, _lp_entry(host, row))
-        if done_i:
-            finished_keys = {k for _, k, _ in self._pending_first[:done_i]}
-            self._pending_first = self._pending_first[done_i:]
-            for k in finished_keys - {k for _, k, _ in self._pending_first}:
+        if done_entries:
+            self._pending_first = still
+            done_keys = {k for _, k, _ in done_entries}
+            for k in done_keys - {k for _, k, _ in still}:
                 self._harvester.discard_key(k)
 
         processed = -1

@@ -58,6 +58,7 @@ def sample(
     top_k: jnp.ndarray,        # [B] int32; 0 or >=V => disabled
     top_p: jnp.ndarray,        # [B] float32; 1.0 => disabled
     penalties: "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray] | None" = None,
+    bias: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
 ) -> "SampleResult":
     """Returns a SampleResult (tokens, chosen logprobs, top-K alternatives).
 
@@ -69,13 +70,29 @@ def sample(
     OpenAI presence/frequency penalties over the OUTPUT tokens generated
     so far (the engine maintains ``counts``). Applied to the raw logits
     before candidate extraction, so the penalized distribution drives
-    top-k/top-p and the reported logprobs — vLLM semantics."""
+    top-k/top-p and the reported logprobs — vLLM semantics.
+
+    ``bias`` = (ids [B, N] int32, values [B, N] float32): the OpenAI
+    ``logit_bias`` map, added to the raw logits before extraction (so a
+    +100 bias forces and a -100 bias bans, vLLM semantics). Padding
+    entries carry id -1 and are dropped by the scatter."""
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     if penalties is not None:
         presence, frequency, counts = penalties
         c = counts.astype(jnp.float32)
         logits = logits - presence[:, None] * (c > 0) - frequency[:, None] * c
+    if bias is not None:
+        b_ids, b_vals = bias
+        rows = jnp.broadcast_to(
+            jnp.arange(B, dtype=jnp.int32)[:, None], b_ids.shape)
+        # padding id -1 would WRAP to column V-1 (jax normalizes negative
+        # indices before mode="drop" applies — verified), so zero the
+        # padded values explicitly; mode="drop" still guards any
+        # out-of-range positive id
+        b_vals = jnp.where(b_ids >= 0, b_vals.astype(jnp.float32), 0.0)
+        logits = logits.at[rows, jnp.maximum(b_ids, 0)].add(
+            b_vals, mode="drop")
     C = min(MAX_CANDIDATES, V)
 
     # --- candidate extraction (sorted descending) ---------------------

@@ -23,7 +23,8 @@ from typing import Optional, Protocol, Sequence
 class TokenizerLike(Protocol):
     def encode(self, text: str) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
-    def apply_chat_template(self, messages: list[dict]) -> list[int]: ...
+    def apply_chat_template(self, messages: list[dict],
+                            tools: Optional[list] = None) -> list[int]: ...
     @property
     def eos_ids(self) -> set[int]: ...
 
@@ -41,8 +42,14 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: list[dict]) -> list[int]:
-        text = "".join(
+    def apply_chat_template(self, messages: list[dict],
+                            tools: Optional[list] = None) -> list[int]:
+        import json
+
+        text = ""
+        if tools:  # render schemas the way tool-aware templates do
+            text += f"<tools>{json.dumps(tools, sort_keys=True)}</tools>"
+        text += "".join(
             f"<{m.get('role', 'user')}>{m.get('content', '')}</{m.get('role', 'user')}>"
             for m in messages
         )
@@ -67,9 +74,10 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+    def apply_chat_template(self, messages: list[dict],
+                            tools: Optional[list] = None) -> list[int]:
         return self._tok.apply_chat_template(
-            messages, tokenize=True, add_generation_prompt=True
+            messages, tools=tools, tokenize=True, add_generation_prompt=True
         )
 
     @property
@@ -228,10 +236,11 @@ class GGUFTokenizer:
                 ids += self._encode_piece(part.replace(" ", "▁"))
         return ids
 
-    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+    def apply_chat_template(self, messages: list[dict],
+                            tools: Optional[list] = None) -> list[int]:
         if self.chat_template:
             try:
-                return self._render_chat_template(messages)
+                return self._render_chat_template(messages, tools=tools)
             except Exception:
                 # malformed template / missing jinja2: fall back to the
                 # generic format — but say WHY, once, or every chat
@@ -254,7 +263,8 @@ class GGUFTokenizer:
                 text += f" {content} "
         return self.encode(text)
 
-    def _render_chat_template(self, messages: list[dict]) -> list[int]:
+    def _render_chat_template(self, messages: list[dict],
+                              tools: Optional[list] = None) -> list[int]:
         """Render ``tokenizer.chat_template`` the way HF/llama.cpp do:
         sandboxed Jinja fed messages + bos/eos token strings +
         add_generation_prompt=True, then tokenize with control-token
@@ -270,7 +280,7 @@ class GGUFTokenizer:
         bos = self.tokens[self.bos_id] if 0 <= self.bos_id < len(self.tokens) else ""
         eos = self.tokens[self.eos_id] if 0 <= self.eos_id < len(self.tokens) else ""
         text = env.from_string(self.chat_template).render(
-            messages=messages, add_generation_prompt=True,
+            messages=messages, add_generation_prompt=True, tools=tools,
             bos_token=bos, eos_token=eos, raise_exception=raise_exception,
         )
         ids = self._encode_with_specials(text)

@@ -183,6 +183,7 @@ def _layer_step(
     inv_freq_local: "jnp.ndarray | None" = None,
     mm_groups: "jnp.ndarray | None" = None,
     mm_pos3: "jnp.ndarray | None" = None,  # [B, 3, T] qwen3vl mrope
+    rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
 ):
     scale = (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
     # Gemma-2/3 interleaved attention: layer is global iff (i+1) % pattern == 0;
@@ -204,7 +205,12 @@ def _layer_step(
 
         q, k = apply_mrope(q, k, mm_pos3, inv_freq, cfg.mrope_section)
     else:
-        q, k = apply_rope(q, k, positions, inv_freq)
+        # rope_positions may be shifted by an mrope delta; ``positions``
+        # stays token-indexed for attention masking / chunk history
+        q, k = apply_rope(
+            q, k,
+            positions if rope_positions is None else rope_positions,
+            inv_freq)
     k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, write_positions)
 
     if mode == "prefill":
@@ -258,6 +264,7 @@ def _run_layers(
     deepstack: "jnp.ndarray | None" = None,   # [n_taps, B, n_img*t_img, D]
     mm_idx: "jnp.ndarray | None" = None,      # [B, T] soft-token index
     mm_is_img: "jnp.ndarray | None" = None,   # [B, T] image-token mask
+    rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
 ):
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling))
     inv_freq_local = (
@@ -278,6 +285,7 @@ def _run_layers(
             cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
             mm_groups=mm_groups, mm_pos3=mm_pos3,
+            rope_positions=rope_positions,
         )
         if deepstack is not None:
             # DeepStack (Qwen3-VL): intermediate vision features are ADDED
@@ -409,20 +417,32 @@ def forward_chunk(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
+    pos_delta: "jnp.ndarray | None" = None,  # [B] mrope position offset
 ):
     """Chunked prefill: process one chunk of a prompt whose earlier chunks
     are already in the paged cache. Returns the chunk's last-token logits
     [B, V] and the updated cache. With history=0 this is semantically
     ``forward_prefill`` (pinned by tests), but attends through the page
-    pool — the engine uses it only for out-of-bucket prompts."""
+    pool — the engine uses it only for out-of-bucket prompts.
+
+    ``pos_delta`` shifts the ROTARY position only, exactly as in
+    ``forward_decode``: a Qwen3-VL prompt whose image region was adopted
+    from the prefix cache replays its TEXT remainder through this path,
+    and those tokens' rope positions lag their token index by the
+    request's mrope delta (text after an image: all three mrope axes
+    equal token_index + delta, which equals plain rope at that shifted
+    position). Cache write positions stay token-indexed."""
     B, T = tokens.shape
     offs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     positions = history[:, None] + offs
     write_positions = jnp.where(offs < lengths[:, None], positions, -1)
+    rope_positions = (None if pos_delta is None
+                      else positions + pos_delta[:, None])
     x = _embed(params, cfg, tokens)
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
         positions, write_positions, lengths, "chunk",
+        rope_positions=rope_positions,
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]

@@ -221,6 +221,52 @@ def preprocess_image(img, image_size: int) -> np.ndarray:
     return (x - 0.5) / 0.5
 
 
+def qwen_grid_candidates(vcfg: VisionConfig) -> list[tuple[int, int]]:
+    """All (sh, sw) patch grids with sh*sw == S^2 (fixed token budget —
+    the engine's soft-token count per image stays static) and both sides
+    multiples of the spatial merge size."""
+    S = vcfg.image_size // vcfg.patch_size
+    m = vcfg.spatial_merge_size
+    total = S * S
+    out = []
+    for sh in range(m, total // m + 1, m):
+        if total % sh == 0 and (total // sh) % m == 0:
+            out.append((sh, total // sh))
+    return out
+
+
+def select_qwen_grid(width: int, height: int,
+                     vcfg: VisionConfig) -> tuple[int, int]:
+    """Pick the aspect-closest allowed patch grid for a width x height
+    image (log-aspect distance, ties to the squarer grid)."""
+    import math
+
+    aspect = math.log(max(height, 1) / max(width, 1))
+    return min(
+        qwen_grid_candidates(vcfg),
+        key=lambda g: (abs(math.log(g[0] / g[1]) - aspect),
+                       abs(math.log(g[0] / g[1]))))
+
+
+def preprocess_image_qwen3vl(img, vcfg: VisionConfig) -> np.ndarray:
+    """Dynamic-resolution Qwen3-VL preprocessing: resize to the
+    aspect-closest allowed patch grid (token budget fixed at S^2 patches,
+    grid shape free — vLLM serves the native dynamic grids; here the
+    budget is pinned for static engine shapes while the aspect ratio is
+    honored). Returns [sh*p, sw*p, C] float32, mean/std-0.5 normalized
+    (the Qwen image processor's rescale+normalize)."""
+    from PIL import Image
+
+    if isinstance(img, np.ndarray):
+        img = Image.fromarray(img)
+    img = img.convert("RGB")
+    sh, sw = select_qwen_grid(img.width, img.height, vcfg)
+    p = vcfg.patch_size
+    img = img.resize((sw * p, sh * p), Image.Resampling.BICUBIC)
+    x = np.asarray(img, np.float32) / 255.0
+    return (x - 0.5) / 0.5
+
+
 # ---------------------------------------------------------------------------
 # Qwen3-VL vision tower (the reference's default model #2,
 # vllm-models/helm-chart/values.yaml:7-12). Structure per the public
@@ -236,61 +282,67 @@ def _qwen_patchify(pixels: jnp.ndarray, vcfg: VisionConfig) -> jnp.ndarray:
     block-merge token order (hb, wb, i, j) with per-patch feature order
     (channel, temporal, ph, pw) — the Qwen image-processor layout the
     pretrained weights expect (single frames are duplicated across the
-    temporal patch dim, exactly like the processor does)."""
-    N = pixels.shape[0]
+    temporal patch dim, exactly like the processor does).
+
+    The patch GRID comes from the pixel shape (H//p, W//p) — dynamic
+    resolution: aspect-preserving non-square grids compile one executable
+    per grid shape (a small set; see ``preprocess_image_qwen3vl``)."""
+    N, H, W, _C = pixels.shape
     p, m = vcfg.patch_size, vcfg.spatial_merge_size
-    S = vcfg.image_size // p           # patches per side
-    hb = S // m
+    sh, sw = H // p, W // p            # patch grid (rows, cols)
     x = pixels.transpose(0, 3, 1, 2)   # [N, C, H, W]
-    x = x.reshape(N, vcfg.num_channels, hb, m, p, hb, m, p)
+    x = x.reshape(N, vcfg.num_channels, sh // m, m, p, sw // m, m, p)
     x = x.transpose(0, 2, 5, 3, 6, 1, 4, 7)  # [N, hb, wb, i, j, C, p, p]
-    x = x.reshape(N, S * S, vcfg.num_channels, 1, p, p)
+    x = x.reshape(N, sh * sw, vcfg.num_channels, 1, p, p)
     x = jnp.broadcast_to(
-        x[:, :, :, :1], (N, S * S, vcfg.num_channels,
+        x[:, :, :, :1], (N, sh * sw, vcfg.num_channels,
                          vcfg.temporal_patch_size, p, p))
-    return x.reshape(N, S * S, -1)
+    return x.reshape(N, sh * sw, -1)
 
 
-def _qwen_pos_embed(params: Params, vcfg: VisionConfig) -> jnp.ndarray:
-    """Bilinearly interpolate the learned [grid^2, D] position table to the
-    S x S patch grid, in block-merge order (static shapes: numpy host
-    math for the indices/weights)."""
-    S = vcfg.image_size // vcfg.patch_size
+def _qwen_pos_embed(params: Params, vcfg: VisionConfig,
+                    sh: int, sw: int) -> jnp.ndarray:
+    """Bilinearly interpolate the learned [grid^2, D] position table to an
+    ``sh x sw`` patch grid (dynamic resolution: the grid need not be
+    square), in block-merge order (static shapes: numpy host math for the
+    indices/weights)."""
     m = vcfg.spatial_merge_size
     g = vcfg.num_grid_per_side
-    idxs = np.linspace(0, g - 1, S)
-    lo = idxs.astype(np.int32)
-    hi = np.clip(lo + 1, None, g - 1)
-    frac = (idxs - lo).astype(np.float32)
+    idx_h = np.linspace(0, g - 1, sh)
+    idx_w = np.linspace(0, g - 1, sw)
+    lo_h, lo_w = idx_h.astype(np.int32), idx_w.astype(np.int32)
+    hi_h = np.clip(lo_h + 1, None, g - 1)
+    hi_w = np.clip(lo_w + 1, None, g - 1)
+    fr_h = (idx_h - lo_h).astype(np.float32)
+    fr_w = (idx_w - lo_w).astype(np.float32)
     pe = params["pos_emb"]             # [g*g, D]
 
-    def gather(hi_or_lo_h, hi_or_lo_w):
-        ids = (hi_or_lo_h[:, None] * g + hi_or_lo_w[None, :]).reshape(-1)
+    def gather(hh, ww):
+        ids = (hh[:, None] * g + ww[None, :]).reshape(-1)
         return pe[jnp.asarray(ids)]
-    w00 = ((1 - frac)[:, None] * (1 - frac)[None, :]).reshape(-1, 1)
-    w01 = ((1 - frac)[:, None] * frac[None, :]).reshape(-1, 1)
-    w10 = (frac[:, None] * (1 - frac)[None, :]).reshape(-1, 1)
-    w11 = (frac[:, None] * frac[None, :]).reshape(-1, 1)
-    pos = (gather(lo, lo) * w00 + gather(lo, hi) * w01
-           + gather(hi, lo) * w10 + gather(hi, hi) * w11)   # [S*S, D] (h, w)
+    w00 = ((1 - fr_h)[:, None] * (1 - fr_w)[None, :]).reshape(-1, 1)
+    w01 = ((1 - fr_h)[:, None] * fr_w[None, :]).reshape(-1, 1)
+    w10 = (fr_h[:, None] * (1 - fr_w)[None, :]).reshape(-1, 1)
+    w11 = (fr_h[:, None] * fr_w[None, :]).reshape(-1, 1)
+    pos = (gather(lo_h, lo_w) * w00 + gather(lo_h, hi_w) * w01
+           + gather(hi_h, lo_w) * w10 + gather(hi_h, hi_w) * w11)  # [sh*sw, D]
     D = pos.shape[-1]
-    pos = pos.reshape(S // m, m, S // m, m, D).transpose(0, 2, 1, 3, 4)
-    return pos.reshape(S * S, D)       # block-merge order
+    pos = pos.reshape(sh // m, m, sw // m, m, D).transpose(0, 2, 1, 3, 4)
+    return pos.reshape(sh * sw, D)     # block-merge order
 
 
-def _qwen_rope_cos_sin(vcfg: VisionConfig, head_dim: int):
-    """2D rotary tables [T, head_dim] in block-merge token order."""
-    S = vcfg.image_size // vcfg.patch_size
+def _qwen_rope_cos_sin(vcfg: VisionConfig, head_dim: int, sh: int, sw: int):
+    """2D rotary tables [T, head_dim] in block-merge token order for an
+    ``sh x sw`` patch grid."""
     m = vcfg.spatial_merge_size
     dim = head_dim // 4                # freqs per spatial axis
     inv = 1.0 / (10000.0 ** (np.arange(0, dim, dtype=np.float32) / dim))
-    hb = np.arange(S // m)
-    row = (hb[:, None, None, None] * m
+    row = (np.arange(sh // m)[:, None, None, None] * m
            + np.arange(m)[None, None, :, None])          # [hb, 1, m, 1]
-    col = (hb[None, :, None, None] * m
+    col = (np.arange(sw // m)[None, :, None, None] * m
            + np.arange(m)[None, None, None, :])          # [1, wb, 1, m]
-    row = np.broadcast_to(row, (S // m, S // m, m, m)).reshape(-1)
-    col = np.broadcast_to(col, (S // m, S // m, m, m)).reshape(-1)
+    row = np.broadcast_to(row, (sh // m, sw // m, m, m)).reshape(-1)
+    col = np.broadcast_to(col, (sh // m, sw // m, m, m)).reshape(-1)
     freqs = np.concatenate([row[:, None] * inv[None, :],
                             col[:, None] * inv[None, :]], axis=1)
     emb = np.concatenate([freqs, freqs], axis=1)         # [T, head_dim]
@@ -324,16 +376,17 @@ def encode_images_qwen3vl(params: Params, vcfg: VisionConfig,
     """Qwen3-VL encode: pixels [N, H, W, C] (normalized) ->
     (soft tokens [N, T_merged, out_hidden],
      deepstack [n_taps, N, T_merged, out_hidden])."""
-    N = pixels.shape[0]
+    N, H, W, _ = pixels.shape
     D = vcfg.hidden_size
     eps = 1e-6
     nh = vcfg.num_heads
     hd = D // nh
     m2 = vcfg.spatial_merge_size ** 2
+    sh, sw = H // vcfg.patch_size, W // vcfg.patch_size
 
     x = _qwen_patchify(pixels, vcfg) @ params["patch_w"] + params["patch_b"]
-    x = x + _qwen_pos_embed(params, vcfg)[None].astype(x.dtype)
-    cos, sin = _qwen_rope_cos_sin(vcfg, hd)
+    x = x + _qwen_pos_embed(params, vcfg, sh, sw)[None].astype(x.dtype)
+    cos, sin = _qwen_rope_cos_sin(vcfg, hd, sh, sw)
     cos = cos[None, :, None, :].astype(jnp.float32)
     sin = sin[None, :, None, :].astype(jnp.float32)
     scale = hd ** -0.5
@@ -467,15 +520,20 @@ def load_qwen3vl_vision_params(vcfg: VisionConfig, fetch,
 
 
 def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int,
-                         prompt_len: "Optional[int]" = None):
+                         prompt_len: "Optional[int]" = None,
+                         grids: "Optional[list]" = None):
     """Qwen3-VL 3-axis rope positions for a prompt with image runs.
 
     Text tokens advance all three axes together; an image's soft tokens
     share the temporal position and spread (h, w) over the merged grid,
-    advancing the running position by the grid SIDE (not the token
-    count). Returns (pos3 [3, T] int32, delta) where delta is the offset
-    decode continuations must add to their token index (vLLM's
+    advancing the running position by the grid's LONGER side (not the
+    token count). Returns (pos3 [3, T] int32, delta) where delta is the
+    offset decode continuations must add to their token index (vLLM's
     mrope_position_delta).
+
+    ``grids`` gives each image's MERGED grid (rows, cols) in prompt
+    order (dynamic resolution); None means square
+    sqrt(tokens_per_image)^2 grids for every image.
 
     ``prompt_len`` bounds the image-run region: tokens at or past it are
     GENERATED text and always advance as text even if a sampled id
@@ -489,16 +547,23 @@ def qwen_mrope_positions(tokens, image_token_id: int, tokens_per_image: int,
     pos = np.zeros((3, T), np.int32)
     cur = 0
     i = 0
+    img_i = 0
     while i < T:
         if i < prompt_len and tokens[i] == image_token_id:
+            gh, gw = (g, g) if grids is None else grids[img_i]
+            if gh * gw != tokens_per_image:
+                raise ValueError(
+                    f"grid {gh}x{gw} does not hold {tokens_per_image} "
+                    f"soft tokens")
+            img_i += 1
             base = cur
-            for r in range(g):
-                for c in range(g):
+            for r in range(gh):
+                for c in range(gw):
                     if i >= T or tokens[i] != image_token_id:
                         raise ValueError("truncated image soft-token run")
                     pos[0, i], pos[1, i], pos[2, i] = base, base + r, base + c
                     i += 1
-            cur = base + g
+            cur = base + max(gh, gw)
         else:
             pos[:, i] = cur
             cur += 1

@@ -368,6 +368,28 @@ class OpenAIServer:
             raise ValueError(
                 f"logprobs/top_logprobs supports at most {LOGPROB_TOPK} "
                 f"alternatives, got {nlp}")
+        # logit_bias: {"token_id": bias in [-100, 100]} (OpenAI); applied
+        # on device every step. Entry count is bounded by the engine's
+        # packed-row budget (LOGIT_BIAS_SLOTS; submit() enforces it).
+        bias_items: list = []
+        lb = body.get("logit_bias")
+        if lb is not None:
+            if not isinstance(lb, dict):
+                raise ValueError("logit_bias must be an object mapping "
+                                 "token ids to bias values")
+            for k, v in lb.items():
+                try:
+                    tid = int(k)
+                except (TypeError, ValueError):
+                    raise ValueError(f"logit_bias key {k!r} is not a "
+                                     f"token id")
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    raise ValueError(f"logit_bias value for {k} must be a "
+                                     f"number")
+                if not -100.0 <= float(v) <= 100.0:
+                    raise ValueError("logit_bias values must be in "
+                                     "[-100, 100]")
+                bias_items.append((tid, float(v)))
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
@@ -378,6 +400,7 @@ class OpenAIServer:
             presence_penalty=float(body.get("presence_penalty", 0.0)),
             frequency_penalty=float(body.get("frequency_penalty", 0.0)),
             logprobs=nlp,
+            logit_bias=tuple(bias_items),
         )
 
     def _extract_images(self, messages: list) -> tuple[list, list]:
@@ -467,26 +490,54 @@ class OpenAIServer:
             return web.json_response(
                 {"error": {"message": f"model {self.model_name!r} does not "
                            f"accept images"}}, status=400)
+        # tools / tool_choice (the vllm-openai surface): schemas render
+        # through the chat template; output is parsed for tool-call blocks
+        from llms_on_kubernetes_tpu.server.tools import (
+            inject_tool_messages, validate_tool_choice, validate_tools,
+        )
+
+        tools = body.get("tools")
         try:
-            prompt_ids = self.tokenizer.apply_chat_template(messages)
+            if tools is not None:
+                tools = validate_tools(tools)
+            tool_mode = validate_tool_choice(body.get("tool_choice"), tools)
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        if tool_mode is not None:
+            messages = inject_tool_messages(messages, tool_mode)
+        try:
+            # pass tools only when active: tools-unaware tokenizer
+            # implementations (duck-typed TokenizerLike) keep working
+            if tool_mode is not None and tools:
+                prompt_ids = self.tokenizer.apply_chat_template(
+                    messages, tools=tools)
+            else:
+                prompt_ids = self.tokenizer.apply_chat_template(messages)
             if images:
                 prompt_ids = self._splice_image_tokens(prompt_ids, len(images))
         except Exception as e:  # bad roles/content shape
             return web.json_response({"error": {"message": f"bad messages: {e}"}}, status=400)
         pixels = None
         if images:
-            import numpy as np
+            from llms_on_kubernetes_tpu.models.vision import (
+                preprocess_image, preprocess_image_qwen3vl,
+            )
 
-            from llms_on_kubernetes_tpu.models.vision import preprocess_image
-
-            size = self.engine.model_config.vision.image_size
+            vis = self.engine.model_config.vision
             try:
-                pixels = np.stack([preprocess_image(im, size) for im in images])
+                if vis.family == "qwen3vl":
+                    # dynamic resolution: aspect-preserving per-image grids
+                    pixels = [preprocess_image_qwen3vl(im, vis)
+                              for im in images]
+                else:
+                    pixels = [preprocess_image(im, vis.image_size)
+                              for im in images]
             except Exception as e:  # undecodable/degenerate image -> 400
                 return web.json_response(
                     {"error": {"message": f"bad image: {e}"}}, status=400)
         return await self._serve(request, body, [prompt_ids], chat=True,
-                                 images=pixels)
+                                 images=pixels,
+                                 tools_on=tool_mode is not None)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         """Supports every OpenAI ``prompt`` form: a string, a token-id list,
@@ -521,7 +572,7 @@ class OpenAIServer:
     # ------------------------------------------------------------------
 
     async def _serve(self, request, body, prompts, *, chat: bool,
-                     images=None) -> web.StreamResponse:
+                     images=None, tools_on: bool = False) -> web.StreamResponse:
         from llms_on_kubernetes_tpu.engine.engine import QueueFullError
 
         try:
@@ -598,10 +649,11 @@ class OpenAIServer:
                 (body.get("stream_options") or {}).get("include_usage"))
             return await self._stream_response(
                 request, reqs, rid, created, chat, stops, params.logprobs,
-                include_usage, prompts)
+                include_usage, prompts, tools_on=tools_on)
         return await self._full_response(
             reqs, rid, created, chat, prompts, stops, params.logprobs,
-            n, best_of, echo=bool(body.get("echo")) and not chat)
+            n, best_of, echo=bool(body.get("echo")) and not chat,
+            tools_on=tools_on)
 
     async def _drain(self, req, stops):
         """Async generator over one request's events: yields
@@ -670,6 +722,13 @@ class OpenAIServer:
                     break  # text still held back (or beyond a stop cut)
                 released.append(pending.pop(0))
                 released_chars += len(piece)
+            if hit and pending and released_chars < stopper.emitted:
+                # the stop cut lands MID-token: part of this entry's text
+                # is in the final visible output, so its logprob entry is
+                # included (truncation rule: every token that contributed
+                # visible characters appears in the logprobs; tokens
+                # entirely beyond the cut do not) — round-3 advisor finding
+                released.append(pending.pop(0))
             if hit:
                 self.loop_thread.abort(req)
                 yield text, True, "stop", total, released
@@ -739,7 +798,7 @@ class OpenAIServer:
 
     async def _full_response(self, reqs, rid, created, chat, prompts, stops,
                              nlp: int, n: int, best_of: int,
-                             echo: bool) -> web.Response:
+                             echo: bool, tools_on: bool = False) -> web.Response:
         per_prompt = best_of  # reqs are prompt-major groups of best_of
         results = []
         completion_tokens = 0
@@ -771,9 +830,22 @@ class OpenAIServer:
         choices = []
         for i, (g, text, finish_reason, entries) in enumerate(results):
             if chat:
+                message = {"role": "assistant", "content": text}
+                if tools_on:
+                    from llms_on_kubernetes_tpu.server.tools import (
+                        ToolStreamParser,
+                    )
+
+                    parser = ToolStreamParser()
+                    content, _ = parser.push(text, final=True)
+                    if parser.calls:
+                        message["content"] = content or None
+                        message["tool_calls"] = parser.calls
+                        if finish_reason == "stop":
+                            finish_reason = "tool_calls"
                 choice = {
                     "index": i,
-                    "message": {"role": "assistant", "content": text},
+                    "message": message,
                     "finish_reason": finish_reason,
                 }
                 if nlp:
@@ -800,7 +872,8 @@ class OpenAIServer:
 
     async def _stream_response(self, request, reqs, rid, created, chat, stops,
                                nlp: int = 0, include_usage: bool = False,
-                               prompts=None) -> web.StreamResponse:
+                               prompts=None,
+                               tools_on: bool = False) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -815,13 +888,16 @@ class OpenAIServer:
         completion_tokens = 0
 
         def chunk(index: int, delta_text: Optional[str], reason: Optional[str],
-                  role: bool = False, entries=None, base_offset: int = 0) -> bytes:
+                  role: bool = False, entries=None, base_offset: int = 0,
+                  tool_deltas=None) -> bytes:
             if chat:
                 delta: dict = {}
                 if role:
                     delta["role"] = "assistant"
                 if delta_text is not None:
                     delta["content"] = delta_text
+                if tool_deltas:
+                    delta["tool_calls"] = tool_deltas
                 choice = {"index": index, "delta": delta, "finish_reason": reason}
                 if nlp and entries:
                     choice["logprobs"] = self._chat_logprobs(entries, nlp)
@@ -843,20 +919,54 @@ class OpenAIServer:
             if chat:
                 async with write_lock:
                     await resp.write(chunk(index, None, None, role=True))
+            tool_parser = None
+            if tools_on and chat:
+                from llms_on_kubernetes_tpu.server.tools import ToolStreamParser
+
+                tool_parser = ToolStreamParser()
+            n_calls = 0
             total = 0
             tok_chars = 0  # cumulative offsets across the WHOLE stream
+            signalled = False  # any chunk written for this choice yet
             async for text, done, reason, total, entries in self._drain(req, stops):
+                tool_deltas = None
+                if tool_parser is not None:
+                    # tool-call blocks are cut out of the content stream;
+                    # each completed block becomes ONE tool_calls delta
+                    # carrying the full id/name/arguments (OpenAI clients
+                    # accept whole-call deltas; finish_reason flips below)
+                    text, new_calls = tool_parser.push(text, final=done)
+                    if new_calls:
+                        tool_deltas = []
+                        for c in new_calls:
+                            tool_deltas.append({"index": n_calls, "id": c["id"],
+                                                "type": c["type"],
+                                                "function": c["function"]})
+                            n_calls += 1
                 async with write_lock:
                     # a chunk is due when there is text OR logprob entries —
                     # entries for tokens whose text is still held back
                     # (partial UTF-8, stop-sequence window) must not be lost
-                    if text or (nlp and entries):
-                        await resp.write(chunk(index, text, None,
+                    if text or tool_deltas or (nlp and entries):
+                        await resp.write(chunk(index, text or None, None,
                                                entries=entries,
-                                               base_offset=tok_chars))
+                                               base_offset=tok_chars,
+                                               tool_deltas=tool_deltas))
+                        signalled = True
                         if nlp:
                             tok_chars += sum(len(p) for _, _, p in entries)
+                    elif not signalled and not done:
+                        # first token arrived but its text is held back
+                        # (mid-UTF-8 sequence / stop-sequence window): emit
+                        # ONE empty delta so the client's time-to-first-
+                        # chunk tracks the engine's first token, not the
+                        # holdback's resolution a decode step later
+                        await resp.write(chunk(index, "", None))
+                        signalled = True
                     if done:
+                        if (tool_parser is not None and tool_parser.calls
+                                and reason == "stop"):
+                            reason = "tool_calls"
                         await resp.write(chunk(index, None, reason))
             completion_tokens += total
 

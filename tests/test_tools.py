@@ -1,0 +1,359 @@
+"""OpenAI tool/function calling + logit_bias (round-4 verdict item 3).
+
+Parity target: the vllm-openai image the reference deploys per model
+(reference vllm-models/helm-chart/templates/model-deployments.yaml:21) —
+tools/tool_choice with streamed tool_calls deltas, finish_reason
+"tool_calls", and on-device logit_bias.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+from llms_on_kubernetes_tpu.server.tools import (
+    ToolStreamParser, inject_tool_messages, validate_tool_choice,
+    validate_tools,
+)
+
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the weather",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}}},
+    },
+}]
+
+
+# ---------------------------------------------------------------------------
+# parser unit tests
+# ---------------------------------------------------------------------------
+
+class TestToolStreamParser:
+    def test_plain_text_passes_through(self):
+        p = ToolStreamParser()
+        text, calls = p.push("hello world", final=True)
+        assert text == "hello world" and calls == []
+
+    def test_single_call_extracted(self):
+        p = ToolStreamParser()
+        text, calls = p.push(
+            'ok <tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "Oslo"}}</tool_call>', final=True)
+        assert text == "ok "
+        assert len(calls) == 1
+        assert calls[0]["type"] == "function"
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Oslo"}
+        assert calls[0]["id"].startswith("call_")
+
+    def test_call_split_across_deltas(self):
+        p = ToolStreamParser()
+        pieces = ['before <tool', '_call>{"name": "f", "argu',
+                  'ments": {}}</tool', '_call> after']
+        out, calls = "", []
+        for i, piece in enumerate(pieces):
+            t, c = p.push(piece, final=i == len(pieces) - 1)
+            out += t
+            calls += c
+        assert out == "before  after"
+        assert len(calls) == 1 and calls[0]["function"]["name"] == "f"
+
+    def test_partial_start_tag_held_back_then_released(self):
+        p = ToolStreamParser()
+        t1, _ = p.push("abc<tool")      # could be a tag: hold back
+        assert t1 == "abc"
+        t2, _ = p.push("box>def", final=True)  # wasn't a tag
+        assert t2 == "<toolbox>def"
+
+    def test_multiple_calls_in_order(self):
+        p = ToolStreamParser()
+        _, calls = p.push(
+            '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>',
+            final=True)
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+    def test_unterminated_block_degrades_to_content(self):
+        p = ToolStreamParser()
+        text, calls = p.push('x<tool_call>{"name": "f"', final=True)
+        assert calls == []
+        assert text == 'x<tool_call>{"name": "f"'
+
+    def test_unparseable_body_surfaces_verbatim(self):
+        p = ToolStreamParser()
+        text, calls = p.push("<tool_call>not json</tool_call>", final=True)
+        assert calls == []
+        assert text == "<tool_call>not json</tool_call>"
+
+    def test_string_arguments_pass_through(self):
+        p = ToolStreamParser()
+        _, calls = p.push(
+            '<tool_call>{"name": "f", "arguments": "{\\"k\\": 2}"}'
+            "</tool_call>", final=True)
+        assert json.loads(calls[0]["function"]["arguments"]) == {"k": 2}
+
+
+class TestValidation:
+    def test_validate_tools_rejects_bad_shapes(self):
+        for bad in ([], [{}], [{"type": "function"}],
+                    [{"type": "function", "function": {}}], "x"):
+            with pytest.raises(ValueError):
+                validate_tools(bad)
+
+    def test_tool_choice_normalization(self):
+        assert validate_tool_choice(None, None) is None
+        assert validate_tool_choice(None, TOOLS) == "auto"
+        assert validate_tool_choice("none", TOOLS) is None
+        assert validate_tool_choice("auto", TOOLS) == "auto"
+        assert validate_tool_choice("required", TOOLS) == "required"
+        named = {"type": "function", "function": {"name": "get_weather"}}
+        assert validate_tool_choice(named, TOOLS) == "get_weather"
+
+    def test_tool_choice_unknown_function_rejected(self):
+        named = {"type": "function", "function": {"name": "nope"}}
+        with pytest.raises(ValueError):
+            validate_tool_choice(named, TOOLS)
+
+    def test_tool_choice_without_tools_rejected(self):
+        with pytest.raises(ValueError):
+            validate_tool_choice("required", None)
+
+    def test_injection_appends_forcing_instruction(self):
+        msgs = [{"role": "user", "content": "hi"},
+                {"role": "assistant", "content": "yes?"},
+                {"role": "user", "content": "do it"}]
+        assert inject_tool_messages(msgs, "auto") == msgs
+        out = inject_tool_messages(msgs, "required")
+        # instruction lands INSIDE the last user message (a trailing
+        # system message breaks strict templates like Gemma's)
+        assert [m["role"] for m in out] == ["user", "assistant", "user"]
+        assert out[-1]["content"].startswith("do it")
+        assert "tool call" in out[-1]["content"]
+        assert msgs[-1]["content"] == "do it"  # input not mutated
+        out = inject_tool_messages(msgs, "get_weather")
+        assert "get_weather" in out[-1]["content"]
+        # multimodal content lists get a text part appended
+        mm = [{"role": "user", "content": [{"type": "image"}]}]
+        out = inject_tool_messages(mm, "required")
+        assert out[0]["content"][-1]["type"] == "text"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end server tests: a scripted tokenizer makes the (random-weight)
+# model's output decode to a known tool-call string, so the full HTTP
+# surface — template injection, parsing, streaming deltas, finish_reason —
+# is exercised black-box
+# ---------------------------------------------------------------------------
+
+TARGET = ('I will check. <tool_call>{"name": "get_weather", "arguments": '
+          '{"city": "Oslo"}}</tool_call>END')
+
+
+class ScriptedTokenizer(ByteTokenizer):
+    """decode(ids) yields a fixed script, one character per token — the
+    engine's sampled ids become a deterministic text stream."""
+
+    def decode(self, ids):
+        return TARGET[:len(ids)]
+
+
+def make_server(tokenizer=None):
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=8, num_pages=256, pages_per_slot=64,
+        prefill_buckets=(32, 64),
+    ))
+    return OpenAIServer(eng, tokenizer or ByteTokenizer(), "debug-tiny")
+
+
+def with_client(fn, tokenizer=None):
+    async def go():
+        server = make_server(tokenizer)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+CHAT_BODY = {
+    "model": "debug-tiny",
+    "messages": [{"role": "user", "content": "weather in Oslo?"}],
+    "tools": TOOLS,
+    "max_tokens": len(TARGET) + 8,
+    "temperature": 0,
+    "stop": ["END"],
+}
+
+
+def test_non_streaming_tool_call():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json=CHAT_BODY)
+        assert r.status == 200
+        data = await r.json()
+        choice = data["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        calls = choice["message"]["tool_calls"]
+        assert len(calls) == 1
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Oslo"}
+        assert choice["message"]["content"] == "I will check. "
+    with_client(body, tokenizer=ScriptedTokenizer())
+
+
+def test_streaming_tool_call_deltas():
+    async def body(client):
+        r = await client.post("/v1/chat/completions",
+                              json={**CHAT_BODY, "stream": True})
+        assert r.status == 200
+        raw = await r.text()
+        chunks = [json.loads(line[len("data: "):])
+                  for line in raw.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        content = "".join(
+            c["choices"][0]["delta"].get("content") or "" for c in chunks)
+        tool_deltas = [d for c in chunks
+                       for d in c["choices"][0]["delta"].get("tool_calls", [])]
+        finish = [c["choices"][0]["finish_reason"] for c in chunks
+                  if c["choices"][0]["finish_reason"]]
+        # the tool-call text never leaks into content
+        assert "<tool_call>" not in content
+        assert content.startswith("I will check. ")
+        assert len(tool_deltas) == 1
+        assert tool_deltas[0]["index"] == 0
+        assert tool_deltas[0]["function"]["name"] == "get_weather"
+        assert json.loads(tool_deltas[0]["function"]["arguments"]) == {
+            "city": "Oslo"}
+        assert finish == ["tool_calls"]
+    with_client(body, tokenizer=ScriptedTokenizer())
+
+
+def test_tool_choice_none_disables_parsing():
+    async def body(client):
+        r = await client.post("/v1/chat/completions",
+                              json={**CHAT_BODY, "tool_choice": "none"})
+        assert r.status == 200
+        data = await r.json()
+        msg = data["choices"][0]["message"]
+        # parsing off: raw text flows through as content, no tool_calls
+        assert "tool_calls" not in msg
+        assert "<tool_call>" in msg["content"]
+    with_client(body, tokenizer=ScriptedTokenizer())
+
+
+def test_bad_tools_and_tool_choice_are_400s():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **CHAT_BODY, "tools": [{"type": "function", "function": {}}]})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={
+            **CHAT_BODY,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "unknown"}}})
+        assert r.status == 400
+    with_client(body)
+
+
+def test_tools_injected_into_template():
+    # ByteTokenizer renders tools as a <tools>{json}</tools> prefix; the
+    # engine sees a longer prompt when tools are active
+    tok = ByteTokenizer()
+    base = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    with_tools = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], tools=TOOLS)
+    assert len(with_tools) > len(base)
+    assert "get_weather" in tok.decode(with_tools)
+
+
+# ---------------------------------------------------------------------------
+# logit_bias
+# ---------------------------------------------------------------------------
+
+def test_sample_applies_bias():
+    from llms_on_kubernetes_tpu.engine.sampling import sample
+
+    B, V = 2, 64
+    logits = jnp.zeros((B, V), jnp.float32)
+    # row 0: +100 on token 7 forces it; row 1: no bias entries (all -1)
+    ids = jnp.array([[7, -1, -1, -1], [-1, -1, -1, -1]], jnp.int32)
+    vals = jnp.array([[100.0, 0, 0, 0], [0, 0, 0, 0]], jnp.float32)
+    keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
+    res = sample(logits, keys,
+                 jnp.zeros((B,)), jnp.zeros((B,), jnp.int32), jnp.ones((B,)),
+                 bias=(ids, vals))
+    assert int(res.tokens[0]) == 7
+    # greedy over uniform zeros without bias: argmax is token 0
+    assert int(res.tokens[1]) == 0
+
+
+def test_sample_bias_bans_token():
+    from llms_on_kubernetes_tpu.engine.sampling import sample
+
+    B, V = 1, 32
+    logits = jnp.zeros((B, V), jnp.float32).at[0, 0].set(5.0)
+    ids = jnp.array([[0, -1]], jnp.int32)
+    vals = jnp.array([[-100.0, 0.0]], jnp.float32)
+    keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
+    res = sample(logits, keys, jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
+                 jnp.ones((B,)), bias=(ids, vals))
+    assert int(res.tokens[0]) != 0
+
+
+def test_logit_bias_forces_token_end_to_end():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc", "max_tokens": 6,
+            "temperature": 0, "logit_bias": {"42": 100},
+        })
+        assert r.status == 200
+        data = await r.json()
+        # byte 42 == "*": the bias dominates every greedy step
+        assert data["choices"][0]["text"] == "*" * 6
+    with_client(body)
+
+
+def test_logit_bias_validation_400s():
+    async def body(client):
+        for bad in (
+            {"logit_bias": {"x": 1}},
+            {"logit_bias": {"1": 500}},
+            {"logit_bias": {"1": True}},
+            {"logit_bias": [1, 2]},
+            {"logit_bias": {str(i): 1 for i in range(40)}},  # > slot budget
+            {"logit_bias": {"9999": 1}},                      # out of vocab
+        ):
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "a", "max_tokens": 2, **bad})
+            assert r.status == 400, bad
+    with_client(body)
+
+
+def test_logit_bias_engine_validation():
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=4, num_pages=64, pages_per_slot=16,
+        prefill_buckets=(32,),
+    ))
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], SamplingParams(
+            logit_bias=tuple((i, 1.0) for i in range(64))))
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], SamplingParams(logit_bias=((300, 1.0),)))
+    # a valid bias generates fine
+    out = eng.generate([1, 2], SamplingParams(
+        temperature=0.0, max_tokens=4, logit_bias=((42, 100.0),)))
+    assert out == [42] * 4

@@ -149,3 +149,108 @@ def test_qwen3vl_vision_encode_matches_hf():
         for j, wd in enumerate(want_deep):
             np.testing.assert_allclose(np.asarray(deep)[j, n], wd.numpy(),
                                        rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3vl_dynamic_resolution_matches_hf():
+    """Dynamic resolution (round-4 verdict item 6): non-square
+    aspect-preserving grids through the SAME tower match HF at two
+    distinct aspect ratios (landscape 2x8 and portrait 8x2 patch grids,
+    both at the fixed 16-patch budget)."""
+    torch = pytest.importorskip("torch")
+    import transformers
+    import numpy as np
+
+    from llms_on_kubernetes_tpu.models.vision import (
+        VisionConfig, _qwen_patchify, encode_images_qwen3vl,
+        load_qwen3vl_vision_params,
+    )
+    from transformers.models.qwen3_vl.configuration_qwen3_vl import (
+        Qwen3VLVisionConfig,
+    )
+
+    hf_vcfg = Qwen3VLVisionConfig(
+        hidden_size=32, intermediate_size=64, depth=3, num_heads=2,
+        patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+        out_hidden_size=48, num_position_embeddings=16,  # 4x4 grid
+        deepstack_visual_indexes=[0, 1], in_channels=3,
+        hidden_act="gelu_pytorch_tanh", initializer_range=0.05,
+    )
+    tower = transformers.models.qwen3_vl.modeling_qwen3_vl.Qwen3VLVisionModel(
+        hf_vcfg).eval()
+    tower.set_attn_implementation("eager")
+    torch.manual_seed(0)
+    for p in tower.parameters():
+        torch.nn.init.normal_(p, std=0.05)
+
+    vcfg = VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=3, num_heads=2,
+        image_size=16, patch_size=4, family="qwen3vl",
+        temporal_patch_size=2, spatial_merge_size=2, out_hidden_size=48,
+        num_grid_per_side=4, deepstack_indexes=(0, 1),
+        mm_tokens_per_image=4,
+    )
+    sd = {"model.visual." + k: v.detach().numpy()
+          for k, v in tower.state_dict().items()}
+    params = load_qwen3vl_vision_params(vcfg, lambda n: sd[n])
+
+    rng = np.random.default_rng(3)
+    for H, W, sh, sw in [(8, 32, 2, 8), (32, 8, 8, 2)]:
+        pixels = rng.standard_normal((1, H, W, 3)).astype(np.float32)
+        soft, deep = encode_images_qwen3vl(params, vcfg, jnp.asarray(pixels))
+        assert soft.shape == (1, 4, 48)  # token budget unchanged by aspect
+        flat = np.asarray(_qwen_patchify(jnp.asarray(pixels), vcfg))
+        with torch.no_grad():
+            want_soft, want_deep = tower(
+                torch.tensor(flat[0]), grid_thw=torch.tensor([[1, sh, sw]]))
+        np.testing.assert_allclose(np.asarray(soft)[0], want_soft.numpy(),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"{sh}x{sw}")
+        for j, wd in enumerate(want_deep):
+            np.testing.assert_allclose(np.asarray(deep)[j, 0], wd.numpy(),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_select_qwen_grid_and_preprocess():
+    from llms_on_kubernetes_tpu.models.vision import (
+        VisionConfig, preprocess_image_qwen3vl, qwen_grid_candidates,
+        select_qwen_grid,
+    )
+
+    vcfg = VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=3, num_heads=2,
+        image_size=16, patch_size=4, family="qwen3vl",
+        temporal_patch_size=2, spatial_merge_size=2, out_hidden_size=48,
+        num_grid_per_side=4, mm_tokens_per_image=4,
+    )
+    cands = qwen_grid_candidates(vcfg)
+    assert set(cands) == {(2, 8), (4, 4), (8, 2)}  # all hold 16 patches
+    assert select_qwen_grid(400, 100, vcfg) == (2, 8)   # wide
+    assert select_qwen_grid(100, 400, vcfg) == (8, 2)   # tall
+    assert select_qwen_grid(100, 100, vcfg) == (4, 4)   # square
+
+    img = np.zeros((100, 400, 3), np.uint8)  # H=100, W=400 (wide)
+    out = preprocess_image_qwen3vl(img, vcfg)
+    assert out.shape == (8, 32, 3)   # 2x8 patch grid at patch 4
+    img = np.zeros((400, 100, 3), np.uint8)  # tall
+    out = preprocess_image_qwen3vl(img, vcfg)
+    assert out.shape == (32, 8, 3)
+
+
+def test_qwen_mrope_positions_dynamic_grids():
+    from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
+
+    # one 1x4 merged-grid image (4 soft tokens), then text
+    toks = [5, 99, 99, 99, 99, 7, 8]
+    pos, delta = qwen_mrope_positions(toks, 99, 4, grids=[(1, 4)])
+    # image starts at position 1; h spans 1 row, w spans 4 cols
+    assert pos[:, 0].tolist() == [0, 0, 0]
+    assert pos[0, 1:5].tolist() == [1, 1, 1, 1]        # t frozen
+    assert pos[1, 1:5].tolist() == [1, 1, 1, 1]        # h: single row
+    assert pos[2, 1:5].tolist() == [1, 2, 3, 4]        # w: 4 cols
+    # text resumes at base + max(1, 4) = 5
+    assert pos[:, 5].tolist() == [5, 5, 5]
+    assert delta == 7 - 7  # cur(7) - T(7)
+
+    # a 4x1 grid advances by max(4, 1) = 4 as well, but spreads h
+    pos2, _ = qwen_mrope_positions(toks, 99, 4, grids=[(4, 1)])
+    assert pos2[1, 1:5].tolist() == [1, 2, 3, 4]
+    assert pos2[2, 1:5].tolist() == [1, 1, 1, 1]
