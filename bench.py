@@ -478,9 +478,11 @@ def main() -> int:
         if gw is None:
             # release the old engine BEFORE building the fresh one: two
             # llama-3-8b engines (weights + KV pool each) cannot coexist
-            # on one 16 GB chip
+            # on one 16 GB chip. BOTH references must drop — `eng` and the
+            # (eng, stats) tuple in eng_out
             import gc
             eng = None
+            eng_out = None  # noqa: F841 — drops the tuple's engine ref
             gc.collect()
             gw = with_retries("gateway-fresh", gateway_phase_fresh, errors,
                               attempts=2)

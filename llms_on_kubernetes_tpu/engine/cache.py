@@ -151,6 +151,7 @@ def write_tokens(
     v: jnp.ndarray,
     page_table: jnp.ndarray,
     positions: jnp.ndarray,
+    owner: "Optional[tuple]" = None,
 ) -> tuple["KVPool", "KVPool"]:
     """Write new KV for one layer into the page pool IN PLACE.
 
@@ -177,6 +178,12 @@ def write_tokens(
     read-merge-write of each touched page for chunked writes. Callers must
     keep the layer loop UNROLLED (see decoder._run_layers) so no while
     loop ever carries the pool.
+
+    ``owner`` = (base, width): context-parallel mode (ops/cp.py) — the
+    pool argument is ONE device's shard of the flat axis, covering global
+    flat slots [base, base+width); page ids are translated to local and
+    non-owned updates become read-merge no-ops (a blind DUS at a clamped
+    local slot would corrupt a page another sequence owns there).
     """
     B, T, n_kv, d = k.shape
     page = k_pages.shape[2]
@@ -204,6 +211,12 @@ def write_tokens(
         # padding -> trash page 0 (never read; keeps the write unconditional)
         pid = jnp.where(pos < 0, 0, pid)
         off = jnp.where(pos < 0, 0, safe % page)
+        owned = None
+        if owner is not None:
+            base, width = owner
+            lpid = pid - base
+            owned = (lpid >= 0) & (lpid < width)
+            pid = jnp.where(owned, lpid, 0)
         # NOTE(measured, round 3): the unrolled per-slot DUS below costs
         # ~3 ms/step at B=64 (4096 tiny ops). A batched Pallas write kernel
         # (group read-merge-write per slot, all slots in one program) was
@@ -214,17 +227,38 @@ def write_tokens(
         for b in range(B):
             upd_k = k[b, 0].astype(dt)[:, None, None, :]   # [n_kv, 1, 1, d]
             upd_v = v[b, 0].astype(dt)[:, None, None, :]
+            if owned is not None:  # CP: non-owner preserves the old value
+                old_k = jax.lax.dynamic_slice(
+                    kd, (0, pid[b], off[b], 0), (kd.shape[0], 1, 1, d))
+                old_v = jax.lax.dynamic_slice(
+                    vd, (0, pid[b], off[b], 0), (vd.shape[0], 1, 1, d))
+                upd_k = jnp.where(owned[b], upd_k, old_k)
+                upd_v = jnp.where(owned[b], upd_v, old_v)
             kd = jax.lax.dynamic_update_slice(kd, upd_k, (0, pid[b], off[b], 0))
             vd = jax.lax.dynamic_update_slice(vd, upd_v, (0, pid[b], off[b], 0))
             if quant:
+                upd_ks = ks[b, 0][:, None, None]
+                upd_vs = vs[b, 0][:, None, None]
+                if owned is not None:
+                    old_ks = jax.lax.dynamic_slice(
+                        ksc, (0, pid[b], off[b]), (ksc.shape[0], 1, 1))
+                    old_vs = jax.lax.dynamic_slice(
+                        vsc, (0, pid[b], off[b]), (vsc.shape[0], 1, 1))
+                    upd_ks = jnp.where(owned[b], upd_ks, old_ks)
+                    upd_vs = jnp.where(owned[b], upd_vs, old_vs)
                 ksc = jax.lax.dynamic_update_slice(
-                    ksc, ks[b, 0][:, None, None], (0, pid[b], off[b]))
+                    ksc, upd_ks, (0, pid[b], off[b]))
                 vsc = jax.lax.dynamic_update_slice(
-                    vsc, vs[b, 0][:, None, None], (0, pid[b], off[b]))
+                    vsc, upd_vs, (0, pid[b], off[b]))
         return rewrap()
 
     n_touch = (T - 1) // page + 2  # max pages a T-token contiguous run spans
     if n_touch > _MAX_RMW_PAGES:
+        if owner is not None:
+            raise ValueError(
+                "context-parallel writes require the RMW page path; this "
+                f"chunk touches {n_touch} pages > {_MAX_RMW_PAGES} "
+                "(use a larger page_size or smaller prefill buckets)")
         return _write_tokens_scatter(k_pages, v_pages, k, v, ks, vs,
                                      page_table, positions)
 
@@ -242,6 +276,12 @@ def write_tokens(
             lg_c = jnp.clip(lg, 0, pps - 1)
             # out-of-range or idle row -> trash page 0 (never read)
             pid = jnp.where((lg < pps) & valid[b, 0], page_table[b, lg_c], 0)
+            own = None
+            if owner is not None:
+                base, width = owner
+                lpid = pid - base
+                own = (lpid >= 0) & (lpid < width)
+                pid = jnp.where(own, lpid, 0)
             page_pos = lg * page + page_iota     # global positions [page]
             t_idx = page_pos - pos0[b]
             t_c = jnp.clip(t_idx, 0, T - 1)
@@ -250,15 +290,19 @@ def write_tokens(
             if quant:
                 new_ks = jnp.take(ks[b], t_c, axis=0).T  # [n_kv, page]
                 new_vs = jnp.take(vs[b], t_c, axis=0).T
-            if j == 0:
+            if j == 0 or own is not None:
                 # head page may hold a PREVIOUS chunk's tokens below pos0:
                 # read-merge-write. Every later page is append-territory —
                 # offsets past the chunk are unwritten (appends only ever
                 # move forward) and each will be overwritten before any
                 # length-masked read can see it, so pages j>=1 are written
-                # blind (no read) with clamped-gather filler.
+                # blind (no read) with clamped-gather filler. Under CP
+                # (own is not None) EVERY page read-merge-writes: a
+                # non-owner's clamped local slot 0 holds a real page.
                 in_chunk = (t_idx >= 0) & (t_idx < T)
                 mask = in_chunk & valid[b, t_c]  # [page]
+                if own is not None:
+                    mask = mask & own
                 cur_k = jax.lax.dynamic_slice(
                     kd, (0, pid, 0, 0), (n_kv, 1, page, d))[:, 0]
                 cur_v = jax.lax.dynamic_slice(

@@ -639,6 +639,14 @@ class Engine:
                     f"prefill buckets {bad} not divisible by the seq-parallel "
                     f"ring size {sp} (ring attention shards the bucket)"
                 )
+            if sp > 1 and engine_config.num_pages % sp != 0:
+                # the flat pool axis shards over seq at page granularity
+                # (ops/cp.py): each page's L layer slots stay on one device
+                raise ValueError(
+                    f"num_pages={engine_config.num_pages} not divisible by "
+                    f"the seq-parallel ring size {sp} (the page pool is "
+                    f"context-sharded)"
+                )
         # ring-attention dispatch reads this at trace time; ALWAYS set it
         # (including to None) so a previous engine's mesh never leaks into
         # this engine's traces
@@ -715,15 +723,18 @@ class Engine:
         )
         if cfg.vision is not None:
             from llms_on_kubernetes_tpu.models.vision import (
-                encode_images, encode_images_qwen3vl,
+                encode_images, encode_images_qwen3vl, encode_video_qwen3vl,
             )
 
             self._mm_prefill_packed = jax.jit(
                 _prefill_mm_packed_step, static_argnums=(1,),
                 donate_argnums=(7, 8, 9))
-            enc = (encode_images_qwen3vl if cfg.vision.family == "qwen3vl"
-                   else encode_images)
+            qwen = cfg.vision.family == "qwen3vl"
+            enc = encode_images_qwen3vl if qwen else encode_images
             self._encode_images = jax.jit(enc, static_argnums=(1,))
+            if qwen:
+                self._encode_video = jax.jit(encode_video_qwen3vl,
+                                             static_argnums=(1,))
         # per-slot OUTPUT-token counts for presence/frequency penalties;
         # donated through every step like the page pools
         self.token_counts = jnp.zeros((B, cfg.vocab_size), jnp.int32)
@@ -735,7 +746,8 @@ class Engine:
         if engine_config.multihost:
             from llms_on_kubernetes_tpu.engine.multihost import ProtoShapes
 
-            self._mh_shapes = ProtoShapes.from_engine_config(engine_config)
+            self._mh_shapes = ProtoShapes.from_engine_config(
+                engine_config, self.model_config)
 
         # async scheduling state (see EngineConfig.async_scheduling)
         self._async = bool(engine_config.async_scheduling)
@@ -780,9 +792,10 @@ class Engine:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if images is not None:
-            # normalize to a LIST of [H, W, C] float32 arrays — dynamic
-            # resolution (Qwen3-VL) allows per-image grids, so one request
-            # may carry differently-shaped images
+            # normalize to a LIST of float32 arrays — [H, W, C] = an
+            # image, [F, H, W, C] = a VIDEO's frames (Qwen3-VL; F frames
+            # in temporal-patch multiples). Dynamic resolution allows
+            # per-entry grids, so one request may mix shapes and kinds.
             images = [np.asarray(im, np.float32) for im in images]
             params = self._validate_images(prompt, params, images)
         if params.top_k > MAX_CANDIDATES:
@@ -863,45 +876,60 @@ class Engine:
             raise ValueError(
                 f"model {cfg.name!r} has no vision tower; images are not "
                 f"supported")
-        if self.config.multihost:
-            raise ValueError("images are not supported under multi-host "
-                             "serving yet (pixels are not in the broadcast "
-                             "step protocol)")
-        n = len(images)
-        if n < 1 or n > self.config.max_images_per_request:
-            raise ValueError(
-                f"{n} images; this engine serves 1.."
-                f"{self.config.max_images_per_request} per request")
         v = cfg.vision
         S2 = (v.image_size // v.patch_size) ** 2
+        total_chunks = 0  # images count 1; videos F/temporal_patch_size
         for im in images:
-            if im.ndim != 3 or im.shape[2] != v.num_channels:
+            if im.ndim == 4:  # video frames [F, H, W, C]
+                if v.family != "qwen3vl":
+                    raise ValueError(
+                        f"model {cfg.name!r} does not accept video input")
+                F = im.shape[0]
+                if F < v.temporal_patch_size or F % v.temporal_patch_size:
+                    raise ValueError(
+                        f"video frame count {F} must be a positive "
+                        f"multiple of {v.temporal_patch_size}")
+                frame, chunks = im[0], F // v.temporal_patch_size
+            elif im.ndim == 3:
+                frame, chunks = im, 1
+            else:
                 raise ValueError(
-                    f"each image must be [H, W, {v.num_channels}]; got "
+                    f"each entry must be an [H, W, C] image or an "
+                    f"[F, H, W, C] video; got {tuple(im.shape)}")
+            total_chunks += chunks
+            if frame.shape[-1] != v.num_channels:
+                raise ValueError(
+                    f"images need {v.num_channels} channels; got "
                     f"{tuple(im.shape)}")
-            sh, sw = im.shape[0] // v.patch_size, im.shape[1] // v.patch_size
+            sh = frame.shape[0] // v.patch_size
+            sw = frame.shape[1] // v.patch_size
             if v.family == "qwen3vl":
                 # dynamic resolution: any grid with the fixed patch budget
                 # whose sides divide into merge blocks (the preprocessor
                 # only produces these; validate so raw submit()s get 400s)
                 m = v.spatial_merge_size
-                if (sh * v.patch_size != im.shape[0]
-                        or sw * v.patch_size != im.shape[1]
+                if (sh * v.patch_size != frame.shape[0]
+                        or sw * v.patch_size != frame.shape[1]
                         or sh % m or sw % m or sh * sw != S2):
                     raise ValueError(
-                        f"image {im.shape[0]}x{im.shape[1]} is not an "
+                        f"image {frame.shape[0]}x{frame.shape[1]} is not an "
                         f"allowed dynamic-resolution grid ({S2} patches, "
                         f"sides divisible by {m * v.patch_size})")
-            elif im.shape[:2] != (v.image_size, v.image_size):
+            elif frame.shape[:2] != (v.image_size, v.image_size):
                 raise ValueError(
                     f"{cfg.name} images must be {v.image_size}x"
-                    f"{v.image_size}; got {im.shape[0]}x{im.shape[1]}")
+                    f"{v.image_size}; got {frame.shape[0]}x{frame.shape[1]}")
+        if total_chunks < 1 or total_chunks > self.config.max_images_per_request:
+            raise ValueError(
+                f"{total_chunks} image/frame blocks; this engine serves 1.."
+                f"{self.config.max_images_per_request} per request (a video "
+                f"counts one block per {v.temporal_patch_size} frames)")
         t_img = cfg.vision.mm_tokens_per_image
         soft = sum(1 for t in prompt if t == cfg.image_token_id)
-        if soft != n * t_img:
+        if soft != total_chunks * t_img:
             raise ValueError(
-                f"prompt has {soft} image soft tokens; {n} images need "
-                f"{n * t_img}")
+                f"prompt has {soft} image soft tokens; {total_chunks} "
+                f"image/frame blocks need {total_chunks * t_img}")
         # soft tokens must form contiguous runs of exactly t_img (the
         # substitution/positions math assumes it; validating HERE keeps a
         # malformed prompt a 400, not an engine-thread exception later)
@@ -939,6 +967,14 @@ class Engine:
     # ------------------------------------------------------------------
 
     def step(self) -> list[StepEvent]:
+        # re-assert THIS engine's mesh for any trace this step triggers:
+        # the active-mesh context is process-global, and constructing
+        # another Engine (tests, rolling restarts) between our __init__
+        # and our first trace would otherwise leak ITS mesh into OUR
+        # executables (observed: a CP engine traced mesh-less)
+        from llms_on_kubernetes_tpu.parallel.mesh import set_active_mesh
+
+        set_active_mesh(self.mesh)
         events: list[StepEvent] = []
         events += self._reap_aborted()
         if self._async:
@@ -1117,20 +1153,37 @@ class Engine:
         return hit
 
     def _mm_grids(self, images) -> list[tuple[int, int]]:
-        """Per-image MERGED grids (rows, cols) from the pixel shapes."""
+        """Per-BLOCK merged grids (rows, cols) in prompt-run order: one
+        per image, one per video temporal patch (all a video's blocks
+        share its grid)."""
         v = self.model_config.vision
         d = v.patch_size * v.spatial_merge_size
-        return [(im.shape[0] // d, im.shape[1] // d) for im in images]
+        out = []
+        for im in images:
+            if im.ndim == 4:
+                g = (im.shape[1] // d, im.shape[2] // d)
+                out += [g] * (im.shape[0] // v.temporal_patch_size)
+            else:
+                out.append((im.shape[0] // d, im.shape[1] // d))
+        return out
 
     def _encode_request_images(self, images):
-        """Encode each image through the vision tower (one jitted call per
-        image — dynamic resolution means per-image pixel shapes, each grid
-        compiling once). Returns (embeds [n, t_img, D],
-        deepstack [n_taps, n, t_img, D] | None)."""
+        """Encode each entry through the vision tower (one jitted call per
+        entry shape — dynamic resolution means per-entry pixel shapes,
+        each compiling once). Video entries yield one embed block per
+        temporal patch. Returns (embeds [n_blocks, t_img, D],
+        deepstack [n_taps, n_blocks, t_img, D] | None)."""
         cfg = self.model_config
         qwen = cfg.vision.family == "qwen3vl"
         embeds_l, deep_l = [], []
         for im in images:
+            if im.ndim == 4:  # video: [T', t_img, D] blocks in one call
+                e, d = self._encode_video(self.params["vision"], cfg.vision,
+                                          jnp.asarray(im))
+                embeds_l += list(e)
+                if d is not None:
+                    deep_l += [d[:, t] for t in range(d.shape[1])]
+                continue
             out = self._encode_images(self.params["vision"], cfg.vision,
                                       jnp.asarray(im)[None])
             if qwen:
@@ -1144,14 +1197,18 @@ class Engine:
                 if qwen and deep_l and deep_l[0] is not None else None)
         return embeds, deep
 
-    def _dispatch_mm_prefill(self, slot: int, req: Request,
-                             prefill_tokens: list[int]):
-        """Encode the request's images and dispatch the multimodal prefill
-        (single row; substitution happens inside the executable). Returns
-        the device SampleResult."""
+    def _mm_execute(self, images, tokens: np.ndarray, packed: np.ndarray,
+                    pos3: Optional[np.ndarray]):
+        """Vision encode + multimodal prefill for one admission — runs
+        IDENTICALLY on the coordinator and on follower pods (both enter
+        the same jitted programs in the same order; followers get the
+        inputs by broadcast). Updates the pools/counts and returns the
+        device SampleResult."""
+        from llms_on_kubernetes_tpu.parallel.mesh import set_active_mesh
+
+        set_active_mesh(self.mesh)  # follower_loop calls this directly
         cfg = self.model_config
-        qwen = cfg.vision.family == "qwen3vl"
-        embeds, deep = self._encode_request_images(req.images)
+        embeds, deep = self._encode_request_images(images)
         n_max = self.config.max_images_per_request
         if embeds.shape[0] < n_max:  # pad image count to the compiled shape
             pad = jnp.zeros((n_max - embeds.shape[0],) + embeds.shape[1:],
@@ -1161,6 +1218,23 @@ class Engine:
                 dpad = jnp.zeros(deep.shape[:1] + (n_max - deep.shape[1],)
                                  + deep.shape[2:], deep.dtype)
                 deep = jnp.concatenate([deep, dpad], axis=1)
+        if deep is not None:  # configs without deepstack taps: None
+            # flatten per row: [n_taps, 1(row), n_img_max*t_img, D]
+            deep = deep.reshape(deep.shape[0], -1, deep.shape[-1])[:, None]
+        pos3_dev = None if pos3 is None else jnp.asarray(pos3)
+        res, self.k_pages, self.v_pages, self.token_counts = self._mm_prefill_packed(
+            self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
+            embeds[None], deep, pos3_dev, self.k_pages, self.v_pages,
+            self.token_counts, self._key,
+        )
+        return res
+
+    def _dispatch_mm_prefill(self, slot: int, req: Request,
+                             prefill_tokens: list[int]):
+        """Build a multimodal admission's inputs, announce them to
+        follower pods (control word + pixel payload), and run the encode
+        + prefill. Returns the device SampleResult."""
+        cfg = self.model_config
         n = len(prefill_tokens)
         bucket = self._bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -1169,7 +1243,7 @@ class Engine:
                           np.int32)
         self._pack_prefill_row(packed, 0, req, n, slot)
         pos3 = None
-        if qwen:
+        if cfg.vision.family == "qwen3vl":
             from llms_on_kubernetes_tpu.models.vision import qwen_mrope_positions
 
             # delta is NOT re-assigned here: submit() already derived it
@@ -1180,17 +1254,16 @@ class Engine:
                 cfg.vision.mm_tokens_per_image,
                 prompt_len=len(req.prompt),
                 grids=self._mm_grids(req.images))
-            full = np.zeros((1, 3, bucket), np.int32)
-            full[0, :, :n] = p3
-            pos3 = jnp.asarray(full)
-            if deep is not None:  # configs without deepstack taps: None
-                # flatten per row: [n_taps, 1(row), n_img_max*t_img, D]
-                deep = deep.reshape(deep.shape[0], -1, deep.shape[-1])[:, None]
-        res, self.k_pages, self.v_pages, self.token_counts = self._mm_prefill_packed(
-            self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
-            embeds[None], deep, pos3, self.k_pages, self.v_pages,
-            self.token_counts, self._key,
-        )
+            pos3 = np.zeros((1, 3, bucket), np.int32)
+            pos3[0, :, :n] = p3
+        if self.config.multihost:
+            from llms_on_kubernetes_tpu.engine import multihost as mh
+
+            self._mh_send(mh.MSG_MM_PREFILL, pre_tokens=tokens,
+                          pre_packed=packed)
+            mh.send_mm_payload(self._mh_shapes, req.images,
+                               None if pos3 is None else pos3[0])
+        res = self._mm_execute(req.images, tokens, packed, pos3)
         self.slot_len[slot] = n
         return res
 

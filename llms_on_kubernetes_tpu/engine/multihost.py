@@ -50,30 +50,54 @@ MSG_PREFILL = 1
 MSG_CHUNK = 2
 MSG_DECODE = 3
 MSG_SHUTDOWN = 4
+# multimodal prefill: the control word announces it (tokens/packed ride the
+# normal buffers), then ONE extra broadcast carries the pixel payload +
+# mrope positions (engine._mm_execute runs identically on every process) —
+# the common decode/prefill path stays a single broadcast
+MSG_MM_PREFILL = 5
 
 CTRL_LEN = 8
 
 
 @dataclasses.dataclass(frozen=True)
 class ProtoShapes:
-    """Fixed message-buffer shapes, derivable from EngineConfig on every
-    process (the config is part of the deployment spec, identical per pod)."""
+    """Fixed message-buffer shapes, derivable from the engine + model
+    configs on every process (both are part of the deployment spec,
+    identical per pod)."""
     admit_batch: int
     max_bucket: int
     pre_width: int     # _CHK_COLS + pages_per_slot (covers prefill's too)
     num_slots: int
     dec_width: int     # _DEC_COLS + pages_per_slot
+    # multimodal payload (0s when the model has no vision tower): every
+    # dynamic-resolution grid holds the same pixel COUNT (fixed patch
+    # budget), so images broadcast as flat fixed-size rows + their grids
+    n_img_max: int = 0
+    img_floats: int = 0   # pixels per image row: S^2 * p^2 * C
+    mrope: bool = False
 
     @classmethod
-    def from_engine_config(cls, cfg: Any) -> "ProtoShapes":
+    def from_engine_config(cls, cfg: Any,
+                           model_config: Any = None) -> "ProtoShapes":
         from llms_on_kubernetes_tpu.engine.engine import _CHK_COLS, _DEC_COLS
 
+        n_img = img_floats = 0
+        mrope = False
+        row_frames = 2
+        if model_config is not None and model_config.vision is not None:
+            v = model_config.vision
+            n_img = cfg.max_images_per_request
+            img_floats = v.image_size * v.image_size * v.num_channels
+            mrope = model_config.mrope_section is not None
+            row_frames = max(1, v.temporal_patch_size)
         return cls(
             admit_batch=cfg.admit_batch,
             max_bucket=max(cfg.prefill_buckets),
             pre_width=_CHK_COLS + cfg.pages_per_slot,
             num_slots=cfg.max_decode_slots,
             dec_width=_DEC_COLS + cfg.pages_per_slot,
+            n_img_max=n_img, img_floats=img_floats, mrope=mrope,
+            mm_row_frames=row_frames,
         )
 
     def zeros(self) -> dict:
@@ -82,6 +106,23 @@ class ProtoShapes:
             "pre_tokens": np.zeros((self.admit_batch, self.max_bucket), np.int32),
             "pre_packed": np.zeros((self.admit_batch, self.pre_width), np.int32),
             "dec_packed": np.zeros((self.num_slots, self.dec_width), np.int32),
+        }
+
+    # frames per pixel-buffer row: a video temporal patch is
+    # temporal_patch_size real frames; one row holds exactly one image OR
+    # one temporal patch, so total rows <= total blocks <= n_img_max
+    mm_row_frames: int = 2
+
+    def mm_zeros(self) -> dict:
+        """The second (mm-only) broadcast: entry pixels flattened into
+        block-aligned rows, per-entry (frames, H, W) shapes (frames=0 =>
+        image), and the mrope position block."""
+        return {
+            "meta": np.zeros((1 + 3 * self.n_img_max,), np.int32),
+            "pixels": np.zeros(
+                (self.n_img_max, self.mm_row_frames * self.img_floats),
+                np.float32),
+            "pos3": np.zeros((3, self.max_bucket), np.int32),
         }
 
 
@@ -120,6 +161,53 @@ def receive_message(shapes: ProtoShapes) -> dict:
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+def send_mm_payload(shapes: ProtoShapes, images: list,
+                    pos3: "Optional[np.ndarray]") -> None:
+    """Coordinator: ship a multimodal admission's pixels (+ mrope
+    positions) in one broadcast right after its MSG_MM_PREFILL control.
+    Entries are images [H, W, C] (meta frames=0) or videos [F, H, W, C];
+    a video occupies F / mm_row_frames consecutive block-aligned rows."""
+    msg = shapes.mm_zeros()
+    msg["meta"][0] = len(images)
+    row = 0
+    for i, im in enumerate(images):
+        video = im.ndim == 4
+        f, h, w = (im.shape[0] if video else 0), im.shape[-3], im.shape[-2]
+        msg["meta"][1 + 3 * i:4 + 3 * i] = (f, h, w)
+        flat = np.asarray(im, np.float32).reshape(-1)
+        n_rows = max(1, f // shapes.mm_row_frames)
+        msg["pixels"][row:row + n_rows].reshape(-1)[:flat.size] = flat
+        row += n_rows
+    if pos3 is not None:
+        msg["pos3"][:, :pos3.shape[-1]] = pos3
+    _broadcast(msg)
+
+
+def receive_mm_payload(shapes: ProtoShapes, channels: int,
+                       bucket: int) -> "tuple[list, Optional[np.ndarray]]":
+    """Follower: rebuild the admission's image/video list (per-entry
+    dynamic grids) and the [3, bucket] mrope block (None for non-mrope
+    models)."""
+    out = _broadcast(shapes.mm_zeros())
+    meta = np.asarray(out["meta"])
+    pixels = np.asarray(out["pixels"])
+    images = []
+    row = 0
+    for i in range(int(meta[0])):
+        f, h, w = (int(x) for x in meta[1 + 3 * i:4 + 3 * i])
+        if f:  # video
+            n_rows = f // shapes.mm_row_frames
+            flat = pixels[row:row + n_rows].reshape(-1)[:f * h * w * channels]
+            images.append(flat.reshape(f, h, w, channels))
+            row += n_rows
+        else:
+            images.append(
+                pixels[row, :h * w * channels].reshape(h, w, channels))
+            row += 1
+    pos3 = np.asarray(out["pos3"])[:, :bucket] if shapes.mrope else None
+    return images, pos3
+
+
 def follower_loop(engine: Any) -> None:
     """Run on pods 1..N-1: mirror the coordinator's call sequence forever.
 
@@ -133,7 +221,8 @@ def follower_loop(engine: Any) -> None:
 
     from llms_on_kubernetes_tpu.engine.engine import _CHK_COLS, _DEC_COLS, _PRE_COLS
 
-    shapes = ProtoShapes.from_engine_config(engine.config)
+    shapes = ProtoShapes.from_engine_config(engine.config,
+                                            engine.model_config)
     pps = engine.config.pages_per_slot
     last_toks = engine._zeros_B
     prefill_toks = engine._zeros_1
@@ -143,6 +232,15 @@ def follower_loop(engine: Any) -> None:
         if op == MSG_SHUTDOWN:
             return
         if op == MSG_IDLE:
+            continue
+        if op == MSG_MM_PREFILL:
+            images, pos3 = receive_mm_payload(
+                shapes, engine.model_config.vision.num_channels, bucket)
+            res = engine._mm_execute(
+                images, m["pre_tokens"][:k, :bucket],
+                m["pre_packed"][:k, :_PRE_COLS + pps],
+                None if pos3 is None else pos3[None])
+            prefill_toks = res.tokens
             continue
         if op in (MSG_PREFILL, MSG_CHUNK):
             cols = (_PRE_COLS if op == MSG_PREFILL else _CHK_COLS) + pps

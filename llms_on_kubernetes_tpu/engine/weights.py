@@ -194,27 +194,58 @@ class _Fetch:
             w = w.reshape(w.shape[0], *out_reshape)
         return w
 
+    def grouped(self, name: str, out_shape: tuple):
+        """Native group-quantized tensor for an AWQ-packed linear, or
+        None when the tensor isn't AWQ-packed (falls back to dequant).
+        ``name`` is the HF `.weight` name; ``out_shape`` the logical
+        output dims of OUR layout (the AWQ in-axis is the contraction)."""
+        if self.quant is None or self.quant["method"] != "awq":
+            return None
+        name = self._resolve(name)
+        base = name[:-len("weight")]
+        if base + "qweight" not in self.loaders:
+            return None
+        from llms_on_kubernetes_tpu.ops.quant import awq_group_tensors
 
-def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int) -> Params:
-    """Return our per-layer param dict for HF layer ``i``."""
+        return awq_group_tensors(
+            np.asarray(self.loaders[base + "qweight"]()),
+            np.asarray(self.loaders[base + "qzeros"]()),
+            np.asarray(self.loaders[base + "scales"]()),
+            bits=self.quant["bits"], out_shape=out_shape,
+        )
+
+
+def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int,
+                  preloaded: "Optional[Params]" = None) -> Params:
+    """Return our per-layer param dict for HF layer ``i``.
+
+    ``preloaded`` short-circuits named entries (the AWQ-native loader
+    passes GroupQTensors so the throwaway f32 dequant of those linears
+    never runs — it would double the host-side load work per layer)."""
     H, KV, hd, D, F = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size, cfg.intermediate_size
     p = f"model.layers.{i}."
-    out: Params = {}
+    pre = preloaded or {}
+    out: Params = dict(pre)
 
     # --- attention ------------------------------------------------------
-    try:
-        out["wq"] = fetch.linear(p + "self_attn.q_proj.weight", (H, hd))
-        out["wk"] = fetch.linear(p + "self_attn.k_proj.weight", (KV, hd))
-        out["wv"] = fetch.linear(p + "self_attn.v_proj.weight", (KV, hd))
-    except KeyError:
-        # phi3 fused qkv: [(H + 2KV) * hd, D]
-        qkv = fetch(p + "self_attn.qkv_proj.weight")
-        q, k, v = np.split(qkv, [H * hd, (H + KV) * hd], axis=0)
-        out["wq"] = q.T.reshape(D, H, hd)
-        out["wk"] = k.T.reshape(D, KV, hd)
-        out["wv"] = v.T.reshape(D, KV, hd)
-    wo = fetch(p + "self_attn.o_proj.weight")  # [D, H*hd]
-    out["wo"] = wo.T.reshape(H, hd, D)
+    if not {"wq", "wk", "wv"} <= pre.keys():
+        try:
+            out["wq"] = pre.get("wq") if "wq" in pre else fetch.linear(
+                p + "self_attn.q_proj.weight", (H, hd))
+            out["wk"] = pre.get("wk") if "wk" in pre else fetch.linear(
+                p + "self_attn.k_proj.weight", (KV, hd))
+            out["wv"] = pre.get("wv") if "wv" in pre else fetch.linear(
+                p + "self_attn.v_proj.weight", (KV, hd))
+        except KeyError:
+            # phi3 fused qkv: [(H + 2KV) * hd, D]
+            qkv = fetch(p + "self_attn.qkv_proj.weight")
+            q, k, v = np.split(qkv, [H * hd, (H + KV) * hd], axis=0)
+            out["wq"] = q.T.reshape(D, H, hd)
+            out["wk"] = k.T.reshape(D, KV, hd)
+            out["wv"] = v.T.reshape(D, KV, hd)
+    if "wo" not in pre:
+        wo = fetch(p + "self_attn.o_proj.weight")  # [D, H*hd]
+        out["wo"] = wo.T.reshape(H, hd, D)
 
     if cfg.attention_bias:
         out["bq"] = fetch(p + "self_attn.q_proj.bias").reshape(H, hd)
@@ -257,15 +288,19 @@ def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int) -> Params:
         out["w_up"] = np.stack(ups)
         out["w_down"] = np.stack(downs)
     else:
-        try:
-            out["w_gate"] = fetch.linear(p + "mlp.gate_proj.weight")
-            out["w_up"] = fetch.linear(p + "mlp.up_proj.weight")
-        except KeyError:
-            gu = fetch(p + "mlp.gate_up_proj.weight")  # phi3 fused [2F, D]
-            g, u = np.split(gu, 2, axis=0)
-            out["w_gate"] = g.T
-            out["w_up"] = u.T
-        out["w_down"] = fetch.linear(p + "mlp.down_proj.weight")
+        if not {"w_gate", "w_up"} <= pre.keys():
+            try:
+                out["w_gate"] = pre.get("w_gate") or fetch.linear(
+                    p + "mlp.gate_proj.weight")
+                out["w_up"] = pre.get("w_up") or fetch.linear(
+                    p + "mlp.up_proj.weight")
+            except KeyError:
+                gu = fetch(p + "mlp.gate_up_proj.weight")  # phi3 fused [2F, D]
+                g, u = np.split(gu, 2, axis=0)
+                out["w_gate"] = g.T
+                out["w_up"] = u.T
+        if "w_down" not in pre:
+            out["w_down"] = fetch.linear(p + "mlp.down_proj.weight")
     return out
 
 
@@ -314,22 +349,58 @@ def load_hf_params(
     # already <= 8-bit); bf16 checkpoints only when asked.
     quantize_now = quantization == "int8" or ckpt_quant is not None
 
+    # Native AWQ execution (round 4): the group format is served as-is
+    # (GroupQTensor — int4 data + per-group scales/zeros, ops/quant.py)
+    # instead of the dequant → per-channel-int8 approximation. Only
+    # tensors stored 1:1 in AWQ packing qualify; anything the layer map
+    # splits/fuses (phi3 fused qkv, MoE expert stacks) falls back to the
+    # dequant path below. (HF name, our out_shape) per our param name:
+    awq_native = ckpt_quant is not None and ckpt_quant["method"] == "awq"
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    awq_sources = {
+        "wq": ("self_attn.q_proj.weight", (H, hd)),
+        "wk": ("self_attn.k_proj.weight", (KV, hd)),
+        "wv": ("self_attn.v_proj.weight", (KV, hd)),
+        "wo": ("self_attn.o_proj.weight", (cfg.hidden_size,)),
+        "w_gate": ("mlp.gate_proj.weight", (cfg.intermediate_size,)),
+        "w_up": ("mlp.up_proj.weight", (cfg.intermediate_size,)),
+        "w_down": ("mlp.down_proj.weight", (cfg.hidden_size,)),
+    }
+
     per_layer: list[Params] = []
     for i in range(cfg.num_layers):
-        lm = hf_layer_maps(cfg, fetch, i)
+        pre: Params = {}
+        if awq_native and not cfg.is_moe:
+            # grouped tensors FIRST so hf_layer_maps never runs the
+            # throwaway f32 dequant for them (it would double host-side
+            # load work per layer — cold-start cost)
+            for name, (src, out_shape) in awq_sources.items():
+                g = fetch.grouped(f"model.layers.{i}.{src}", out_shape)
+                if g is not None:
+                    pre[name] = g
+        lm = hf_layer_maps(cfg, fetch, i, preloaded=pre)
         if quantize_now:
             # quantize BEFORE stacking: host RAM holds at most one layer
             # of dequantized f32, never the whole model
+            from llms_on_kubernetes_tpu.ops.quant import GroupQTensor
+
             for name in _LAYER_REDUCE_AXES:
                 w = lm.get(name)
-                if w is None:
+                if w is None or isinstance(w, GroupQTensor):
                     continue
                 axes = tuple(a - 1 for a in reduce_axes_for(name, w.ndim + 1))
                 lm[name] = quantize(w, axes)
         per_layer.append(lm)
 
     def stack(key):
+        from llms_on_kubernetes_tpu.ops.quant import GroupQTensor
+
         vals = [pl[key] for pl in per_layer]
+        if isinstance(vals[0], GroupQTensor):
+            return GroupQTensor(np.stack([v.data for v in vals]),
+                                np.stack([v.scale for v in vals]),
+                                np.stack([v.zero_scaled for v in vals]),
+                                vals[0].out_shape)
         if isinstance(vals[0], QTensor):
             return QTensor(np.stack([v.data for v in vals]),
                            np.stack([v.scale for v in vals]))

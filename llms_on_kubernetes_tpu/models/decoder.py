@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig
-from llms_on_kubernetes_tpu.engine.cache import write_tokens
+from llms_on_kubernetes_tpu.ops.cp import dispatch_write_tokens as write_tokens
 from llms_on_kubernetes_tpu.ops.attention import (
     dispatch_chunk_attention, dispatch_paged_attention,
     dispatch_prefill_attention, softcap,
@@ -272,7 +272,14 @@ def _run_layers(
         if cfg.rope_local_theta is not None else None
     )
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-    # layer l's pages live in the flat pool block [l*P, (l+1)*P)
+    # flat-pool layer folding. Default (layer-major): layer l's pages live
+    # in the block [l*P, (l+1)*P). Context parallelism (seq>1 mesh)
+    # numbers PAGE-MAJOR (flat = page_id * L + l) instead, so a contiguous
+    # 1/R shard of the flat axis holds 1/R of every layer's pages — see
+    # ops/cp.py. Trace-time switch: one executable per mesh, as always.
+    from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
+
+    cp = seq_parallelism() > 1
     pages_per_layer = k_pages.shape[1] // cfg.num_layers
 
     def body(carry, per_layer):
@@ -280,7 +287,10 @@ def _run_layers(
         idx, lp = per_layer
         # pools ride the CARRY (aliased buffer -> in-place scatter), never
         # the xs/ys path (which would rewrite the whole pool every step)
-        pt = page_table + idx * pages_per_layer
+        if cp:
+            pt = page_table * cfg.num_layers + idx
+        else:
+            pt = page_table + idx * pages_per_layer
         xc, kp, vp = _layer_step(
             cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,

@@ -376,15 +376,24 @@ def encode_images_qwen3vl(params: Params, vcfg: VisionConfig,
     """Qwen3-VL encode: pixels [N, H, W, C] (normalized) ->
     (soft tokens [N, T_merged, out_hidden],
      deepstack [n_taps, N, T_merged, out_hidden])."""
-    N, H, W, _ = pixels.shape
+    _N, H, W, _ = pixels.shape
+    sh, sw = H // vcfg.patch_size, W // vcfg.patch_size
+    return _qwen_encode_patches(params, vcfg, _qwen_patchify(pixels, vcfg),
+                                sh, sw)
+
+
+def _qwen_encode_patches(params: Params, vcfg: VisionConfig,
+                         feats: jnp.ndarray, sh: int, sw: int):
+    """Shared tower over patch features [N, sh*sw, C*tp*p*p]: each row is
+    one attention span (an image, or one temporal patch of a video)."""
+    N = feats.shape[0]
     D = vcfg.hidden_size
     eps = 1e-6
     nh = vcfg.num_heads
     hd = D // nh
     m2 = vcfg.spatial_merge_size ** 2
-    sh, sw = H // vcfg.patch_size, W // vcfg.patch_size
 
-    x = _qwen_patchify(pixels, vcfg) @ params["patch_w"] + params["patch_b"]
+    x = feats @ params["patch_w"] + params["patch_b"]
     x = x + _qwen_pos_embed(params, vcfg, sh, sw)[None].astype(x.dtype)
     cos, sin = _qwen_rope_cos_sin(vcfg, hd, sh, sw)
     cos = cos[None, :, None, :].astype(jnp.float32)
@@ -431,6 +440,44 @@ def encode_images_qwen3vl(params: Params, vcfg: VisionConfig,
                      postshuffle=True)
         for t in range(n_taps)])
     return soft, deepstack
+
+
+def _qwen_patchify_video(frames: jnp.ndarray, vcfg: VisionConfig) -> jnp.ndarray:
+    """frames [F, H, W, C] (F a multiple of temporal_patch_size) ->
+    patch features [1, T'*sh*sw, C*tp*p*p] in (t, hb, wb, i, j) block-merge
+    order with per-patch feature order (channel, temporal, ph, pw) — the
+    video layout of the Qwen processor: REAL consecutive frames fill the
+    temporal patch dim (images duplicate one frame instead)."""
+    F, H, W, C = frames.shape
+    p, m, tp = vcfg.patch_size, vcfg.spatial_merge_size, vcfg.temporal_patch_size
+    sh, sw = H // p, W // p
+    Tt = F // tp
+    x = frames.reshape(Tt, tp, H, W, C).transpose(0, 4, 1, 2, 3)  # [T',C,tp,H,W]
+    x = x.reshape(Tt, C, tp, sh // m, m, p, sw // m, m, p)
+    # -> [T', hb, wb, i, j, C, tp, ph, pw]
+    x = x.transpose(0, 3, 6, 4, 7, 1, 2, 5, 8)
+    return x.reshape(1, Tt * sh * sw, C * tp * p * p)
+
+
+def encode_video_qwen3vl(params: Params, vcfg: VisionConfig,
+                         frames: jnp.ndarray):
+    """Qwen3-VL VIDEO encode: frames [F, H, W, C] (normalized, F a
+    multiple of temporal_patch_size) -> (soft tokens [T', t_img, D],
+    deepstack [n_taps, T', t_img, D] | None), T' = F/temporal_patch_size.
+
+    HF video semantics (modeling_qwen3_vl.py: ``cu_seqlens =
+    repeat_interleave(h*w, t)``): each temporal patch is its own
+    attention span — a video is a BATCH of frame-pair 'images' whose
+    conv3d temporal dim holds REAL consecutive frames (images duplicate
+    one frame). Temporal information reaches the decoder as timestamp
+    text between the frame blocks, each of which behaves exactly like an
+    image there (llm_grid_t is always 1)."""
+    F, H, W, C = frames.shape
+    tp = vcfg.temporal_patch_size
+    feats = _qwen_patchify_video(frames, vcfg)       # [1, T'*sh*sw, feat]
+    sh, sw = H // vcfg.patch_size, W // vcfg.patch_size
+    feats = feats.reshape(F // tp, sh * sw, -1)      # per temporal patch
+    return _qwen_encode_patches(params, vcfg, feats, sh, sw)
 
 
 def init_qwen3vl_vision_params(vcfg: VisionConfig, key: jax.Array,

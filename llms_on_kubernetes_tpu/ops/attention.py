@@ -278,6 +278,17 @@ def dispatch_prefill_attention(q, k, v, lengths, *, scale, sliding_window=None,
 def dispatch_chunk_attention(q, k_pages, v_pages, page_table, history,
                              chunk_lengths, *, scale, sliding_window=None,
                              attn_softcap=None):
+    from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
+
+    if seq_parallelism() > 1 and _static_window(sliding_window):
+        # context-sharded pool: partial attention per page shard + one
+        # psum merge (ops/cp.py)
+        from llms_on_kubernetes_tpu.ops.cp import cp_chunk_attention
+
+        return cp_chunk_attention(
+            q, k_pages, v_pages, page_table, history, chunk_lengths,
+            scale=scale, sliding_window=sliding_window,
+            attn_softcap=attn_softcap)
     # XLA gather path everywhere for now: chunked prefill is bandwidth-bound
     # on the page gather, which XLA fuses acceptably; a Pallas paged-flash
     # chunk kernel is the designated upgrade path (see pallas_flash.py).
@@ -289,6 +300,17 @@ def dispatch_chunk_attention(q, k_pages, v_pages, page_table, history,
 
 def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
                              scale, sliding_window=None, attn_softcap=None):
+    from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
+
+    if seq_parallelism() > 1 and _static_window(sliding_window):
+        # context-parallel decode: the pool is sharded over the seq axis,
+        # so max context exceeds one device's page share; each device
+        # attends over its own pages and one psum merges the partials
+        from llms_on_kubernetes_tpu.ops.cp import cp_paged_attention
+
+        return cp_paged_attention(
+            q, k_pages, v_pages, page_table, lengths, scale=scale,
+            sliding_window=sliding_window, attn_softcap=attn_softcap)
     # The decode kernel's manual page DMA needs a lane-aligned head_dim on
     # real TPU (Mosaic pads the pool's minor dim to 128 and rejects sub-tile
     # slices); d=64/96 models (TinyLlama, Phi-3) take the XLA gather path.

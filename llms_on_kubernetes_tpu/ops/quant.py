@@ -25,6 +25,7 @@ channel (relative error <= 1/254 per weight).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -83,6 +84,129 @@ def quantize(w, reduce_axes: tuple[int, ...]) -> QTensor:
     return QTensor(data, scale)
 
 
+@jax.tree_util.register_pytree_node_class
+class GroupQTensor:
+    """Group-wise quantized weight, executed NATIVELY (AWQ int4/int8).
+
+    The serving-exact representation of an AWQ 'gemm' tensor — no
+    re-quantization to int8 per-channel (round-3 verdict: that was an
+    accuracy approximation, vLLM executes the group format natively).
+
+    data        [..., G, gs, O] int4 (or int8): CENTERED quantized values
+                (q - 2^(bits-1)); int4 storage streams 0.5 byte/param
+    scale       [..., G, O] float32
+    zero_scaled [..., G, O] float32 = scale * (zero - 2^(bits-1))
+    out_shape   logical output dims (prod == O); the logical weight is
+                w[i, o] = data[g, i % gs, o] * scale[g, o]
+                          - zero_scaled[g, o],  g = i // gs
+    Leading axes (the engine's layer stack) ride along; lax.scan slices
+    them per layer like any other leaf.
+    """
+
+    def __init__(self, data, scale, zero_scaled, out_shape: tuple):
+        self.data = data
+        self.scale = scale
+        self.zero_scaled = zero_scaled
+        self.out_shape = tuple(out_shape)
+
+    @property
+    def shape(self):  # logical [in, *out_shape]
+        g, gs = self.data.shape[-3], self.data.shape[-2]
+        return tuple(self.data.shape[:-3]) + (g * gs,) + self.out_shape
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jnp.ndarray:
+        w = (self.data.astype(jnp.float32) * self.scale[..., None, :]
+             - self.zero_scaled[..., None, :])
+        lead = self.data.shape[:-3]
+        g, gs, o = self.data.shape[-3:]
+        return w.reshape(lead + (g * gs,) + self.out_shape).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.data, self.scale, self.zero_scaled), self.out_shape
+
+    @classmethod
+    def tree_unflatten(cls, out_shape, children):
+        return cls(*children, out_shape)
+
+    def __repr__(self):
+        return (f"GroupQTensor(data={tuple(self.data.shape)} "
+                f"{self.data.dtype}, out={self.out_shape})")
+
+
+def awq_group_tensors(qweight, qzeros, scales, bits: int = 4,
+                      storage=None, out_shape=None) -> GroupQTensor:
+    """AWQ gemm tensors -> a GroupQTensor (native execution; exact).
+
+    qweight int32 [in, out*bits/32], qzeros int32 [G, out*bits/32],
+    scales f16/f32 [G, out]. ``storage`` overrides the packed dtype
+    (default: int4 for 4-bit — half the HBM stream of int8 — int8 for
+    8-bit; env LLMK_AWQ_STORAGE=int8 forces int8 if a backend lacks
+    int4 support). Leaves are HOST numpy arrays (ml_dtypes int4) so
+    checkpoint loading stacks layers in host RAM before device placement
+    (same policy as ``quantize``)."""
+    import ml_dtypes
+    import numpy as np
+
+    q = _awq_unpack(np.asarray(qweight, np.int32), bits)   # [in, out]
+    z = _awq_unpack(np.asarray(qzeros, np.int32), bits)    # [G, out]
+    G, O = z.shape
+    gs = q.shape[0] // G
+    center = 1 << (bits - 1)
+    if storage is None:
+        storage = os.environ.get("LLMK_AWQ_STORAGE")
+    if storage is None:
+        # int4 storage halves the weight HBM stream, but the current TPU
+        # runtime rejects int4 arrays outright (probed: transfer/convert
+        # both fail); int8 keeps the group math EXACT at the int8-class
+        # stream. CPU defaults to int4 so the packed path stays tested;
+        # LLMK_AWQ_STORAGE=int4 opts in on runtimes that support it.
+        storage = ("int8" if bits == 8 or jax.default_backend() == "tpu"
+                   else "int4")
+    dt = ml_dtypes.int4 if storage == "int4" else np.int8
+    s = np.asarray(scales, np.float32)
+    return GroupQTensor(
+        (q - center).astype(np.int8).reshape(G, gs, O).astype(dt),
+        s,
+        (z.astype(np.float32) - center) * s,
+        out_shape=tuple(out_shape) if out_shape is not None else (O,),
+    )
+
+
+def group_qeinsum(eq: str, x: jnp.ndarray, w: GroupQTensor) -> jnp.ndarray:
+    """einsum against a group-quantized weight, contraction grouped.
+
+    Exact algebra (no dequantized weight ever materializes in HBM):
+        y[., o] = sum_g  s[g, o] * (x[., g, :] @ data[g, :, o])
+                - sum_g  zs[g, o] * sum_i x[., g, i]
+    computed as a ``lax.scan`` over groups with an f32 accumulator, so
+    peak memory is one [batch, O] buffer and the weight streams once at
+    its packed width. Decoder contract (asserted): the weight's
+    contraction axis is its FIRST logical axis and x's LAST.
+    """
+    lhs, out_sub = eq.split("->")
+    x_sub, w_sub = lhs.split(",")
+    n_con = len(w_sub) - len(w.out_shape)
+    assert x_sub[-n_con:] == w_sub[:n_con] and all(
+        c not in out_sub for c in w_sub[:n_con]), (
+        f"group_qeinsum: {eq} does not contract the weight's leading axes")
+    G, gs, O = w.data.shape[-3:]
+    lead = x.shape[:-n_con]
+    xg = x.reshape(lead + (G, gs))
+    xs_x = jnp.moveaxis(xg, -2, 0)                     # [G, ..., gs]
+
+    def body(acc, per_g):
+        xg_, qg, sg, zg = per_g                        # [..., gs] / [gs, O]
+        part = jnp.einsum("...i,io->...o", xg_, qg.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+        xsum = xg_.sum(axis=-1).astype(jnp.float32)[..., None]
+        return acc + part * sg - xsum * zg, None
+
+    acc0 = jnp.zeros(lead + (O,), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0,
+                          (xs_x, w.data, w.scale, w.zero_scaled))
+    return acc.reshape(lead + w.out_shape).astype(x.dtype)
+
+
 def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
     """einsum where the second operand may be a QTensor.
 
@@ -94,6 +218,8 @@ def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
     no matter how XLA schedules the fusion. Only the int8->bf16 convert
     rides on the weight read (fused into the MXU operand load).
     """
+    if isinstance(w, GroupQTensor):
+        return group_qeinsum(eq, x, w)
     if not isinstance(w, QTensor):
         return jnp.einsum(eq, x, w)
     lhs, out = eq.split("->")
@@ -122,6 +248,22 @@ def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
 _AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
 
 
+def _awq_unpack(arr, bits: int):
+    """[r, c] int32 -> [r, c*pack] unpacked values with AWQ interleave."""
+    import numpy as np
+
+    if bits not in (4, 8):
+        raise ValueError(f"unsupported AWQ bits={bits}")
+    pack = 32 // bits
+    mask = (1 << bits) - 1
+    r, c = arr.shape
+    out = np.empty((r, c * pack), np.int32)
+    order = _AWQ_ORDER if bits == 4 else range(pack)
+    for k, o in enumerate(order):
+        out[:, o::pack] = (arr >> (bits * k)) & mask
+    return out
+
+
 def awq_dequantize(qweight: "np.ndarray", qzeros: "np.ndarray",
                    scales: "np.ndarray", bits: int = 4) -> "np.ndarray":
     """AWQ GEMM-format dequant -> float32 [in, out].
@@ -132,21 +274,8 @@ def awq_dequantize(qweight: "np.ndarray", qzeros: "np.ndarray",
     """
     import numpy as np
 
-    if bits not in (4, 8):
-        raise ValueError(f"unsupported AWQ bits={bits}")
-    pack = 32 // bits
-    mask = (1 << bits) - 1
-
-    def unpack(arr):  # [r, c] int32 -> [r, c*pack] with AWQ interleave
-        r, c = arr.shape
-        out = np.empty((r, c * pack), np.int32)
-        order = _AWQ_ORDER if bits == 4 else range(pack)
-        for k, o in enumerate(order):
-            out[:, o::pack] = (arr >> (bits * k)) & mask
-        return out
-
-    q = unpack(qweight.astype(np.int32))           # [in, out]
-    z = unpack(qzeros.astype(np.int32))            # [n_groups, out]
+    q = _awq_unpack(qweight.astype(np.int32), bits)  # [in, out]
+    z = _awq_unpack(qzeros.astype(np.int32), bits)   # [n_groups, out]
     n_groups = z.shape[0]
     group = q.shape[0] // n_groups
     zf = np.repeat(z, group, axis=0).astype(np.float32)

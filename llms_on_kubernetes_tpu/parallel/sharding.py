@@ -79,19 +79,28 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
 
 
 def cache_specs(cfg: ModelConfig, mesh: Mesh) -> tuple[P, P]:
+    from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ
+
     m_kv = _axis(mesh, cfg.num_kv_heads, AXIS_MODEL)
-    spec = P(m_kv, None, None, None)  # [KV, L*P, page, hd] flat head-major
+    sq = AXIS_SEQ if mesh.shape.get(AXIS_SEQ, 1) > 1 else None
+    spec = P(m_kv, sq, None, None)  # [KV, L*P, page, hd] flat head-major
     return spec, spec
 
 
 def shard_pool(pool, cfg: ModelConfig, mesh: Mesh):
     """Device_put a KVPool onto the mesh: every leaf (int8 data AND the
     per-token scales) shards its leading kv-head axis over the model axis,
-    so each TP shard keeps its own heads' pages and scales local."""
+    so each TP shard keeps its own heads' pages and scales local. On a
+    seq>1 mesh the FLAT PAGE axis additionally shards over ``seq``
+    (context parallelism, ops/cp.py): total KV capacity then scales with
+    the ring size instead of being bounded by one device's share."""
+    from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ
+
     m_kv = _axis(mesh, cfg.num_kv_heads, AXIS_MODEL)
+    sq = AXIS_SEQ if mesh.shape.get(AXIS_SEQ, 1) > 1 else None
 
     def put(x):
-        spec = P(m_kv, *([None] * (x.ndim - 1)))
+        spec = P(m_kv, sq, *([None] * (x.ndim - 2)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, pool)
@@ -109,7 +118,7 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     dims — so a TP-sharded weight carries its channel scales on the same
     chip as the channels.
     """
-    from llms_on_kubernetes_tpu.ops.quant import QTensor, scale_spec
+    from llms_on_kubernetes_tpu.ops.quant import GroupQTensor, QTensor, scale_spec
 
     specs = param_specs(cfg, mesh)
     if "vision" in params:
@@ -117,6 +126,26 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
         specs["vision"] = jax.tree.map(lambda _: P(), params["vision"])
 
     def put(x, s):
+        if isinstance(x, GroupQTensor):
+            # group-quantized (AWQ-native) weight: shard only the FLAT
+            # OUTPUT axis, with the model axis the original spec put on
+            # any of the logical out dims (column-parallel preserved).
+            # Contraction-sharded (row-parallel) originals — wo/w_down —
+            # replicate instead: their group axis cannot shard without a
+            # partial-sum rework of group_qeinsum (PARITY known gap).
+            k = len(x.out_shape)
+            out_axes = tuple(s)[-k:] if len(tuple(s)) >= k else ()
+            m = next((a for a in out_axes if a is not None), None)
+            if m is not None and x.data.shape[-1] % mesh.shape[m] != 0:
+                m = None
+            def spec_for(arr):
+                return P(*([None] * (arr.ndim - 1)), m)
+            return GroupQTensor(
+                jax.device_put(x.data, NamedSharding(mesh, spec_for(x.data))),
+                jax.device_put(x.scale, NamedSharding(mesh, spec_for(x.scale))),
+                jax.device_put(x.zero_scaled,
+                               NamedSharding(mesh, spec_for(x.zero_scaled))),
+                x.out_shape)
         if isinstance(x, QTensor):
             data = jax.device_put(x.data, NamedSharding(mesh, s))
             scale = jax.device_put(
@@ -126,5 +155,6 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
         return jax.device_put(x, NamedSharding(mesh, s))
 
     return jax.tree.map(
-        put, params, specs, is_leaf=lambda x: isinstance(x, QTensor)
+        put, params, specs,
+        is_leaf=lambda x: isinstance(x, (QTensor, GroupQTensor))
     )

@@ -403,14 +403,76 @@ class OpenAIServer:
             logit_bias=tuple(bias_items),
         )
 
+    def _decode_data_url(self, url: str, what: str):
+        """data: URL -> loaded PIL image (400 on bad bytes)."""
+        import base64
+        import binascii
+        import io
+
+        from PIL import Image
+
+        if not url.startswith("data:"):
+            raise ValueError(
+                f"{what} must be a data: URL (base64); the server does "
+                f"not fetch remote media")
+        try:
+            img = Image.open(io.BytesIO(base64.b64decode(url.split(",", 1)[-1])))
+            img.load()  # force decode NOW: bad bytes -> 400, not a 500 later
+        except (OSError, binascii.Error, SyntaxError) as e:
+            raise ValueError(f"undecodable {what} data: {e}")
+        return img
+
+    def _extract_video(self, part):
+        """``video_url`` data URL (animated GIF/WebP/APNG — the formats
+        PIL iterates; pre-extracted frames are the deployment contract,
+        matching the reference's in-cluster no-egress stance) ->
+        (frames [PIL], per-temporal-patch timestamps in seconds).
+
+        Frames are uniformly sampled to LLMK_MAX_VIDEO_FRAMES (default 8
+        = 4 temporal patches, the default per-request block budget) and
+        trimmed to a temporal_patch_size multiple; timestamps follow the
+        HF Qwen3-VL processor (mean of first/last frame time within each
+        temporal patch, from the container's frame durations)."""
+        import os
+
+        import numpy as np
+        from PIL import ImageSequence
+
+        vis = self.engine.model_config.vision
+        if vis is None:  # text-only model: a 400, not an AttributeError 500
+            raise ValueError(
+                f"model {self.model_name!r} does not accept video input")
+        tp = vis.temporal_patch_size
+        img = self._decode_data_url(
+            (part.get("video_url") or {}).get("url", ""), "video_url")
+        frames, times, t = [], [], 0.0
+        for f in ImageSequence.Iterator(img):
+            times.append(t)
+            t += float(f.info.get("duration", 1000.0 / 24.0)) / 1000.0
+            frames.append(f.convert("RGB").copy())
+        cap = max(tp, int(os.environ.get("LLMK_MAX_VIDEO_FRAMES", "8")))
+        if len(frames) > cap:
+            idx = np.linspace(0, len(frames) - 1, cap).round().astype(int)
+            frames = [frames[i] for i in idx]
+            times = [times[i] for i in idx]
+        while len(frames) % tp:  # pad to a temporal-patch multiple
+            frames.append(frames[-1])
+            times.append(times[-1])
+        ts = [(times[i] + times[i + tp - 1]) / 2
+              for i in range(0, len(frames), tp)]
+        return frames, ts
+
     def _extract_images(self, messages: list) -> tuple[list, list]:
         """OpenAI multimodal content parts -> (template-ready messages,
-        decoded images). ``image_url`` parts accept data: URLs (base64);
-        remote http(s) URLs are rejected — the serving pod must not fetch
-        arbitrary URLs. Image parts become {"type": "image"} placeholders
-        the model's chat template renders as its begin-of-image marker."""
-        import base64
-
+        decoded media). ``image_url`` / ``video_url`` parts accept data:
+        URLs (base64); remote http(s) URLs are rejected — the serving pod
+        must not fetch arbitrary URLs. Image parts become
+        {"type": "image"} placeholders the model's chat template renders
+        as its begin-of-image marker; a video becomes one
+        ``<t seconds>`` text + image placeholder PER TEMPORAL PATCH (the
+        Qwen3-VL prompt convention: timestamps carry time, every frame
+        block behaves as an image) and contributes one ("video", frames)
+        entry to the media list."""
         out, images = [], []
         for m in messages:
             content = m.get("content")
@@ -421,24 +483,17 @@ class OpenAIServer:
             for part in content:
                 ptype = part.get("type") if isinstance(part, dict) else None
                 if ptype == "image_url":
-                    url = (part.get("image_url") or {}).get("url", "")
-                    if not url.startswith("data:"):
-                        raise ValueError(
-                            "image_url must be a data: URL (base64); the "
-                            "server does not fetch remote images")
-                    b64 = url.split(",", 1)[-1]
-                    import binascii
-                    import io
-
-                    from PIL import Image
-                    try:
-                        img = Image.open(io.BytesIO(base64.b64decode(b64)))
-                        img.load()  # force decode NOW: bad bytes -> 400,
-                        # not a 500 later in preprocessing
-                    except (OSError, binascii.Error, SyntaxError) as e:
-                        raise ValueError(f"undecodable image_url data: {e}")
-                    images.append(img)
+                    images.append(self._decode_data_url(
+                        (part.get("image_url") or {}).get("url", ""),
+                        "image_url"))
                     parts.append({"type": "image"})
+                elif ptype == "video_url":
+                    frames, ts = self._extract_video(part)
+                    for t in ts:
+                        parts.append({"type": "text",
+                                      "text": f"<{t:.1f} seconds>"})
+                        parts.append({"type": "image"})
+                    images.append(("video", frames))
                 else:
                     parts.append(part)
             out.append({**m, "content": parts})
@@ -514,24 +569,44 @@ class OpenAIServer:
             else:
                 prompt_ids = self.tokenizer.apply_chat_template(messages)
             if images:
-                prompt_ids = self._splice_image_tokens(prompt_ids, len(images))
+                vis = self.engine.model_config.vision
+                n_blocks = sum(
+                    len(e[1]) // vis.temporal_patch_size
+                    if isinstance(e, tuple) and e[0] == "video" else 1
+                    for e in images)
+                prompt_ids = self._splice_image_tokens(prompt_ids, n_blocks)
         except Exception as e:  # bad roles/content shape
             return web.json_response({"error": {"message": f"bad messages: {e}"}}, status=400)
         pixels = None
         if images:
+            import numpy as np
+
             from llms_on_kubernetes_tpu.models.vision import (
                 preprocess_image, preprocess_image_qwen3vl,
             )
 
             vis = self.engine.model_config.vision
             try:
-                if vis.family == "qwen3vl":
-                    # dynamic resolution: aspect-preserving per-image grids
-                    pixels = [preprocess_image_qwen3vl(im, vis)
-                              for im in images]
-                else:
-                    pixels = [preprocess_image(im, vis.image_size)
-                              for im in images]
+                pixels = []
+                for entry in images:
+                    if isinstance(entry, tuple) and entry[0] == "video":
+                        if vis.family != "qwen3vl":
+                            raise ValueError(
+                                f"model {self.model_name!r} does not "
+                                f"accept video input")
+                        # every frame on the FIRST frame's grid (one
+                        # dynamic-resolution choice per video)
+                        pixels.append(np.stack([
+                            preprocess_image_qwen3vl(f, vis)
+                            for f in entry[1]]))
+                    elif vis.family == "qwen3vl":
+                        # dynamic resolution: aspect-preserving grids
+                        pixels.append(preprocess_image_qwen3vl(entry, vis))
+                    else:
+                        pixels.append(preprocess_image(entry, vis.image_size))
+            except ValueError as e:
+                return web.json_response(
+                    {"error": {"message": str(e)}}, status=400)
             except Exception as e:  # undecodable/degenerate image -> 400
                 return web.json_response(
                     {"error": {"message": f"bad image: {e}"}}, status=400)

@@ -1,0 +1,111 @@
+"""Real ``helm template`` rendering vs the Python renderer (round-4
+verdict item 8): the Go templates were previously never executed in CI —
+schema tests validated values and the Python renderer was pinned, but a
+Go-template typo would ship. This golden test renders BOTH charts with
+their shipped values.yaml through the actual helm binary and asserts
+resource-level equivalence with ``deploy.manifests.render_manifests``.
+
+Skips when no helm binary is installed (the sandbox image has none); any
+environment with helm — CI, operator laptops — runs it automatically.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+HELM = shutil.which("helm")
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+
+pytestmark = pytest.mark.skipif(HELM is None,
+                                reason="helm binary not installed")
+
+
+def _helm_docs(chart: str):
+    cdir = ROOT / chart / "helm-chart"
+    out = subprocess.run(
+        [HELM, "template", "golden", str(cdir)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, f"helm template failed:\n{out.stderr}"
+    return [d for d in yaml.safe_load_all(out.stdout) if d]
+
+
+def _python_docs(chart: str):
+    from llms_on_kubernetes_tpu.deploy.manifests import render_manifests
+    from llms_on_kubernetes_tpu.deploy.spec import load_spec
+
+    values = str(ROOT / chart / "helm-chart" / "values.yaml")
+    return render_manifests(load_spec(values))
+
+
+def _by_key(docs):
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+def _container(doc):
+    return doc["spec"]["template"]["spec"]["containers"][0]
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_helm_and_python_render_the_same_resources(chart):
+    """The two renderers must agree on the full resource set: a template
+    that stops rendering (Go typo) or renders an extra/renamed resource
+    is drift the schema tests cannot see."""
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+    assert set(helm) == set(py), (
+        f"resource sets diverge\nhelm only: {sorted(set(helm) - set(py))}\n"
+        f"python only: {sorted(set(py) - set(helm))}")
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_model_workloads_match_field_level(chart):
+    """For every model Deployment/StatefulSet: image, command+args,
+    replica count, nodeSelector, and resource requests must be identical
+    between helm and the Python renderer."""
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+    model_keys = [k for k in py
+                  if k[0] in ("Deployment", "StatefulSet")
+                  and k[1].startswith("model-")]
+    assert model_keys, "no model workloads rendered"
+    for key in model_keys:
+        h, p = _container(helm[key]), _container(py[key])
+        assert h["image"] == p["image"], key
+        assert h.get("command") == p.get("command"), key
+        assert h.get("args") == p.get("args"), key
+        assert (h.get("resources") or {}) == (p.get("resources") or {}), key
+        hs = helm[key]["spec"]["template"]["spec"].get("nodeSelector")
+        ps = py[key]["spec"]["template"]["spec"].get("nodeSelector")
+        assert hs == ps, key
+        assert helm[key]["spec"]["replicas"] == py[key]["spec"]["replicas"], key
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_router_and_gateway_match(chart):
+    """The router ConfigMap's backend map and the Istio VirtualService's
+    route list are the traffic-critical surfaces — compare them parsed,
+    not textually."""
+    import json
+
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+
+    cm_keys = [k for k in py if k[0] == "ConfigMap" and "router" in k[1]]
+    for key in cm_keys:
+        for fname, text in py[key]["data"].items():
+            assert fname in helm[key]["data"], key
+            if fname.endswith(".json"):
+                assert json.loads(helm[key]["data"][fname]) == json.loads(text)
+
+    vs_keys = [k for k in py if k[0] == "VirtualService"]
+    for key in vs_keys:
+        hroutes = helm[key]["spec"]["http"]
+        proutes = py[key]["spec"]["http"]
+        def norm(routes):
+            return [(json.dumps(r.get("match"), sort_keys=True),
+                     json.dumps(r.get("route"), sort_keys=True))
+                    for r in routes]
+        assert norm(hroutes) == norm(proutes), key

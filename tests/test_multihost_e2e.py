@@ -138,3 +138,133 @@ def test_two_process_spmd_serving_matches_single_process(async_sched):
     got = _extract(outs[0])
     assert "FOLLOWER done" in outs[1]
     assert got == want, (got, want)
+
+
+WORKER_MM = r"""
+import json, os, sys
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.engine.multihost import follower_loop
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+cfg = EngineConfig(
+    model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+    multihost=True, max_images_per_request=2,
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+
+if pid == 0:
+    from llms_on_kubernetes_tpu.configs import get_config
+    qcfg = get_config("debug-qwen-mm")
+    run = [qcfg.boi_token_id] + [qcfg.image_token_id] * 4 + [qcfg.eoi_token_id]
+    prompt = [1] + run + [5, 6]
+    img = np.random.default_rng(11).standard_normal((8, 32, 3)).astype(np.float32)
+    req = eng.submit(prompt, SamplingParams(temperature=0.0, max_tokens=6),
+                     images=[img])
+    while not req.finished:
+        eng.step()
+    # VIDEO under multi-host: 4 frames = 2 blocks, landscape grids,
+    # broadcast block-aligned in the mm payload
+    vprompt = [1] + run + [5] + run + [6]
+    vid = np.random.default_rng(12).standard_normal((4, 8, 32, 3)).astype(np.float32)
+    vreq = eng.submit(vprompt, SamplingParams(temperature=0.0, max_tokens=5),
+                      images=[vid])
+    while not vreq.finished:
+        eng.step()
+    out_text = eng.generate([7, 8, 9], SamplingParams(temperature=0.0, max_tokens=4))
+    eng.stop_followers()
+    print("RESULT:" + json.dumps([req.output, vreq.output, out_text]), flush=True)
+else:
+    follower_loop(eng)
+    print("FOLLOWER done", flush=True)
+"""
+
+REFERENCE_MM = r"""
+import json, sys
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+from llms_on_kubernetes_tpu.configs import get_config
+
+cfg = EngineConfig(
+    model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+    max_images_per_request=2,
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+qcfg = get_config("debug-qwen-mm")
+run = [qcfg.boi_token_id] + [qcfg.image_token_id] * 4 + [qcfg.eoi_token_id]
+prompt = [1] + run + [5, 6]
+img = np.random.default_rng(11).standard_normal((8, 32, 3)).astype(np.float32)
+req = eng.submit(prompt, SamplingParams(temperature=0.0, max_tokens=6),
+                 images=[img])
+while not req.finished:
+    eng.step()
+vprompt = [1] + run + [5] + run + [6]
+vid = np.random.default_rng(12).standard_normal((4, 8, 32, 3)).astype(np.float32)
+vreq = eng.submit(vprompt, SamplingParams(temperature=0.0, max_tokens=5),
+                  images=[vid])
+while not vreq.finished:
+    eng.step()
+out_text = eng.generate([7, 8, 9], SamplingParams(temperature=0.0, max_tokens=4))
+print("RESULT:" + json.dumps([req.output, vreq.output, out_text]), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_multimodal_matches_single_process():
+    """Image requests under multi-host (round-4 verdict item 4): the
+    coordinator broadcasts the pixel payload + mrope block; the follower
+    mirrors the per-image vision encode and mm prefill. Greedy output of
+    a Qwen3-VL-style request (dynamic-resolution landscape grid) is
+    pinned against a single-process run, plus a text request after it
+    (protocol state stays in sync across the mm message)."""
+    ref = subprocess.run(
+        [sys.executable, "-c", REFERENCE_MM], env=_env(4),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    want = _extract(ref.stdout)
+
+    coord = f"127.0.0.1:{free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER_MM, str(pid), coord],
+            env=_env(2),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=600)
+            assert p.returncode == 0, stderr[-2000:]
+            outs.append(stdout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    got = _extract(outs[0])
+    assert "FOLLOWER done" in outs[1]
+    assert got == want, (got, want)

@@ -79,9 +79,14 @@ def test_mm_submit_validation():
     eng = _mk()
     with pytest.raises(ValueError, match="soft tokens"):
         eng.submit([1, 2, 3], SamplingParams(max_tokens=4), images=_image())
-    with pytest.raises(ValueError, match="images"):
+    with pytest.raises(ValueError, match="blocks"):
+        # 2 images against a 1-run prompt: block/soft-token mismatch
         eng.submit(PROMPT, SamplingParams(max_tokens=4),
-                   images=np.concatenate([_image(), _image()]))  # > max
+                   images=np.concatenate([_image(), _image()]))
+    with pytest.raises(ValueError, match="blocks"):
+        # over the per-request block budget (default 4)
+        eng.submit(PROMPT, SamplingParams(max_tokens=4),
+                   images=np.concatenate([_image()] * 5))
     text_eng = Engine(EngineConfig(
         model="debug-tiny", dtype="float32", max_decode_slots=2,
         page_size=8, num_pages=32, pages_per_slot=4, prefill_buckets=(16,)))
@@ -539,3 +544,249 @@ def test_qwen_dynamic_resolution_multi_image_engine():
     with _pytest.raises(ValueError):
         mk().submit(list(prompt), SamplingParams(max_tokens=2),
                     images=[bad, land])
+
+
+# ---------------------------------------------------------------------------
+# video input (round 4): Qwen3-VL frame blocks + timestamp text
+# ---------------------------------------------------------------------------
+
+def _gif_data_url(n_frames=5, size=(20, 20)):
+    from PIL import Image
+
+    frames = [Image.new("RGB", size, (40 * i % 255, 30, 200 - 30 * i))
+              for i in range(n_frames)]
+    buf = io.BytesIO()
+    frames[0].save(buf, "GIF", save_all=True, append_images=frames[1:],
+                   duration=100, loop=0)
+    return "data:image/gif;base64," + base64.b64encode(buf.getvalue()).decode()
+
+
+def test_video_engine_generates_and_differs_from_stills():
+    """Engine-level video: one [F, H, W, C] entry = F/tp frame blocks,
+    each an image-like soft-token run; real frame pairs through the
+    conv3d make the output differ from the same frames as stills."""
+    qcfg = get_config("debug-qwen-mm")
+    run = ([qcfg.boi_token_id] + [qcfg.image_token_id] * 4
+           + [qcfg.eoi_token_id])
+    # video of 4 frames = 2 temporal patches = 2 runs, with "timestamp
+    # text" tokens between them (any text ids work at engine level)
+    prompt = [1] + run + [70, 71] + run + [9]
+    rng = np.random.default_rng(11)
+    frames = rng.standard_normal((4, 16, 16, 3)).astype(np.float32)
+
+    def mk():
+        return Engine(EngineConfig(
+            model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+            page_size=8, num_pages=64, pages_per_slot=8,
+            prefill_buckets=(32,)))
+
+    def gen(eng, images):
+        req = eng.submit(list(prompt), SamplingParams(
+            temperature=0.0, max_tokens=4), images=images)
+        steps = 0
+        while not req.finished:
+            eng.step()
+            steps += 1
+            assert steps < 10_000
+        return req.output
+
+    video_out = gen(mk(), [frames])
+    assert len(video_out) == 4
+    assert gen(mk(), [frames]) == video_out          # deterministic
+    # same frames as two stills (frames 0 and 2): different conv3d input
+    stills_out = gen(mk(), [frames[0], frames[2]])
+    assert stills_out != video_out
+
+    # validation: odd frame counts are rejected
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="multiple"):
+        mk().submit(list(prompt), SamplingParams(max_tokens=2),
+                    images=[frames[:3]])
+    # chunk budget: a video longer than the block budget is rejected
+    big = rng.standard_normal((12, 16, 16, 3)).astype(np.float32)
+    with _pytest.raises(ValueError, match="blocks"):
+        mk().submit(list(prompt), SamplingParams(max_tokens=2),
+                    images=[big])
+
+
+def test_chat_completions_with_video_e2e():
+    """HTTP: a video_url data URL (animated GIF) becomes timestamp text +
+    one image-placeholder run per temporal patch."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    qcfg = get_config("debug-qwen-mm")
+
+    class QwenMMTok(MMTestTokenizer):
+        def apply_chat_template(self, messages, tools=None):
+            ids = [257]
+            for m in messages:
+                content = m.get("content", "")
+                if isinstance(content, list):
+                    for part in content:
+                        if part.get("type") == "image":
+                            ids += [qcfg.boi_token_id, qcfg.image_token_id,
+                                    qcfg.eoi_token_id]
+                        else:
+                            ids += self.encode(part.get("text", ""))
+                else:
+                    ids += self.encode(content)
+            return ids
+
+    eng = Engine(EngineConfig(
+        model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=128, pages_per_slot=16,
+        prefill_buckets=(64, 128)))
+    server = OpenAIServer(eng, QwenMMTok(), "debug-qwen-mm")
+
+    async def go():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-qwen-mm",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "clip: "},
+                    {"type": "video_url",
+                     "video_url": {"url": _gif_data_url(5)}},
+                ]}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            # 5 frames pad to 6 = 3 temporal patches: 3 timestamp texts
+            # ("<0.1 seconds>" etc) + 3 runs of (start + 4 soft + end)
+            usage = data["usage"]["prompt_tokens"]
+            # bos(1) + "clip: "(6) + 3 * (len("<x.x seconds>") + 6)
+            ts_len = len("<0.1 seconds>")
+            assert usage == 1 + 6 + 3 * (ts_len + 6), usage
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_qwen3vl_full_model_video_parity(tmp_path):
+    """Full-model logit parity for VIDEO input: our engine renders a
+    video as per-temporal-patch frame blocks at image placeholders with
+    timestamp text between (the Qwen3-VL prompt convention); HF consumes
+    video_token placeholders + pixel_values_videos. Same positions, same
+    embeds -> same logits."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from llms_on_kubernetes_tpu.configs import from_hf_config
+    from llms_on_kubernetes_tpu.engine.weights import load_hf_params
+    from llms_on_kubernetes_tpu.models.vision import (
+        _qwen_patchify_video, encode_video_qwen3vl, qwen_mrope_positions,
+    )
+
+    g_cfg = transformers.Qwen3VLConfig(
+        text_config=dict(
+            vocab_size=128, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+            rope_scaling={"rope_type": "default", "mrope_section": [3, 3, 2],
+                          "mrope_interleaved": True},
+        ),
+        vision_config=dict(
+            hidden_size=32, intermediate_size=64, depth=2, num_heads=2,
+            patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+            out_hidden_size=48, num_position_embeddings=16,
+            deepstack_visual_indexes=[0, 1], in_channels=3,
+            hidden_act="gelu_pytorch_tanh", image_size=16,
+        ),
+        image_token_id=96, video_token_id=95,
+        vision_start_token_id=97, vision_end_token_id=98,
+    )
+    hf = transformers.Qwen3VLForConditionalGeneration(g_cfg)
+    torch.manual_seed(0)
+    for p in hf.parameters():
+        torch.nn.init.normal_(p, std=0.05)
+    hf = hf.eval().to(torch.float32)
+    hf.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    cfg = from_hf_config(json.loads((tmp_path / "config.json").read_text()),
+                         name="qwen-video-tiny")
+    params = load_hf_params(cfg, str(tmp_path), dtype="float32")
+
+    rng = np.random.default_rng(9)
+    frames = rng.standard_normal((4, 16, 16, 3)).astype(np.float32)  # T'=2
+
+    # two frame blocks with "timestamp text" tokens between them — HF
+    # places video embeds at video_token(95); we use image_token(96) at
+    # the SAME positions (the only id difference; positions/mrope match)
+    def block(tok):
+        return [97] + [tok] * 4 + [98]
+    text1, text2, tail = [30, 31], [32, 33], [11, 12]
+    ours = [2] + text1 + block(96) + text2 + block(96) + tail
+    hf_ids = [2] + text1 + block(95) + text2 + block(95) + tail
+
+    import jax.numpy as jnp
+
+    from llms_on_kubernetes_tpu.engine.cache import (
+        CacheConfig, PageAllocator, init_pages,
+    )
+    from llms_on_kubernetes_tpu.models.decoder import forward_prefill_mm
+
+    cc = CacheConfig(num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+                     head_dim=cfg.head_dim, num_pages=32, page_size=4,
+                     pages_per_slot=8, dtype="float32")
+    kp, vp = init_pages(cc)
+    al = PageAllocator(cc.num_pages, cc.page_size, 1, cc.pages_per_slot)
+    al.allocate(0, len(ours))
+    soft, deep = encode_video_qwen3vl(params["vision"], cfg.vision,
+                                      jnp.asarray(frames))
+    # each 16x16 frame block is a square (2, 2) merged grid == the
+    # default square layout, so grids=None
+    pos3, _ = qwen_mrope_positions(ours, 96, 4)
+    logits, _, _ = forward_prefill_mm(
+        params, cfg, jnp.asarray([ours], jnp.int32),
+        jnp.asarray([len(ours)], jnp.int32), kp, vp,
+        jnp.asarray(al.page_tables), soft[None],
+        deepstack=deep.reshape(deep.shape[0], 1, -1, deep.shape[-1]),
+        pos3=jnp.asarray(pos3[None]),
+    )
+    got = np.asarray(logits)[0]
+
+    flat = np.asarray(_qwen_patchify_video(jnp.asarray(frames), cfg.vision))[0]
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.tensor([hf_ids]),
+            pixel_values_videos=torch.tensor(flat),
+            video_grid_thw=torch.tensor([[2, 4, 4]]),
+        ).logits[0, -1].numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+def test_video_rejected_on_text_model_is_400():
+    """video_url against a text-only model must be a 400 (round-4 review:
+    the vision-None guard ran after _extract_video, yielding a 500)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=32, pages_per_slot=4, prefill_buckets=(16,)))
+    server = OpenAIServer(eng, ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": [
+                    {"type": "video_url",
+                     "video_url": {"url": _gif_data_url(3)}}]}],
+                "max_tokens": 2})
+            assert r.status == 400
+            assert "video" in (await r.json())["error"]["message"]
+        finally:
+            await client.close()
+
+    asyncio.run(go())

@@ -223,14 +223,33 @@ def test_awq_checkpoint_loads_with_logit_parity(tmp_path):
     cfg = from_hf_config(base_cfg, name="awq-tiny")
     params_awq = load_hf_params(cfg, str(tmp_path / "awq"), dtype="float32",
                                 quantization="awq")
-    params_ref = load_hf_params(cfg, str(ref_dir), dtype="float32",
-                                quantization="int8")
+    # round 4: AWQ executes NATIVELY (GroupQTensor int4 + group scales/
+    # zeros — ops/quant.py), no int8 re-quantization approximation
+    from llms_on_kubernetes_tpu.ops.quant import GroupQTensor
+
+    wq = params_awq["layers"]["wq"]
+    assert isinstance(wq, GroupQTensor)
+    assert str(wq.data.dtype) == "int4"
+    # the group path is algebraically exact vs the full-precision dequant
+    # of the same tensors (fp association tolerance only)
+    params_ref = load_hf_params(cfg, str(ref_dir), dtype="float32")
     prompt = [1, 5, 9, 42, 17, 3]
     logits_awq = _prefill_logits(cfg, params_awq, prompt)
     logits_ref = _prefill_logits(cfg, params_ref, prompt)
-    np.testing.assert_allclose(logits_awq, logits_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(logits_awq, logits_ref, rtol=2e-4, atol=2e-4)
 
-    # close to the original model (4-bit group quant + int8 error)
+    # int8 storage override serves the same numbers (backends w/o int4)
+    import os as _os
+    _os.environ["LLMK_AWQ_STORAGE"] = "int8"
+    try:
+        params_i8 = load_hf_params(cfg, str(tmp_path / "awq"),
+                                   dtype="float32", quantization="awq")
+    finally:
+        del _os.environ["LLMK_AWQ_STORAGE"]
+    logits_i8 = _prefill_logits(cfg, params_i8, prompt)
+    np.testing.assert_allclose(logits_i8, logits_awq, rtol=1e-5, atol=1e-5)
+
+    # close to the original model (4-bit group quant error only)
     import torch
     with torch.no_grad():
         want = hf(torch.tensor([prompt])).logits[0, -1].numpy()
@@ -244,3 +263,53 @@ def test_unsupported_quant_method_rejected(tmp_path):
         {"quantization_config": {"quant_method": "gptq"}}))
     with pytest.raises(ValueError, match="unsupported quant_method"):
         checkpoint_quantization(str(d))
+
+
+def test_awq_native_engine_e2e_and_tp_sharded(tmp_path):
+    """The native AWQ path through the FULL engine (layer-stacked
+    GroupQTensors riding the lax.scan) and under a TP mesh (flat output
+    axis column-parallel, contraction replicated)."""
+    group = 16
+    seed_dir, _hf = _seed_model(tmp_path)
+    base_cfg = json.loads((seed_dir / "config.json").read_text())
+    tensors = _load_tensors(seed_dir)
+    awq_tensors = {}
+    for name, w in tensors.items():
+        if any(lin in name for lin in LINEARS):
+            qweight, qzeros, scales, _ = _awq_pack(w, group)
+            base = name[:-len("weight")]
+            awq_tensors[base + "qweight"] = qweight
+            awq_tensors[base + "qzeros"] = qzeros
+            awq_tensors[base + "scales"] = scales
+        else:
+            awq_tensors[name] = w
+    _write_ckpt(tmp_path / "awq", awq_tensors, base_cfg,
+                {"quant_method": "awq", "bits": 4, "group_size": group,
+                 "version": "gemm"})
+
+    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+
+    def gen(mesh=None):
+        eng = Engine(
+            EngineConfig(model="awq-tiny", dtype="float32",
+                         max_decode_slots=2, page_size=8, num_pages=32,
+                         pages_per_slot=8, prefill_buckets=(16,),
+                         quantization="awq"),
+            model_config=from_hf_config(base_cfg, name="awq-tiny"),
+            model_dir=str(tmp_path / "awq"), mesh=mesh)
+        req = eng.submit([1, 5, 9, 42], SamplingParams(
+            temperature=0.0, max_tokens=6))
+        steps = 0
+        while not req.finished:
+            eng.step()
+            steps += 1
+            assert steps < 10_000
+        return req.output
+
+    single = gen()
+    assert len(single) == 6
+
+    from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+    tp = gen(make_mesh(data=1, expert=1, model=2))
+    assert tp == single  # TP sharding must not change greedy output

@@ -254,3 +254,72 @@ def test_qwen_mrope_positions_dynamic_grids():
     pos2, _ = qwen_mrope_positions(toks, 99, 4, grids=[(4, 1)])
     assert pos2[1, 1:5].tolist() == [1, 2, 3, 4]
     assert pos2[2, 1:5].tolist() == [1, 1, 1, 1]
+
+
+def test_qwen3vl_video_encode_matches_hf():
+    """Video path: real consecutive frames fill the conv3d temporal dim
+    and each temporal patch is its own attention span (HF cu_seqlens =
+    repeat_interleave(h*w, t)). Pinned against the HF tower fed the same
+    processor-ordered video patches with grid_thw=[[T', h, w]]."""
+    torch = pytest.importorskip("torch")
+    import transformers
+    import numpy as np
+
+    from llms_on_kubernetes_tpu.models.vision import (
+        VisionConfig, _qwen_patchify_video, encode_video_qwen3vl,
+        load_qwen3vl_vision_params,
+    )
+    from transformers.models.qwen3_vl.configuration_qwen3_vl import (
+        Qwen3VLVisionConfig,
+    )
+
+    hf_vcfg = Qwen3VLVisionConfig(
+        hidden_size=32, intermediate_size=64, depth=3, num_heads=2,
+        patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+        out_hidden_size=48, num_position_embeddings=16,
+        deepstack_visual_indexes=[0, 1], in_channels=3,
+        hidden_act="gelu_pytorch_tanh", initializer_range=0.05,
+    )
+    tower = transformers.models.qwen3_vl.modeling_qwen3_vl.Qwen3VLVisionModel(
+        hf_vcfg).eval()
+    tower.set_attn_implementation("eager")
+    torch.manual_seed(0)
+    for p in tower.parameters():
+        torch.nn.init.normal_(p, std=0.05)
+
+    vcfg = VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=3, num_heads=2,
+        image_size=16, patch_size=4, family="qwen3vl",
+        temporal_patch_size=2, spatial_merge_size=2, out_hidden_size=48,
+        num_grid_per_side=4, deepstack_indexes=(0, 1),
+        mm_tokens_per_image=4,
+    )
+    sd = {"model.visual." + k: v.detach().numpy()
+          for k, v in tower.state_dict().items()}
+    params = load_qwen3vl_vision_params(vcfg, lambda n: sd[n])
+
+    rng = np.random.default_rng(5)
+    frames = rng.standard_normal((6, 16, 16, 3)).astype(np.float32)  # T'=3
+    soft, deep = encode_video_qwen3vl(params, vcfg, jnp.asarray(frames))
+    assert soft.shape == (3, 4, 48)     # one t_img block per temporal patch
+    assert deep.shape == (2, 3, 4, 48)
+
+    flat = np.asarray(_qwen_patchify_video(jnp.asarray(frames), vcfg))[0]
+    with torch.no_grad():
+        want_soft, want_deep = tower(torch.tensor(flat),
+                                     grid_thw=torch.tensor([[3, 4, 4]]))
+    np.testing.assert_allclose(
+        np.asarray(soft).reshape(-1, 48), want_soft.numpy(),
+        rtol=2e-4, atol=2e-4)
+    for j, wd in enumerate(want_deep):
+        np.testing.assert_allclose(
+            np.asarray(deep)[j].reshape(-1, 48), wd.numpy(),
+            rtol=2e-4, atol=2e-4)
+
+    # and a video differs from the same frames encoded as stills
+    # (duplicated-frame conv3d input vs real pairs)
+    from llms_on_kubernetes_tpu.models.vision import encode_images_qwen3vl
+
+    stills, _ = encode_images_qwen3vl(params, vcfg,
+                                      jnp.asarray(frames[0::2]))
+    assert not np.allclose(np.asarray(soft), np.asarray(stills), atol=1e-3)
