@@ -300,9 +300,12 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     # background load: fill the decode batch during the probes (throughput
     # through the gateway is only meaningful at capacity). ONE asyncio
     # client thread drives all load connections — a thread per connection
-    # would measure GIL churn, not the serving path.
+    # would measure GIL churn, not the serving path. 96-token outputs:
+    # short gens churn the admission queue every ~0.5 s and the probe then
+    # mostly measures competition with re-admission waves rather than
+    # prefill-under-load (median serving outputs are longer than 48).
     n_load = max(8, eng.config.max_decode_slots - 2)
-    gen = 48
+    gen = 96
     load_done = threading.Event()
     load_wall_box: dict = {}
 

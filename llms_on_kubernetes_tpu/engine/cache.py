@@ -144,6 +144,67 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 _MAX_RMW_PAGES = 33
 
 
+def _scatter_decode_writes() -> bool:
+    """Decode (T==1) write strategy (LLMK_KV_WRITE=scatter|dus).
+
+    The per-slot DUS loop costs ~0.7 us PER OP in dispatch overhead
+    (profiled round 4: 4096 ops = 3.0 ms of a 23 ms Llama-3-8B step at
+    B=64). One scatter per (layer, side) cuts the op count 64x and is
+    MOSTLY in place — but XLA's TPU scatter lowering reserves one
+    ~0.37-pool-sized HBM temp (measured 786 MB for the 2.15 GB bench
+    pool; identical for 2-D and linearized index forms), which pushes the
+    Llama-3-8B@16GB-v5e bench config 786 MB past HBM at COMPILE time. So
+    DUS stays the default; scatter is the right choice whenever the
+    deployment has that much HBM headroom (smaller models, v5p, larger
+    slices)."""
+    import os
+
+    return os.environ.get("LLMK_KV_WRITE", "dus") == "scatter"
+
+
+def _write_decode_scatter(kd, vd, ksc, vsc, k, v, ks, vs, pid, off, pos,
+                          owner, dt):
+    """One scatter per side for the whole decode batch.
+
+    Indices are UNIQUE by construction (each active slot appends into its
+    own page; rows to drop get pid = pool_size + row, distinct and out of
+    range so mode="drop" discards them without breaking the uniqueness
+    promise)."""
+    import os
+
+    B = pid.shape[0]
+    total = kd.shape[1]
+    oob = total + jnp.arange(B, dtype=pid.dtype)
+    drop = pos < 0
+    if owner is not None:
+        base, width = owner
+        lpid = pid - base
+        drop = drop | (lpid < 0) | (lpid >= width)
+        pid = lpid
+    pid = jnp.where(drop, oob, pid)
+    kh = jnp.moveaxis(k[:, 0].astype(dt), 1, 0)        # [n_kv, B, d]
+    vh = jnp.moveaxis(v[:, 0].astype(dt), 1, 0)
+    if os.environ.get("LLMK_SCATTER_VARIANT") == "linear":
+        # single-dim scatter on a [n_kv, flat*page, d] view: one index
+        # vector, simplest possible lowering
+        page = kd.shape[2]
+        lin = pid * page + off
+        n_kv, total_p, _, d = kd.shape
+        kd = kd.reshape(n_kv, total_p * page, d).at[:, lin].set(
+            kh, unique_indices=True, mode="drop").reshape(kd.shape)
+        vd = vd.reshape(n_kv, total_p * page, d).at[:, lin].set(
+            vh, unique_indices=True, mode="drop").reshape(vd.shape)
+    else:
+        kd = kd.at[:, pid, off].set(kh, unique_indices=True, mode="drop")
+        vd = vd.at[:, pid, off].set(vh, unique_indices=True, mode="drop")
+    if ks is not None:
+        ksc = ksc.at[:, pid, off].set(ks[:, 0].T, unique_indices=True,
+                                      mode="drop")
+        vsc = vsc.at[:, pid, off].set(vs[:, 0].T, unique_indices=True,
+                                      mode="drop")
+    return KVPool(kd, ksc), KVPool(vd, vsc)
+
+
 def write_tokens(
     k_pages: "KVPool",
     v_pages: "KVPool",
@@ -211,6 +272,9 @@ def write_tokens(
         # padding -> trash page 0 (never read; keeps the write unconditional)
         pid = jnp.where(pos < 0, 0, pid)
         off = jnp.where(pos < 0, 0, safe % page)
+        if _scatter_decode_writes():
+            return _write_decode_scatter(
+                kd, vd, ksc, vsc, k, v, ks, vs, pid, off, pos, owner, dt)
         owned = None
         if owner is not None:
             base, width = owner

@@ -75,6 +75,10 @@ class ProtoShapes:
     n_img_max: int = 0
     img_floats: int = 0   # pixels per image row: S^2 * p^2 * C
     mrope: bool = False
+    # frames per pixel-buffer row: a video temporal patch is
+    # temporal_patch_size real frames; one row holds exactly one image OR
+    # one temporal patch, so total rows <= total blocks <= n_img_max
+    mm_row_frames: int = 2
 
     @classmethod
     def from_engine_config(cls, cfg: Any,
@@ -107,11 +111,6 @@ class ProtoShapes:
             "pre_packed": np.zeros((self.admit_batch, self.pre_width), np.int32),
             "dec_packed": np.zeros((self.num_slots, self.dec_width), np.int32),
         }
-
-    # frames per pixel-buffer row: a video temporal patch is
-    # temporal_patch_size real frames; one row holds exactly one image OR
-    # one temporal patch, so total rows <= total blocks <= n_img_max
-    mm_row_frames: int = 2
 
     def mm_zeros(self) -> dict:
         """The second (mm-only) broadcast: entry pixels flattened into

@@ -290,10 +290,10 @@ def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int,
     else:
         if not {"w_gate", "w_up"} <= pre.keys():
             try:
-                out["w_gate"] = pre.get("w_gate") or fetch.linear(
-                    p + "mlp.gate_proj.weight")
-                out["w_up"] = pre.get("w_up") or fetch.linear(
-                    p + "mlp.up_proj.weight")
+                out["w_gate"] = (pre["w_gate"] if "w_gate" in pre
+                                 else fetch.linear(p + "mlp.gate_proj.weight"))
+                out["w_up"] = (pre["w_up"] if "w_up" in pre
+                               else fetch.linear(p + "mlp.up_proj.weight"))
             except KeyError:
                 gu = fetch(p + "mlp.gate_up_proj.weight")  # phi3 fused [2F, D]
                 g, u = np.split(gu, 2, axis=0)

@@ -100,7 +100,7 @@ def dispatch_write_tokens(k_pages, v_pages, k, v, page_table, positions):
 # decode attention: partial softmax per shard + one psum merge
 # ---------------------------------------------------------------------------
 
-def _owned_token_mask(page_table, base, W, B, page):
+def _owned_token_mask(page_table, base, W, page):
     """[B, S] bool: key tokens whose (flat) page this device owns."""
     local = page_table - base                       # [B, pages_per_seq]
     owned = (local >= 0) & (local < W)
@@ -142,7 +142,7 @@ def cp_paged_attention(q, k_pages, v_pages, page_table, lengths, *, scale,
         data = kp.data if hasattr(kp, "data") else kp
         W = data.shape[1]
         S = pt.shape[1] * page
-        tok_owned, local_pt = _owned_token_mask(pt, r * W, W, B, page)
+        tok_owned, local_pt = _owned_token_mask(pt, r * W, W, page)
         k = _gather_pool(kp, local_pt, B, S, d)      # [n_kv_l, B, S, d]
         v = _gather_pool(vp, local_pt, B, S, d)
         nk = k.shape[0]
@@ -191,7 +191,7 @@ def cp_chunk_attention(q, k_pages, v_pages, page_table, history,
         data = kp.data if hasattr(kp, "data") else kp
         W = data.shape[1]
         S = pt.shape[1] * page
-        tok_owned, local_pt = _owned_token_mask(pt, r * W, W, B, page)
+        tok_owned, local_pt = _owned_token_mask(pt, r * W, W, page)
         k = _gather_pool(kp, local_pt, B, S, d)
         v = _gather_pool(vp, local_pt, B, S, d)
         nk = k.shape[0]
@@ -211,11 +211,7 @@ def cp_chunk_attention(q, k_pages, v_pages, page_table, history,
                       jnp.exp(logits - m[..., None]), 0.0)
         den = p.sum(axis=-1)
         num = jnp.einsum("bkgts,kbsd->bkgtd", p, v)
-        M = jax.lax.pmax(m, AXIS_SEQ)
-        w = jnp.where(m > _HALF_NEG, jnp.exp(m - M), 0.0)
-        num = jax.lax.psum(num * w[..., None], AXIS_SEQ)
-        den = jax.lax.psum(den * w, AXIS_SEQ)
-        out = num / jnp.maximum(den, 1e-30)[..., None]   # [B, nk, g, T, d]
+        out = _merge_partials(num, den, m, AXIS_SEQ)     # [B, nk, g, T, d]
         out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, qq.shape[2], d)
         return out.astype(qq.dtype)
 

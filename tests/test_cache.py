@@ -113,3 +113,42 @@ def test_cache_config_accounting():
     assert kq.scale.shape == (4, 2 * 16, 8)
     # int8 halves the per-page bytes vs bf16 (scale adds 4B per token)
     assert cq.bytes_per_page < cc.bytes_per_page
+
+
+def test_scatter_decode_writes_match_dus(monkeypatch):
+    """LLMK_KV_WRITE=scatter (for HBM-headroom deployments) must write
+    bit-identically to the default DUS path, including padding rows and
+    int8-quantized pools."""
+    import os
+
+    import jax.numpy as jnp
+
+    from llms_on_kubernetes_tpu.engine.cache import (
+        CacheConfig, init_pages, write_tokens,
+    )
+
+    for kv_dtype in (None, "int8"):
+        cfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=8,
+                          num_pages=24, page_size=4, pages_per_slot=4,
+                          dtype="float32", kv_dtype=kv_dtype)
+        rng = np.random.default_rng(0)
+        B = 5
+        k = jnp.asarray(rng.standard_normal((B, 1, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, 1, 2, 8)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(23)[:B * 4].reshape(B, 4) + 1,
+                         jnp.int32)
+        pos = jnp.asarray([[3], [0], [7], [-1], [5]], jnp.int32)  # one pad
+
+        outs = {}
+        for mode in ("dus", "scatter"):
+            monkeypatch.setenv("LLMK_KV_WRITE", mode)
+            kp, vp = init_pages(cfg)
+            kp2, vp2 = write_tokens(kp, vp, k, v, pt, pos)
+            outs[mode] = (np.asarray(kp2.data), np.asarray(vp2.data),
+                          None if kp2.scale is None else np.asarray(kp2.scale))
+        for a, b in zip(outs["dus"], outs["scatter"]):
+            if a is not None:
+                # page 0 is the never-read trash page: DUS routes padded
+                # rows there, scatter drops them — both fine, not
+                # bit-identical. Every REAL page must match exactly.
+                np.testing.assert_array_equal(a[:, 1:], b[:, 1:])
