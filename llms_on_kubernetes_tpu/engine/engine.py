@@ -774,6 +774,7 @@ class Engine:
         self._est_step = 0.02
         self._busy_until = 0.0
         self._last_harvest_t: Optional[float] = None
+        self._score_jit = None  # lazy: prompt scoring (echo+logprobs)
 
     # ------------------------------------------------------------------
     # submission
@@ -1840,3 +1841,39 @@ class Engine:
         while not req.finished:
             self.step()
         return req.output
+
+    def score_prompt(self, prompt: list[int], top_k: int = 8):
+        """Per-position prompt logprobs (the OpenAI ``echo+logprobs`` /
+        vLLM ``prompt_logprobs`` surface): returns
+        (token_logprobs [len-1], top_ids [len, k], top_logprobs [len, k])
+        where token_logprobs[i] scores prompt[i+1].
+
+        Thread-safe against the engine loop: the scoring forward is
+        cache-free (decoder.forward_score — writes go to a private dummy
+        trash pool), touches no donated engine state, and the device
+        serializes it between scheduler steps. Unsupported on seq-parallel
+        meshes (the scoring pool is unsharded)."""
+        from llms_on_kubernetes_tpu.models.decoder import forward_score
+        from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ
+
+        if self.mesh is not None and int(self.mesh.shape.get(AXIS_SEQ, 1)) > 1:
+            raise ValueError("prompt scoring is not supported under "
+                             "sequence-parallel serving")
+        if len(prompt) > self.config.max_model_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds max_model_len="
+                f"{self.config.max_model_len}")
+        if self._score_jit is None:
+            self._score_jit = jax.jit(forward_score, static_argnums=(1, 4))
+        n = len(prompt)
+        bucket = next((b for b in self.config.prefill_buckets if n <= b),
+                      None)
+        T = bucket if bucket is not None else n
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, :n] = prompt
+        nxt_lp, top_ids, top_lp = self._score_jit(
+            self.params, self.model_config, jnp.asarray(tokens),
+            jnp.asarray([n], jnp.int32), top_k)
+        host = jax.device_get((nxt_lp, top_ids, top_lp))
+        return (host[0][0, :n - 1].tolist(),
+                host[1][0, :n].tolist(), host[2][0, :n].tolist())

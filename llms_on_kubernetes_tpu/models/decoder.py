@@ -184,6 +184,7 @@ def _layer_step(
     mm_groups: "jnp.ndarray | None" = None,
     mm_pos3: "jnp.ndarray | None" = None,  # [B, 3, T] qwen3vl mrope
     rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
+    token_valid: "jnp.ndarray | None" = None,  # [B, T]; default: writes>=0
 ):
     scale = (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
     # Gemma-2/3 interleaved attention: layer is global iff (i+1) % pattern == 0;
@@ -241,7 +242,9 @@ def _layer_step(
     x = x + out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
-    m = _mlp(lp, cfg, h, token_valid=write_positions >= 0)
+    m = _mlp(lp, cfg, h,
+             token_valid=(write_positions >= 0 if token_valid is None
+                          else token_valid))
     if cfg.post_norms:
         m = rms_norm(m, lp["mlp_post_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     x = x + m
@@ -265,6 +268,7 @@ def _run_layers(
     mm_idx: "jnp.ndarray | None" = None,      # [B, T] soft-token index
     mm_is_img: "jnp.ndarray | None" = None,   # [B, T] image-token mask
     rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
+    token_valid: "jnp.ndarray | None" = None,  # [B, T] MoE routing mask
 ):
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling))
     inv_freq_local = (
@@ -295,7 +299,7 @@ def _run_layers(
             cfg, inv_freq, pt, positions, write_positions, lengths, mode,
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
             mm_groups=mm_groups, mm_pos3=mm_pos3,
-            rope_positions=rope_positions,
+            rope_positions=rope_positions, token_valid=token_valid,
         )
         if deepstack is not None:
             # DeepStack (Qwen3-VL): intermediate vision features are ADDED
@@ -361,6 +365,77 @@ def forward_prefill(
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
     return _logits(params, cfg, x_last), k_pages, v_pages
+
+
+def forward_score(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [1, T] padded prompt bucket
+    lengths: jnp.ndarray,     # [1]
+    top_k: int = 8,
+):
+    """Score a prompt: per-position logprob of the NEXT prompt token and
+    the top-k alternatives at every position — the OpenAI ``echo`` +
+    ``logprobs`` surface (prompt-token logprobs; vLLM ``prompt_logprobs``),
+    which the serving prefill cannot provide (it keeps only the LAST
+    position's logits).
+
+    Cache-free: the causal attention runs over the in-flight k/v only, and
+    writes are routed to a caller-provided single-page dummy pool (every
+    write position is -1 = the trash page), so scoring never touches — and
+    cannot corrupt — the serving engine's paged pool. The [T, V] logits
+    reduce to [T] + [T, k] ON DEVICE; only those small arrays cross the
+    host boundary.
+
+    Returns (next_logprob [1, T] f32 — entry t scores tokens[t+1]; the
+    last valid entry and padding are 0 —, top_ids [1, T, k] int32,
+    top_logprobs [1, T, k] f32).
+    """
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    write_positions = jnp.full((B, T), -1, jnp.int32)  # all writes -> trash
+    # MoE routing validity must NOT come from write_positions here (every
+    # write is routed to trash): all -1 would mask every expert claim and
+    # zero the whole MLP on MoE models — round-4 review finding
+    token_valid = positions < lengths[:, None]
+    from llms_on_kubernetes_tpu.engine.cache import KVPool
+
+    dummy_shape = (cfg.num_kv_heads, cfg.num_layers, 1, cfg.head_dim)
+    k_pages = KVPool(jnp.zeros(dummy_shape, jnp.float32))
+    v_pages = KVPool(jnp.zeros(dummy_shape, jnp.float32))
+    page_table = jnp.zeros((B, 1), jnp.int32)
+    x = _embed(params, cfg, tokens)
+    x, _, _ = _run_layers(
+        cfg, params, x, k_pages, v_pages, page_table,
+        positions, write_positions, lengths, "prefill",
+        token_valid=token_valid,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 style=cfg.norm_style)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    nxt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)  # shift
+    # slab the head projection: a monolithic [T, V] f32 logits buffer is a
+    # multi-GB transient at long buckets x 128k vocab (round-4 review
+    # finding); 512-token slabs bound it to ~256 MB while each slab
+    # reduces to [t] + [t, k] before the next is computed
+    slab = min(512, T)
+    nxt_lps, tids, tlps = [], [], []
+    for s in range(0, T, slab):
+        logits = jnp.einsum("btd,dv->btv", x[:, s:s + slab],
+                            head.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        logits = softcap(logits, cfg.logit_softcap)
+        lse = jax.nn.logsumexp(logits, axis=-1)                   # [B, t]
+        nxt_lps.append(jnp.take_along_axis(
+            logits, nxt[:, s:s + slab, None], axis=-1)[..., 0] - lse)
+        lp, ids = jax.lax.top_k(logits, top_k)                    # exact
+        tids.append(ids.astype(jnp.int32))
+        tlps.append(lp - lse[..., None])
+    nxt_lp = jnp.concatenate(nxt_lps, axis=1)
+    valid = positions < (lengths[:, None] - 1)
+    nxt_lp = jnp.where(valid, nxt_lp, 0.0)
+    return (nxt_lp, jnp.concatenate(tids, axis=1),
+            jnp.concatenate(tlps, axis=1))
 
 
 def forward_prefill_mm(
