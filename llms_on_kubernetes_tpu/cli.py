@@ -48,6 +48,10 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
                    help="store KV quantized (halved decode HBM traffic, "
                         "2x token capacity; ~1/127 per-element error)")
+    p.add_argument("--max-images-per-request", type=int, default=4,
+                   help="image/frame blocks the mm prefill is compiled for "
+                        "(a video counts one block per temporal patch); "
+                        "requests beyond it get a 400")
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -208,6 +212,7 @@ def main(argv: list[str] | None = None) -> int:
         quantization=args.quantization,
         prefix_caching=args.prefix_caching,
         kv_cache_dtype=args.kv_cache_dtype,
+        max_images_per_request=args.max_images_per_request,
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
     )

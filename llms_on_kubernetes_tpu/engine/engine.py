@@ -774,7 +774,13 @@ class Engine:
         self._est_step = 0.02
         self._busy_until = 0.0
         self._last_harvest_t: Optional[float] = None
-        self._score_jit = None  # lazy: prompt scoring (echo+logprobs)
+        # prompt scoring (echo+logprobs): wrapper built eagerly — jit()
+        # itself is free, compilation is per-shape on first use, and an
+        # unsynchronized lazy init would let concurrent requests each pay
+        # a duplicate compile through their own wrapper
+        from llms_on_kubernetes_tpu.models.decoder import forward_score
+
+        self._score_jit = jax.jit(forward_score, static_argnums=(1, 4))
 
     # ------------------------------------------------------------------
     # submission
@@ -1842,38 +1848,51 @@ class Engine:
             self.step()
         return req.output
 
-    def score_prompt(self, prompt: list[int], top_k: int = 8):
+    def score_prompt(self, prompt: list[int]):
         """Per-position prompt logprobs (the OpenAI ``echo+logprobs`` /
         vLLM ``prompt_logprobs`` surface): returns
-        (token_logprobs [len-1], top_ids [len, k], top_logprobs [len, k])
-        where token_logprobs[i] scores prompt[i+1].
+        (token_logprobs [len-1], top_ids [len, K], top_logprobs [len, K])
+        where token_logprobs[i] scores prompt[i+1]; K is always
+        sampling.LOGPROB_TOPK (a per-request k would compile a separate
+        executable per value — callers slice).
 
         Thread-safe against the engine loop: the scoring forward is
         cache-free (decoder.forward_score — writes go to a private dummy
         trash pool), touches no donated engine state, and the device
         serializes it between scheduler steps. Unsupported on seq-parallel
-        meshes (the scoring pool is unsharded)."""
-        from llms_on_kubernetes_tpu.models.decoder import forward_score
+        meshes (the scoring pool is unsharded) and under multi-host (a
+        coordinator-only program over globally sharded params would
+        deadlock the pod group — scoring is not in the broadcast
+        protocol)."""
+        from llms_on_kubernetes_tpu.engine.sampling import LOGPROB_TOPK
         from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ
 
         if self.mesh is not None and int(self.mesh.shape.get(AXIS_SEQ, 1)) > 1:
             raise ValueError("prompt scoring is not supported under "
                              "sequence-parallel serving")
+        if self.config.multihost:
+            raise ValueError("prompt scoring is not supported under "
+                             "multi-host serving")
         if len(prompt) > self.config.max_model_len:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds max_model_len="
                 f"{self.config.max_model_len}")
-        if self._score_jit is None:
-            self._score_jit = jax.jit(forward_score, static_argnums=(1, 4))
         n = len(prompt)
+        # pad to a prefill bucket, or — past the largest bucket — to a
+        # multiple of it: unbounded per-length shapes would compile (and
+        # cache) one executable per distinct long-prompt length, and odd
+        # lengths fall off the flash kernel onto the [T, T]-materializing
+        # reference attention
         bucket = next((b for b in self.config.prefill_buckets if n <= b),
                       None)
-        T = bucket if bucket is not None else n
-        tokens = np.zeros((1, T), np.int32)
+        if bucket is None:
+            big = max(self.config.prefill_buckets)
+            bucket = -(-n // big) * big
+        tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
         nxt_lp, top_ids, top_lp = self._score_jit(
             self.params, self.model_config, jnp.asarray(tokens),
-            jnp.asarray([n], jnp.int32), top_k)
+            jnp.asarray([n], jnp.int32), LOGPROB_TOPK)
         host = jax.device_get((nxt_lp, top_ids, top_lp))
         return (host[0][0, :n - 1].tolist(),
                 host[1][0, :n].tolist(), host[2][0, :n].tolist())

@@ -734,8 +734,7 @@ class OpenAIServer:
             try:
                 prompt_scores = [
                     await loop.run_in_executor(
-                        None, self.engine.score_prompt, p,
-                        max(params.logprobs, 1))
+                        None, self.engine.score_prompt, p)
                     for p in prompts]
             except ValueError as e:  # e.g. sequence-parallel serving
                 for r in reqs:
@@ -954,6 +953,7 @@ class OpenAIServer:
             results = kept
 
         choices = []
+        prompt_blocks: dict = {}
         for i, (g, text, finish_reason, entries) in enumerate(results):
             if chat:
                 message = {"role": "assistant", "content": text}
@@ -984,8 +984,10 @@ class OpenAIServer:
                     lp = self._completion_logprobs(
                         entries, nlp, len(echo_text))
                     if prompt_scores is not None:
-                        pb = self._prompt_logprob_block(
-                            prompts[g], prompt_scores[g], nlp)
+                        if g not in prompt_blocks:  # once per prompt, not
+                            prompt_blocks[g] = self._prompt_logprob_block(
+                                prompts[g], prompt_scores[g], nlp)
+                        pb = prompt_blocks[g]       # per n/best_of choice
                         lp = {k: pb[k] + lp[k] for k in lp}
                     choice["logprobs"] = lp
             choices.append(choice)

@@ -216,7 +216,7 @@ def test_prompt_scoring_matches_full_softmax():
         model="debug-tiny", dtype="float32", max_decode_slots=2,
         page_size=8, num_pages=32, pages_per_slot=4, prefill_buckets=(16,)))
     prompt = [5, 9, 42, 17, 3, 7]
-    lps, top_ids, top_lps = eng.score_prompt(prompt, top_k=4)
+    lps, top_ids, top_lps = eng.score_prompt(prompt)
     assert len(lps) == len(prompt) - 1
     assert len(top_ids) == len(prompt)
 
@@ -352,7 +352,7 @@ def test_prompt_scoring_moe_not_zeroed():
         model="debug-moe", dtype="float32", max_decode_slots=2,
         page_size=8, num_pages=32, pages_per_slot=4, prefill_buckets=(16,)))
     prompt = [5, 9, 42, 17, 3, 7]
-    lps, _, _ = eng.score_prompt(prompt, top_k=4)
+    lps, _, _ = eng.score_prompt(prompt)
 
     # reference: serving prefill per prefix (experts active there)
     import jax.numpy as jnp
