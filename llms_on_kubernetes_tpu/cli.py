@@ -48,7 +48,13 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
                    help="store KV quantized (halved decode HBM traffic, "
                         "2x token capacity; ~1/127 per-element error)")
-    p.add_argument("--max-images-per-request", type=int, default=4,
+    def _positive_int(v: str) -> int:
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+        return n
+
+    p.add_argument("--max-images-per-request", type=_positive_int, default=4,
                    help="image/frame blocks the mm prefill is compiled for "
                         "(a video counts one block per temporal patch); "
                         "requests beyond it get a 400")

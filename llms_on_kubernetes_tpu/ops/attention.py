@@ -280,9 +280,11 @@ def dispatch_chunk_attention(q, k_pages, v_pages, page_table, history,
                              attn_softcap=None):
     from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
 
-    if seq_parallelism() > 1 and _static_window(sliding_window):
+    if seq_parallelism() > 1:
         # context-sharded pool: partial attention per page shard + one
-        # psum merge (ops/cp.py)
+        # psum merge (ops/cp.py). Traced (gemma interleaved) window sizes
+        # are fine here — shard_map hoists closed-over tracers as
+        # replicated inputs (pinned by tests/test_cp.py)
         from llms_on_kubernetes_tpu.ops.cp import cp_chunk_attention
 
         return cp_chunk_attention(
@@ -302,10 +304,11 @@ def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
                              scale, sliding_window=None, attn_softcap=None):
     from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
 
-    if seq_parallelism() > 1 and _static_window(sliding_window):
+    if seq_parallelism() > 1:
         # context-parallel decode: the pool is sharded over the seq axis,
         # so max context exceeds one device's page share; each device
         # attends over its own pages and one psum merges the partials
+        # (traced gemma window sizes hoist through the shard_map fine)
         from llms_on_kubernetes_tpu.ops.cp import cp_paged_attention
 
         return cp_paged_attention(

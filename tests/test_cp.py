@@ -99,3 +99,23 @@ def test_cp_with_int8_kv():
     ref = Engine(_cfg(kv_cache_dtype="int8"))
     prompt = list(np.random.default_rng(1).integers(1, 255, 24))
     assert _gen(eng, prompt, n=5) == _gen(ref, prompt, n=5)
+
+
+@pytest.mark.slow
+def test_cp_gemma_interleaved_windows():
+    """Gemma-style interleaved local/global layers carry their sliding
+    window as a TRACED scalar inside the layer scan; the CP attention
+    paths must accept it (shard_map hoists closed-over tracers) and match
+    the single-device reference — chunked prefill AND decode."""
+    mesh = make_mesh(data=1, seq=R, expert=1, model=1)
+
+    def gcfg():
+        return EngineConfig(
+            model="debug-gemma", dtype="float32", max_decode_slots=2,
+            page_size=8, num_pages=16, pages_per_slot=8,
+            prefill_buckets=(16,))
+
+    prompt = list(np.random.default_rng(5).integers(1, 255, 40))
+    got = _gen(Engine(gcfg(), mesh=mesh), prompt, n=6)
+    want = _gen(Engine(gcfg()), prompt, n=6)
+    assert got == want
