@@ -28,7 +28,8 @@ def _prefill_logits(cfg, params, prompt):
     return np.asarray(logits)[0]
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "mixtral", "qwen3_moe"])
+@pytest.mark.parametrize("family",
+                         ["llama", "qwen2", "mixtral", "qwen3_moe", "phi3"])
 def test_load_hf_checkpoint_logit_parity(tmp_path, family):
     torch = pytest.importorskip("torch")
     import transformers
@@ -50,6 +51,12 @@ def test_load_hf_checkpoint_logit_parity(tmp_path, family):
             num_local_experts=4, num_experts_per_tok=2, **common
         )
         hf = transformers.MixtralForCausalLM(hf_cfg)
+    elif family == "phi3":
+        # phi3: FUSED qkv_proj / gate_up_proj tensors — the KeyError
+        # fallback split path in hf_layer_maps, otherwise untested vs HF
+        # default pad_token_id (32000) would index past the tiny vocab
+        hf_cfg = transformers.Phi3Config(pad_token_id=0, **common)
+        hf = transformers.Phi3ForCausalLM(hf_cfg)
     else:  # qwen3_moe: qk-norm + mlp.experts.* naming + moe_intermediate_size
         hf_cfg = transformers.Qwen3MoeConfig(
             num_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
