@@ -144,8 +144,39 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 _MAX_RMW_PAGES = 33
 
 
+# Decode (T==1) write strategy. Part of the engine's STATIC config
+# (EngineConfig.kv_write, env LLMK_KV_WRITE as the default): the value is
+# baked into each engine's traced executables, so it is set via
+# set_kv_write_strategy() right before every dispatch block (the
+# set_active_mesh pattern) rather than read from the environment at trace
+# time — two engines in one process may differ, and mutating the env var
+# mid-process is no longer silently ignored (it was never re-read; now it
+# is explicitly documented as resolved once at EngineConfig construction).
+KV_WRITE_STRATEGIES = ("dus", "scatter", "scatter-linear")
+_active_kv_write = "dus"
+
+
+def set_kv_write_strategy(strategy: str) -> None:
+    global _active_kv_write
+    if strategy not in KV_WRITE_STRATEGIES:
+        raise ValueError(f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
+                         f"got {strategy!r}")
+    _active_kv_write = strategy
+
+
+def default_kv_write_strategy() -> str:
+    """Resolve the env default ONCE (EngineConfig construction time)."""
+    import os
+
+    s = os.environ.get("LLMK_KV_WRITE", "dus")
+    # legacy spelling: LLMK_KV_WRITE=scatter + LLMK_SCATTER_VARIANT=linear
+    if s == "scatter" and os.environ.get("LLMK_SCATTER_VARIANT") == "linear":
+        s = "scatter-linear"
+    return s if s in KV_WRITE_STRATEGIES else "dus"
+
+
 def _scatter_decode_writes() -> bool:
-    """Decode (T==1) write strategy (LLMK_KV_WRITE=scatter|dus).
+    """Decode (T==1) write strategy (see set_kv_write_strategy).
 
     The per-slot DUS loop costs ~0.7 us PER OP in dispatch overhead
     (profiled round 4: 4096 ops = 3.0 ms of a 23 ms Llama-3-8B step at
@@ -157,9 +188,7 @@ def _scatter_decode_writes() -> bool:
     DUS stays the default; scatter is the right choice whenever the
     deployment has that much HBM headroom (smaller models, v5p, larger
     slices)."""
-    import os
-
-    return os.environ.get("LLMK_KV_WRITE", "dus") == "scatter"
+    return _active_kv_write in ("scatter", "scatter-linear")
 
 
 def _write_decode_scatter(kd, vd, ksc, vsc, k, v, ks, vs, pid, off, pos,
@@ -170,8 +199,6 @@ def _write_decode_scatter(kd, vd, ksc, vsc, k, v, ks, vs, pid, off, pos,
     own page; rows to drop get pid = pool_size + row, distinct and out of
     range so mode="drop" discards them without breaking the uniqueness
     promise)."""
-    import os
-
     B = pid.shape[0]
     total = kd.shape[1]
     oob = total + jnp.arange(B, dtype=pid.dtype)
@@ -184,7 +211,7 @@ def _write_decode_scatter(kd, vd, ksc, vsc, k, v, ks, vs, pid, off, pos,
     pid = jnp.where(drop, oob, pid)
     kh = jnp.moveaxis(k[:, 0].astype(dt), 1, 0)        # [n_kv, B, d]
     vh = jnp.moveaxis(v[:, 0].astype(dt), 1, 0)
-    if os.environ.get("LLMK_SCATTER_VARIANT") == "linear":
+    if _active_kv_write == "scatter-linear":
         # single-dim scatter on a [n_kv, flat*page, d] view: one index
         # vector, simplest possible lowering
         page = kd.shape[2]

@@ -130,7 +130,26 @@ class EngineConfig:
     # quantized KV (halved decode-attention HBM traffic, doubled token
     # capacity; accuracy pinned by logit-tolerance tests)
     kv_cache_dtype: Optional[str] = None
+    # decode KV write strategy: "dus" | "scatter" | "scatter-linear"
+    # (cache.py discusses the tradeoff). None => the LLMK_KV_WRITE env
+    # default, resolved ONCE in __post_init__ — the strategy is part of
+    # the engine's static config and baked into its executables, so env
+    # mutation after construction has no effect (by design, documented)
+    # and two engines in one process may use different strategies.
+    kv_write: Optional[str] = None
     seed: int = 0
+
+    def __post_init__(self):
+        from llms_on_kubernetes_tpu.engine.cache import (
+            KV_WRITE_STRATEGIES, default_kv_write_strategy,
+        )
+
+        if self.kv_write is None:
+            self.kv_write = default_kv_write_strategy()
+        if self.kv_write not in KV_WRITE_STRATEGIES:
+            raise ValueError(
+                f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
+                f"got {self.kv_write!r}")
 
     @property
     def max_model_len(self) -> int:
@@ -818,11 +837,19 @@ class Engine:
             raise ValueError(
                 f"logit_bias supports at most {LOGIT_BIAS_SLOTS} entries, "
                 f"got {len(params.logit_bias)}")
+        seen_bias: set[int] = set()
         for tid, _bv in params.logit_bias:
             if not 0 <= tid < self.model_config.vocab_size:
                 raise ValueError(
                     f"logit_bias token id {tid} outside the vocabulary "
                     f"(size {self.model_config.vocab_size})")
+            if tid in seen_bias:
+                # the on-device scatter-ADD would apply duplicates
+                # cumulatively, silently diverging from the documented
+                # map semantics (unreachable via the API — dict keys are
+                # unique — but direct submit()s must not differ)
+                raise ValueError(f"logit_bias has duplicate token id {tid}")
+            seen_bias.add(tid)
         # prompts longer than the largest prefill bucket are served too:
         # admission splits them into bucket-size chunks against the paged
         # pool (chunked prefill — forward_chunk). The only hard limit is
@@ -979,9 +1006,11 @@ class Engine:
         # another Engine (tests, rolling restarts) between our __init__
         # and our first trace would otherwise leak ITS mesh into OUR
         # executables (observed: a CP engine traced mesh-less)
+        from llms_on_kubernetes_tpu.engine.cache import set_kv_write_strategy
         from llms_on_kubernetes_tpu.parallel.mesh import set_active_mesh
 
         set_active_mesh(self.mesh)
+        set_kv_write_strategy(self.config.kv_write)
         events: list[StepEvent] = []
         events += self._reap_aborted()
         if self._async:
@@ -1211,9 +1240,11 @@ class Engine:
         the same jitted programs in the same order; followers get the
         inputs by broadcast). Updates the pools/counts and returns the
         device SampleResult."""
+        from llms_on_kubernetes_tpu.engine.cache import set_kv_write_strategy
         from llms_on_kubernetes_tpu.parallel.mesh import set_active_mesh
 
         set_active_mesh(self.mesh)  # follower_loop calls this directly
+        set_kv_write_strategy(self.config.kv_write)
         cfg = self.model_config
         embeds, deep = self._encode_request_images(images)
         n_max = self.config.max_images_per_request

@@ -255,6 +255,14 @@ class OpenAIServer:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        # the vllm-openai image's utility surface (reference
+        # vllm-models/helm-chart/templates/model-deployments.yaml:21):
+        # /tokenize, /detokenize, /version, and an explicit 501 for
+        # /v1/embeddings (this server generates; it does not embed)
+        app.router.add_post("/tokenize", self.tokenize)
+        app.router.add_post("/detokenize", self.detokenize)
+        app.router.add_get("/version", self.version)
+        app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/debug/profile/start", self.profile_start)
         app.router.add_post("/debug/profile/stop", self.profile_stop)
         app.on_startup.append(self._start_loop)
@@ -329,6 +337,67 @@ class OpenAIServer:
                 "owned_by": "llms-on-kubernetes-tpu",
             }],
         })
+
+    async def version(self, request: web.Request) -> web.Response:
+        from llms_on_kubernetes_tpu import __version__
+
+        return web.json_response({"version": __version__})
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        # explicit 501 (not a blank 404): the endpoint exists in the
+        # OpenAI surface, this server just doesn't serve embedding models
+        return web.json_response(
+            {"error": {"message": "this server hosts a generative model; "
+                       "/v1/embeddings is not supported",
+                       "type": "not_implemented"}}, status=501)
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        """vllm-openai's POST /tokenize: {"prompt": str} or
+        {"messages": [...]} -> {"tokens", "count", "max_model_len"}."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON"}}, status=400)
+        prompt = body.get("prompt")
+        messages = body.get("messages")
+        try:
+            if isinstance(prompt, str):
+                ids = self.tokenizer.encode(prompt)
+            elif isinstance(messages, list) and messages:
+                ids = self.tokenizer.apply_chat_template(messages)
+            else:
+                return web.json_response(
+                    {"error": {"message": "provide prompt (string) or "
+                               "messages (list)"}}, status=400)
+        except Exception as e:  # bad roles/content shape
+            return web.json_response(
+                {"error": {"message": f"bad input: {e}"}}, status=400)
+        return web.json_response({
+            "tokens": list(ids), "count": len(ids),
+            "max_model_len": self.engine.config.max_model_len,
+        })
+
+    async def detokenize(self, request: web.Request) -> web.Response:
+        """vllm-openai's POST /detokenize: {"tokens": [ids]} -> {"prompt"}."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON"}}, status=400)
+        toks = body.get("tokens")
+        if (not isinstance(toks, list)
+                or any(not isinstance(t, int) or isinstance(t, bool)
+                       for t in toks)):
+            return web.json_response(
+                {"error": {"message": "tokens must be a list of token ids"}},
+                status=400)
+        vocab = self.engine.model_config.vocab_size
+        if any(not 0 <= t < vocab for t in toks):
+            return web.json_response(
+                {"error": {"message": f"token id outside the vocabulary "
+                           f"(size {vocab})"}}, status=400)
+        return web.json_response({"prompt": self.tokenizer.decode(toks)})
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(
@@ -432,11 +501,22 @@ class OpenAIServer:
         = 4 temporal patches, the default per-request block budget) and
         trimmed to a temporal_patch_size multiple; timestamps follow the
         HF Qwen3-VL processor (mean of first/last frame time within each
-        temporal patch, from the container's frame durations)."""
+        temporal patch, from the container's frame durations).
+
+        Only the SAMPLED frames are materialized: animated containers
+        compress highly, so eagerly retaining every decoded frame would
+        let a 32 MB body expand to gigabytes of host RAM before the
+        subsampling cap ran (untrusted-input availability risk). The
+        frame count and size are checked against a total decoded-pixel
+        budget (LLMK_MAX_VIDEO_PIXELS) up front — PIL must still walk
+        earlier frames to composite deltas, so the budget bounds decode
+        CPU as well as memory. Per-frame durations are clamped to
+        [1 ms, 10 s]: they render as '<t seconds>' prompt text, and a
+        container with zero/garbage duration metadata must not produce
+        nonsensical timestamps."""
         import os
 
         import numpy as np
-        from PIL import ImageSequence
 
         vis = self.engine.model_config.vision
         if vis is None:  # text-only model: a 400, not an AttributeError 500
@@ -445,16 +525,45 @@ class OpenAIServer:
         tp = vis.temporal_patch_size
         img = self._decode_data_url(
             (part.get("video_url") or {}).get("url", ""), "video_url")
-        frames, times, t = [], [], 0.0
-        for f in ImageSequence.Iterator(img):
-            times.append(t)
-            t += float(f.info.get("duration", 1000.0 / 24.0)) / 1000.0
-            frames.append(f.convert("RGB").copy())
+        n = int(getattr(img, "n_frames", 1))
+        w, h = img.size
+        # independent frame-count cap: the pixel budget alone would admit
+        # a ~1M-frame GIF of 1x1 pixels, whose per-frame seek/composite
+        # loop below still stalls the event loop for its duration
+        max_frames = int(os.environ.get("LLMK_MAX_VIDEO_INPUT_FRAMES",
+                                        "4096"))
+        if n > max_frames:
+            raise ValueError(
+                f"video has {n} frames; at most {max_frames} are accepted "
+                f"(frames are subsampled anyway — send fewer)")
+        budget = int(os.environ.get("LLMK_MAX_VIDEO_PIXELS", str(1 << 28)))
+        if n * w * h > budget:
+            raise ValueError(
+                f"video of {n} frames at {w}x{h} exceeds the decoded-pixel "
+                f"budget ({budget}); send fewer/smaller frames")
         cap = max(tp, int(os.environ.get("LLMK_MAX_VIDEO_FRAMES", "8")))
-        if len(frames) > cap:
-            idx = np.linspace(0, len(frames) - 1, cap).round().astype(int)
-            frames = [frames[i] for i in idx]
-            times = [times[i] for i in idx]
+        idx = np.linspace(0, n - 1, min(n, cap)).round().astype(int)
+        want = set(idx.tolist())
+        by_i, times_all, t = {}, [], 0.0
+        for i in range(n):
+            try:
+                img.seek(i)
+            except EOFError:  # container lied about n_frames
+                break
+            times_all.append(t)
+            dur = img.info.get("duration")
+            try:
+                dur = float(dur) if dur else 1000.0 / 24.0
+            except (TypeError, ValueError):
+                dur = 1000.0 / 24.0
+            t += min(max(dur, 1.0), 10_000.0) / 1000.0
+            if i in want:
+                by_i[i] = img.convert("RGB")
+        idx = idx[idx < len(times_all)]
+        frames = [by_i[i] for i in idx]
+        times = [times_all[i] for i in idx]
+        if not frames:
+            raise ValueError("video contains no decodable frames")
         while len(frames) % tp:  # pad to a temporal-patch multiple
             frames.append(frames[-1])
             times.append(times[-1])

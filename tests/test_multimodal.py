@@ -790,3 +790,60 @@ def test_video_rejected_on_text_model_is_400():
             await client.close()
 
     asyncio.run(go())
+
+
+def test_video_pixel_budget_and_duration_clamp(monkeypatch):
+    """Round-4 advisor (medium): _extract_video must bound decoded pixels
+    BEFORE materializing frames (a tiny compressed GIF can expand to GBs)
+    and clamp garbage per-frame durations to [1ms, 10s]."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    eng = Engine(EngineConfig(
+        model="debug-qwen-mm", dtype="float32", max_decode_slots=2,
+        page_size=8, num_pages=128, pages_per_slot=16,
+        prefill_buckets=(64, 128)))
+    server = OpenAIServer(eng, MMTestTokenizer(), "debug-qwen-mm")
+
+    # over-budget: 20x20x40 = 16000 px > 8000 -> 400, never decoded
+    monkeypatch.setenv("LLMK_MAX_VIDEO_PIXELS", "8000")
+    url = _gif_data_url(n_frames=40)
+
+    async def go():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-qwen-mm",
+                "messages": [{"role": "user", "content": [
+                    {"type": "video_url", "video_url": {"url": url}},
+                ]}],
+                "max_tokens": 2, "temperature": 0,
+            })
+            assert r.status == 400, await r.text()
+            assert "decoded-pixel budget" in (await r.json())["error"]["message"]
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+    # duration clamp: a zero-duration GIF must not produce 0-second
+    # timestamps for every patch (falls back to the 1/24s default)
+    part = {"video_url": {"url": _gif_data_url(n_frames=4)}}
+    monkeypatch.setenv("LLMK_MAX_VIDEO_PIXELS", str(1 << 28))
+    frames, ts = server._extract_video(part)
+    assert len(frames) == 4
+    assert ts == sorted(ts) and len(ts) == 2
+
+    # garbage duration metadata: build a GIF with duration=0
+    from PIL import Image
+    imgs = [Image.new("RGB", (12, 12), (i * 50, 0, 0)) for i in range(4)]
+    buf = io.BytesIO()
+    imgs[0].save(buf, "GIF", save_all=True, append_images=imgs[1:],
+                 duration=0, loop=0)
+    url0 = ("data:image/gif;base64,"
+            + base64.b64encode(buf.getvalue()).decode())
+    frames0, ts0 = server._extract_video({"video_url": {"url": url0}})
+    # 0ms frames clamp to the 1/24s fallback: strictly increasing stamps
+    assert ts0[1] > ts0[0] >= 0.0
