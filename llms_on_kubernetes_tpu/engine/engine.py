@@ -72,6 +72,11 @@ class SamplingParams:
     # on device every step; at most LOGIT_BIAS_SLOTS entries (rejected at
     # submit beyond that — the packed-row column budget is a hard bound)
     logit_bias: tuple = ()
+    # grammar-constrained decoding: a grammar.CompiledGrammar (OpenAI
+    # response_format json_object/json_schema, forced tool_choice). The
+    # engine masks every sampled token to the grammar's allowed set and
+    # advances the FSM on device (engine/grammar.py).
+    grammar: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -130,6 +135,15 @@ class EngineConfig:
     # quantized KV (halved decode-attention HBM traffic, doubled token
     # capacity; accuracy pinned by logit-tolerance tests)
     kv_cache_dtype: Optional[str] = None
+    # grammar-constrained decoding device-table capacities (static jit
+    # shapes). A grammar whose tables exceed states/classes caps is
+    # rejected at submit (400); distinct RESIDENT grammars beyond
+    # max_grammars wait for a slot like page-pool pressure. The arrays
+    # only exist once the first constrained request is admitted —
+    # grammar-free serving compiles the exact pre-grammar executables.
+    max_grammars: int = 4
+    grammar_states: int = 4096
+    grammar_classes: int = 512
     # decode KV write strategy: "dus" | "scatter" | "scatter-linear"
     # (cache.py discusses the tradeoff). None => the LLMK_KV_WRITE env
     # default, resolved ONCE in __post_init__ — the strategy is part of
@@ -183,6 +197,13 @@ class Request:
     output_logprobs: list = dataclasses.field(default_factory=list)
     slot: int = -1
     pending_token: int = -1        # sampled but KV not yet cached
+    # grammar-constrained decoding: the request's row in the device class
+    # table and its absolute start state (set at admission, -1 = none);
+    # pending_fsm_state carries a host-replayed state the next decode
+    # launch must force onto the device (resume-after-preemption)
+    fsm_row: int = -1
+    fsm_start: int = -1
+    pending_fsm_state: Optional[int] = None
     finished: bool = False
     finish_reason: Optional[str] = None
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
@@ -438,6 +459,46 @@ def _rebuild_count_rows(counts, tokens, slots, history, prompt_len, lengths,
 LOGIT_BIAS_SLOTS = 32
 
 
+# --- grammar-constrained decoding (engine/grammar.py) ----------------------
+# The per-slot FSM lives ON DEVICE so constrained requests ride the async
+# pipeline: each packed step masks the logits with the allowed-token set of
+# the slot's current FSM state and advances the state by the token it
+# samples — no host round trip. The packed rows carry:
+#   prefill/chunk: [fsm_row, fsm_init]   row in the class table (-1 = this
+#     row samples unconstrained) and the absolute start state to assume
+#     before sampling (set at admission; -1 for resumed rows, whose decode
+#     overrides with the host-replayed state)
+#   decode:        [fsm_row, fsm_set, fsm_val]   fsm_set=1 overrides the
+#     device state with fsm_val before masking (resume-after-preemption)
+# The tables (class_of [G, V] int16, trans [S, C] int16) are device arrays
+# rebuilt only when the RESIDENT GRAMMAR SET changes (admission-time, never
+# per-step). fsm=None compiles the exact pre-grammar executables — serving
+# without grammars pays nothing.
+
+
+def _fsm_apply(fsm, g_rows, states):
+    """Per-row mask + transition lookup: one [R, V] gather serves both.
+
+    Returns (allowed [R, V] bool, nxt_all [R, V] int — the state each
+    token would lead to, -1 = token not allowed). Rows with g_rows < 0
+    are unconstrained (allowed all-True)."""
+    _state_arr, class_of, trans = fsm
+    classes = class_of[jnp.maximum(g_rows, 0)]             # [R, V] int16
+    row_trans = trans[jnp.maximum(states, 0)]              # [R, C] int16
+    nxt_all = jnp.take_along_axis(
+        row_trans, classes.astype(jnp.int32), axis=1)      # [R, V]
+    constrained = (g_rows >= 0) & (states >= 0)
+    allowed = jnp.where(constrained[:, None], nxt_all >= 0, True)
+    return allowed, nxt_all, constrained
+
+
+def _fsm_next(nxt_all, tokens):
+    """State after emitting the sampled token ([R] int32)."""
+    return jnp.take_along_axis(
+        nxt_all, tokens.astype(jnp.int32)[:, None], axis=1)[:, 0].astype(
+        jnp.int32)
+
+
 def _unpack_bias(packed, base: int):
     ids = packed[:, base:base + LOGIT_BIAS_SLOTS]
     vals = jax.lax.bitcast_convert_type(
@@ -455,14 +516,15 @@ def _pack_bias(packed: np.ndarray, row: int, base: int, params) -> None:
 
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
 # 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
-# 9 frequency(bits), 10 pos_delta (mrope), 11.. logit_bias ids/vals,
-# then page_table
-_BIAS_DEC = 11
+# 9 frequency(bits), 10 pos_delta (mrope), 11-13 fsm (row, set, val),
+# 14.. logit_bias ids/vals, then page_table
+_FSM_DEC = 11
+_BIAS_DEC = 14
 _DEC_COLS = _BIAS_DEC + 2 * LOGIT_BIAS_SLOTS
 
 
 def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
-                        k_pages, v_pages, counts, base_key):
+                        k_pages, v_pages, counts, base_key, fsm=None):
     lengths = packed[:, 0]
     src, vals = packed[:, 1], packed[:, 2]
     top_ks = packed[:, 3]
@@ -485,21 +547,44 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
         pos_delta=pos_delta,
     )
     keys = _slot_keys(base_key, seeds, lengths)
+    allowed = nxt_all = new_state = None
+    if fsm is not None:
+        g_rows = packed[:, _FSM_DEC]
+        base = jnp.where(packed[:, _FSM_DEC + 1] == 1,
+                         packed[:, _FSM_DEC + 2], fsm[0])
+        allowed, nxt_all, constrained = _fsm_apply(fsm, g_rows, base)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts), bias=bias)
-    return res, k_pages, v_pages, counts
+                 penalties=(presence, frequency, counts), bias=bias,
+                 allowed=allowed)
+    if fsm is not None:
+        new_state = jnp.where(constrained & (lengths > 0),
+                              _fsm_next(nxt_all, res.tokens), base)
+    return res, k_pages, v_pages, counts, new_state
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
 # 4 seed, 5 presence(bits), 6 frequency(bits), 7 slot, 8 prompt_len,
-# 9.. logit_bias ids/vals, then page_table
-_BIAS_PRE = 9
+# 9-10 fsm (row, init), 11.. logit_bias ids/vals, then page_table
+_FSM_PRE = 9
+_BIAS_PRE = 11
 _PRE_COLS = _BIAS_PRE + 2 * LOGIT_BIAS_SLOTS
+
+
+def _fsm_scatter(fsm, g_rows, init, nxt_all, tokens, lengths, slots):
+    """Prefill/chunk/mm per-slot state scatter. Rows write their slot's
+    FSM state only when they are constrained FRESH starts (fsm_row >= 0
+    and fsm_init >= 0) and real (length > 0); everything else leaves the
+    slot's device state alone (resumed rows are overridden by their first
+    decode's fsm_set instead)."""
+    nxt = _fsm_next(nxt_all, tokens)
+    write = (g_rows >= 0) & (init >= 0) & (lengths > 0)
+    slot_eff = jnp.where(write, slots, fsm[0].shape[0])  # OOB => dropped
+    return fsm[0].at[slot_eff].set(nxt, mode="drop")
 
 
 def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
                             deepstack, pos3, k_pages, v_pages, counts,
-                            base_key):
+                            base_key, fsm=None):
     """Multimodal prefill ([1, bucket]): image soft-token embeddings are
     substituted inside forward_prefill_mm; sampling/penalties identical
     to the text prefill. ``deepstack``/``pos3`` are None for gemma-3 and
@@ -526,13 +611,21 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
         img_embeds, deepstack=deepstack, pos3=pos3, prompt_len=prompt_len,
     )
     keys = _slot_keys(base_key, seeds, lengths)
+    allowed = nxt_all = new_state = None
+    if fsm is not None:
+        g_rows, init = packed[:, _FSM_PRE], packed[:, _FSM_PRE + 1]
+        allowed, nxt_all, _ = _fsm_apply(fsm, g_rows, init)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts[slots]), bias=bias)
-    return res, k_pages, v_pages, counts
+                 penalties=(presence, frequency, counts[slots]), bias=bias,
+                 allowed=allowed)
+    if fsm is not None:
+        new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
+                                 lengths, slots)
+    return res, k_pages, v_pages, counts, new_state
 
 
 def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
-                         counts, base_key):
+                         counts, base_key, fsm=None):
     lengths = packed[:, 0]
     top_ks = packed[:, 1]
     temps = jax.lax.bitcast_convert_type(packed[:, 2], jnp.float32)
@@ -553,9 +646,17 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     row_counts = counts[slots]
+    allowed = nxt_all = new_state = None
+    if fsm is not None:
+        g_rows, init = packed[:, _FSM_PRE], packed[:, _FSM_PRE + 1]
+        allowed, nxt_all, _ = _fsm_apply(fsm, g_rows, init)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, row_counts), bias=bias)
-    return res, k_pages, v_pages, counts
+                 penalties=(presence, frequency, row_counts), bias=bias,
+                 allowed=allowed)
+    if fsm is not None:
+        new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
+                                 lengths, slots)
+    return res, k_pages, v_pages, counts, new_state
 
 
 # packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
@@ -563,16 +664,18 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
 # 9 prompt_len, 10 reset (first chunk of the request — history may be
 # nonzero when a cached prefix was adopted), 11 pos_delta (mrope: a
 # cache-hit Qwen3-VL remainder replays through this path with rope
-# positions shifted by the request's mrope delta), 12.. logit_bias
-# ids/vals, then page_table. Sampling position is the TOTAL length
-# (history + chunk_len) so a chunked prompt draws exactly the tokens a
-# one-shot prefill of the same prompt would.
-_BIAS_CHK = 12
+# positions shifted by the request's mrope delta), 12-13 fsm (row, init —
+# set only on the FINAL chunk, whose sample is the first real token),
+# 14.. logit_bias ids/vals, then page_table. Sampling position is the
+# TOTAL length (history + chunk_len) so a chunked prompt draws exactly
+# the tokens a one-shot prefill of the same prompt would.
+_FSM_CHK = 12
+_BIAS_CHK = 14
 _CHK_COLS = _BIAS_CHK + 2 * LOGIT_BIAS_SLOTS
 
 
 def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
-                       counts, base_key):
+                       counts, base_key, fsm=None):
     lengths = packed[:, 0]
     history = packed[:, 1]
     top_ks = packed[:, 2]
@@ -595,9 +698,17 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
         pos_delta=pos_delta,
     )
     keys = _slot_keys(base_key, seeds, history + lengths)
+    allowed = nxt_all = new_state = None
+    if fsm is not None:
+        g_rows, init = packed[:, _FSM_CHK], packed[:, _FSM_CHK + 1]
+        allowed, nxt_all, _ = _fsm_apply(fsm, g_rows, init)
     res = sample(logits, keys, temps, top_ks, top_ps,
-                 penalties=(presence, frequency, counts[slots]), bias=bias)
-    return res, k_pages, v_pages, counts
+                 penalties=(presence, frequency, counts[slots]), bias=bias,
+                 allowed=allowed)
+    if fsm is not None:
+        new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
+                                 lengths, slots)
+    return res, k_pages, v_pages, counts, new_state
 
 
 def _start_host_copy(res) -> None:
@@ -787,6 +898,14 @@ class Engine:
         # device-resident zero vectors for the packed steps (uploaded once)
         self._zeros_B = jnp.zeros((B,), jnp.int32)
         self._zeros_1 = jnp.zeros((1,), jnp.int32)
+        # grammar-constrained decoding: resident-grammar registry + device
+        # tables, created lazily on the first constrained admission
+        # (engine/grammar.py; _ensure_grammar/_fsm_args below)
+        self._g_resident: dict = {}      # key -> [row, base, size, refs, g]
+        self._fsm_state = None           # device [B] int32
+        self._g_class_h = None           # host [G, vocab] int16
+        self._g_trans_h = None           # host [S_cap, C_cap] int16
+        self._g_dev = None               # (class_of, trans) device arrays
         # pacing state: EMA of the device step time (measured from harvest
         # completion spacing — in steady state the loop is device-paced)
         # and the estimated wall time when all dispatched work completes
@@ -829,6 +948,20 @@ class Engine:
                 f"top_k={params.top_k} exceeds the sampling candidate pool "
                 f"({MAX_CANDIDATES}); values above it are not supported"
             )
+        if params.grammar is not None:
+            g = params.grammar
+            if (g.n_states > self.config.grammar_states
+                    or g.n_classes > self.config.grammar_classes):
+                raise ValueError(
+                    f"grammar needs {g.n_states} states / {g.n_classes} "
+                    f"token classes; this engine's device-table caps are "
+                    f"{self.config.grammar_states} / "
+                    f"{self.config.grammar_classes}")
+            if len(g.class_of) > self.model_config.vocab_size:
+                raise ValueError(
+                    f"grammar was compiled for a {len(g.class_of)}-token "
+                    f"vocabulary; the model's is "
+                    f"{self.model_config.vocab_size}")
         for name in ("presence_penalty", "frequency_penalty"):
             val = getattr(params, name)
             if not -2.0 <= val <= 2.0:
@@ -1084,6 +1217,14 @@ class Engine:
         packed[row, 6] = np.float32(req.params.frequency_penalty).view(np.int32)
         packed[row, 7] = slot
         packed[row, 8] = len(req.prompt)  # output-token counting boundary
+        # fresh constrained rows start at the grammar's start state;
+        # resumed rows (req.output non-empty) sample a DISCARDED token
+        # unconstrained and their first decode fsm_sets the replayed state
+        if req.fsm_row >= 0 and not req.output:
+            packed[row, _FSM_PRE] = req.fsm_row
+            packed[row, _FSM_PRE + 1] = req.fsm_start
+        else:
+            packed[row, _FSM_PRE:_FSM_PRE + 2] = -1
         _pack_bias(packed, row, _BIAS_PRE, req.params)
         packed[row, _PRE_COLS:] = self.allocator.page_tables[slot]
 
@@ -1135,14 +1276,29 @@ class Engine:
             packed[0, 9] = len(req.prompt)
             packed[0, 10] = 1 if pos == start else 0  # first chunk: reset counts
             packed[0, 11] = req.mrope_delta
+            # only the FINAL chunk's sample is the request's first real
+            # token; earlier chunks (and every chunk of a resumed
+            # request) sample discarded tokens unconstrained
+            final = pos + m >= n
+            if final and req.fsm_row >= 0 and not req.output:
+                packed[0, _FSM_CHK] = req.fsm_row
+                packed[0, _FSM_CHK + 1] = req.fsm_start
+            else:
+                packed[0, _FSM_CHK:_FSM_CHK + 2] = -1
+            use_fsm = packed[0, _FSM_CHK] >= 0
             _pack_bias(packed, 0, _BIAS_CHK, req.params)
             packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
-            self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed)
-            res, self.k_pages, self.v_pages, self.token_counts = self._chunk_packed(
+            self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed,
+                          fsm_used=use_fsm)
+            (res, self.k_pages, self.v_pages, self.token_counts,
+             new_state) = self._chunk_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(packed), self.k_pages, self.v_pages,
                 self.token_counts, self._key,
+                self._fsm_args() if use_fsm else None,
             )
+            if new_state is not None:
+                self._fsm_state = new_state
             pos += m
         self.slot_len[slot] = n
         return res
@@ -1260,11 +1416,16 @@ class Engine:
             # flatten per row: [n_taps, 1(row), n_img_max*t_img, D]
             deep = deep.reshape(deep.shape[0], -1, deep.shape[-1])[:, None]
         pos3_dev = None if pos3 is None else jnp.asarray(pos3)
-        res, self.k_pages, self.v_pages, self.token_counts = self._mm_prefill_packed(
+        use_fsm = bool(packed[0, _FSM_PRE] >= 0)  # same bytes on followers
+        (res, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._mm_prefill_packed(
             self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
             embeds[None], deep, pos3_dev, self.k_pages, self.v_pages,
             self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
         )
+        if new_state is not None:
+            self._fsm_state = new_state
         return res
 
     def _dispatch_mm_prefill(self, slot: int, req: Request,
@@ -1305,6 +1466,116 @@ class Engine:
         self.slot_len[slot] = n
         return res
 
+    # ------------------------------------------------------------------
+    # grammar-constrained decoding: device-table residency
+    # ------------------------------------------------------------------
+
+    def _fsm_args(self):
+        """The (state, class_of, trans) device tuple, or None before the
+        first constrained admission."""
+        if self._fsm_state is None:
+            return None
+        return (self._fsm_state, *self._g_dev)
+
+    def _fsm_any_active(self) -> bool:
+        return any(r is not None and r.fsm_row >= 0 for r in self.slots)
+
+    def _g_first_fit(self, size: int) -> Optional[int]:
+        occ = sorted((e[1], e[1] + e[2]) for e in self._g_resident.values())
+        base = 0
+        for lo, hi in occ:
+            if lo - base >= size:
+                return base
+            base = max(base, hi)
+        return base if self.config.grammar_states - base >= size else None
+
+    def _ensure_grammar(self, req: Request) -> bool:
+        """Make the request's grammar resident in the device tables and
+        point req.fsm_row/fsm_start at it (refcounted). Returns False when
+        every table row / state range is pinned by RUNNING requests — the
+        admission waits, exactly like page-pool pressure. Idempotent per
+        request (a blocked admission retries every iteration)."""
+        if req.fsm_row >= 0:
+            return True
+        g = req.params.grammar
+        ent = self._g_resident.get(g.key)
+        if ent is None:
+            cfg = self.config
+            if self._g_class_h is None:
+                V = self.model_config.vocab_size
+                self._g_class_h = np.zeros((cfg.max_grammars, V), np.int16)
+                self._g_trans_h = np.full(
+                    (cfg.grammar_states, cfg.grammar_classes), -1, np.int16)
+                self._fsm_state = jnp.full(
+                    (cfg.max_decode_slots,), -1, jnp.int32)
+            while True:
+                used = {e[0] for e in self._g_resident.values()}
+                row = next((r for r in range(cfg.max_grammars)
+                            if r not in used), None)
+                base = self._g_first_fit(g.n_states)
+                if row is not None and base is not None:
+                    break
+                victim = next((k for k, e in self._g_resident.items()
+                               if e[3] == 0), None)
+                if victim is None:
+                    return False  # all pinned by running requests; wait
+                del self._g_resident[victim]
+            V = self.model_config.vocab_size
+            co = np.full((V,), g.n_classes - 2, np.int16)  # pad: reject
+            co[:len(g.class_of)] = g.class_of
+            self._g_class_h[row] = co
+            tr = g.trans.astype(np.int32)
+            self._g_trans_h[base:base + g.n_states, :] = -1
+            self._g_trans_h[base:base + g.n_states, :g.n_classes] = np.where(
+                tr >= 0, tr + base, -1).astype(np.int16)
+            ent = [row, base, g.n_states, 0, g]
+            self._g_resident[g.key] = ent
+            self._upload_grammars()
+        ent[3] += 1
+        req.fsm_row = ent[0]
+        req.fsm_start = ent[1] + g.start
+        return True
+
+    def _upload_grammars(self) -> None:
+        self._g_dev = (jnp.asarray(self._g_class_h),
+                       jnp.asarray(self._g_trans_h))
+        if self.config.multihost:
+            from llms_on_kubernetes_tpu.engine import multihost as mh
+
+            self._mh_send(mh.MSG_GRAMMAR)
+            mh.send_grammar_payload(self._mh_shapes, self._g_class_h,
+                                    self._g_trans_h)
+
+    def _g_release(self, req: Request) -> None:
+        """Drop the request's hold on its resident grammar (finish, abort,
+        preemption — a resumed admission re-ensures residency)."""
+        if req.fsm_row < 0 or req.params.grammar is None:
+            return
+        ent = self._g_resident.get(req.params.grammar.key)
+        if ent is not None and ent[3] > 0:
+            ent[3] -= 1
+        req.fsm_row = -1
+        req.fsm_start = -1
+        req.pending_fsm_state = None
+
+    def _fsm_replay(self, req: Request) -> None:
+        """Resume-after-preemption: recompute the FSM state after every
+        emitted token on the HOST (the device state was lost with the
+        slot) and stage it for the next decode launch's fsm_set."""
+        g = req.params.grammar
+        s = g.start
+        for t in req.output:
+            s = g.next_state(s, t)
+            if s < 0:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "request %s: emitted token %d not reachable in its own "
+                    "grammar; continuing unconstrained", req.id, t)
+                self._g_release(req)
+                return
+        req.pending_fsm_state = (req.fsm_start
+                                 - g.start) + s  # base + replayed state
+
     def _admit_one(self) -> list[StepEvent]:
         """Admit + prefill at most one waiting request per iteration.
 
@@ -1320,6 +1591,9 @@ class Engine:
             if slot is None:
                 return []
             req = self.waiting[0]
+            if (req.params.grammar is not None
+                    and not self._ensure_grammar(req)):
+                return []  # all grammar rows pinned; wait like page pressure
             resumed = bool(req.output)
             prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
             n = len(prefill_tokens)
@@ -1342,6 +1616,8 @@ class Engine:
             self.allocator.commit_adopt(slot, hit)
         self.slots[slot] = req
         req.slot = slot
+        if resumed and req.fsm_row >= 0:
+            self._fsm_replay(req)  # stages fsm_set for the next decode
 
         if req.images is not None and hit == 0:
             res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
@@ -1358,13 +1634,20 @@ class Engine:
             tokens[0, :n] = prefill_tokens
             packed = np.zeros((1, _PRE_COLS + self.allocator.pages_per_slot),
                               np.int32)
+            packed[:, _FSM_PRE:_FSM_PRE + 2] = -1
             self._pack_prefill_row(packed, 0, req, n, slot)
-            self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
-            res, self.k_pages, self.v_pages, self.token_counts = self._prefill_packed(
+            use_fsm = packed[0, _FSM_PRE] >= 0
+            self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed,
+                          fsm_used=use_fsm)
+            (res, self.k_pages, self.v_pages, self.token_counts,
+             new_state) = self._prefill_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(packed), self.k_pages, self.v_pages,
                 self.token_counts, self._key,
+                self._fsm_args() if use_fsm else None,
             )
+            if new_state is not None:
+                self._fsm_state = new_state
             self.slot_len[slot] = n
         # the dispatched prefill writes these pages; device order makes
         # them valid for any later-dispatched adopter
@@ -1401,6 +1684,7 @@ class Engine:
         """Release a request's slot/pages and mark it finished."""
         req.finished = True
         req.finish_reason = reason
+        self._g_release(req)
         if req.slot >= 0:
             self.allocator.free(req.slot)
             self.slot_len[req.slot] = 0
@@ -1422,6 +1706,9 @@ class Engine:
         self.slots[slot] = None
         victim.slot = -1
         victim.pending_token = -1
+        # release the grammar hold too: re-admission re-ensures residency
+        # and host-replays the FSM state from the emitted tokens
+        self._g_release(victim)
         with self._lock:
             self.waiting.appendleft(victim)
 
@@ -1453,6 +1740,7 @@ class Engine:
         packed = np.zeros((B, _DEC_COLS + pps), np.int32)
         packed[:, 1] = 1                               # src: host value
         packed[:, 5] = np.float32(1.0).view(np.int32)  # top_p disabled
+        packed[:, _FSM_DEC] = -1                       # unconstrained
         for i, r in active:
             packed[i, 0] = self.slot_len[i] + 1
             packed[i, 2] = r.pending_token
@@ -1463,15 +1751,26 @@ class Engine:
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             packed[i, 10] = r.mrope_delta
+            if r.fsm_row >= 0:
+                packed[i, _FSM_DEC] = r.fsm_row
+                if r.pending_fsm_state is not None:  # resume: force state
+                    packed[i, _FSM_DEC + 1] = 1
+                    packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                    r.pending_fsm_state = None
             _pack_bias(packed, i, _BIAS_DEC, r.params)
         packed[:, _DEC_COLS:] = self.allocator.page_tables
 
-        self._mh_send(MSG_DECODE, dec_packed=packed)
-        res, self.k_pages, self.v_pages, self.token_counts = self._decode_packed(
+        use_fsm = self._fsm_any_active()
+        self._mh_send(MSG_DECODE, dec_packed=packed, fsm_used=use_fsm)
+        (res, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             self._zeros_B, self._zeros_1, self.k_pages, self.v_pages,
             self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
         )
+        if new_state is not None:
+            self._fsm_state = new_state
         host = jax.device_get(res)
 
         events: list[StepEvent] = []
@@ -1513,6 +1812,9 @@ class Engine:
                 if slot is None:
                     break
                 req = self.waiting[0]
+                if (req.params.grammar is not None
+                        and not self._ensure_grammar(req)):
+                    break  # all grammar rows pinned; wait
                 resumed = bool(req.output)
                 prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
                 n = len(prefill_tokens)
@@ -1535,6 +1837,8 @@ class Engine:
                         self.allocator.commit_adopt(slot, hit)
                     self.slots[slot] = req
                     req.slot = slot
+                    if resumed and req.fsm_row >= 0:
+                        self._fsm_replay(req)
                     long_pick = (slot, req, resumed, prefill_tokens, hit)
                     break
                 if picked and self._bucket_for(n) != self._bucket_for(
@@ -1546,6 +1850,8 @@ class Engine:
                 self.allocator.allocate(slot, n + 1)
                 self.slots[slot] = req
                 req.slot = slot
+                if resumed and req.fsm_row >= 0:
+                    self._fsm_replay(req)
                 picked.append((slot, req, resumed, prefill_tokens))
         if long_pick is not None:
             slot, req, resumed, prefill_tokens, hit = long_pick
@@ -1586,18 +1892,25 @@ class Engine:
         tokens = np.zeros((K, bucket), np.int32)
         packed = np.zeros((K, _PRE_COLS + pps), np.int32)
         packed[:, 3] = np.float32(1.0).view(np.int32)  # top_p disabled
+        packed[:, _FSM_PRE:_FSM_PRE + 2] = -1          # padded rows: none
         for row, (slot, req, _resumed, ptoks) in enumerate(picked):
             n = len(ptoks)
             tokens[row, :n] = ptoks
             self._pack_prefill_row(packed, row, req, n, slot)
             self.slot_len[slot] = n
 
-        self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed)
-        res, self.k_pages, self.v_pages, self.token_counts = self._prefill_packed(
+        use_fsm = bool((packed[:, _FSM_PRE] >= 0).any())
+        self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed,
+                      fsm_used=use_fsm)
+        (res, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._prefill_packed(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(packed), self.k_pages, self.v_pages,
             self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
         )
+        if new_state is not None:
+            self._fsm_state = new_state
         self._busy_until = (max(time.monotonic(), self._busy_until)
                             + 2.0 * self._est_step)  # prefill ≈ 2 steps
         for slot, req, _resumed, _ptoks in picked:
@@ -1668,6 +1981,7 @@ class Engine:
         packed = np.zeros((B, _DEC_COLS + pps), np.int32)
         packed[:, 1] = 1                                   # src: host value
         packed[:, 5] = np.float32(1.0).view(np.int32)      # top_p disabled
+        packed[:, _FSM_DEC] = -1                           # unconstrained
         for i, r in active:
             need = int(self.slot_len[i]) + self._inflight_count(i) + 1
             packed[i, 0] = 0 if need > max_len else need
@@ -1678,6 +1992,12 @@ class Engine:
             packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             packed[i, 10] = r.mrope_delta
+            if r.fsm_row >= 0:
+                packed[i, _FSM_DEC] = r.fsm_row
+                if r.pending_fsm_state is not None:  # resume: force state
+                    packed[i, _FSM_DEC + 1] = 1
+                    packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                    r.pending_fsm_state = None
             _pack_bias(packed, i, _BIAS_DEC, r.params)
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
@@ -1699,14 +2019,19 @@ class Engine:
         # followers pick the same token references by these flags: their own
         # newest decode output (last_valid) / newest prefill-or-chunk output
         # (use_prefill) are the same global arrays by SPMD determinism
+        use_fsm = self._fsm_any_active()
         self._mh_send(MSG_DECODE, dec_packed=packed,
                       last_valid=bool(self._inflight),
-                      use_prefill=admitted is not None)
-        res, self.k_pages, self.v_pages, self.token_counts = self._decode_packed(
+                      use_prefill=admitted is not None, fsm_used=use_fsm)
+        (res, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             last_toks, prefill_toks, self.k_pages, self.v_pages,
             self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
         )
+        if new_state is not None:
+            self._fsm_state = new_state
         seq = next(self._seq_counter)
         step = InflightStep(res, active, seq)
         self._inflight.append(step)

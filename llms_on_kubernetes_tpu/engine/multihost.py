@@ -55,6 +55,11 @@ MSG_SHUTDOWN = 4
 # mrope positions (engine._mm_execute runs identically on every process) —
 # the common decode/prefill path stays a single broadcast
 MSG_MM_PREFILL = 5
+# grammar residency change: the control word announces it, then ONE extra
+# broadcast ships the updated device tables (engine/grammar.py) — like the
+# multimodal pixel payload, the common step path stays a single broadcast.
+# Sent only when the resident-grammar SET changes (admission-time).
+MSG_GRAMMAR = 6
 
 CTRL_LEN = 8
 
@@ -79,6 +84,12 @@ class ProtoShapes:
     # temporal_patch_size real frames; one row holds exactly one image OR
     # one temporal patch, so total rows <= total blocks <= n_img_max
     mm_row_frames: int = 2
+    # grammar device-table shapes (EngineConfig caps + model vocab);
+    # only the MSG_GRAMMAR payload broadcast uses them
+    g_rows: int = 0
+    g_vocab: int = 0
+    g_states: int = 0
+    g_classes: int = 0
 
     @classmethod
     def from_engine_config(cls, cfg: Any,
@@ -88,12 +99,15 @@ class ProtoShapes:
         n_img = img_floats = 0
         mrope = False
         row_frames = 2
-        if model_config is not None and model_config.vision is not None:
-            v = model_config.vision
-            n_img = cfg.max_images_per_request
-            img_floats = v.image_size * v.image_size * v.num_channels
-            mrope = model_config.mrope_section is not None
-            row_frames = max(1, v.temporal_patch_size)
+        vocab = 0
+        if model_config is not None:
+            vocab = model_config.vocab_size
+            if model_config.vision is not None:
+                v = model_config.vision
+                n_img = cfg.max_images_per_request
+                img_floats = v.image_size * v.image_size * v.num_channels
+                mrope = model_config.mrope_section is not None
+                row_frames = max(1, v.temporal_patch_size)
         return cls(
             admit_batch=cfg.admit_batch,
             max_bucket=max(cfg.prefill_buckets),
@@ -102,6 +116,8 @@ class ProtoShapes:
             dec_width=_DEC_COLS + cfg.pages_per_slot,
             n_img_max=n_img, img_floats=img_floats, mrope=mrope,
             mm_row_frames=row_frames,
+            g_rows=cfg.max_grammars, g_vocab=vocab,
+            g_states=cfg.grammar_states, g_classes=cfg.grammar_classes,
         )
 
     def zeros(self) -> dict:
@@ -124,6 +140,15 @@ class ProtoShapes:
             "pos3": np.zeros((3, self.max_bucket), np.int32),
         }
 
+    def grammar_zeros(self) -> dict:
+        """The second (MSG_GRAMMAR-only) broadcast: the full host-side
+        grammar tables (int16 — a few MB at the default caps, sent only
+        when the resident set changes)."""
+        return {
+            "class_of": np.zeros((self.g_rows, self.g_vocab), np.int16),
+            "trans": np.zeros((self.g_states, self.g_classes), np.int16),
+        }
+
 
 def _broadcast(value):
     from jax.experimental import multihost_utils
@@ -140,8 +165,11 @@ def send_message(
     dec_packed: Optional[np.ndarray] = None,
     last_valid: bool = False,
     use_prefill: bool = False,
+    fsm_used: bool = False,
 ) -> None:
-    """Coordinator: announce one device call in ONE broadcast."""
+    """Coordinator: announce one device call in ONE broadcast.
+    ``fsm_used`` tells followers to enter the grammar-constrained variant
+    of the step executable (same trace decision as the coordinator)."""
     msg = shapes.zeros()
     k = bucket = 0
     if pre_tokens is not None:
@@ -150,7 +178,8 @@ def send_message(
         msg["pre_packed"][:k, :pre_packed.shape[1]] = pre_packed
     if dec_packed is not None:
         msg["dec_packed"][:, :] = dec_packed
-    msg["ctrl"][:5] = (op, k, bucket, int(last_valid), int(use_prefill))
+    msg["ctrl"][:6] = (op, k, bucket, int(last_valid), int(use_prefill),
+                       int(fsm_used))
     _broadcast(msg)
 
 
@@ -207,6 +236,20 @@ def receive_mm_payload(shapes: ProtoShapes, channels: int,
     return images, pos3
 
 
+def send_grammar_payload(shapes: ProtoShapes, class_h: np.ndarray,
+                         trans_h: np.ndarray) -> None:
+    """Coordinator: ship the full grammar tables right after MSG_GRAMMAR."""
+    msg = shapes.grammar_zeros()
+    msg["class_of"][:, :] = class_h
+    msg["trans"][:, :] = trans_h
+    _broadcast(msg)
+
+
+def receive_grammar_payload(shapes: ProtoShapes) -> dict:
+    out = _broadcast(shapes.grammar_zeros())
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
 def follower_loop(engine: Any) -> None:
     """Run on pods 1..N-1: mirror the coordinator's call sequence forever.
 
@@ -232,10 +275,23 @@ def follower_loop(engine: Any) -> None:
     prefill_toks = engine._zeros_1
     while True:
         m = receive_message(shapes)
-        op, k, bucket, last_valid, use_prefill = (int(x) for x in m["ctrl"][:5])
+        op, k, bucket, last_valid, use_prefill, fsm_used = (
+            int(x) for x in m["ctrl"][:6])
         if op == MSG_SHUTDOWN:
             return
         if op == MSG_IDLE:
+            continue
+        if op == MSG_GRAMMAR:
+            # mirror the coordinator's residency change: same host tables,
+            # same device arrays (engine._ensure_grammar/_upload_grammars)
+            payload = receive_grammar_payload(shapes)
+            engine._g_class_h = payload["class_of"]
+            engine._g_trans_h = payload["trans"]
+            if engine._fsm_state is None:
+                engine._fsm_state = jnp.full(
+                    (engine.config.max_decode_slots,), -1, jnp.int32)
+            engine._g_dev = (jnp.asarray(engine._g_class_h),
+                             jnp.asarray(engine._g_trans_h))
             continue
         if op == MSG_MM_PREFILL:
             images, pos3 = receive_mm_payload(
@@ -246,27 +302,33 @@ def follower_loop(engine: Any) -> None:
                 None if pos3 is None else pos3[None])
             prefill_toks = res.tokens
             continue
+        fsm = engine._fsm_args() if fsm_used else None
         if op in (MSG_PREFILL, MSG_CHUNK):
             cols = (_PRE_COLS if op == MSG_PREFILL else _CHK_COLS) + pps
             tokens = jnp.asarray(m["pre_tokens"][:k, :bucket])
             packed = jnp.asarray(m["pre_packed"][:k, :cols])
             fn = engine._prefill_packed if op == MSG_PREFILL else engine._chunk_packed
-            res, engine.k_pages, engine.v_pages, engine.token_counts = fn(
+            (res, engine.k_pages, engine.v_pages, engine.token_counts,
+             new_state) = fn(
                 engine.params, engine.model_config, tokens, packed,
                 engine.k_pages, engine.v_pages, engine.token_counts,
-                engine._key,
+                engine._key, fsm,
             )
+            if new_state is not None:
+                engine._fsm_state = new_state
             prefill_toks = res.tokens
         elif op == MSG_DECODE:
             packed = jnp.asarray(m["dec_packed"])
             last = last_toks if last_valid else engine._zeros_B
             pre = prefill_toks if use_prefill else engine._zeros_1
-            res, engine.k_pages, engine.v_pages, engine.token_counts = (
-                engine._decode_packed(
-                    engine.params, engine.model_config, packed, last, pre,
-                    engine.k_pages, engine.v_pages, engine.token_counts,
-                    engine._key,
-                ))
+            (res, engine.k_pages, engine.v_pages, engine.token_counts,
+             new_state) = engine._decode_packed(
+                engine.params, engine.model_config, packed, last, pre,
+                engine.k_pages, engine.v_pages, engine.token_counts,
+                engine._key, fsm,
+            )
+            if new_state is not None:
+                engine._fsm_state = new_state
             last_toks = res.tokens
         else:
             raise ValueError(f"unknown multihost op {op}")

@@ -59,6 +59,7 @@ def sample(
     top_p: jnp.ndarray,        # [B] float32; 1.0 => disabled
     penalties: "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray] | None" = None,
     bias: "tuple[jnp.ndarray, jnp.ndarray] | None" = None,
+    allowed: "jnp.ndarray | None" = None,
 ) -> "SampleResult":
     """Returns a SampleResult (tokens, chosen logprobs, top-K alternatives).
 
@@ -75,7 +76,13 @@ def sample(
     ``bias`` = (ids [B, N] int32, values [B, N] float32): the OpenAI
     ``logit_bias`` map, added to the raw logits before extraction (so a
     +100 bias forces and a -100 bias bans, vLLM semantics). Padding
-    entries carry id -1 and are dropped by the scatter."""
+    entries carry id -1 and are dropped by the scatter.
+
+    ``allowed`` [B, V] bool: grammar-constrained decoding's per-step
+    token mask (engine/grammar.py). Applied AFTER bias — a +100
+    logit_bias must not defeat a grammar guarantee — and before
+    candidate extraction, so reported logprobs renormalize over the
+    allowed set (guided-decoding semantics)."""
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     if penalties is not None:
@@ -93,6 +100,8 @@ def sample(
         b_vals = jnp.where(b_ids >= 0, b_vals.astype(jnp.float32), 0.0)
         logits = logits.at[rows, jnp.maximum(b_ids, 0)].add(
             b_vals, mode="drop")
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, NEG_INF)
     C = min(MAX_CANDIDATES, V)
 
     # --- candidate extraction (sorted descending) ---------------------
