@@ -323,6 +323,18 @@ _register(
 )
 _register(
     ModelConfig(
+        # debug-tiny sized, but the vocab covers the ByteTokenizer's full
+        # id range (256 bytes + BOS + EOS) so EOS is SAMPLEABLE — grammar-
+        # constrained smoke runs (response_format/tool_choice) need the
+        # model able to terminate a constrained generation
+        "debug-byte",
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=512,
+    ),
+)
+_register(
+    ModelConfig(
         "debug-gemma",
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_layers=4, num_heads=4, num_kv_heads=2, head_dim=16,

@@ -164,6 +164,17 @@ class EngineConfig:
             raise ValueError(
                 f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
                 f"got {self.kv_write!r}")
+        # grammar tables are int16 on device; ABSOLUTE (rebased) state and
+        # class ids must fit, or the rebase in _ensure_grammar would wrap
+        # silently and mask the wrong tokens
+        if not 0 < self.grammar_states <= 32767:
+            raise ValueError(
+                f"grammar_states must be in (0, 32767], got "
+                f"{self.grammar_states}")
+        if not 0 < self.grammar_classes <= 32767:
+            raise ValueError(
+                f"grammar_classes must be in (0, 32767], got "
+                f"{self.grammar_classes}")
 
     @property
     def max_model_len(self) -> int:

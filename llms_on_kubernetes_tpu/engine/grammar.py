@@ -209,6 +209,33 @@ class _NFA:
             self.add_eps(ie, ss)
             self.add_eps(se, is_)
             return s, e
+        if kind == "members":
+            # ordered object members with optional skips: the separator
+            # belongs to the TRANSITION between two present members, so
+            # "has one been emitted yet" is encoded as two parallel node
+            # chains (A = none yet, B = at least one) — LINEAR in the
+            # member count, vs the 2^n duplication a naive alternation
+            # over "first present member" suffers.
+            items, sep = ast[1], ast[2]
+            n = len(items)
+            A = [self.node() for _ in range(n + 1)]
+            Bc = [self.node() for _ in range(n + 1)]
+            for i, (required, frag) in enumerate(items):
+                fs, fe = self.build(frag)          # A-path copy (first)
+                self.add_eps(A[i], fs)
+                self.add_eps(fe, Bc[i + 1])
+                ss, se = self.build(sep)           # B-path copy (sep+frag)
+                fs2, fe2 = self.build(frag)
+                self.add_eps(Bc[i], ss)
+                self.add_eps(se, fs2)
+                self.add_eps(fe2, Bc[i + 1])
+                if not required:
+                    self.add_eps(A[i], A[i + 1])
+                    self.add_eps(Bc[i], Bc[i + 1])
+            end = self.node()
+            self.add_eps(A[n], end)   # reachable only if nothing required
+            self.add_eps(Bc[n], end)
+            return A[0], end
         raise GrammarError(f"unknown AST node {kind!r}")
 
 
@@ -499,13 +526,23 @@ def _schema_array_ast(schema: dict, depth: int):
     if prefix is not None:
         if not isinstance(prefix, list) or not prefix:
             raise GrammarError("prefixItems must be a non-empty list")
+        k = len(prefix)
+        if mx is not None and mx < k:
+            raise GrammarError(
+                f"maxItems {mx} below the {k} prefixItems (the generator "
+                f"always emits the full prefix)")
+        if mn > k and items in (None, False):
+            raise GrammarError(
+                f"minItems {mn} exceeds the {k} prefixItems with no "
+                f"items schema for the rest")
         parts = [_schema_ast(p, depth - 1) for p in prefix]
         body = parts[0]
         for p in parts[1:]:
             body = seq(body, sep, p)
         if items not in (None, False):
             extra = _schema_ast(items if items is not True else {}, depth - 1)
-            body = seq(body, star(seq(sep, extra)))
+            body = seq(body, rep(seq(sep, extra), max(0, mn - k),
+                                 None if mx is None else mx - k))
         return seq(lit("["), _ws, body, _ws, lit("]"))
     item = _schema_ast(items if items is not None else {}, depth - 1)
     if mx is not None and mx > MAX_REP:
@@ -541,9 +578,8 @@ def _schema_object_ast(schema: dict, depth: int):
     if not props:
         return seq(lit("{"), _ws, lit("}"))
     # Emit properties in DECLARED ORDER (outlines/vLLM convention);
-    # optional ones are skippable. The comma belongs to the TRANSITION
-    # between two present members — encode "have we emitted a member yet"
-    # by building alternatives over the index of the FIRST present member.
+    # optional ones are skippable. The "members" NFA node keeps this
+    # linear in the property count (see _NFA.build).
     members = []
     for name, sub in props.items():
         key = lit(json.dumps(name, ensure_ascii=False))
@@ -551,31 +587,7 @@ def _schema_object_ast(schema: dict, depth: int):
                         seq(key, _ws, lit(":"), _ws,
                             _schema_ast(sub, depth - 1))))
     comma = seq(_ws, lit(","), _ws)
-
-    def tail(i: int):
-        """Members i.. given at least one member already emitted."""
-        if i == len(members):
-            return ("seq", [])
-        req_i, frag_i = members[i]
-        with_i = seq(comma, frag_i, tail(i + 1))
-        if req_i:
-            return with_i
-        return alt(with_i, tail(i + 1))
-
-    def first(i: int):
-        """Members i.. with none emitted yet: pick the first present one."""
-        if i == len(members):
-            return ("seq", [])
-        req_i, frag_i = members[i]
-        start_here = seq(frag_i, tail(i + 1))
-        if req_i:
-            return start_here
-        return alt(start_here, first(i + 1))
-
-    body = first(0)
-    if not required:
-        body = alt(body, ("seq", []))  # empty object allowed
-    return seq(lit("{"), _ws, body, _ws, lit("}"))
+    return seq(lit("{"), _ws, ("members", members, comma), _ws, lit("}"))
 
 
 # ---------------------------------------------------------------------------

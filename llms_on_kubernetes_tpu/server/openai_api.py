@@ -239,6 +239,11 @@ class OpenAIServer:
         self.metrics = engine_metrics(self.registry)
         self.loop_thread = EngineLoop(engine, self.metrics)
         self.engine = engine
+        # grammar-constrained decoding (response_format / forced
+        # tool_choice): the tokenizer's byte map is derived once on first
+        # use; compiled grammars are cached in engine/grammar.py
+        self._token_bytes = None
+        self._token_bytes_lock = threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -471,6 +476,40 @@ class OpenAIServer:
             logprobs=nlp,
             logit_bias=tuple(bias_items),
         )
+
+    def _grammar_for_request(self, body: dict, tool_grammar):
+        """Compile the request's decoding constraint, or None.
+
+        BLOCKING (runs in an executor): grammar compilation is CPU-bound
+        host work (~1s for the generic JSON grammar at a 128K vocab,
+        cached per (grammar, vocab) after that — engine/grammar.py).
+        ``tool_grammar`` is ``(tools, force_name_or_None)`` when
+        tool_choice forces calls; it is exclusive with a JSON
+        response_format (one token stream cannot satisfy both).
+        Raises GrammarError (mapped to 400)."""
+        from llms_on_kubernetes_tpu.engine.grammar import (
+            GrammarError, compile_response_format, compile_tool_choice,
+            token_bytes_of,
+        )
+
+        rf = body.get("response_format")
+        rf_active = isinstance(rf, dict) and rf.get("type") not in (
+            None, "text")
+        if tool_grammar is not None and rf_active:
+            raise GrammarError(
+                "response_format json_object/json_schema cannot be combined "
+                "with a forced tool_choice — the constrained token stream "
+                "can only satisfy one")
+        if tool_grammar is None and rf is None:
+            return None
+        with self._token_bytes_lock:
+            if self._token_bytes is None:
+                self._token_bytes = token_bytes_of(self.tokenizer)
+        eos = sorted(self.tokenizer.eos_ids)
+        if tool_grammar is not None:
+            tools, force = tool_grammar
+            return compile_tool_choice(tools, force, self._token_bytes, eos)
+        return compile_response_format(rf, self._token_bytes, eos)
 
     def _decode_data_url(self, url: str, what: str):
         """data: URL -> loaded PIL image (400 on bad bytes)."""
@@ -719,9 +758,20 @@ class OpenAIServer:
             except Exception as e:  # undecodable/degenerate image -> 400
                 return web.json_response(
                     {"error": {"message": f"bad image: {e}"}}, status=400)
+        # "required" / named-function forcing is grammar-GUARANTEED: the
+        # sampled stream cannot be anything but well-formed tool calls
+        # (auto mode stays parser-based — the model may answer in text).
+        # Whether the request NAMED a function is judged from the body's
+        # original shape, not the normalized string — a tool literally
+        # called "required" or "auto" must not be mistaken for a mode.
+        tool_grammar = None
+        named = isinstance(body.get("tool_choice"), dict)
+        if tool_mode is not None and (named or tool_mode == "required"):
+            tool_grammar = (tools, tool_mode if named else None)
         return await self._serve(request, body, [prompt_ids], chat=True,
                                  images=pixels,
-                                 tools_on=tool_mode is not None)
+                                 tools_on=tool_mode is not None,
+                                 tool_grammar=tool_grammar)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         """Supports every OpenAI ``prompt`` form: a string, a token-id list,
@@ -756,13 +806,31 @@ class OpenAIServer:
     # ------------------------------------------------------------------
 
     async def _serve(self, request, body, prompts, *, chat: bool,
-                     images=None, tools_on: bool = False) -> web.StreamResponse:
+                     images=None, tools_on: bool = False,
+                     tool_grammar=None) -> web.StreamResponse:
         from llms_on_kubernetes_tpu.engine.engine import QueueFullError
+        from llms_on_kubernetes_tpu.engine.grammar import GrammarError
 
         try:
             params = self._sampling_from_body(body, chat=chat)
         except (ValueError, TypeError) as e:  # bad seed/temperature/... -> 400
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        rf = body.get("response_format")
+        rf_active = rf is not None and not (
+            isinstance(rf, dict) and rf.get("type") in (None, "text"))
+        if tool_grammar is not None or rf_active:
+            # guided decoding (vllm-openai parity): response_format
+            # json_object/json_schema and grammar-guaranteed tool forcing.
+            # An explicit {"type": "text"} skips the executor hop (and the
+            # first-use vocab byte-map derivation) entirely.
+            try:
+                grammar = await asyncio.get_running_loop().run_in_executor(
+                    None, self._grammar_for_request, body, tool_grammar)
+            except GrammarError as e:
+                return web.json_response(
+                    {"error": {"message": str(e)}}, status=400)
+            if grammar is not None:
+                params = dataclasses.replace(params, grammar=grammar)
         if not chat and body.get("suffix"):
             return web.json_response(
                 {"error": {"message": "suffix (fill-in-middle) is not "

@@ -75,12 +75,12 @@ def validate_tool_choice(tool_choice, tools: Optional[list]) -> Optional[str]:
 
 
 def inject_tool_messages(messages: list[dict], choice: Optional[str]) -> list[dict]:
-    """Prompt-side forcing for "required" / named tool_choice: the chat
+    """Prompt-side nudge for "required" / named tool_choice: the chat
     template renders the tool schemas; this adds the instruction that a
-    call MUST happen (vLLM implements forcing with guided decoding — here
-    the instruction + the parser's finish_reason mapping provide the same
-    API surface; the schema-grammar hard guarantee is a known delta,
-    PARITY.md).
+    call MUST happen. The HARD guarantee is enforced separately by
+    grammar-constrained decoding (engine/grammar.py — the sampled stream
+    cannot be anything but well-formed tool calls); the instruction keeps
+    the model emitting sensible content INSIDE the grammar.
 
     The instruction is appended to the LAST USER message's text — never
     as a trailing system message, which strict templates reject (Gemma

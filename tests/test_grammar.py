@@ -93,6 +93,42 @@ def test_schema_enum_const_anyof():
         assert not dfa.matches(s), s
 
 
+def test_many_optional_properties_stay_linear():
+    # the "members" NFA node must keep optional-property objects linear;
+    # the naive first-present-member alternation was 2^n (code review)
+    import time
+
+    props = {f"k{i:02d}": {"type": "integer"} for i in range(24)}
+    sch = {"type": "object", "properties": props, "required": ["k00"]}
+    t0 = time.time()
+    dfa = compile_char_dfa(json_schema_ast(sch))
+    assert time.time() - t0 < 5.0
+    assert dfa.matches(b'{"k00": 1}')
+    assert dfa.matches(b'{"k00": 1, "k05": 2, "k23": 3}')
+    assert not dfa.matches(b'{"k05": 2}')          # required missing
+    assert not dfa.matches(b'{"k00": 1, "k05": 2, "k03": 3}')  # order
+
+
+def test_prefix_items_with_bounds():
+    sch = {"type": "array", "prefixItems": [{"type": "integer"}],
+           "items": {"type": "integer"}, "maxItems": 2}
+    dfa = compile_char_dfa(json_schema_ast(sch))
+    assert dfa.matches(b'[1]')
+    assert dfa.matches(b'[1, 2]')
+    assert not dfa.matches(b'[1, 2, 3]')           # maxItems honored
+    with pytest.raises(GrammarError):  # contradiction: maxItems < prefix
+        json_schema_ast({"type": "array",
+                         "prefixItems": [{}, {}], "maxItems": 1})
+    with pytest.raises(GrammarError):  # minItems unreachable w/o items
+        json_schema_ast({"type": "array",
+                         "prefixItems": [{}], "minItems": 3})
+    sch2 = {"type": "array", "prefixItems": [{"type": "string"}],
+            "items": {"type": "integer"}, "minItems": 3}
+    d2 = compile_char_dfa(json_schema_ast(sch2))
+    assert d2.matches(b'["a", 1, 2]')
+    assert not d2.matches(b'["a", 1]')             # minItems honored
+
+
 def test_unsupported_constructs_raise():
     for bad in [{"$ref": "#/x"}, {"allOf": [{}]}, {"not": {}},
                 {"type": "string", "pattern": "a+"},

@@ -1,0 +1,213 @@
+"""Guided decoding over HTTP: response_format + grammar-forced tool_choice.
+
+Parity target: the vllm-openai image the reference deploys per model
+(reference vllm-models/helm-chart/templates/model-deployments.yaml:21)
+serves OpenAI ``response_format`` (json_object / json_schema) and
+guarantees forced ``tool_choice`` via guided decoding. These tests drive
+the full HTTP path against a random-weights engine at temperature > 0:
+valid output is a property of the MASK, not of the model.
+"""
+
+import asyncio
+import json
+
+import jsonschema
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.configs import ModelConfig
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+
+def make_server():
+    cfg = ModelConfig(
+        "debug-guided", vocab_size=258, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=1024)
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=8, num_pages=256, pages_per_slot=64,
+        prefill_buckets=(64, 128, 512),
+    ), model_config=cfg)
+    return OpenAIServer(eng, ByteTokenizer(), "debug-guided")
+
+
+def with_client(fn):
+    async def go():
+        server = make_server()
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+BASE = {"model": "debug-guided",
+        "messages": [{"role": "user", "content": "emit json"}],
+        "max_tokens": 64, "temperature": 1.0, "seed": 3}
+
+SCHEMA = {"type": "object",
+          "properties": {"name": {"type": "string", "maxLength": 6},
+                         "n": {"type": "integer"}},
+          "required": ["name", "n"]}
+
+
+def test_json_object_mode_chat():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": {"type": "json_object"}})
+        assert r.status == 200
+        data = await r.json()
+        choice = data["choices"][0]
+        txt = choice["message"]["content"]
+        if choice["finish_reason"] == "stop":
+            assert isinstance(json.loads(txt), dict)
+        else:  # length-cut: still a valid JSON prefix by construction
+            assert txt.lstrip()[:1] in ("{", "")
+    with_client(body)
+
+
+def test_json_schema_mode_validates():
+    async def body(client):
+        for seed in (1, 2, 5):
+            r = await client.post("/v1/chat/completions", json={
+                **BASE, "seed": seed, "max_tokens": 96,
+                "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {"name": "thing", "schema": SCHEMA}}})
+            assert r.status == 200
+            data = await r.json()
+            choice = data["choices"][0]
+            if choice["finish_reason"] == "stop":
+                obj = json.loads(choice["message"]["content"])
+                jsonschema.validate(obj, SCHEMA)
+    with_client(body)
+
+
+def test_json_object_streaming():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "stream": True,
+            "response_format": {"type": "json_object"}})
+        assert r.status == 200
+        raw = await r.text()
+        chunks = [json.loads(line[len("data: "):])
+                  for line in raw.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        content = "".join(
+            c["choices"][0]["delta"].get("content") or "" for c in chunks)
+        finish = [c["choices"][0]["finish_reason"] for c in chunks
+                  if c["choices"][0]["finish_reason"]]
+        if finish == ["stop"]:
+            assert isinstance(json.loads(content), dict)
+    with_client(body)
+
+
+def test_response_format_on_completions():
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-guided", "prompt": "json: ", "max_tokens": 64,
+            "temperature": 1.0, "seed": 9,
+            "response_format": {"type": "json_object"}})
+        assert r.status == 200
+        data = await r.json()
+        choice = data["choices"][0]
+        if choice["finish_reason"] == "stop":
+            assert isinstance(json.loads(choice["text"]), dict)
+    with_client(body)
+
+
+def test_forced_tool_choice_guarantees_calls():
+    tools = [{"type": "function", "function": {
+        "name": "set_value",
+        "parameters": {"type": "object",
+                       "properties": {"v": {"type": "integer"}},
+                       "required": ["v"]}}}]
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "max_tokens": 128, "tools": tools,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "set_value"}}})
+        assert r.status == 200
+        data = await r.json()
+        choice = data["choices"][0]
+        if choice["finish_reason"] in ("tool_calls", "stop"):
+            calls = choice["message"].get("tool_calls", [])
+            assert len(calls) == 1
+            assert calls[0]["function"]["name"] == "set_value"
+            args = json.loads(calls[0]["function"]["arguments"])
+            assert isinstance(args["v"], int)
+            # grammar-forced: no plain-text answer beside whitespace
+            assert (choice["message"].get("content") or "").strip() == ""
+    with_client(body)
+
+
+def test_guided_400s():
+    async def body(client):
+        # unsupported schema construct
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": {
+                "type": "json_schema",
+                "json_schema": {"schema": {"$ref": "#/x"}}}})
+        assert r.status == 400
+        assert "$ref" in (await r.json())["error"]["message"]
+        # unknown response_format type
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": {"type": "grammar"}})
+        assert r.status == 400
+        # malformed response_format
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": "json"})
+        assert r.status == 400
+        # response_format + forced tool_choice is contradictory
+        tools = [{"type": "function", "function": {"name": "f"}}]
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "tools": tools, "tool_choice": "required",
+            "response_format": {"type": "json_object"}})
+        assert r.status == 400
+        # json_schema without a schema body
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": {"type": "json_schema"}})
+        assert r.status == 400
+    with_client(body)
+
+
+def test_tool_named_required_is_pinned():
+    # a function literally named "required" must be treated as a NAMED
+    # choice (judged from the body's dict shape), not as the mode string
+    tools = [
+        {"type": "function", "function": {
+            "name": "required",
+            "parameters": {"type": "object",
+                           "properties": {"v": {"type": "integer"}},
+                           "required": ["v"]}}},
+        {"type": "function", "function": {"name": "other"}},
+    ]
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "max_tokens": 128, "tools": tools,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "required"}}})
+        assert r.status == 200
+        data = await r.json()
+        choice = data["choices"][0]
+        if choice["finish_reason"] in ("tool_calls", "stop"):
+            calls = choice["message"].get("tool_calls", [])
+            assert len(calls) == 1
+            # pinned to the named function, never "other"
+            assert calls[0]["function"]["name"] == "required"
+    with_client(body)
+
+
+def test_response_format_text_is_noop():
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            **BASE, "response_format": {"type": "text"}, "max_tokens": 8})
+        assert r.status == 200
+    with_client(body)
