@@ -37,7 +37,9 @@ import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
 from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
-from llms_on_kubernetes_tpu.engine.sampling import MAX_CANDIDATES, sample
+from llms_on_kubernetes_tpu.engine.sampling import (
+    MAX_CANDIDATES, HostSample, sample,
+)
 from llms_on_kubernetes_tpu.models.decoder import (
     forward_chunk, forward_decode, forward_prefill, init_params,
 )
@@ -241,13 +243,10 @@ class StepEvent:
 @dataclasses.dataclass
 class InflightStep:
     """A launched-but-unharvested decode step (async scheduling)."""
-    res: Any                               # device SampleResult
+    pack: Any                              # device [B, 2+2K] packed result
+    toks: Any                              # device [B] sampled tokens (merge)
     active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
     seq: int = -1                          # harvester sequence number
-
-    @property
-    def toks(self):
-        return self.res.tokens
 
 
 class _Harvester(threading.Thread):
@@ -570,7 +569,7 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     if fsm is not None:
         new_state = jnp.where(constrained & (lengths > 0),
                               _fsm_next(nxt_all, res.tokens), base)
-    return res, k_pages, v_pages, counts, new_state
+    return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
@@ -632,7 +631,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
     if fsm is not None:
         new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
                                  lengths, slots)
-    return res, k_pages, v_pages, counts, new_state
+    return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
 
 
 def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
@@ -667,7 +666,7 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     if fsm is not None:
         new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
                                  lengths, slots)
-    return res, k_pages, v_pages, counts, new_state
+    return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
 
 
 # packed chunk columns: 0 chunk_len, 1 history, 2 top_k, 3 temps(bits),
@@ -719,20 +718,19 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     if fsm is not None:
         new_state = _fsm_scatter(fsm, g_rows, init, nxt_all, res.tokens,
                                  lengths, slots)
-    return res, k_pages, v_pages, counts, new_state
+    return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
 
 
-def _start_host_copy(res) -> None:
-    """Begin async device->host transfer of a SampleResult's leaves."""
-    for leaf in (res.tokens, res.logprobs, res.top_ids, res.top_logprobs):
-        try:
-            leaf.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
+def _start_host_copy(pack) -> None:
+    """Begin async device->host transfer of a step's packed result."""
+    try:
+        pack.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
 
 
 def _lp_entry(host_res, row: int) -> tuple:
-    """(logprob, top_ids, top_logprobs) for one row of a host SampleResult."""
+    """(logprob, top_ids, top_logprobs) for one row of a HostSample."""
     return (float(host_res.logprobs[row]),
             host_res.top_ids[row].tolist(),
             host_res.top_logprobs[row].tolist())
@@ -1260,14 +1258,14 @@ class Engine:
         for the WHOLE prompt are already allocated/adopted. Pure dispatch:
         each chunk chains on the previous through the donated page pool —
         no host read here, so the async pipeline stays full. Returns the
-        FINAL chunk's device SampleResult (row 0 is the request's first
-        generated token)."""
+        FINAL chunk's (packed result, device tokens) pair (row 0 is the
+        request's first generated token)."""
         from llms_on_kubernetes_tpu.engine.multihost import MSG_CHUNK
 
         n = len(prefill_tokens)
         step = max(self.config.prefill_buckets)
         pps = self.allocator.pages_per_slot
-        res = None
+        pack = toks = None
         pos = start
         while pos < n:
             m = min(step, n - pos)
@@ -1301,7 +1299,7 @@ class Engine:
             packed[0, _CHK_COLS:] = self.allocator.page_tables[slot]
             self._mh_send(MSG_CHUNK, pre_tokens=tokens, pre_packed=packed,
                           fsm_used=use_fsm)
-            (res, self.k_pages, self.v_pages, self.token_counts,
+            (pack, toks, self.k_pages, self.v_pages, self.token_counts,
              new_state) = self._chunk_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(packed), self.k_pages, self.v_pages,
@@ -1312,7 +1310,7 @@ class Engine:
                 self._fsm_state = new_state
             pos += m
         self.slot_len[slot] = n
-        return res
+        return pack, toks
 
     def _cache_salt_for(self, images) -> Optional[bytes]:
         """Prefix-cache digest salt, computed ONCE at submit (a blocked
@@ -1428,7 +1426,7 @@ class Engine:
             deep = deep.reshape(deep.shape[0], -1, deep.shape[-1])[:, None]
         pos3_dev = None if pos3 is None else jnp.asarray(pos3)
         use_fsm = bool(packed[0, _FSM_PRE] >= 0)  # same bytes on followers
-        (res, self.k_pages, self.v_pages, self.token_counts,
+        (pack, toks, self.k_pages, self.v_pages, self.token_counts,
          new_state) = self._mm_prefill_packed(
             self.params, cfg, jnp.asarray(tokens), jnp.asarray(packed),
             embeds[None], deep, pos3_dev, self.k_pages, self.v_pages,
@@ -1437,7 +1435,7 @@ class Engine:
         )
         if new_state is not None:
             self._fsm_state = new_state
-        return res
+        return pack, toks
 
     def _dispatch_mm_prefill(self, slot: int, req: Request,
                              prefill_tokens: list[int]):
@@ -1473,9 +1471,9 @@ class Engine:
                           pre_packed=packed)
             mh.send_mm_payload(self._mh_shapes, req.images,
                                None if pos3 is None else pos3[0])
-        res = self._mm_execute(req.images, tokens, packed, pos3)
+        pack, toks = self._mm_execute(req.images, tokens, packed, pos3)
         self.slot_len[slot] = n
-        return res
+        return pack, toks
 
     # ------------------------------------------------------------------
     # grammar-constrained decoding: device-table residency
@@ -1631,12 +1629,13 @@ class Engine:
             self._fsm_replay(req)  # stages fsm_set for the next decode
 
         if req.images is not None and hit == 0:
-            res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
+            pack, _toks = self._dispatch_mm_prefill(slot, req, prefill_tokens)
         elif hit > 0 or n > max(self.config.prefill_buckets):
             # cache-hit admissions run the chunk path: prefill-with-history
             # attention over the remainder, history = the adopted prefix
             # (for a multimodal hit the remainder is pure text)
-            res = self._chunked_prefill(slot, req, prefill_tokens, start=hit)
+            pack, _toks = self._chunked_prefill(slot, req, prefill_tokens,
+                                                start=hit)
         else:
             from llms_on_kubernetes_tpu.engine.multihost import MSG_PREFILL
 
@@ -1650,7 +1649,7 @@ class Engine:
             use_fsm = packed[0, _FSM_PRE] >= 0
             self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed,
                           fsm_used=use_fsm)
-            (res, self.k_pages, self.v_pages, self.token_counts,
+            (pack, _toks, self.k_pages, self.v_pages, self.token_counts,
              new_state) = self._prefill_packed(
                 self.params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(packed), self.k_pages, self.v_pages,
@@ -1668,7 +1667,7 @@ class Engine:
         if resumed:
             req.pending_token = req.output[-1]
             return []
-        host = jax.device_get(res)
+        host = HostSample(np.asarray(jax.device_get(pack)))
         first = int(host.tokens[0])
         req.pending_token = first
         req.first_token_at = time.monotonic()
@@ -1773,7 +1772,7 @@ class Engine:
 
         use_fsm = self._fsm_any_active()
         self._mh_send(MSG_DECODE, dec_packed=packed, fsm_used=use_fsm)
-        (res, self.k_pages, self.v_pages, self.token_counts,
+        (pack, _toks, self.k_pages, self.v_pages, self.token_counts,
          new_state) = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             self._zeros_B, self._zeros_1, self.k_pages, self.v_pages,
@@ -1782,7 +1781,7 @@ class Engine:
         )
         if new_state is not None:
             self._fsm_state = new_state
-        host = jax.device_get(res)
+        host = HostSample(np.asarray(jax.device_get(pack)))
 
         events: list[StepEvent] = []
         for i, r in active:
@@ -1805,6 +1804,17 @@ class Engine:
         cur = self.slots[slot]
         return sum(1 for s in self._inflight
                    for j, r in s.active if j == slot and r is cur)
+
+    def _inflight_counts(self) -> dict:
+        """Per-slot in-flight step counts in ONE pass over the pipeline
+        (same semantics as _inflight_count, amortized for the launch
+        path's B consumers)."""
+        counts: dict[int, int] = {}
+        for s in self._inflight:
+            for j, r in s.active:
+                if self.slots[j] is r:
+                    counts[j] = counts.get(j, 0) + 1
+        return counts
 
     def _admit_async(self, events: list[StepEvent]):
         """Admission without host sync: prefill up to admit_batch waiting
@@ -1867,13 +1877,14 @@ class Engine:
         if long_pick is not None:
             slot, req, resumed, prefill_tokens, hit = long_pick
             if req.images is not None and hit == 0:
-                res = self._dispatch_mm_prefill(slot, req, prefill_tokens)
+                pack, toks = self._dispatch_mm_prefill(slot, req,
+                                                       prefill_tokens)
                 n_chunks = 2  # image encode + prefill
             else:
                 # cache-hit remainder (pure text for multimodal hits) or
                 # an out-of-bucket text prompt
-                res = self._chunked_prefill(slot, req, prefill_tokens,
-                                            start=hit)
+                pack, toks = self._chunked_prefill(slot, req, prefill_tokens,
+                                                   start=hit)
                 n_chunks = -(-(len(prefill_tokens) - hit)
                              // max(self.config.prefill_buckets))
             if req.cache_salt is not None:
@@ -1881,13 +1892,13 @@ class Engine:
                                                salt=req.cache_salt)
             self._busy_until = (max(time.monotonic(), self._busy_until)
                                 + 2.0 * n_chunks * self._est_step)
-            merge = {"toks": res.tokens, "slots": {}}
+            merge = {"toks": toks, "slots": {}}
             if resumed:
                 req.pending_token = req.output[-1]
                 merge["slots"][slot] = (True, req.output[-1], 0)
             else:
                 key = -1 - next(self._first_counter)
-                self._harvester.push(key, res, priority=True)
+                self._harvester.push(key, pack, priority=True)
                 merge["slots"][slot] = (False, 0, 0)
                 self._pending_first.append((req, key, 0))
             return merge
@@ -1913,7 +1924,7 @@ class Engine:
         use_fsm = bool((packed[:, _FSM_PRE] >= 0).any())
         self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed,
                       fsm_used=use_fsm)
-        (res, self.k_pages, self.v_pages, self.token_counts,
+        (pack, toks, self.k_pages, self.v_pages, self.token_counts,
          new_state) = self._prefill_packed(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray(packed), self.k_pages, self.v_pages,
@@ -1930,8 +1941,8 @@ class Engine:
         if any(not resumed for _, _, resumed, _ in picked):
             # priority read: first tokens jump the decode-read queue
             key = -1 - next(self._first_counter)
-            self._harvester.push(key, res, priority=True)
-        merge = {"toks": res.tokens, "slots": {}}
+            self._harvester.push(key, pack, priority=True)
+        merge = {"toks": toks, "slots": {}}
         for row, (slot, req, resumed, _ptoks) in enumerate(picked):
             if resumed:
                 # pending token is already host-known (the last emitted
@@ -1962,14 +1973,20 @@ class Engine:
             if self._busy_until - time.monotonic() > pace * self._est_step:
                 return "paced"
 
-        # grow page tables; drain in-flight work, then preempt, on exhaustion
+        # grow page tables; drain in-flight work, then preempt, on exhaustion.
+        # inflight counts are computed ONCE per pass (a per-slot
+        # _inflight_count scan is O(B * depth * B) per launch — measured
+        # ~6 ms/step at B=64, a real slice of the step budget on a
+        # small-core host) and recomputed only when a drain/preempt
+        # changes the in-flight set.
+        infl = self._inflight_counts()
         i = 0
         while i < B:
             r = self.slots[i]
             if r is None:
                 i += 1
                 continue
-            need = int(self.slot_len[i]) + self._inflight_count(i) + 1
+            need = int(self.slot_len[i]) + infl.get(i, 0) + 1
             if need > max_len:
                 i += 1  # rides along idle; finishes by length at harvest
                 continue
@@ -1981,8 +1998,10 @@ class Engine:
                     # freeing may come from finishes hiding in unharvested
                     # steps — drain before resorting to preemption
                     events += self._harvest(drain=True)
+                    infl = self._inflight_counts()
                     continue
                 self._preempt_youngest()
+                infl = self._inflight_counts()
 
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
@@ -1994,7 +2013,7 @@ class Engine:
         packed[:, 5] = np.float32(1.0).view(np.int32)      # top_p disabled
         packed[:, _FSM_DEC] = -1                           # unconstrained
         for i, r in active:
-            need = int(self.slot_len[i]) + self._inflight_count(i) + 1
+            need = int(self.slot_len[i]) + infl.get(i, 0) + 1
             packed[i, 0] = 0 if need > max_len else need
             packed[i, 3] = r.params.top_k
             packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
@@ -2016,7 +2035,7 @@ class Engine:
                     packed[i, 1], packed[i, 2] = 1, host_val
                 else:                    # fresh: token sampled by the prefill
                     packed[i, 1], packed[i, 7] = 2, row
-            elif self._inflight_count(i) > 0:
+            elif infl.get(i, 0) > 0:
                 packed[i, 1] = 0         # newest in-flight step's output
             else:
                 packed[i, 1], packed[i, 2] = 1, r.pending_token
@@ -2034,7 +2053,7 @@ class Engine:
         self._mh_send(MSG_DECODE, dec_packed=packed,
                       last_valid=bool(self._inflight),
                       use_prefill=admitted is not None, fsm_used=use_fsm)
-        (res, self.k_pages, self.v_pages, self.token_counts,
+        (pack, toks, self.k_pages, self.v_pages, self.token_counts,
          new_state) = self._decode_packed(
             self.params, self.model_config, jnp.asarray(packed),
             last_toks, prefill_toks, self.k_pages, self.v_pages,
@@ -2044,9 +2063,9 @@ class Engine:
         if new_state is not None:
             self._fsm_state = new_state
         seq = next(self._seq_counter)
-        step = InflightStep(res, active, seq)
+        step = InflightStep(pack, toks, active, seq)
         self._inflight.append(step)
-        self._harvester.push(seq, res)
+        self._harvester.push(seq, pack)
         now = time.monotonic()
         self._busy_until = max(now, self._busy_until) + self._est_step
         return "launched"
@@ -2156,7 +2175,7 @@ class Engine:
         for req, key, row in done_entries:
             if req.finished:
                 continue
-            host = self._harvester.get(key)
+            host = HostSample(np.asarray(self._harvester.get(key)))
             tok = int(host.tokens[row])
             req.pending_token = tok
             req.first_token_at = time.monotonic()
@@ -2173,7 +2192,7 @@ class Engine:
             if self._head_blocking_first() is not None:
                 break  # the step's request still awaits its first token
             step = self._inflight.popleft()
-            host = self._harvester.get(step.seq)
+            host = HostSample(np.asarray(self._harvester.get(step.seq)))
             processed = step.seq
             n_steps += 1
             for slot, req in step.active:

@@ -296,11 +296,11 @@ def follower_loop(engine: Any) -> None:
         if op == MSG_MM_PREFILL:
             images, pos3 = receive_mm_payload(
                 shapes, engine.model_config.vision.num_channels, bucket)
-            res = engine._mm_execute(
+            _pack, toks = engine._mm_execute(
                 images, m["pre_tokens"][:k, :bucket],
                 m["pre_packed"][:k, :_PRE_COLS + pps],
                 None if pos3 is None else pos3[None])
-            prefill_toks = res.tokens
+            prefill_toks = toks
             continue
         fsm = engine._fsm_args() if fsm_used else None
         if op in (MSG_PREFILL, MSG_CHUNK):
@@ -308,27 +308,27 @@ def follower_loop(engine: Any) -> None:
             tokens = jnp.asarray(m["pre_tokens"][:k, :bucket])
             packed = jnp.asarray(m["pre_packed"][:k, :cols])
             fn = engine._prefill_packed if op == MSG_PREFILL else engine._chunk_packed
-            (res, engine.k_pages, engine.v_pages, engine.token_counts,
-             new_state) = fn(
+            (_pack, toks, engine.k_pages, engine.v_pages,
+             engine.token_counts, new_state) = fn(
                 engine.params, engine.model_config, tokens, packed,
                 engine.k_pages, engine.v_pages, engine.token_counts,
                 engine._key, fsm,
             )
             if new_state is not None:
                 engine._fsm_state = new_state
-            prefill_toks = res.tokens
+            prefill_toks = toks
         elif op == MSG_DECODE:
             packed = jnp.asarray(m["dec_packed"])
             last = last_toks if last_valid else engine._zeros_B
             pre = prefill_toks if use_prefill else engine._zeros_1
-            (res, engine.k_pages, engine.v_pages, engine.token_counts,
-             new_state) = engine._decode_packed(
+            (_pack, toks, engine.k_pages, engine.v_pages,
+             engine.token_counts, new_state) = engine._decode_packed(
                 engine.params, engine.model_config, packed, last, pre,
                 engine.k_pages, engine.v_pages, engine.token_counts,
                 engine._key, fsm,
             )
             if new_state is not None:
                 engine._fsm_state = new_state
-            last_toks = res.tokens
+            last_toks = toks
         else:
             raise ValueError(f"unknown multihost op {op}")

@@ -182,6 +182,33 @@ class SampleResult:
     def tree_unflatten(cls, aux, children):
         return cls(*children)
 
+    def host_pack(self) -> jnp.ndarray:
+        """All four outputs as ONE [B, 2 + 2K] int32 array (floats ride
+        bitcast). The tunnel pays a per-ARRAY cost on device->host reads
+        (measured ~6 ms/leaf mid-pipeline): reading one packed array per
+        step instead of four leaves is a ~4x cut in the harvester's host
+        work — which is what bounds throughput on small-core hosts."""
+        lp = jax.lax.bitcast_convert_type(self.logprobs, jnp.int32)
+        tlp = jax.lax.bitcast_convert_type(self.top_logprobs, jnp.int32)
+        return jnp.concatenate(
+            [self.tokens[:, None], lp[:, None], self.top_ids, tlp], axis=1)
+
+
+class HostSample:
+    """Host-side view of a device_get of SampleResult.host_pack()."""
+
+    __slots__ = ("tokens", "logprobs", "top_ids", "top_logprobs")
+
+    def __init__(self, arr):
+        import numpy as np
+
+        K = (arr.shape[1] - 2) // 2
+        self.tokens = arr[:, 0]
+        self.logprobs = np.ascontiguousarray(arr[:, 1]).view(np.float32)
+        self.top_ids = arr[:, 2:2 + K]
+        self.top_logprobs = np.ascontiguousarray(
+            arr[:, 2 + K:2 + 2 * K]).view(np.float32)
+
 
 def make_sampling_arrays(requests, num_slots: int):
     """Host helper: build [num_slots] parameter arrays from per-slot request

@@ -1,0 +1,187 @@
+"""Decode-step profiler: where does the step time go?
+
+Round-4 verdict: decode sits at ~39% of the v5e HBM roofline and nobody
+has published a breakdown. This script measures, on the real chip:
+
+1. PURE DEVICE step time — N decode steps chained on device (each step's
+   sampled tokens feed the next through last_toks, exactly like the async
+   pipeline), ONE final read. Amortizes the tunnel RTT away.
+2. ENGINE-LOOP step time — the same config driven through Engine.step()
+   at full batch (what bench.py measures), isolating host/scheduler cost.
+3. An op-level breakdown from a jax.profiler trace over the chained
+   window (device "X" events summed by op name).
+
+Usage (real TPU):  python scripts/profile_decode.py [--steps 40]
+Env: BENCH_SLOTS/BENCH_PAGE/BENCH_KV/BENCH_MODEL as bench.py.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def steady_packed(eng, lengths_val: int) -> np.ndarray:
+    """A full-batch decode packed array at a fixed context length."""
+    from llms_on_kubernetes_tpu.engine.engine import (
+        _BIAS_DEC, _DEC_COLS, _FSM_DEC,
+    )
+
+    B = eng.config.max_decode_slots
+    pps = eng.allocator.pages_per_slot
+    packed = np.zeros((B, _DEC_COLS + pps), np.int32)
+    packed[:, 0] = lengths_val
+    packed[:, 1] = 0                                # src: last_toks chain
+    packed[:, 4] = np.float32(0.0).view(np.int32)   # greedy
+    packed[:, 5] = np.float32(1.0).view(np.int32)
+    packed[:, _FSM_DEC] = -1
+    packed[:, _BIAS_DEC:_BIAS_DEC + 32] = -1
+    packed[:, _DEC_COLS:] = eng.allocator.page_tables
+    return packed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--ctx", type=int, default=96, help="context length")
+    ap.add_argument("--trace", default="/tmp/llmk-prof")
+    ap.add_argument("--engine-steps", type=int, default=200)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "/root/repo")
+    from bench import build_engine, make_configs, warm_engine
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    ecfg, cfg, prompt_len, gen_len = make_configs()
+    print(f"platform={jax.devices()[0].platform} model={ecfg.model} "
+          f"B={ecfg.max_decode_slots} page={ecfg.page_size} "
+          f"kv={ecfg.kv_cache_dtype or ecfg.dtype}", flush=True)
+    eng = build_engine(ecfg, cfg)
+    rng = np.random.default_rng(0)
+    warm_engine(eng, cfg, prompt_len, rng)
+
+    # occupy every slot so page tables are real
+    B = ecfg.max_decode_slots
+    reqs = [eng.submit(list(rng.integers(1, 100, prompt_len)),
+                       SamplingParams(temperature=0.0, max_tokens=gen_len))
+            for _ in range(B)]
+    for _ in range(200):
+        eng.step()
+        if all(r is not None for r in eng.slots):
+            break
+    eng._drain_async()
+    # grow allocations to cover the probed context length
+    for i in range(B):
+        eng.allocator.allocate(i, args.ctx + 2)
+
+    packed_np = steady_packed(eng, args.ctx)
+    packed = jnp.asarray(packed_np)
+    toks = jnp.asarray(np.full((B,), 17, np.int32))
+
+    def chain(n):
+        nonlocal toks
+        t0 = time.monotonic()
+        for _ in range(n):
+            (_pack, toks, eng.k_pages, eng.v_pages, eng.token_counts,
+             _state) = eng._decode_packed(
+                eng.params, cfg, packed, toks, eng._zeros_1, eng.k_pages,
+                eng.v_pages, eng.token_counts, eng._key, None)
+        np.asarray(toks)  # ONE synchronizing read
+        return time.monotonic() - t0
+
+    chain(4)  # warm this exact shape/chain
+    wall = chain(args.steps)
+    rtt_probe = chain(1)  # ~dispatch + RTT + 1 step
+    per_step = (wall - rtt_probe) / (args.steps - 1)
+    print(f"pure-device decode step: {1000 * per_step:.2f} ms "
+          f"({args.steps} chained; 1-step probe {1000 * rtt_probe:.1f} ms)",
+          flush=True)
+    print(f"  => {B / per_step:.0f} tok/s/chip device ceiling at B={B}",
+          flush=True)
+
+    # --- op-level trace over a chained window -------------------------
+    os.makedirs(args.trace, exist_ok=True)
+    try:
+        jax.profiler.start_trace(args.trace)
+        chain(10)
+        jax.profiler.stop_trace()
+    except Exception as e:
+        print(f"trace failed: {e}", flush=True)
+    else:
+        report_trace(args.trace, n_steps=10)
+
+    # --- engine-loop comparison ---------------------------------------
+    for r in reqs:
+        eng.abort(r)
+    eng.step()
+    eng._drain_async()
+    reqs = [eng.submit(list(rng.integers(1, 100, prompt_len)),
+                       SamplingParams(temperature=0.0, max_tokens=gen_len))
+            for _ in range(B - 1)]
+    t0 = time.monotonic()
+    total = 0
+    window_start = window_tokens = None
+    end_t = end_tok = None
+    while any(not r.finished for r in reqs):
+        events = eng.step()
+        total += sum(len(ev.new_tokens) for ev in events)
+        active = sum(r is not None for r in eng.slots)
+        now = time.monotonic()
+        if events and active >= B - 1:
+            if window_start is None:
+                window_start, window_tokens = now, total
+            end_t, end_tok = now, total
+    if window_start is not None and end_t is not None and end_t > window_start:
+        tps = (end_tok - window_tokens) / (end_t - window_start)
+        print(f"engine-loop steady decode: {tps:.0f} tok/s "
+              f"({1000 * (B - 1) / tps:.2f} ms/step at B={B - 1})",
+              flush=True)
+    print(f"total wall {time.monotonic() - t0:.1f}s", flush=True)
+
+
+def report_trace(trace_dir: str, n_steps: int) -> None:
+    """Sum device-track "X" events by op name across the trace."""
+    files = glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz"))
+    if not files:
+        print("no trace files found", flush=True)
+        return
+    path = max(files, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device pids: process names containing "TPU" / "/device:"
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "args" in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower() or "Chip" in n}
+    agg: dict = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        agg[name] = agg.get(name, 0.0) + e.get("dur", 0.0)
+    total = sum(agg.values())
+    print(f"-- device op breakdown ({path.split('/')[-1]}, "
+          f"{n_steps} steps, {total / 1000 / n_steps:.2f} ms/step "
+          f"device-busy) --", flush=True)
+    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:18]:
+        print(f"  {dur / 1000 / n_steps:8.3f} ms/step  "
+              f"{100 * dur / max(total, 1e-9):5.1f}%  {name[:90]}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
