@@ -152,8 +152,13 @@ _MAX_RMW_PAGES = 33
 # time — two engines in one process may differ, and mutating the env var
 # mid-process is no longer silently ignored (it was never re-read; now it
 # is explicitly documented as resolved once at EngineConfig construction).
-KV_WRITE_STRATEGIES = ("dus", "scatter", "scatter-linear")
-_active_kv_write = "dus"
+#
+# "fused" (default): the decode write folds INTO the Pallas attention
+# kernel (ops/attention.dispatch_paged_attention_write) — no separate
+# write op at all; falls back to "dus" behavior wherever the fused kernel
+# doesn't apply (CP meshes, int8 KV, traced windows, small head_dim).
+KV_WRITE_STRATEGIES = ("fused", "dus", "scatter", "scatter-linear")
+_active_kv_write = "fused"
 
 
 def set_kv_write_strategy(strategy: str) -> None:
@@ -164,15 +169,19 @@ def set_kv_write_strategy(strategy: str) -> None:
     _active_kv_write = strategy
 
 
+def kv_write_strategy() -> str:
+    return _active_kv_write
+
+
 def default_kv_write_strategy() -> str:
     """Resolve the env default ONCE (EngineConfig construction time)."""
     import os
 
-    s = os.environ.get("LLMK_KV_WRITE", "dus")
+    s = os.environ.get("LLMK_KV_WRITE", "fused")
     # legacy spelling: LLMK_KV_WRITE=scatter + LLMK_SCATTER_VARIANT=linear
     if s == "scatter" and os.environ.get("LLMK_SCATTER_VARIANT") == "linear":
         s = "scatter-linear"
-    return s if s in KV_WRITE_STRATEGIES else "dus"
+    return s if s in KV_WRITE_STRATEGIES else "fused"
 
 
 def _scatter_decode_writes() -> bool:

@@ -212,30 +212,39 @@ def _layer_step(
             q, k,
             positions if rope_positions is None else rope_positions,
             inv_freq)
-    k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table, write_positions)
+    if mode == "decode":
+        # decode: the current token's KV append rides INSIDE the paged
+        # attention dispatch (fused Pallas write on the fast path — no
+        # per-slot DUS loop; plain write+attend elsewhere)
+        from llms_on_kubernetes_tpu.ops.attention import (
+            dispatch_paged_attention_write,
+        )
 
-    if mode == "prefill":
-        attn = dispatch_prefill_attention(
-            q, k, v, lengths,
-            scale=scale, sliding_window=window,
-            attn_softcap=cfg.attn_softcap, mm_groups=mm_groups,
-        )
-    elif mode == "chunk":
-        # chunked prefill: queries attend to previous chunks' cached KV
-        # plus this chunk, through the page table (history = global
-        # position of the chunk's first token)
-        attn = dispatch_chunk_attention(
-            q, k_pages, v_pages, page_table,
-            positions[:, 0], lengths,
-            scale=scale, sliding_window=window,
-            attn_softcap=cfg.attn_softcap,
-        )
-    else:
-        attn = dispatch_paged_attention(
+        attn, k_pages, v_pages = dispatch_paged_attention_write(
             q[:, 0], k_pages, v_pages, page_table, lengths,
+            k[:, 0], v[:, 0], write_positions,
             scale=scale, sliding_window=window,
             attn_softcap=cfg.attn_softcap,
-        )[:, None]
+        )
+        attn = attn[:, None]
+    else:
+        k_pages, v_pages = write_tokens(k_pages, v_pages, k, v, page_table,
+                                        write_positions)
+        if mode == "prefill":
+            attn = dispatch_prefill_attention(
+                q, k, v, lengths,
+                scale=scale, sliding_window=window,
+                attn_softcap=cfg.attn_softcap, mm_groups=mm_groups,
+            )
+        else:  # "chunk": queries attend to previous chunks' cached KV
+            # plus this chunk, through the page table (history = global
+            # position of the chunk's first token)
+            attn = dispatch_chunk_attention(
+                q, k_pages, v_pages, page_table,
+                positions[:, 0], lengths,
+                scale=scale, sliding_window=window,
+                attn_softcap=cfg.attn_softcap,
+            )
     out = qeinsum("bthk,hkd->btd", attn, lp["wo"])
     if cfg.post_norms:
         out = rms_norm(out, lp["attn_post_norm"], cfg.rms_norm_eps, style=cfg.norm_style)

@@ -300,6 +300,64 @@ def dispatch_chunk_attention(q, k_pages, v_pages, page_table, history,
                            attn_softcap=attn_softcap)
 
 
+def dispatch_paged_attention_write(q, k_pages, v_pages, page_table, lengths,
+                                   k_new, v_new, write_positions, *, scale,
+                                   sliding_window=None, attn_softcap=None):
+    """Decode attention WITH the current token's KV append.
+
+    On the Pallas fast path the write folds INTO the attention kernel
+    (pallas_paged.pallas_paged_attention_write): the per-slot program DMAs
+    the new row into the pool in place and merges the current token's
+    contribution in registers — eliminating the per-slot DUS write loop
+    (~3 ms/step of dispatch overhead at B=64, round-4 profile). Anywhere
+    the fused kernel doesn't apply (CP meshes, int8 KV pools, traced
+    gemma windows, sub-128 head_dim on real TPU, kv_write config other
+    than "fused") this is exactly write_tokens + dispatch_paged_attention.
+
+    q [B, n_q, d]; k_new/v_new [B, n_kv, d] (post-rope);
+    write_positions [B, 1] (negative => idle/trash).
+    Returns (attn [B, n_q, d], k_pages, v_pages)."""
+    from llms_on_kubernetes_tpu.engine.cache import kv_write_strategy
+    from llms_on_kubernetes_tpu.ops.cp import dispatch_write_tokens
+    from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism
+
+    on_cpu = jax.default_backend() == "cpu"
+    d_ok = q.shape[-1] % 128 == 0 or on_cpu
+    # the in-kernel append is an 8-token-block RMW (Mosaic sublane tiling):
+    # sub-8 page sizes can't host an aligned block
+    kd_shape = getattr(k_pages, "data", k_pages).shape
+    page_ok = kd_shape[2] % 8 == 0 or on_cpu
+    fused = (kv_write_strategy() == "fused"
+             and seq_parallelism() == 1
+             and not getattr(k_pages, "quantized", False)
+             and use_pallas_kernels() and _static_window(sliding_window)
+             and d_ok and page_ok)
+    if fused:
+        from llms_on_kubernetes_tpu.ops.pallas_paged import (
+            pallas_paged_attention_write,
+        )
+
+        kd = getattr(k_pages, "data", k_pages)
+        vd = getattr(v_pages, "data", v_pages)
+        attn, kd, vd = pallas_paged_attention_write(
+            q, kd, vd, page_table, lengths, k_new, v_new, scale=scale,
+            sliding_window=sliding_window, attn_softcap=attn_softcap,
+            interpret=jax.default_backend() == "cpu",
+        )
+        if hasattr(k_pages, "data"):
+            from llms_on_kubernetes_tpu.engine.cache import KVPool
+
+            return attn, KVPool(kd), KVPool(vd)
+        return attn, kd, vd
+    k_pages, v_pages = dispatch_write_tokens(
+        k_pages, v_pages, k_new[:, None], v_new[:, None], page_table,
+        write_positions)
+    attn = dispatch_paged_attention(
+        q, k_pages, v_pages, page_table, lengths, scale=scale,
+        sliding_window=sliding_window, attn_softcap=attn_softcap)
+    return attn, k_pages, v_pages
+
+
 def dispatch_paged_attention(q, k_pages, v_pages, page_table, lengths, *,
                              scale, sliding_window=None, attn_softcap=None):
     from llms_on_kubernetes_tpu.parallel.mesh import seq_parallelism

@@ -318,6 +318,233 @@ def pallas_paged_attention_int8(
     return out.reshape(B, n_q, d)
 
 
+def _paged_kernel_write(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    lengths_ref,      # SMEM [B]                (scalar prefetch)
+    q_ref,            # VMEM [1, n_kv, group, d]
+    k_hbm,            # ANY  [n_kv, P, page, d] (aliased with k_out)
+    v_hbm,            # ANY  [n_kv, P, page, d] (aliased with v_out)
+    k_new_ref,        # VMEM [1, n_kv, d] — current token's K
+    v_new_ref,        # VMEM [1, n_kv, d]
+    o_ref,            # VMEM [1, n_kv, group, d]
+    k_out,            # ANY  (alias of k_hbm)
+    v_out,            # ANY  (alias of v_hbm)
+    k_buf,            # VMEM [n_kv, S, d] scratch
+    v_buf,            # VMEM [n_kv, S, d] scratch
+    kblk,             # VMEM [n_kv, 8, d] write-block scratch
+    vblk,             # VMEM [n_kv, 8, d]
+    sems,             # DMA semaphores [2, pages_per_seq]
+    wsem,             # DMA semaphores [2] (write-block RMW)
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    attn_softcap: Optional[float],
+    page_size: int,
+    pages_per_seq: int,
+):
+    """Decode attention WITH the current token's KV write folded in.
+
+    The per-slot DUS write loop costs ~3 ms/step at B=64 (4096 tiny ops
+    of pure dispatch overhead — round-4 profile), and the opt-in HLO
+    scatter reserves a ~0.37-pool HBM temp that breaks the 16 GB bench
+    config at compile time. This kernel removes the separate write
+    entirely: each slot's program (which is already running for the
+    attention) DMAs its new K/V row [n_kv, d] into the pool page
+    in place (input_output aliasing) and folds the current token into
+    the softmax IN REGISTERS via the online-softmax merge — so the row
+    never needs to be read back from HBM, and cached-page DMAs cover
+    only the length-1 previously written tokens.
+
+    Idle slots (length == 0) skip the write and produce a harmless
+    pure-current-token output (discarded by the engine)."""
+    b = pl.program_id(0)
+    S = pages_per_seq * page_size
+    length = lengths_ref[b]
+    cached = length - 1                       # tokens already in the pool
+    n_pages = (cached + page_size - 1) // page_size
+
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _start(i=i):
+            page_id = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                k_hbm.at[:, page_id],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i],
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[:, page_id],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i],
+            ).start()
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _wait(i=i):
+            pltpu.make_async_copy(
+                k_hbm.at[:, page_table_ref[b, i]],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i],
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[:, page_table_ref[b, i]],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i],
+            ).wait()
+
+    # Write-back of the new row, AFTER the cached-page reads are done (the
+    # target page is often in this program's own read set — its stale
+    # lanes beyond `cached` are masked, so read-then-write order is safe).
+    # Mosaic requires page-dim slices be 8-sublane-tile aligned, so this
+    # is an 8-token-block READ-MODIFY-WRITE: fetch the aligned block the
+    # new token lands in, splice the row in with a vector select, DMA the
+    # block back. The block's other rows are the same slot's own earlier
+    # tokens (pages are slot-private at the write position — adopted
+    # prefix pages always end before it) or unwritten garbage, both of
+    # which round-trip unchanged.
+    pos = jnp.maximum(cached, 0)
+    w_pid = page_table_ref[b, pos // page_size]
+    off8 = pl.multiple_of((pos % page_size) // 8 * 8, 8)
+
+    @pl.when(length > 0)
+    def _write_fetch():
+        pltpu.make_async_copy(
+            k_hbm.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).start()
+        pltpu.make_async_copy(
+            v_hbm.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).start()
+
+    @pl.when(length > 0)
+    def _write_back():
+        pltpu.make_async_copy(
+            k_hbm.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).wait()
+        pltpu.make_async_copy(
+            v_hbm.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).wait()
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (1, 8, 1), 1) == (pos % page_size) - off8
+        kblk[...] = jnp.where(row, k_new_ref[0][:, None, :], kblk[...])
+        vblk[...] = jnp.where(row, v_new_ref[0][:, None, :], vblk[...])
+        pltpu.make_async_copy(
+            kblk, k_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).start()
+        pltpu.make_async_copy(
+            vblk, v_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).start()
+
+    q = q_ref[0].astype(jnp.float32)                   # [n_kv, group, d]
+    k = k_buf[:].astype(jnp.float32)                   # [n_kv, S, d]
+    v = v_buf[:].astype(jnp.float32)
+    n_kv, group, d = q.shape
+    v = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (n_kv, S, 1), 1) < cached, v, 0.0)
+
+    logits = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [n_kv, group, S]
+    logits = softcap(logits, attn_softcap)
+
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (n_kv, group, S), 2)
+    mask = k_pos < cached
+    if sliding_window is not None:
+        mask &= k_pos > cached - sliding_window        # q_pos == cached
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    # current token, in registers (never read back from HBM); always
+    # inside any sliding window (it IS the query position)
+    k_new = k_new_ref[0].astype(jnp.float32)           # [n_kv, d]
+    v_new = v_new_ref[0].astype(jnp.float32)
+    l_cur = jnp.sum(q * k_new[:, None, :], axis=-1) * scale  # [n_kv, group]
+    l_cur = softcap(l_cur, attn_softcap)
+
+    m1 = jnp.max(logits, axis=-1)                      # [n_kv, group]
+    m = jnp.maximum(m1, l_cur)
+    p = jnp.exp(logits - m[..., None])
+    num = jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                  # [n_kv, group, d]
+    w_cur = jnp.exp(l_cur - m)                         # [n_kv, group]
+    num = num + w_cur[..., None] * v_new[:, None, :]
+    den = jnp.sum(p, axis=-1) + w_cur
+    o_ref[0] = (num / den[..., None]).astype(o_ref.dtype)
+
+    @pl.when(length > 0)
+    def _finish():
+        pltpu.make_async_copy(
+            kblk, k_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).wait()
+        pltpu.make_async_copy(
+            vblk, v_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).wait()
+
+
+def pallas_paged_attention_write(
+    q: jnp.ndarray,            # [B, n_q, d]
+    k_pages: jnp.ndarray,      # [n_kv, P, page, d] (head-major pool; donated)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    lengths: jnp.ndarray,      # [B] int32 (incl. current token; 0 => idle)
+    k_new: jnp.ndarray,        # [B, n_kv, d] current token's K (post-rope)
+    v_new: jnp.ndarray,        # [B, n_kv, d]
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused decode attention + in-place KV append (see _paged_kernel_write).
+    Returns (attn [B, n_q, d], k_pages, v_pages)."""
+    B, n_q, d = q.shape
+    n_kv, P, page_size, _ = k_pages.shape
+    pages_per_seq = page_table.shape[1]
+    S = pages_per_seq * page_size
+    group = n_q // n_kv
+
+    kernel = functools.partial(
+        _paged_kernel_write,
+        scale=scale, sliding_window=sliding_window,
+        attn_softcap=attn_softcap,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+    )
+    qg = q.reshape(B, n_kv, group, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, n_kv, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, n_kv, d), lambda b, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, S, d), k_pages.dtype),
+            pltpu.VMEM((n_kv, S, d), v_pages.dtype),
+            pltpu.VMEM((n_kv, 8, d), k_pages.dtype),
+            pltpu.VMEM((n_kv, 8, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_seq)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # inputs count scalar-prefetch args first: pt=0, lengths=1, q=2,
+        # k_pages=3, v_pages=4, k_new=5, v_new=6; outputs: attn=0, k=1, v=2
+        input_output_aliases={3: 1, 4: 2},
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pages, v_pages,
+      k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype))
+    return out.reshape(B, n_q, d), k_pages, v_pages
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
 )

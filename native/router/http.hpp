@@ -81,6 +81,9 @@ class SockReader {
   bool timed_out() const { return timed_out_; }
   bool consumed_any() const { return consumed_any_; }
   void reset_consumed() { consumed_any_ = false; }
+  // bytes past the response framing still sitting in the buffer mean the
+  // connection is desynced and must not return to a keep-alive pool
+  bool has_buffered() const { return pos_ < len_; }
 
   // Reads until "\r\n" (tolerates bare "\n"); returns false on EOF/error.
   bool read_line(std::string& line, size_t max_len = 64 * 1024) {

@@ -167,20 +167,31 @@ def report_trace(trace_dir: str, n_steps: int) -> None:
                  and "args" in e}
     dev_pids = {p for p, n in pid_names.items()
                 if "TPU" in n or "/device" in n.lower() or "Chip" in n}
+    import re
+
     agg: dict = {}
+    counts: dict = {}
+    parent = 0.0
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in dev_pids:
             continue
         name = e.get("name", "?")
-        agg[name] = agg.get(name, 0.0) + e.get("dur", 0.0)
+        if name.startswith("jit_"):      # whole-module parent span
+            parent += e.get("dur", 0.0)
+            continue
+        # group op instances: strip trailing .N / digits (fusion.324,
+        # pallas_paged_attention.77 -> one family each)
+        fam = re.sub(r"[.\d]+$", "", name)
+        agg[fam] = agg.get(fam, 0.0) + e.get("dur", 0.0)
+        counts[fam] = counts.get(fam, 0) + 1
     total = sum(agg.values())
-    print(f"-- device op breakdown ({path.split('/')[-1]}, "
-          f"{n_steps} steps, {total / 1000 / n_steps:.2f} ms/step "
-          f"device-busy) --", flush=True)
-    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:18]:
+    print(f"-- device op breakdown ({path.split('/')[-1]}, {n_steps} steps; "
+          f"module span {parent / 1000 / n_steps:.2f} ms/step, child ops "
+          f"{total / 1000 / n_steps:.2f} ms/step) --", flush=True)
+    for fam, dur in sorted(agg.items(), key=lambda kv: -kv[1])[:22]:
         print(f"  {dur / 1000 / n_steps:8.3f} ms/step  "
-              f"{100 * dur / max(total, 1e-9):5.1f}%  {name[:90]}",
-              flush=True)
+              f"{100 * dur / max(total, 1e-9):5.1f}%  x{counts[fam]:<5d} "
+              f"{fam[:80]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -444,3 +444,64 @@ def test_config_file_mode_chart_schema(binary, tmp_path):
         proc.terminate()
         proc.wait(timeout=5)
         backend.shutdown()
+
+
+def test_upstream_connections_are_pooled(binary):
+    """Round-5: sequential client requests must REUSE the upstream TCP
+    connection (keep-alive pool) instead of a fresh connect per request —
+    the per-request handshake was a measurable slice of gateway TTFT
+    (round-4 verdict item 3)."""
+    conns = []
+
+    class CountingBackend(FakeBackend):
+        name = "counted"
+
+        def setup(self):
+            conns.append(self.client_address)
+            super().setup()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), CountingBackend)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    router = RouterProc(binary, {"counted": srv.server_address[1]})
+    try:
+        for _ in range(6):
+            status, body = router.request(
+                "POST", "/v1/chat/completions", body={"model": "counted"})
+            assert status == 200
+            assert json.loads(body)["served_by"] == "counted"
+            # the handler thread releases the socket right after the last
+            # response byte; give it a beat so the next request finds it
+            time.sleep(0.05)
+        # 6 proxied requests must NOT open 6 upstream connections (the
+        # release/acquire hand-off allows an occasional fresh connect on
+        # a loaded single-core host, so tolerate a stray one)
+        assert len(conns) <= 2, conns
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_pooled_connection_death_is_retried(binary):
+    """An upstream that closes idle keep-alive connections must not surface
+    as a 502: the router retries once on a fresh connection when a POOLED
+    socket yields zero response bytes."""
+    class ClosingBackend(FakeBackend):
+        name = "closer"
+
+        def do_POST(self):  # noqa: N802
+            super().do_POST()
+            # close after every response: the pooled socket dies idle
+            self.close_connection = True
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ClosingBackend)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    router = RouterProc(binary, {"closer": srv.server_address[1]})
+    try:
+        for _ in range(4):  # every request after the first may hit a dead fd
+            status, body = router.request(
+                "POST", "/v1/chat/completions", body={"model": "closer"})
+            assert status == 200
+            assert json.loads(body)["served_by"] == "closer"
+    finally:
+        router.stop()
+        srv.shutdown()

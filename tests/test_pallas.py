@@ -147,3 +147,47 @@ def test_engine_greedy_identical_under_pallas(monkeypatch):
     monkeypatch.setenv("LLMK_ATTENTION_IMPL", "pallas")
     out = run()
     assert out == ref, f"pallas diverged: {out} vs {ref}"
+
+
+@pytest.mark.parametrize("window,softcap", [(None, None), (9, None), (None, 40.0)])
+def test_paged_decode_fused_write_matches_reference(rng, window, softcap):
+    """The fused write+attend kernel (decode KV append folded into the
+    attention program — the round-5 replacement for the per-slot DUS
+    loop) must match write_tokens + paged_attention exactly: same
+    attention output and, outside the never-read trash page 0, the same
+    pool bytes. Covers mid-page, page-boundary, length-1, and idle rows."""
+    from llms_on_kubernetes_tpu.engine.cache import KVPool, write_tokens
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_attention_write,
+    )
+
+    B, n_q, n_kv, d, page, pps = 5, 4, 2, 8, 8, 4
+    lengths_np = np.asarray([13, 16, 1, 0, 32], np.int32)  # 16, 32: new page
+    k_pages, v_pages, table = _paged_setup(rng, B, n_kv, d, page, pps, lengths_np)
+    q = jnp.asarray(rng.normal(size=(B, n_q, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(B, n_kv, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray(lengths_np)
+
+    wp = np.where(lengths_np > 0, lengths_np - 1, -1)[:, None].astype(np.int32)
+    kp_ref, vp_ref = write_tokens(
+        KVPool(k_pages), KVPool(v_pages), k_new[:, None], v_new[:, None],
+        table, jnp.asarray(wp))
+    ref = paged_attention(q, kp_ref.data, vp_ref.data, table, lengths,
+                          scale=d ** -0.5, sliding_window=window,
+                          attn_softcap=softcap)
+
+    out, kp2, vp2 = pallas_paged_attention_write(
+        q, k_pages, v_pages, table, lengths, k_new, v_new,
+        scale=d ** -0.5, sliding_window=window, attn_softcap=softcap,
+        interpret=True)
+    act = lengths_np > 0
+    np.testing.assert_allclose(np.asarray(out)[act], np.asarray(ref)[act],
+                               rtol=2e-5, atol=2e-5)
+    assert np.isfinite(np.asarray(out)).all()  # idle row must not NaN
+    # pools identical outside the trash page (the DUS reference writes
+    # idle rows there; the fused kernel skips them entirely)
+    np.testing.assert_array_equal(np.asarray(kp2)[:, 1:],
+                                  np.asarray(kp_ref.data)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(vp2)[:, 1:],
+                                  np.asarray(vp_ref.data)[:, 1:])
