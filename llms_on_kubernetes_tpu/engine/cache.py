@@ -153,12 +153,15 @@ _MAX_RMW_PAGES = 33
 # mid-process is no longer silently ignored (it was never re-read; now it
 # is explicitly documented as resolved once at EngineConfig construction).
 #
-# "fused" (default): the decode write folds INTO the Pallas attention
-# kernel (ops/attention.dispatch_paged_attention_write) — no separate
-# write op at all; falls back to "dus" behavior wherever the fused kernel
-# doesn't apply (CP meshes, int8 KV, traced windows, small head_dim).
+# "fused": the decode write folds INTO the Pallas attention kernel
+# (ops/attention.dispatch_paged_attention_write) — no separate write op
+# at all; falls back to "dus" behavior wherever the fused kernel doesn't
+# apply (CP meshes, int8 KV, traced windows, small head_dim). Opt-in
+# (LLMK_KV_WRITE=fused) until validated on hardware: the kernel is only
+# interpreter-tested on CPU, and a silent KV corruption is the worst
+# failure mode a serving engine can ship as a default.
 KV_WRITE_STRATEGIES = ("fused", "dus", "scatter", "scatter-linear")
-_active_kv_write = "fused"
+_active_kv_write = "dus"
 
 
 def set_kv_write_strategy(strategy: str) -> None:
@@ -177,11 +180,11 @@ def default_kv_write_strategy() -> str:
     """Resolve the env default ONCE (EngineConfig construction time)."""
     import os
 
-    s = os.environ.get("LLMK_KV_WRITE", "fused")
+    s = os.environ.get("LLMK_KV_WRITE", "dus")
     # legacy spelling: LLMK_KV_WRITE=scatter + LLMK_SCATTER_VARIANT=linear
     if s == "scatter" and os.environ.get("LLMK_SCATTER_VARIANT") == "linear":
         s = "scatter-linear"
-    return s if s in KV_WRITE_STRATEGIES else "fused"
+    return s if s in KV_WRITE_STRATEGIES else "dus"
 
 
 def _scatter_decode_writes() -> bool:

@@ -47,6 +47,17 @@ from llms_on_kubernetes_tpu.models.decoder import (
 Params = dict[str, Any]
 
 
+class EngineStallError(RuntimeError):
+    """The device failed to complete a step within the watchdog budget.
+
+    Raised on the engine thread when a wedged device (or its transport)
+    stops producing step completions. ``Engine.step`` converts it into a
+    clean shed: every in-flight and waiting request finishes with reason
+    "stalled", the engine marks itself ``wedged`` (readiness flips, no
+    further dispatch), and submit() rejects new work — HTTP 503 upstream
+    instead of a hung serving loop."""
+
+
 class QueueFullError(RuntimeError):
     """Admission rejected: the waiting queue is at max_waiting capacity.
     The API layer maps this to HTTP 429 + Retry-After."""
@@ -146,22 +157,35 @@ class EngineConfig:
     max_grammars: int = 4
     grammar_states: int = 4096
     grammar_classes: int = 512
-    # decode KV write strategy: "dus" | "scatter" | "scatter-linear"
-    # (cache.py discusses the tradeoff). None => the LLMK_KV_WRITE env
+    # decode KV write strategy: "dus" (default) | "scatter" |
+    # "scatter-linear" | "fused" (opt-in until hardware-validated —
+    # cache.py discusses the tradeoff). None => the LLMK_KV_WRITE env
     # default, resolved ONCE in __post_init__ — the strategy is part of
     # the engine's static config and baked into its executables, so env
     # mutation after construction has no effect (by design, documented)
     # and two engines in one process may use different strategies.
     kv_write: Optional[str] = None
+    # watchdog: a device step that produces no completion within
+    # max(watchdog_stall_s, 50 x recent step estimate) is declared stalled
+    # — the engine sheds all work (EngineStallError -> "stalled" finishes,
+    # wedged state, 503s upstream) instead of blocking forever in a
+    # harvester wait. None => env LLMK_WATCHDOG_S (default 120); <= 0
+    # disables.
+    watchdog_stall_s: Optional[float] = None
     seed: int = 0
 
     def __post_init__(self):
+        import os
+
         from llms_on_kubernetes_tpu.engine.cache import (
             KV_WRITE_STRATEGIES, default_kv_write_strategy,
         )
 
         if self.kv_write is None:
             self.kv_write = default_kv_write_strategy()
+        if self.watchdog_stall_s is None:
+            self.watchdog_stall_s = float(
+                os.environ.get("LLMK_WATCHDOG_S", "120"))
         if self.kv_write not in KV_WRITE_STRATEGIES:
             raise ValueError(
                 f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
@@ -327,6 +351,13 @@ class _Harvester(threading.Thread):
                     batch = [self._pending.popleft() for _ in range(n)]
                     priority = False
             try:
+                # deterministic fault hooks (LLMK_FAULT=): a wedged device
+                # read ("engine_stall" hangs here; the engine thread's
+                # watchdog wait must fire) or a slow-but-live one
+                # ("slow_step" delays each read)
+                from llms_on_kubernetes_tpu import faults
+                faults.inject_hang("engine_stall")
+                faults.inject_delay("slow_step", 0.2)
                 host = jax.device_get([r for _, r in batch])
             except BaseException as e:  # noqa: BLE001 — must not die silent
                 with self._cv:
@@ -369,28 +400,51 @@ class _Harvester(threading.Thread):
         with self._cv:
             return self._done[key]
 
-    def wait_done(self, seq: int, wake: Optional[threading.Event] = None) -> None:
+    def wait_done(self, seq: int, wake: Optional[threading.Event] = None,
+                  timeout_s: Optional[float] = None) -> None:
         """Block until step ``seq`` is done — or, if ``wake`` is given,
         until it is set (a new submission wants admission NOW — submit()
         pokes this cv; the caller re-enters its loop and the next step()
-        admits before waiting again)."""
+        admits before waiting again). With ``timeout_s`` (the engine's
+        watchdog budget) raises EngineStallError if the step is still
+        incomplete at the deadline."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
         with self._cv:
             while self._done_upto < seq:
                 self._check_error()
                 if wake is not None and wake.is_set():
                     return
-                self._cv.wait()
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise EngineStallError(
+                        f"device step {seq} produced no completion within "
+                        f"{timeout_s:.1f}s watchdog budget")
+                self._cv.wait(timeout=min(remaining, 1.0))
 
     def poke(self) -> None:
         """Wake any wait_done(wake=...) waiter (called from submit())."""
         with self._cv:
             self._cv.notify_all()
 
-    def wait_key(self, key: int) -> None:
+    def wait_key(self, key: int, timeout_s: Optional[float] = None) -> None:
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
         with self._cv:
             while key not in self._done:
                 self._check_error()
-                self._cv.wait()
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise EngineStallError(
+                        f"prefill result {key} did not arrive within "
+                        f"{timeout_s:.1f}s watchdog budget")
+                self._cv.wait(timeout=min(remaining, 1.0))
 
     def discard_upto(self, seq: int) -> None:
         with self._cv:
@@ -921,6 +975,10 @@ class Engine:
         self._est_step = 0.02
         self._busy_until = 0.0
         self._last_harvest_t: Optional[float] = None
+        # watchdog: set by _shed_wedged() when a device step exceeded the
+        # stall budget; a wedged engine rejects submissions (the server
+        # flips readiness and a restart is the only recovery)
+        self.wedged = False
         # prompt scoring (echo+logprobs): wrapper built eagerly — jit()
         # itself is free, compilation is per-shape on first use, and an
         # unsynchronized lazy init would let concurrent requests each pay
@@ -941,6 +999,10 @@ class Engine:
         on_event=None,
         images=None,
     ) -> Request:
+        if self.wedged:
+            raise EngineStallError(
+                "engine wedged: a device step stalled past the watchdog "
+                "budget; restart the server to recover")
         params = params or SamplingParams()
         max_len = self.config.max_model_len
         if len(prompt) == 0:
@@ -1154,11 +1216,25 @@ class Engine:
         set_active_mesh(self.mesh)
         set_kv_write_strategy(self.config.kv_write)
         events: list[StepEvent] = []
+        if self.wedged:
+            # nothing left to drive; reap anything that slipped in between
+            # the wedge and the server's readiness flip
+            events += self._reap_aborted()
+            for ev in events:
+                payload = (ev.new_tokens, ev.finished, ev.finish_reason)
+                ev.request.events.put(payload)
+                if ev.request.on_event is not None:
+                    ev.request.on_event(payload)
+            return events
         events += self._reap_aborted()
         if self._async:
-            admitted = self._admit_async(events)
-            status = self._launch_decode_async(admitted, events)
-            events += self._harvest(drain=status == "idle")
+            try:
+                admitted = self._admit_async(events)
+                status = self._launch_decode_async(admitted, events)
+                events += self._harvest(drain=status == "idle")
+            except EngineStallError as e:
+                events += self._shed_wedged(str(e))
+                status = "idle"
             if status == "paced" and not events and not self.waiting:
                 # nothing to do until device work completes; a bounded nap
                 # keeps the loop from burning the GIL the harvester needs
@@ -1702,6 +1778,52 @@ class Engine:
             req.slot = -1
         return StepEvent(req, [], True, reason)
 
+    # ------------------------------------------------------------------
+    # watchdog
+    # ------------------------------------------------------------------
+
+    def _stall_budget(self) -> Optional[float]:
+        """Max seconds a blocking harvest wait may sit with no completion.
+
+        ``max(watchdog_stall_s, 50 x recent step estimate)`` — the floor
+        keeps a slow-but-live device (long prefill compile, first-step
+        tracing) out of the abort path, while the step-estimate multiple
+        scales up for genuinely slow configs. None disables the watchdog.
+        """
+        limit = self.config.watchdog_stall_s
+        if not limit or limit <= 0:
+            return None
+        return max(float(limit), 50.0 * self._est_step)
+
+    def _shed_wedged(self, why: str) -> list[StepEvent]:
+        """A device step blew the watchdog budget: the accelerator (or its
+        transport) is wedged and no in-flight work will ever complete.
+        Finish every request with reason "stalled" so clients get a clean
+        terminal event instead of a hang, and mark the engine wedged —
+        submit() rejects from here on and the server flips readiness; a
+        process restart is the only recovery."""
+        import sys
+
+        print(f"[engine] WATCHDOG: {why} — shedding all requests and "
+              f"marking engine wedged", file=sys.stderr, flush=True)
+        self.wedged = True
+        events: list[StepEvent] = []
+        with self._lock:
+            doomed = list(self.waiting)
+            self.waiting.clear()
+        for r in doomed:
+            if not r.finished:
+                events.append(self._finish(r, "stalled"))
+        for r in list(self.slots):
+            if r is not None and not r.finished:
+                events.append(self._finish(r, "stalled"))
+        for req, _key, _row in self._pending_first:
+            if not req.finished:
+                events.append(self._finish(req, "stalled"))
+        self._inflight.clear()
+        self._pending_first = []
+        return events
+
     def _preempt_youngest(self) -> None:
         """Free the most recently admitted request's pages; requeue it to
         re-prefill (prompt + generated so far) when memory frees up."""
@@ -2087,6 +2209,7 @@ class Engine:
         if not self._inflight and not self._pending_first:
             return events
         depth = max(1, self.config.async_depth)
+        budget = self._stall_budget()
         n_steps = 0
         while True:
             n_steps += self._collect_ready(events)
@@ -2102,14 +2225,15 @@ class Engine:
             # and feed the model a wrong input token.
             key = self._head_blocking_first()
             if key is not None:
-                self._harvester.wait_key(key)
+                self._harvester.wait_key(key, timeout_s=budget)
                 continue
             if self._inflight:
                 k = (len(self._inflight) if drain
                      else len(self._inflight) - (depth - 1))
                 self._harvester.wait_done(
                     self._inflight[k - 1].seq,
-                    wake=None if drain else self._admit_wake)
+                    wake=None if drain else self._admit_wake,
+                    timeout_s=budget)
                 if not drain and self._admit_wake.is_set():
                     # a submission wants admission NOW; collect whatever
                     # completed and hand control back (pipeline may sit
@@ -2118,7 +2242,8 @@ class Engine:
                     break
                 continue
             # drain with only firsts left
-            self._harvester.wait_key(self._pending_first[0][1])
+            self._harvester.wait_key(self._pending_first[0][1],
+                                     timeout_s=budget)
         # pacing calibration: completion spacing per decode step bounds the
         # device step time from ABOVE (reads add latency, never remove it),
         # so track the MINIMUM with slow upward drift. A mean/EMA here is

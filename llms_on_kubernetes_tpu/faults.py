@@ -1,0 +1,91 @@
+"""Deterministic fault injection for the serving spine (``LLMK_FAULT=``).
+
+Every fault-tolerance path in this repo — entry-point timeouts, router
+retries/breakers, the engine watchdog — is testable on CPU by flipping an
+environment variable instead of waiting for real infrastructure to break.
+The hooks are read from the environment *at call time*, so tests can
+monkeypatch ``LLMK_FAULT`` (or pass it to a subprocess) without import-order
+games, and production pays one ``os.environ.get`` per hook site.
+
+Spec grammar::
+
+    LLMK_FAULT="<name>[:<arg>][;<name>[:<arg>]...]"
+
+Known fault names (each documented at its injection site):
+
+- ``backend_hang``        — accelerator-backend initialization never
+  returns (simulates a wedged TPU runtime).  Injected immediately before
+  the first backend touch in ``bench.py``'s probe subprocess; the parent's
+  hard timeout must convert it into a clean JSON error.
+- ``engine_stall[:N]``    — the harvester never observes completion of the
+  N-th (default: first) device step, simulating a hung device program.
+  The engine watchdog must detect it and shed in-flight work.
+- ``slow_step[:SECONDS]`` — every device-step completion is delayed by
+  SECONDS (default 0.2), for pacing/timeout tests that need a slow but
+  live device.
+
+Routers do not read ``LLMK_FAULT``; their faults (connection resets,
+stalled responses) are injected by the fake upstream backends in the test
+fixtures, which is both more deterministic and closer to the real failure.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+ENV_VAR = "LLMK_FAULT"
+
+
+def _parse(raw: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, arg = part.partition(":")
+        out[name.strip()] = arg.strip()
+    return out
+
+
+def get(name: str) -> str | None:
+    """Arg string of fault ``name`` ("" if given bare), or None if inactive."""
+    return _parse(os.environ.get(ENV_VAR, "")).get(name)
+
+
+def is_active(name: str) -> bool:
+    return get(name) is not None
+
+
+def get_float(name: str, default: float) -> float | None:
+    """Float arg of fault ``name`` (``default`` if bare); None if inactive."""
+    arg = get(name)
+    if arg is None:
+        return None
+    try:
+        return float(arg) if arg else default
+    except ValueError:
+        return default
+
+
+def inject_hang(name: str, hang_s: float = 3600.0) -> None:
+    """If fault ``name`` is active, sleep far past any caller's deadline.
+
+    The caller is expected to wrap the hanging code path in a hard timeout
+    (subprocess timeout, watchdog) — the injected hang proves that timeout
+    actually fires.  Sleeps in 1 s slices, re-checking the env each slice,
+    so signals still interrupt and an in-process test's monkeypatch
+    teardown releases a hung background thread instead of stranding it.
+    """
+    if not is_active(name):
+        return
+    deadline = time.monotonic() + hang_s
+    while time.monotonic() < deadline and is_active(name):
+        time.sleep(1.0)
+
+
+def inject_delay(name: str, default_s: float) -> None:
+    """If fault ``name`` is active, sleep its arg (or ``default_s``)."""
+    s = get_float(name, default_s)
+    if s is not None and s > 0:
+        time.sleep(s)

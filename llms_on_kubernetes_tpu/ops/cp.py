@@ -32,11 +32,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+from llms_on_kubernetes_tpu.ops.shard_map_compat import shard_map
 
 from llms_on_kubernetes_tpu.ops.attention import NEG_INF, _gather_pool, softcap
 from llms_on_kubernetes_tpu.parallel.mesh import (
@@ -92,7 +90,7 @@ def dispatch_write_tokens(k_pages, v_pages, k, v, page_table, positions):
         body, mesh=mesh,
         in_specs=(pool_spec, pool_spec, kv_spec, kv_spec, P(), P()),
         out_specs=(pool_spec, pool_spec),
-        check_vma=False,
+        check=False,
     )(k_pages, v_pages, k, v, page_table, positions)
 
 
@@ -166,7 +164,7 @@ def cp_paged_attention(q, k_pages, v_pages, page_table, lengths, *, scale,
         body, mesh=mesh,
         in_specs=(q_spec, pool_spec, pool_spec, P(), P()),
         out_specs=q_spec,
-        check_vma=False,
+        check=False,
     )(q, k_pages, v_pages, page_table, lengths)
 
 
@@ -219,5 +217,5 @@ def cp_chunk_attention(q, k_pages, v_pages, page_table, history,
         body, mesh=mesh,
         in_specs=(q_spec, pool_spec, pool_spec, P(), P(), P()),
         out_specs=q_spec,
-        check_vma=False,
+        check=False,
     )(q, k_pages, v_pages, page_table, history, chunk_lengths)

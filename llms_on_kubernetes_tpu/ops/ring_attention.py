@@ -124,8 +124,7 @@ def ring_prefill_attention(
     'model' inside the shard_map (when it divides evenly), so CP×TP runs
     with no head all-gather — each device owns its heads' slice of its
     sequence chunk and only K/V blocks move, around the seq ring."""
-    from jax import shard_map
-
+    from llms_on_kubernetes_tpu.ops.shard_map_compat import shard_map
     from llms_on_kubernetes_tpu.parallel.mesh import AXIS_MODEL
 
     n_q, n_kv = q.shape[2], k.shape[2]
@@ -141,6 +140,6 @@ def ring_prefill_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec, P()),
         out_specs=spec,
-        check_vma=False,
+        check=False,
     )
     return fn(q, k, v, lengths)

@@ -1,0 +1,29 @@
+"""shard_map across JAX versions, resolved once at import.
+
+Two things moved under us: the import location (jax.experimental.shard_map
+-> top-level jax.shard_map) and the replication-check kwarg
+(check_rep -> check_vma). Passing the wrong spelling is a TypeError at
+trace time, which took down every CP/ring code path on jax 0.4.x
+(observed: dryrun_multichip's CP layout and tests/test_ring.py). All
+in-repo callers go through this wrapper instead of importing shard_map
+directly.
+"""
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # new import location
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    """shard_map with the replication/VMA check spelled for the running
+    JAX version. ``check=False`` everywhere in this repo: the specs are
+    exact by construction and the check re-traces."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check})

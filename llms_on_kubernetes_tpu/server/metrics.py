@@ -136,4 +136,8 @@ def engine_metrics(registry: Registry) -> dict:
         "prefix_hit_tokens": Gauge(
             "llm_prefix_cache_hit_tokens_total",
             "Prompt tokens served from the prefix cache", registry),
+        "engine_state": Gauge(
+            "llm_engine_state",
+            "Serving lifecycle: 0=loading 1=serving 2=draining 3=wedged",
+            registry),
     }

@@ -239,6 +239,13 @@ class OpenAIServer:
         self.metrics = engine_metrics(self.registry)
         self.loop_thread = EngineLoop(engine, self.metrics)
         self.engine = engine
+        # readiness lifecycle: loading -> serving -> draining; "wedged" is
+        # derived from the engine watchdog and overrides everything.
+        # /health (liveness) fails ONLY when wedged — a restart helps
+        # there and nowhere else; /ready (readiness) is 200 only while
+        # serving, so k8s pulls the pod from endpoints during load and
+        # the preStop drain window without killing it.
+        self._state = "loading"
         # grammar-constrained decoding (response_format / forced
         # tool_choice): the tokenizer's byte map is derived once on first
         # use; compiled grammars are cached in engine/grammar.py
@@ -256,6 +263,7 @@ class OpenAIServer:
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=self.MAX_BODY_BYTES)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/ready", self.ready)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
@@ -277,8 +285,10 @@ class OpenAIServer:
     async def _start_loop(self, app) -> None:
         if not self.loop_thread.is_alive():
             self.loop_thread.start()
+        self._state = "serving"
 
     async def _stop_loop(self, app) -> None:
+        self._state = "draining"
         self.loop_thread.stop()
         if self.loop_thread.is_alive():
             # join OFF the event loop so cleanup isn't blocked; the join
@@ -293,8 +303,39 @@ class OpenAIServer:
     # endpoints
     # ------------------------------------------------------------------
 
+    STATE_CODES = {"loading": 0, "serving": 1, "draining": 2, "wedged": 3}
+
+    @property
+    def state(self) -> str:
+        """Lifecycle state for probes; wedged (engine watchdog fired)
+        overrides the loading/serving/draining progression."""
+        if self.engine is not None and getattr(self.engine, "wedged", False):
+            return "wedged"
+        return self._state
+
     async def health(self, request: web.Request) -> web.Response:
+        # liveness: fail ONLY when a restart would help. Loading and
+        # draining are healthy; a wedged device step is not.
+        if self.state == "wedged":
+            return web.json_response(
+                {"error": {"message": "engine wedged: device step stalled",
+                           "type": "service_unavailable"}},
+                status=503)
         return web.Response(text="OK")
+
+    async def ready(self, request: web.Request) -> web.Response:
+        # readiness: only "serving" takes traffic. Non-200 while loading,
+        # draining (preStop window) or wedged pulls the pod from Service
+        # endpoints without restarting it.
+        state = self.state
+        self.metrics["engine_state"].set(self.STATE_CODES.get(state, 0))
+        if state == "serving":
+            return web.json_response({"state": state})
+        return web.json_response(
+            {"state": state,
+             "error": {"message": f"not ready: {state}",
+                       "type": "service_unavailable"}},
+            status=503)
 
     # JAX profiler hooks (SURVEY §5 tracing gap: the reference exposed no
     # profiling at all). Traces land under the operator-configured
@@ -405,6 +446,8 @@ class OpenAIServer:
         return web.json_response({"prompt": self.tokenizer.decode(toks)})
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        self.metrics["engine_state"].set(
+            self.STATE_CODES.get(self.state, 0))
         return web.Response(
             text=self.registry.render(),
             content_type="text/plain", charset="utf-8",
@@ -808,7 +851,8 @@ class OpenAIServer:
     async def _serve(self, request, body, prompts, *, chat: bool,
                      images=None, tools_on: bool = False,
                      tool_grammar=None) -> web.StreamResponse:
-        from llms_on_kubernetes_tpu.engine.engine import QueueFullError
+        from llms_on_kubernetes_tpu.engine.engine import (
+            EngineStallError, QueueFullError)
         from llms_on_kubernetes_tpu.engine.grammar import GrammarError
 
         try:
@@ -883,6 +927,13 @@ class OpenAIServer:
                         images=images)
                     req._aq = q
                     reqs.append(req)
+        except EngineStallError as e:
+            for r in reqs:
+                self.loop_thread.abort(r)
+            return web.json_response(
+                {"error": {"message": str(e), "type": "service_unavailable",
+                           "code": "engine_stalled"}},
+                status=503, headers={"Retry-After": "30"})
         except QueueFullError as e:
             for r in reqs:
                 self.loop_thread.abort(r)
@@ -1114,6 +1165,18 @@ class OpenAIServer:
             for r in reqs:
                 self.loop_thread.abort(r, "disconnect")
             raise
+
+        if any(r[2] == "stalled" for r in results):
+            # the engine watchdog shed this request: the device step it was
+            # riding never completed. A non-streaming client gets a clean
+            # 503 (a retry may land on a healthy replica) instead of a
+            # truncated completion masquerading as success.
+            return web.json_response(
+                {"error": {"message": "engine stalled while generating; "
+                           "request was aborted",
+                           "type": "service_unavailable",
+                           "code": "engine_stalled"}},
+                status=503, headers={"Retry-After": "30"})
 
         if best_of > n:
             # keep the n best candidates per prompt by mean token logprob;

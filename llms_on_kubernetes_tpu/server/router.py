@@ -18,6 +18,20 @@ SURVEY §3.1) with its defects fixed:
   broke SSE (api-gateway.yaml:99); this one never buffers.
 - 502 with a JSON error on upstream failure (api-gateway.yaml:100-104).
 
+Fault tolerance (the layer the pulled vLLM image got from its ingress for
+free, SURVEY §5 / ISSUE 1):
+
+- per-request **connect/read timeouts** (connect default 5 s, sock-read
+  default 120 s between chunks, total default 300 s);
+- **bounded retries** with exponential backoff + jitter, only on
+  connect-phase failures (no response head received yet — the request
+  body is fully buffered, so a resend cannot double-apply);
+- a per-upstream **circuit breaker**: after ``breaker_threshold``
+  consecutive transport failures the upstream is OPEN for
+  ``breaker_open_s`` seconds (503 + ``Retry-After``), then one half-open
+  probe decides close vs re-open;
+- consistent OpenAI-style error JSON for every gateway-generated failure.
+
 A native C++ implementation with identical semantics lives in
 native/router/ for the OpenResty-equivalent deployment; this Python one is
 the local-path/default router and the executable spec both are tested
@@ -26,7 +40,9 @@ against.
 
 from __future__ import annotations
 
+import asyncio
 import json
+import random
 import time
 from typing import Optional
 
@@ -39,6 +55,76 @@ HOP_BY_HOP = {
     "content-length",
 }
 
+# Connect-phase failures: the upstream never produced a response head, so
+# the (fully buffered) request is safe to resend. Read-phase failures after
+# the head arrives are NOT in this set — they are relayed/terminated, never
+# retried (the upstream may have executed the request).
+RETRYABLE_ERRORS = (
+    aiohttp.ClientConnectionError,   # incl. ClientConnectorError, ServerDisconnectedError
+    ConnectionResetError,
+    asyncio.TimeoutError,
+)
+
+
+def error_body(message: str, type_: str, code: str = "") -> dict:
+    body = {"error": {"message": message, "type": type_}}
+    if code:
+        body["error"]["code"] = code
+    return body
+
+
+class CircuitBreaker:
+    """Per-upstream consecutive-failure breaker (closed → open → half-open).
+
+    ``allow()`` gates requests; callers report outcomes via
+    ``record_success``/``record_failure``. While OPEN every request is
+    rejected until ``open_s`` elapses; then exactly one probe is admitted
+    (half-open) and its outcome closes or re-opens the circuit. The clock
+    is injectable so tests can drive the state machine deterministically.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, threshold: int = 5, open_s: float = 10.0,
+                 clock=time.monotonic):
+        self.threshold = max(1, threshold)
+        self.open_s = open_s
+        self.clock = clock
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probe_started: Optional[float] = None
+
+    def allow(self) -> bool:
+        now = self.clock()
+        if self.state == self.OPEN:
+            if now - self.opened_at < self.open_s:
+                return False
+            self.state = self.HALF_OPEN
+            self._probe_started = None
+        if self.state == self.HALF_OPEN:
+            # one probe at a time; a stuck probe frees the slot after open_s
+            if (self._probe_started is not None
+                    and now - self._probe_started < self.open_s):
+                return False
+            self._probe_started = now
+        return True
+
+    def record_success(self) -> None:
+        self.state = self.CLOSED
+        self.failures = 0
+        self._probe_started = None
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == self.HALF_OPEN or self.failures >= self.threshold:
+            self.state = self.OPEN
+            self.opened_at = self.clock()
+            self._probe_started = None
+
+    def retry_after_s(self) -> float:
+        return max(0.0, self.open_s - (self.clock() - self.opened_at))
+
 
 class Router:
     def __init__(
@@ -47,6 +133,13 @@ class Router:
         default_model: Optional[str] = None,
         strict: bool = False,
         upstream_timeout: float = 300.0,
+        connect_timeout: float = 5.0,
+        read_timeout: float = 120.0,
+        retry_attempts: int = 3,
+        retry_backoff_s: float = 0.2,
+        breaker_threshold: int = 5,
+        breaker_open_s: float = 10.0,
+        clock=time.monotonic,
     ):
         """backends: model name -> base URL (e.g. http://svc:8080)."""
         if not backends:
@@ -56,7 +149,16 @@ class Router:
         if self.default_model not in backends:
             raise ValueError(f"default model {self.default_model!r} not in backends")
         self.strict = strict
-        self.timeout = aiohttp.ClientTimeout(total=upstream_timeout)
+        self.timeout = aiohttp.ClientTimeout(
+            total=upstream_timeout, connect=connect_timeout,
+            sock_read=read_timeout,
+        )
+        self.retry_attempts = max(1, retry_attempts)
+        self.retry_backoff_s = retry_backoff_s
+        self.breakers = {
+            name: CircuitBreaker(breaker_threshold, breaker_open_s, clock)
+            for name in backends
+        }
         self._session: Optional[aiohttp.ClientSession] = None
 
     def make_app(self) -> web.Application:
@@ -118,9 +220,19 @@ class Router:
         model, err = self.select_backend(body)
         if err:
             return web.json_response(
-                {"error": {"message": err, "type": "invalid_request_error",
-                           "code": "model_not_found"}},
+                error_body(err, "invalid_request_error", "model_not_found"),
                 status=404,
+            )
+        breaker = self.breakers[model]
+        if not breaker.allow():
+            retry_after = max(1, int(breaker.retry_after_s() + 0.999))
+            return web.json_response(
+                error_body(
+                    f"upstream {model!r} unavailable (circuit open after "
+                    f"{breaker.failures} consecutive failures)",
+                    "service_unavailable", "upstream_circuit_open"),
+                status=503,
+                headers={"Retry-After": str(retry_after)},
             )
         base = self.backends[model].rstrip("/")
         url = f"{base}/{request.match_info['path']}"
@@ -138,11 +250,42 @@ class Router:
         headers["X-Forwarded-For"] = f"{prior}, {client_ip}" if prior else client_ip
         headers["X-Forwarded-Proto"] = request.scheme
 
+        # --- connect/request phase: bounded retries with backoff+jitter.
+        # Only failures BEFORE a response head are retried (the buffered
+        # body makes the resend safe); each transport failure feeds the
+        # breaker, so a dead upstream trips open instead of burning the
+        # full retry budget on every request.
+        upstream: Optional[aiohttp.ClientResponse] = None
+        last_err: Optional[BaseException] = None
+        for attempt in range(1, self.retry_attempts + 1):
+            try:
+                upstream = await self._session.request(
+                    request.method, url, data=body or None, headers=headers,
+                )
+                breaker.record_success()
+                break
+            except RETRYABLE_ERRORS as e:
+                breaker.record_failure()
+                last_err = e
+                if attempt >= self.retry_attempts or not breaker.allow():
+                    break
+                backoff = self.retry_backoff_s * (2 ** (attempt - 1))
+                await asyncio.sleep(backoff * (1.0 + random.random()))
+            except (aiohttp.ClientError, TimeoutError, OSError) as e:
+                breaker.record_failure()
+                last_err = e
+                break
+        if upstream is None:
+            return web.json_response(
+                error_body(f"upstream error: {last_err}", "bad_gateway",
+                           "upstream_error"),
+                status=502,
+            )
+
+        # --- relay phase: stream the response; never retried.
         resp: Optional[web.StreamResponse] = None
         try:
-            async with self._session.request(
-                request.method, url, data=body or None, headers=headers,
-            ) as upstream:
+            async with upstream:
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in HOP_BY_HOP:
@@ -154,10 +297,11 @@ class Router:
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, TimeoutError, OSError) as e:
+            breaker.record_failure()
             if resp is None or not resp.prepared:
                 return web.json_response(
-                    {"error": {"message": f"upstream error: {e}",
-                               "type": "bad_gateway"}},
+                    error_body(f"upstream error: {e}", "bad_gateway",
+                               "upstream_error"),
                     status=502,
                 )
             # Upstream died mid-stream: headers are already on the wire, so a

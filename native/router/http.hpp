@@ -325,8 +325,14 @@ inline std::optional<Url> parse_url(const std::string& url) {
   return u;
 }
 
-// Blocking connect with timeout (seconds). Returns fd or -1.
-inline int connect_to(const std::string& host, int port, int timeout_s) {
+// Blocking connect with separate connect and I/O timeouts (seconds).
+// ``connect_timeout_s`` bounds the TCP handshake (a dead host must fail in
+// seconds, not the 300 s read budget); ``timeout_s`` becomes the per-recv/
+// per-send timeout once connected (the read timeout between chunks).
+// connect_timeout_s <= 0 falls back to timeout_s. Returns fd or -1.
+inline int connect_to(const std::string& host, int port, int timeout_s,
+                      int connect_timeout_s = 0) {
+  if (connect_timeout_s <= 0) connect_timeout_s = timeout_s;
   struct addrinfo hints {};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -337,12 +343,17 @@ inline int connect_to(const std::string& host, int port, int timeout_s) {
   for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
     fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    struct timeval tv {timeout_s, 0};
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    // SO_SNDTIMEO bounds connect(2) on Linux
+    struct timeval ctv {connect_timeout_s, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &ctv, sizeof ctv);
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      struct timeval tv {timeout_s, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+      break;
+    }
     ::close(fd);
     fd = -1;
   }

@@ -27,7 +27,12 @@
 //   {"backends": {"<name>": "http://host:port", ...},
 //    "default_model": "<name>",       // optional; first model otherwise
 //    "strict": false,                 // optional; 404 unknown models
-//    "upstream_timeout_s": 300}       // optional; reference used 300s
+//    "upstream_timeout_s": 300,       // optional; reference used 300s
+//    "connect_timeout_s": 5,          // optional; TCP handshake budget
+//    "retry_attempts": 3,             // optional; connect-phase retries
+//    "retry_backoff_ms": 200,         // optional; x2 per attempt + jitter
+//    "breaker_threshold": 5,          // optional; consecutive failures
+//    "breaker_open_s": 10}            // optional; open duration / probe gap
 // ("models"/"default" are accepted as aliases.) Or inline
 // --models "name=url,name2=url2" (tests, quick runs). A leading "router"
 // subcommand token is accepted and ignored so the binary is invocable with
@@ -48,9 +53,11 @@
 #include <cstdarg>
 #include <csignal>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <fstream>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <optional>
@@ -75,6 +82,16 @@ struct Config {
   // total budget for reading one client request (slowloris defense, see
   // SockReader::set_deadline); also the keep-alive idle timeout
   int client_timeout_s = 75;
+  // fault tolerance (mirrors the Python router's defaults): TCP handshake
+  // budget, connect-phase retry count, base backoff (doubled per attempt,
+  // +0..100% jitter), and the per-upstream circuit breaker (open after
+  // `breaker_threshold` consecutive transport failures, one half-open
+  // probe after `breaker_open_s`)
+  int connect_timeout_s = 5;
+  int retry_attempts = 3;
+  int retry_backoff_ms = 200;
+  int breaker_threshold = 5;
+  double breaker_open_s = 10.0;
   int port = 8080;
   bool quiet = false;
 
@@ -128,12 +145,14 @@ static std::string select_backend(const Config& cfg, const std::string& body,
 
 static std::string simple_response(int status, const char* reason,
                                    const std::string& content_type,
-                                   const std::string& body, bool keep_alive) {
+                                   const std::string& body, bool keep_alive,
+                                   const std::string& extra_headers = "") {
   std::ostringstream out;
   out << "HTTP/1.1 " << status << " " << reason << "\r\n"
       << "Content-Type: " << content_type << "\r\n"
       << "Content-Length: " << body.size() << "\r\n"
       << "Connection: " << (keep_alive ? "keep-alive" : "close") << "\r\n"
+      << extra_headers  // each entry "Name: value\r\n"
       << "\r\n"
       << body;
   return out.str();
@@ -211,6 +230,103 @@ class UpstreamPool {
 };
 
 static UpstreamPool g_upstream_pool;
+
+// ---------------------------------------------------------------------------
+// Per-upstream circuit breaker (mirrors server/router.py::CircuitBreaker)
+// ---------------------------------------------------------------------------
+
+// Consecutive-transport-failure breaker: closed -> open (after `threshold`
+// failures, every request 503s for `open_s` seconds) -> half-open (exactly
+// one probe; success closes, failure re-opens). Keeps a dead upstream from
+// burning every request's full connect-timeout x retry budget.
+class Breaker {
+ public:
+  // gate a request; on rejection *retry_after_s gets the remaining open time
+  bool allow(int threshold, double open_s, double* retry_after_s) {
+    (void)threshold;
+    std::lock_guard<std::mutex> lock(mu_);
+    auto now = std::chrono::steady_clock::now();
+    if (state_ == kOpen) {
+      double elapsed = std::chrono::duration<double>(now - opened_at_).count();
+      if (elapsed < open_s) {
+        *retry_after_s = open_s - elapsed;
+        return false;
+      }
+      state_ = kHalfOpen;
+      probe_inflight_ = false;
+    }
+    if (state_ == kHalfOpen) {
+      // one probe at a time; a stuck probe frees the slot after open_s
+      double since =
+          std::chrono::duration<double>(now - probe_started_).count();
+      if (probe_inflight_ && since < open_s) {
+        *retry_after_s = open_s - since;
+        return false;
+      }
+      probe_inflight_ = true;
+      probe_started_ = now;
+    }
+    return true;
+  }
+
+  void record_success() {
+    std::lock_guard<std::mutex> lock(mu_);
+    state_ = kClosed;
+    failures_ = 0;
+    probe_inflight_ = false;
+  }
+
+  void record_failure(int threshold, double open_s) {
+    (void)open_s;
+    std::lock_guard<std::mutex> lock(mu_);
+    ++failures_;
+    if (state_ == kHalfOpen || failures_ >= threshold) {
+      state_ = kOpen;
+      opened_at_ = std::chrono::steady_clock::now();
+      probe_inflight_ = false;
+    }
+  }
+
+  int failures() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return failures_;
+  }
+
+ private:
+  enum State { kClosed, kOpen, kHalfOpen };
+  std::mutex mu_;
+  State state_ = kClosed;
+  int failures_ = 0;
+  bool probe_inflight_ = false;
+  std::chrono::steady_clock::time_point opened_at_{};
+  std::chrono::steady_clock::time_point probe_started_{};
+};
+
+class BreakerRegistry {
+ public:
+  Breaker& get(const std::string& host, int port) {
+    std::lock_guard<std::mutex> lock(mu_);
+    return map_[{host, port}];  // std::map nodes are pointer-stable
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::pair<std::string, int>, Breaker> map_;
+};
+
+static BreakerRegistry g_breakers;
+
+// exponential backoff with full jitter: base * 2^attempt * (1 + U[0,1))
+static void backoff_sleep(const Config& cfg, int attempt) {
+  static thread_local unsigned seed =
+      static_cast<unsigned>(std::chrono::steady_clock::now()
+                                .time_since_epoch().count()) ^
+      static_cast<unsigned>(
+          std::hash<std::thread::id>{}(std::this_thread::get_id()));
+  double jitter = 1.0 + static_cast<double>(rand_r(&seed)) / RAND_MAX;
+  long ms = static_cast<long>(cfg.retry_backoff_ms * (1L << attempt) * jitter);
+  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
 
 // ---------------------------------------------------------------------------
 // Proxy
@@ -324,37 +440,87 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   out << "Connection: keep-alive\r\n\r\n";
   const std::string head_bytes = out.str();
 
+  // circuit breaker: a tripped upstream 503s immediately (with Retry-After)
+  // instead of burning connect-timeout x retries on every request
+  Breaker& breaker = g_breakers.get(target.host, target.port);
+  double retry_after_s = 0.0;
+  if (!breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
+                     &retry_after_s)) {
+    int ra = static_cast<int>(retry_after_s) + 1;
+    std::string body = error_json(
+        "upstream " + model + " unavailable (circuit open after " +
+            std::to_string(breaker.failures()) + " consecutive failures)",
+        "service_unavailable", "upstream_circuit_open");
+    send_all(client_fd,
+             simple_response(503, "Service Unavailable", "application/json",
+                             body, req.keep_alive,
+                             "Retry-After: " + std::to_string(ra) + "\r\n"));
+    logf(cfg, "-> 503 (circuit open: %s)", model.c_str());
+    return req.keep_alive;
+  }
+
+  // connect/request phase with bounded retries. Retried failures: connect
+  // refused/timed out, and connection death with ZERO response bytes and
+  // no read timeout (the buffered body makes a resend safe; a TIMEOUT is
+  // excluded — the upstream may still be executing the request). Pooled
+  // idle-connection death retries for free (the upstream closing idle
+  // keep-alives is routine, not a failure).
   int up_fd = -1;
   ResponseHead head;
   std::optional<SockReader> up;
-  for (int attempt = 0; attempt < 2; ++attempt) {
+  bool got_head = false;
+  int pooled_retries = 0;
+  std::string fail_msg = "upstream error";
+  int max_attempts = std::max(1, cfg.retry_attempts);
+  for (int attempt = 0; attempt < max_attempts; ++attempt) {
     bool pooled = false;
     up_fd = g_upstream_pool.acquire(target.host, target.port);
     if (up_fd >= 0) {
       pooled = true;
     } else {
-      up_fd = connect_to(target.host, target.port, cfg.upstream_timeout_s);
+      up_fd = connect_to(target.host, target.port, cfg.upstream_timeout_s,
+                         cfg.connect_timeout_s);
       if (up_fd < 0) {
-        std::string body =
-            error_json("upstream connect failed: " + target.host + ":" +
-                           std::to_string(target.port),
-                       "bad_gateway");
-        send_all(client_fd,
-                 simple_response(502, "Bad Gateway", "application/json", body,
-                                 req.keep_alive));
-        return req.keep_alive;
+        breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        fail_msg = "upstream connect failed: " + target.host + ":" +
+                   std::to_string(target.port);
+        if (attempt + 1 < max_attempts &&
+            breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
+                          &retry_after_s)) {
+          backoff_sleep(cfg, attempt);
+          continue;
+        }
+        break;
       }
     }
     bool ok = send_all(up_fd, head_bytes) &&
               (req.body.empty() || send_all(up_fd, req.body));
     up.emplace(up_fd);
-    if (ok && read_response_head(*up, head)) break;
+    if (ok && read_response_head(*up, head)) {
+      breaker.record_success();
+      got_head = true;
+      break;
+    }
+    bool timed_out = up->timed_out();
+    bool virgin = !up->consumed_any();
     ::close(up_fd);
     up_fd = -1;
-    // retry once when a POOLED connection produced no response — the
-    // upstream closed it while idle; a fresh connect is safe
-    if (pooled && attempt == 0 && !up->consumed_any()) continue;
-    std::string body = error_json("upstream error", "bad_gateway");
+    if (pooled && virgin && pooled_retries++ < 2) {
+      --attempt;  // idle-death: free retry, no breaker hit, no backoff
+      continue;
+    }
+    breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+    fail_msg = timed_out ? "upstream read timed out" : "upstream error";
+    if (virgin && !timed_out && attempt + 1 < max_attempts &&
+        breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
+                      &retry_after_s)) {
+      backoff_sleep(cfg, attempt);
+      continue;
+    }
+    break;
+  }
+  if (!got_head) {
+    std::string body = error_json(fail_msg, "bad_gateway", "upstream_error");
     send_all(client_fd,
              simple_response(502, "Bad Gateway", "application/json", body,
                              req.keep_alive));
@@ -535,6 +701,21 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("client_timeout_s");
       t && t->type == Json::Type::Number)
     cfg.client_timeout_s = static_cast<int>(t->number);
+  if (const Json* t = root->get("connect_timeout_s");
+      t && t->type == Json::Type::Number)
+    cfg.connect_timeout_s = static_cast<int>(t->number);
+  if (const Json* t = root->get("retry_attempts");
+      t && t->type == Json::Type::Number)
+    cfg.retry_attempts = static_cast<int>(t->number);
+  if (const Json* t = root->get("retry_backoff_ms");
+      t && t->type == Json::Type::Number)
+    cfg.retry_backoff_ms = static_cast<int>(t->number);
+  if (const Json* t = root->get("breaker_threshold");
+      t && t->type == Json::Type::Number)
+    cfg.breaker_threshold = static_cast<int>(t->number);
+  if (const Json* t = root->get("breaker_open_s");
+      t && t->type == Json::Type::Number)
+    cfg.breaker_open_s = t->number;
   return true;
 }
 
@@ -617,11 +798,33 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       cfg.client_timeout_s = atoi(v);
+    } else if (a == "--connect-timeout") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.connect_timeout_s = atoi(v);
+    } else if (a == "--retries") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.retry_attempts = atoi(v);
+    } else if (a == "--retry-backoff-ms") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.retry_backoff_ms = atoi(v);
+    } else if (a == "--breaker-threshold") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.breaker_threshold = atoi(v);
+    } else if (a == "--breaker-open") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.breaker_open_s = atof(v);
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url,...) "
               "[--port P] [--default NAME] [--strict] [--quiet] "
-              "[--upstream-timeout S] [--client-timeout S]\n");
+              "[--upstream-timeout S] [--client-timeout S] "
+              "[--connect-timeout S] [--retries N] [--retry-backoff-ms MS] "
+              "[--breaker-threshold N] [--breaker-open S]\n");
       return 2;
     }
   }

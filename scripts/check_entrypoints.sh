@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Entry-point hardening smoke check (ISSUE 1, robustness spine).
+#
+# Simulates a wedged accelerator runtime (LLMK_FAULT=backend_hang hangs
+# backend init inside the probe subprocess) and asserts the two batch
+# entry points degrade the way the fleet depends on:
+#
+#   bench.py          -> exits NON-ZERO within 60 s, stdout is ONE
+#                        parseable {"error": ...} JSON line (never a
+#                        traceback, never a hang — round-5 rc=124).
+#   dryrun_multichip  -> completes OK on the CPU-subprocess path without
+#                        ever touching the default backend, so the hung
+#                        runtime cannot stall it.
+#
+# CPU-only, no cluster, no accelerator. Run from anywhere:
+#   scripts/check_entrypoints.sh
+set -u
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+PY="${PYTHON:-python3}"
+fails=0
+
+echo "== bench.py under LLMK_FAULT=backend_hang =="
+start=$(date +%s)
+out="$(cd "$REPO" && timeout -k 10 60 env \
+        LLMK_FAULT=backend_hang \
+        LLMK_BACKEND_PROBE_TIMEOUT_S=5 \
+        BENCH_MODEL=debug-tiny \
+        "$PY" bench.py 2>/dev/null)"
+rc=$?
+elapsed=$(( $(date +%s) - start ))
+if [ "$rc" -eq 0 ] || [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "FAIL: bench.py rc=$rc (want nonzero, not a timeout kill)"
+    fails=$((fails + 1))
+elif [ "$elapsed" -ge 60 ]; then
+    echo "FAIL: bench.py took ${elapsed}s (budget 60s)"
+    fails=$((fails + 1))
+elif ! echo "$out" | "$PY" -c '
+import json, sys
+lines = [ln for ln in sys.stdin.read().splitlines() if ln.strip()]
+assert len(lines) == 1, f"want exactly one stdout line, got {len(lines)}"
+doc = json.loads(lines[0])
+assert "error" in doc and doc["error"].get("message"), doc
+'; then
+    echo "FAIL: bench.py stdout is not a single {\"error\": ...} JSON line:"
+    echo "$out" | head -5
+    fails=$((fails + 1))
+else
+    echo "ok: rc=$rc in ${elapsed}s, parseable error JSON"
+fi
+
+echo "== dryrun_multichip under LLMK_FAULT=backend_hang =="
+start=$(date +%s)
+out="$(cd "$REPO" && timeout -k 10 300 env \
+        LLMK_FAULT=backend_hang \
+        "$PY" __graft_entry__.py 2 2>&1)"
+rc=$?
+elapsed=$(( $(date +%s) - start ))
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: dryrun_multichip rc=$rc after ${elapsed}s (the CPU"
+    echo "      subprocess path must not depend on the default backend):"
+    echo "$out" | tail -5
+    fails=$((fails + 1))
+elif ! echo "$out" | grep -q "dryrun_multichip(2): OK"; then
+    echo "FAIL: no OK line in dryrun output:"
+    echo "$out" | tail -5
+    fails=$((fails + 1))
+else
+    echo "ok: rc=0 in ${elapsed}s, OK line present"
+fi
+
+if [ "$fails" -ne 0 ]; then
+    echo "check_entrypoints: $fails FAILURE(S)"
+    exit 1
+fi
+echo "check_entrypoints: all good"

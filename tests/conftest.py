@@ -27,6 +27,31 @@ if os.environ.get("LLMK_TEST_TPU") != "1":
 import numpy as np
 import pytest
 
+# Test tiers (pyproject markers): "unit" is the fast inner loop —
+# `pytest -m unit` stays under 60 s by construction, so only modules
+# with no model compiles or subprocess servers are listed. "e2e" covers
+# the serving-path modules (real sockets, subprocess engines/routers).
+# Everything keeps working unmarked; tiers are additive selection aids.
+_UNIT_MODULES = {
+    "test_faults", "test_grammar", "test_helm_golden", "test_hub",
+    "test_manifests", "test_router", "test_tools",
+}
+_E2E_MODULES = {
+    "test_bench", "test_cold_start", "test_entrypoints", "test_kind_e2e",
+    "test_multihost_e2e", "test_native_router", "test_native_sanitizers",
+    "test_server", "test_server_extras",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.path.stem if item.path else ""
+        explicit = {m.name for m in item.iter_markers()}
+        if mod in _UNIT_MODULES and not ({"slow", "e2e"} & explicit):
+            item.add_marker(pytest.mark.unit)
+        elif mod in _E2E_MODULES and "e2e" not in explicit:
+            item.add_marker(pytest.mark.e2e)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,491 @@
+"""Deterministic fault-tolerance tests for the serving spine (ISSUE 1).
+
+Every failure path runs CPU-only and deterministically: the ``LLMK_FAULT=``
+hooks (llms_on_kubernetes_tpu/faults.py) wedge the engine's device reads
+and the entry points' backend init, while raw-socket fake upstreams inject
+connection resets and stalls for the Python router. Covered here:
+
+- fault-spec parsing and the inject_* hook semantics;
+- the CircuitBreaker state machine under an injected fake clock;
+- Python router: retry-then-success, retry-exhausted 502, breaker
+  open -> half-open -> close, stalled-upstream bounded failure;
+- engine watchdog: a stalled device step is shed with reason "stalled"
+  and the engine wedges (submit rejects, step no-ops);
+- /health vs /ready lifecycle (loading/serving/draining/wedged) and the
+  llm_engine_state gauge;
+- bench.py / dryrun_multichip under LLMK_FAULT=backend_hang (subprocess:
+  one parseable error JSON line / CPU path untouched by the hang).
+
+The native router's equivalents live in tests/test_native_router.py and
+tests/test_native_sanitizers.py.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu import faults
+from llms_on_kubernetes_tpu.server.router import CircuitBreaker, Router
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# fault-spec parsing + hooks
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing(monkeypatch):
+    monkeypatch.setenv("LLMK_FAULT", "engine_stall; slow_step:0.5")
+    assert faults.is_active("engine_stall")
+    assert faults.get("engine_stall") == ""
+    assert faults.get_float("slow_step", 0.2) == 0.5
+    assert faults.get_float("engine_stall", 7.0) == 7.0  # bare -> default
+    assert not faults.is_active("backend_hang")
+    assert faults.get_float("backend_hang", 1.0) is None
+    monkeypatch.delenv("LLMK_FAULT")
+    assert not faults.is_active("engine_stall")  # read at call time
+
+
+def test_inject_hooks_noop_when_inactive(monkeypatch):
+    monkeypatch.delenv("LLMK_FAULT", raising=False)
+    t0 = time.monotonic()
+    faults.inject_hang("backend_hang")
+    faults.inject_delay("slow_step", 5.0)
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_inject_delay_sleeps_its_arg(monkeypatch):
+    monkeypatch.setenv("LLMK_FAULT", "slow_step:0.05")
+    t0 = time.monotonic()
+    faults.inject_delay("slow_step", 5.0)
+    assert 0.04 <= time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (fake clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_breaker_state_machine():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=3, open_s=10.0, clock=clk)
+    assert b.allow() and b.state == b.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.allow()                       # below threshold: still closed
+    b.record_failure()
+    assert b.state == b.OPEN and not b.allow()
+    assert 0 < b.retry_after_s() <= 10.0
+    clk.advance(9.9)
+    assert not b.allow()                   # still inside the open window
+    clk.advance(0.2)
+    assert b.allow()                       # half-open: one probe admitted
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()                   # ...and only one
+    b.record_success()
+    assert b.state == b.CLOSED and b.failures == 0 and b.allow()
+
+
+def test_breaker_halfopen_failure_reopens_and_stuck_probe_frees():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=2, open_s=5.0, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == b.OPEN
+    clk.advance(5.1)
+    assert b.allow()                       # probe
+    b.record_failure()                     # ONE failure re-opens half-open
+    assert b.state == b.OPEN and not b.allow()
+    clk.advance(5.1)
+    assert b.allow()                       # probe admitted, never reported
+    assert not b.allow()                   # slot held by the stuck probe
+    clk.advance(5.1)
+    assert b.allow()                       # stuck probe freed after open_s
+
+
+# ---------------------------------------------------------------------------
+# Python router vs dying/stalling fake upstreams
+# ---------------------------------------------------------------------------
+
+class FlakyUpstream(threading.Thread):
+    """Raw-socket upstream: RSTs the first ``fail_first`` connections
+    (SO_LINGER 0 close -> connection reset on the client, a retryable
+    connect-phase failure) and answers a canned HTTP 200 JSON after."""
+
+    def __init__(self, fail_first: int):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self.fail_first = fail_first
+        self.hits = 0
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            if self.hits <= self.fail_first:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+                conn.close()               # RST, not FIN
+                continue
+            try:
+                conn.settimeout(5)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                body = b'{"served_by": "flaky"}'
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode()
+                    + b"\r\nConnection: close\r\n\r\n" + body)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class StallingUpstream(threading.Thread):
+    """Accepts and reads the request, then never answers — the router's
+    read timeout (not the client's patience) must bound the request."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self.hits = 0
+        self._stop = threading.Event()
+        self._conns: list = []
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            self._conns.append(conn)       # hold open, never respond
+
+    def stop(self):
+        self._stop.set()
+        for c in [self.sock] + self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def _drive_router(router: Router, fn):
+    async def go():
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_router_retry_then_success():
+    up = FlakyUpstream(fail_first=2)
+    up.start()
+    router = Router({"m": f"http://127.0.0.1:{up.port}"},
+                    retry_attempts=3, retry_backoff_s=0.01)
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        assert r.status == 200, await r.text()
+        assert (await r.json())["served_by"] == "flaky"
+
+    try:
+        _drive_router(router, body)
+    finally:
+        up.stop()
+    assert up.hits == 3  # two resets + the successful retry
+
+
+def test_router_retry_exhausted_502():
+    up = FlakyUpstream(fail_first=10 ** 9)
+    up.start()
+    router = Router({"m": f"http://127.0.0.1:{up.port}"},
+                    retry_attempts=3, retry_backoff_s=0.01,
+                    breaker_threshold=10)
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        assert r.status == 502
+        err = await r.json()
+        assert err["error"]["type"] == "bad_gateway"
+        assert err["error"]["code"] == "upstream_error"
+
+    try:
+        _drive_router(router, body)
+    finally:
+        up.stop()
+    assert up.hits == 3  # bounded: exactly retry_attempts connections
+
+
+def test_router_breaker_open_halfopen_close():
+    clk = FakeClock()
+    up = FlakyUpstream(fail_first=2)
+    up.start()
+    router = Router({"m": f"http://127.0.0.1:{up.port}"},
+                    retry_attempts=1, retry_backoff_s=0.0,
+                    breaker_threshold=2, breaker_open_s=30.0, clock=clk)
+
+    async def body(client):
+        for _ in range(2):                 # trip the breaker
+            r = await client.post("/v1/chat/completions", json={"model": "m"})
+            assert r.status == 502
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        assert r.status == 503             # OPEN: rejected at the gateway
+        err = await r.json()
+        assert err["error"]["code"] == "upstream_circuit_open"
+        assert int(r.headers["Retry-After"]) >= 1
+        assert up.hits == 2                # no connect burned while open
+        clk.advance(31.0)                  # -> half-open
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        assert r.status == 200             # probe hits the now-healthy
+        assert (await r.json())["served_by"] == "flaky"
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        assert r.status == 200             # closed again
+        assert router.breakers["m"].state == CircuitBreaker.CLOSED
+
+    try:
+        _drive_router(router, body)
+    finally:
+        up.stop()
+
+
+def test_router_stalled_upstream_bounded_502():
+    up = StallingUpstream()
+    up.start()
+    router = Router({"m": f"http://127.0.0.1:{up.port}"},
+                    upstream_timeout=5.0, read_timeout=0.3,
+                    retry_attempts=2, retry_backoff_s=0.01)
+
+    async def body(client):
+        t0 = time.monotonic()
+        r = await client.post("/v1/chat/completions", json={"model": "m"})
+        elapsed = time.monotonic() - t0
+        assert r.status == 502
+        assert elapsed < 4.0, "stalled upstream must not pin the gateway"
+
+    try:
+        _drive_router(router, body)
+    finally:
+        up.stop()
+    assert up.hits <= 2
+
+
+# ---------------------------------------------------------------------------
+# engine watchdog (LLMK_FAULT=engine_stall wedges the harvester's read)
+# ---------------------------------------------------------------------------
+
+def _mk_engine(**kw):
+    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+    base = dict(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=8, num_pages=64, pages_per_slot=8,
+        prefill_buckets=(16, 32), async_scheduling=True, async_depth=2,
+    )
+    base.update(kw)
+    return Engine(EngineConfig(**base))
+
+
+@pytest.mark.e2e
+def test_engine_watchdog_sheds_stalled_step(monkeypatch):
+    from llms_on_kubernetes_tpu.engine.engine import (
+        EngineStallError, SamplingParams)
+
+    eng = _mk_engine(watchdog_stall_s=0.5)
+    monkeypatch.setenv("LLMK_FAULT", "engine_stall")
+    reqs = [eng.submit([1, 2, 3], SamplingParams(temperature=0.0,
+                                                 max_tokens=8)),
+            eng.submit([4, 5, 6, 7], SamplingParams(temperature=0.0,
+                                                    max_tokens=8))]
+    deadline = time.monotonic() + 120
+    while not all(r.finished for r in reqs):
+        assert time.monotonic() < deadline, "watchdog never fired"
+        eng.step()
+    assert [r.finish_reason for r in reqs] == ["stalled", "stalled"]
+    assert eng.wedged
+    with pytest.raises(EngineStallError):
+        eng.submit([1, 2], SamplingParams(max_tokens=4))
+    assert eng.step() == []                # wedged engine no-ops
+    monkeypatch.delenv("LLMK_FAULT")       # release the hung harvester
+
+
+@pytest.mark.e2e
+def test_engine_watchdog_disabled_and_healthy_paths(monkeypatch):
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    # watchdog armed but the device is healthy: generation completes
+    # normally, nothing sheds
+    monkeypatch.delenv("LLMK_FAULT", raising=False)
+    eng = _mk_engine(watchdog_stall_s=30.0)
+    req = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=6))
+    deadline = time.monotonic() + 120
+    while not req.finished and time.monotonic() < deadline:
+        eng.step()
+    assert req.finish_reason in ("length", "stop") and not eng.wedged
+    # <= 0 disables: _stall_budget resolves to None (waits block forever,
+    # pre-watchdog behavior)
+    assert _mk_engine(watchdog_stall_s=0)._stall_budget() is None
+
+
+# ---------------------------------------------------------------------------
+# /health vs /ready lifecycle + state gauge
+# ---------------------------------------------------------------------------
+
+@pytest.mark.e2e
+def test_ready_health_lifecycle_and_state_gauge():
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+    assert srv.state == "loading"          # constructed but not started
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()        # on_startup -> serving
+        try:
+            r = await client.get("/ready")
+            assert r.status == 200 and (await r.json())["state"] == "serving"
+            assert (await client.get("/health")).status == 200
+            text = await (await client.get("/metrics")).text()
+            assert "llm_engine_state 1" in text
+
+            srv.engine.wedged = True       # what the watchdog sets
+            r = await client.get("/ready")
+            assert r.status == 503 and (await r.json())["state"] == "wedged"
+            # liveness fails ONLY when wedged: restart is the cure here
+            assert (await client.get("/health")).status == 503
+            text = await (await client.get("/metrics")).text()
+            assert "llm_engine_state 3" in text
+
+            srv.engine.wedged = False
+            await srv._stop_loop(None)     # preStop/cleanup -> draining
+            r = await client.get("/ready")
+            assert r.status == 503 and (await r.json())["state"] == "draining"
+            # draining is HEALTHY: restarting a draining pod loses work
+            assert (await client.get("/health")).status == 200
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+@pytest.mark.e2e
+def test_wedged_engine_503s_submissions():
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            srv.engine.wedged = True
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+            })
+            assert r.status == 503
+            err = await r.json()
+            assert err["error"]["code"] == "engine_stalled"
+            assert r.headers.get("Retry-After")
+        finally:
+            srv.engine.wedged = False
+            await client.close()
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# hardened entry points under a hung backend (subprocess, like production)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.e2e
+def test_bench_backend_hang_emits_error_json():
+    env = dict(os.environ)
+    env.update(LLMK_FAULT="backend_hang", LLMK_BACKEND_PROBE_TIMEOUT_S="3",
+               BENCH_MODEL="debug-tiny")
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert time.monotonic() - t0 < 55, "hang must be bounded by the probe"
+    assert r.returncode != 0
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout contract is ONE JSON line: {lines}"
+    doc = json.loads(lines[0])
+    assert doc["error"]["type"] == "BackendProbeError"
+    assert "did not complete" in doc["error"]["message"]
+
+
+@pytest.mark.e2e
+def test_dryrun_multichip_untouched_by_backend_hang():
+    # the CPU-subprocess path must never initialize the default backend,
+    # so a wedged accelerator runtime cannot stall it (round-5 rc=124)
+    env = dict(os.environ)
+    env["LLMK_FAULT"] = "backend_hang"
+    r = subprocess.run([sys.executable, "__graft_entry__.py", "2"],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip(2): OK" in r.stdout
+
+
+@pytest.mark.e2e
+def test_dryrun_subprocess_timeout_kills_wedged_child():
+    code = (
+        "import sys; sys.path.insert(0, '.'); "
+        "import __graft_entry__ as g\n"
+        "try:\n"
+        "    g._dryrun_subprocess(2, timeout_s=0.5)\n"
+        "except RuntimeError as e:\n"
+        "    assert 'wall-clock' in str(e), e\n"
+        "    print('TIMEOUT-OK')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "TIMEOUT-OK" in r.stdout

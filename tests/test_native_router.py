@@ -505,3 +505,149 @@ def test_pooled_connection_death_is_retried(binary):
     finally:
         router.stop()
         srv.shutdown()
+
+
+def test_native_upstream_timeout_bounded_and_not_retried(binary):
+    """An upstream that accepts and never answers is bounded by
+    --upstream-timeout and NOT retried (the request may be executing
+    upstream; a resend could double-apply it)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    held = []
+
+    def accept_loop():
+        while True:
+            try:
+                c, _ = lsock.accept()
+            except OSError:
+                return
+            held.append(c)  # hold open, never respond
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    router = RouterProc(binary, {"stall": lsock.getsockname()[1]},
+                        extra_args=("--upstream-timeout", "1",
+                                    "--retries", "3",
+                                    "--retry-backoff-ms", "10"))
+    try:
+        t0 = time.monotonic()
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "stall"})
+        elapsed = time.monotonic() - t0
+        assert status == 502
+        err = json.loads(data)
+        assert err["error"]["type"] == "bad_gateway"
+        assert "timed out" in err["error"]["message"]
+        assert elapsed < 3.5, (
+            f"timeout must fire once, not per retry attempt ({elapsed:.1f}s)")
+        assert len(held) == 1, "a timed-out request must not be resent"
+    finally:
+        router.stop()
+        lsock.close()
+        for c in held:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_native_breaker_open_halfopen_close(binary):
+    """Consecutive connect failures trip the per-upstream breaker (503 +
+    Retry-After, no connect burned); after --breaker-open seconds one
+    half-open probe hits the now-recovered upstream and closes the
+    circuit."""
+    port = free_port()  # nothing listening yet: connect refused
+    router = RouterProc(binary, {"flappy": port},
+                        extra_args=("--retries", "1",
+                                    "--connect-timeout", "1",
+                                    "--breaker-threshold", "2",
+                                    "--breaker-open", "1"))
+    srv = None
+    try:
+        for _ in range(2):  # trip the breaker (threshold 2, 1 attempt each)
+            status, data = router.request("POST", "/v1/chat/completions",
+                                          {"model": "flappy"})
+            assert status == 502, data
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps({"model": "flappy"}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503, body
+        assert body["error"]["code"] == "upstream_circuit_open"
+        assert int(resp.getheader("Retry-After")) >= 1
+        conn.close()
+
+        # upstream recovers on the same port; wait out the open window
+        handler = type("Backend_flappy", (FakeBackend,), {"name": "flappy"})
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        time.sleep(1.2)
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "flappy"})  # half-open probe
+        assert status == 200 and json.loads(data)["served_by"] == "flappy"
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "flappy"})  # circuit closed
+        assert status == 200 and json.loads(data)["served_by"] == "flappy"
+    finally:
+        router.stop()
+        if srv is not None:
+            srv.shutdown()
+
+
+def test_native_retry_rides_out_connection_resets(binary):
+    """First two connections die with RST; the third succeeds — bounded
+    retries with backoff turn a flapping upstream into one slow 200."""
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    hits = []
+
+    def serve_loop():
+        import struct as _struct
+        while True:
+            try:
+                c, _ = lsock.accept()
+            except OSError:
+                return
+            hits.append(1)
+            if len(hits) <= 2:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             _struct.pack("ii", 1, 0))
+                c.close()  # RST
+                continue
+            try:
+                c.settimeout(5)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                payload = b'{"served_by": "resets"}'
+                c.sendall(b"HTTP/1.1 200 OK\r\n"
+                          b"Content-Type: application/json\r\n"
+                          b"Content-Length: " + str(len(payload)).encode()
+                          + b"\r\nConnection: close\r\n\r\n" + payload)
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+    threading.Thread(target=serve_loop, daemon=True).start()
+    router = RouterProc(binary, {"resets": lsock.getsockname()[1]},
+                        extra_args=("--retries", "3",
+                                    "--retry-backoff-ms", "10",
+                                    "--breaker-threshold", "10"))
+    try:
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "resets"})
+        assert status == 200, data
+        assert json.loads(data)["served_by"] == "resets"
+        assert len(hits) == 3
+    finally:
+        router.stop()
+        lsock.close()

@@ -130,6 +130,67 @@ def _drive(binary: Path):
                 _, sl_err = sl.communicate()
         assert "ERROR: " not in (sl_err or ""), sl_err[-3000:]
 
+        # fault paths under the sanitizer: retry-exhausted 502 against a
+        # dead upstream, then the circuit breaker opening (503 +
+        # Retry-After) and re-opening after a failed half-open probe —
+        # the error/retry/breaker code paths allocate and format buffers
+        # that only these scenarios exercise
+        br_port = free_port()
+        br = subprocess.Popen(
+            [str(binary), "--models", "deadmodel=http://127.0.0.1:1",
+             "--port", str(br_port), "--quiet",
+             "--retries", "2", "--retry-backoff-ms", "10",
+             "--connect-timeout", "1",
+             "--breaker-threshold", "2", "--breaker-open", "1"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", br_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            def dead_request() -> tuple[int, dict, str]:
+                c = http.client.HTTPConnection("127.0.0.1", br_port,
+                                               timeout=15)
+                c.request("POST", "/v1/chat/completions",
+                          body=json.dumps({"model": "deadmodel"}).encode(),
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                body = json.loads(r.read())
+                retry_after = r.getheader("Retry-After") or ""
+                c.close()
+                return r.status, body, retry_after
+
+            status, body, _ = dead_request()   # retries exhausted -> 502
+            assert status == 502, body
+            assert body["error"]["code"] == "upstream_error", body
+            status, body, retry_after = dead_request()  # breaker is open
+            assert status == 503, body
+            assert body["error"]["code"] == "upstream_circuit_open", body
+            assert retry_after, "503 must carry Retry-After"
+            time.sleep(1.2)                     # half-open probe window
+            status, body, _ = dead_request()    # probe fails -> 502
+            assert status == 502, body
+            status, body, _ = dead_request()    # probe failure re-opened
+            assert status == 503, body
+        finally:
+            br.terminate()
+            try:
+                _, br_err = br.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                br.kill()
+                _, br_err = br.communicate()
+        assert "ERROR: " not in (br_err or ""), br_err[-3000:]
+        assert "runtime error:" not in (br_err or ""), br_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (br_err or ""), br_err[-3000:]
+
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")
     finally:
