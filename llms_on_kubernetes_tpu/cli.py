@@ -63,7 +63,8 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
 def _add_router(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser("router", help="run the multi-model API gateway")
     p.add_argument("--backend", action="append", default=None,
-                   metavar="NAME=URL", help="repeatable: model name=base url")
+                   metavar="NAME=URL[|URL...]",
+                   help="repeatable: model name=replica url(s), |-separated")
     p.add_argument("--config", default=None,
                    help="router.json (from `render`): backends/default/strict")
     p.add_argument("--default-model", default=None)
@@ -71,6 +72,10 @@ def _add_router(sub: argparse._SubParsersAction) -> None:
                    help="404 on unknown model instead of silent default fallback")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--probe-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="active /ready probe period per replica "
+                        "(default 2.0; 0 disables probing)")
 
 
 def _add_render(sub: argparse._SubParsersAction) -> None:
@@ -108,21 +113,27 @@ def main(argv: list[str] | None = None) -> int:
 
         backends = {}
         default_model, strict = args.default_model, args.strict
+        probe_interval = args.probe_interval
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
             backends.update(cfg.get("backends", {}))
             default_model = default_model or cfg.get("default_model")
             strict = strict or bool(cfg.get("strict", False))
+            if probe_interval is None and "probe_interval_s" in cfg:
+                probe_interval = float(cfg["probe_interval_s"])
         for spec in args.backend or ():
-            name, _, url = spec.partition("=")
-            if not url:
-                parser.error(f"--backend must be NAME=URL, got {spec!r}")
-            backends[name] = url
+            name, _, urls = spec.partition("=")
+            if not urls:
+                parser.error(f"--backend must be NAME=URL[|URL...], got {spec!r}")
+            backends[name] = [u for u in urls.split("|") if u]
         if not backends:
             parser.error("router needs --config or at least one --backend")
+        if probe_interval is None:
+            probe_interval = 2.0
         run_router(backends, default_model, strict,
-                   host=args.host, port=args.port)
+                   host=args.host, port=args.port,
+                   probe_interval_s=probe_interval or None)
         return 0
 
     # serve

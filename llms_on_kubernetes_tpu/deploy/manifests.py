@@ -40,6 +40,19 @@ ENGINE_PORT = 8080
 ROUTER_PORT = 8080
 WEBUI_PORT = 8080
 
+# Drain budget (k8s/tpu-models/README.md "Graceful shutdown / rollout"):
+# the preStop sleep holds SIGTERM until endpoint removal has propagated,
+# then the server's drain (in-flight generations complete, /ready -> 503)
+# must finish inside the grace period or the kubelet SIGKILLs mid-stream.
+PRESTOP_SLEEP_S = 5
+MODEL_GRACE_S = 330    # worst-case long generation + preStop sleep
+ROUTER_GRACE_S = 30    # router only relays; in-flight proxying is short
+
+
+def _lifecycle() -> dict[str, Any]:
+    return {"lifecycle": {"preStop": {"exec": {
+        "command": ["sh", "-c", f"sleep {PRESTOP_SLEEP_S}"]}}}}
+
 
 def _labels(app: str, component: str) -> dict[str, str]:
     return {
@@ -117,6 +130,7 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
             }}},
         ],
         **_probes(),
+        **_lifecycle(),
     }
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
@@ -180,6 +194,7 @@ def _scrape_annotations() -> dict[str, str]:
 
 def render_model_single_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
     pod_spec: Manifest = {
+        "terminationGracePeriodSeconds": MODEL_GRACE_S,
         "containers": [_engine_container(m, spec)],
         "volumes": _volumes(m, spec),
     }
@@ -254,6 +269,7 @@ def render_model_multi_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
                 },
                 "spec": {
                     "subdomain": f"{name}-workers",
+                    "terminationGracePeriodSeconds": MODEL_GRACE_S,
                     "nodeSelector": _tpu_node_selector(m),
                     "containers": [container],
                     "volumes": _volumes(m, spec),
@@ -283,6 +299,30 @@ def render_model_service(m: ModelSpec, spec: DeploySpec) -> Manifest:
     }
 
 
+def render_model_replica_service(m: ModelSpec,
+                                 spec: DeploySpec) -> Optional[Manifest]:
+    """Headless Service over a replicated single-host model's pods.
+
+    The router targets this for replicas > 1: headless DNS resolves to the
+    ready pod IPs directly, so a new connection after a failover retry can
+    land on a different replica than the one that just refused (a ClusterIP
+    Service would be a single conntrack-balanced VIP hiding the replicas).
+    """
+    if m.replicas <= 1 or (m.tpu is not None and m.tpu.multi_host):
+        return None
+    name = f"model-{m.model_name}"
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"{name}-replicas", spec, "model-replicas"),
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": name},
+            "ports": [{"port": ENGINE_PORT, "name": "http"}],
+        },
+    }
+
+
 def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
     if not m.huggingface_id:
         return None
@@ -305,17 +345,29 @@ def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
 # Router
 # ---------------------------------------------------------------------------
 
+def _backend_urls(m: ModelSpec, spec: DeploySpec) -> list[str]:
+    """Replica-set URLs for one model (always a list, even for one)."""
+    if m.replicas > 1 and not (m.tpu is not None and m.tpu.multi_host):
+        # replicated single-host model: route via the headless -replicas
+        # Service, whose DNS answers with the READY pod IPs (Deployment
+        # pods have no stable per-pod names to enumerate). Explicit
+        # multi-URL replica lists remain a config-level capability for
+        # out-of-cluster replicas.
+        return [f"http://model-{m.model_name}-replicas."
+                f"{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"]
+    return [f"http://model-{m.model_name}."
+            f"{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"]
+
+
 def router_config(spec: DeploySpec) -> dict[str, Any]:
-    """The router's model→backend table (consumed by server/router.py and
-    by the native C++ router alike)."""
+    """The router's model→replica-set table (consumed by server/router.py
+    and by the native C++ router alike)."""
     return {
-        "backends": {
-            m.model_name:
-                f"http://model-{m.model_name}.{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"
-            for m in spec.models
-        },
+        "backends": {m.model_name: _backend_urls(m, spec)
+                     for m in spec.models},
         "default_model": spec.resolved_default,
         "strict": spec.strict_routing,
+        "probe_interval_s": spec.probe_interval_s,
     }
 
 
@@ -349,10 +401,12 @@ def render_router(spec: DeploySpec) -> list[Manifest]:
                     "annotations": {"checksum/router-config": config_hash(spec)},
                 },
                 "spec": {
+                    "terminationGracePeriodSeconds": ROUTER_GRACE_S,
                     "containers": [{
                         "name": "router",
                         "image": spec.image,
                         "imagePullPolicy": spec.image_pull_policy,
+                        **_lifecycle(),
                         "command": (
                             ["/usr/local/bin/tpu-router"] if spec.native_router
                             else ["python", "-m", "llms_on_kubernetes_tpu"]
@@ -522,6 +576,9 @@ def render_manifests(spec: DeploySpec) -> list[Manifest]:
         else:
             out += render_model_single_host(m, spec)
         out.append(render_model_service(m, spec))
+        replica_svc = render_model_replica_service(m, spec)
+        if replica_svc:
+            out.append(replica_svc)
         pvc = render_model_pvc(m, spec)
         if pvc:
             out.append(pvc)

@@ -157,6 +157,8 @@ class DeploySpec:
     strict_routing: bool = False           # 404 unknown models (reference
                                            # silently fell back, SURVEY §3.1)
     native_router: bool = True             # C++ router image vs python
+    # router-side active /ready probe period per replica; 0 disables
+    probe_interval_s: float = 2.0
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -279,6 +281,8 @@ def load_spec(source: "str | dict") -> DeploySpec:
         default_model=(data.get("router") or {}).get("defaultModel"),
         strict_routing=bool((data.get("router") or {}).get("strict", False)),
         native_router=bool((data.get("router") or {}).get("native", True)),
+        probe_interval_s=float(
+            (data.get("router") or {}).get("probeIntervalS", 2.0)),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -226,6 +226,11 @@ class Request:
     # function of the request, never of batch composition or preemption
     seed: int = 0
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # absolute time.monotonic() deadline (end-to-end budget threaded from
+    # the client via router/API). Expired in the waiting queue => shed
+    # without ever being admitted; expired in a slot => aborted, both with
+    # finish_reason "timeout". None = no budget.
+    deadline: Optional[float] = None
     # runtime state
     output: list[int] = dataclasses.field(default_factory=list)
     # per output token: (logprob, top_ids, top_logprobs) — recorded by
@@ -998,6 +1003,7 @@ class Engine:
         request_id: Optional[str] = None,
         on_event=None,
         images=None,
+        deadline: Optional[float] = None,
     ) -> Request:
         if self.wedged:
             raise EngineStallError(
@@ -1089,6 +1095,7 @@ class Engine:
             prompt=list(prompt), params=params, seed=seed, images=images,
             mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
+            deadline=deadline,
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:
@@ -1257,6 +1264,22 @@ class Engine:
         req.abort_reason = reason
 
     def _reap_aborted(self) -> list[StepEvent]:
+        # Deadline sweep first: an expired deadline becomes an abort with
+        # reason "timeout", so the shed rides the exact same reap path as
+        # client disconnects. Waiting requests are shed here WITHOUT ever
+        # being admitted (no prefill burned); slotted requests release
+        # their slot/pages at the start of this step.
+        now = time.monotonic()
+        with self._lock:
+            for r in self.waiting:
+                if (r.deadline is not None and now >= r.deadline
+                        and not r.abort_reason and not r.finished):
+                    r.abort_reason = "timeout"
+        for r in self.slots:
+            if (r is not None and r.deadline is not None
+                    and now >= r.deadline
+                    and not r.abort_reason and not r.finished):
+                r.abort_reason = "timeout"
         events: list[StepEvent] = []
         with self._lock:
             doomed_waiting = [r for r in self.waiting
@@ -1669,6 +1692,13 @@ class Engine:
         sampled token is discarded and the old pending token is restored, so
         the output stream is unaffected by preemption.
         """
+        from llms_on_kubernetes_tpu import faults
+
+        if faults.is_active("queue_stall"):
+            # LLMK_FAULT=queue_stall: admission refuses while the flag is
+            # set — waiting requests age in the queue (deadline-shed and
+            # Retry-After paths become deterministically testable)
+            return []
         with self._lock:
             if not self.waiting:
                 return []
@@ -1943,6 +1973,12 @@ class Engine:
         same-bucket requests in ONE padded call; first-token reads are
         deferred to _harvest. Returns None or a dict describing the
         admissions for the decode launch's on-device token merge."""
+        from llms_on_kubernetes_tpu import faults
+
+        if faults.is_active("queue_stall"):
+            # LLMK_FAULT=queue_stall: admission refuses while the flag is
+            # set (see _admit_one)
+            return None
         # clear BEFORE scanning: a submit after this point re-sets the flag
         # (at worst a spurious backpressure wakeup), while anything already
         # queued is handled right here

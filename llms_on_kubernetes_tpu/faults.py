@@ -23,6 +23,15 @@ Known fault names (each documented at its injection site):
 - ``slow_step[:SECONDS]`` — every device-step completion is delayed by
   SECONDS (default 0.2), for pacing/timeout tests that need a slow but
   live device.
+- ``queue_stall``         — the engine's admission loop refuses to admit
+  while the flag is set: waiting requests age in the queue without ever
+  being prefilled. Drives the deadline queue-shed path and the
+  queue-depth-based 429 ``Retry-After`` estimate deterministically.
+- ``flappy_replica[:PERIOD]`` — the server's readiness flaps: ``/ready``
+  alternates between ``serving`` and 503 ``draining`` every PERIOD
+  seconds (default 1.0) while the engine keeps serving. A cluster-level
+  fault (replica joining/leaving endpoints repeatedly) for exercising
+  router health-probe ejection/re-admission against a live server.
 
 Routers do not read ``LLMK_FAULT``; their faults (connection resets,
 stalled responses) are injected by the fake upstream backends in the test

@@ -14,38 +14,78 @@ import threading
 from typing import Optional
 
 
-class Counter:
-    def __init__(self, name: str, help_: str, registry: "Registry"):
-        self.name, self.help = name, help_
+class _LabeledValue:
+    """One child time series of a labeled Counter/Gauge."""
+
+    def __init__(self) -> None:
         self.value = 0.0
-        registry._add(self)
 
     def inc(self, n: float = 1.0) -> None:
         self.value += n
 
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name} {self.value}\n"
-        )
-
-
-class Gauge:
-    def __init__(self, name: str, help_: str, registry: "Registry"):
-        self.name, self.help = name, help_
-        self.value = 0.0
-        registry._add(self)
-
     def set(self, v: float) -> None:
         self.value = v
 
+
+class _Metric:
+    """Shared scalar-or-labeled plumbing for Counter and Gauge.
+
+    Without ``label_names`` the metric is a single scalar series (the
+    original behavior). With ``label_names`` the parent holds child series
+    keyed by label values; ``labels(**kv)`` returns (creating on first use)
+    the child, which supports ``inc``/``set``.
+    """
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 label_names: tuple[str, ...] = ()):
+        self.name, self.help = name, help_
+        self.value = 0.0
+        self.label_names = tuple(label_names)
+        self._children: dict[tuple[str, ...], _LabeledValue] = {}
+        registry._add(self)
+
+    def labels(self, **kv: str) -> _LabeledValue:
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            child = self._children[key] = _LabeledValue()
+        return child
+
+    def labeled_value(self, **kv: str) -> Optional[float]:
+        """Current value of a child series, or None if never touched."""
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        return child.value if child is not None else None
+
     def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value}\n"
-        )
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        if not self.label_names:
+            out.append(f"{self.name} {self.value}")
+        else:
+            for key in sorted(self._children):
+                lbl = ",".join(f'{n}="{v}"'
+                               for n, v in zip(self.label_names, key))
+                out.append(f"{self.name}{{{lbl}}} {self._children[key].value}")
+        return "\n".join(out) + "\n"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float) -> None:
+        self.value = v
 
 
 class Histogram:
@@ -140,4 +180,32 @@ def engine_metrics(registry: Registry) -> dict:
             "llm_engine_state",
             "Serving lifecycle: 0=loading 1=serving 2=draining 3=wedged",
             registry),
+        "deadline_exceeded": Counter(
+            "llm_deadline_exceeded_total",
+            "Requests shed at their end-to-end deadline, by phase: "
+            "queue=expired while waiting (never admitted), "
+            "decode=aborted in flight",
+            registry, label_names=("phase",)),
+    }
+
+
+def router_metrics(registry: Registry) -> dict:
+    """Gateway-side metric set (replica routing + failover visibility)."""
+    return {
+        "replica_healthy": Gauge(
+            "llm_replica_healthy",
+            "Active /ready probe verdict per replica (1=routable)",
+            registry, label_names=("model", "replica")),
+        "failover": Counter(
+            "llm_failover_total",
+            "Requests retried on a different replica after a "
+            "connect-phase failure", registry),
+        "unknown_model_fallback": Counter(
+            "llm_router_unknown_model_fallback_total",
+            "Requests naming an unknown model that were routed to the "
+            "default backend (strict=false)", registry),
+        "deadline_rejected": Counter(
+            "llm_router_deadline_rejected_total",
+            "Requests rejected at the gateway with an already-expired "
+            "deadline", registry),
     }

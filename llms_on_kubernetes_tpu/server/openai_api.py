@@ -35,10 +35,41 @@ from aiohttp import web
 from llms_on_kubernetes_tpu.engine.engine import Engine, Request, SamplingParams
 from llms_on_kubernetes_tpu.engine.tokenizer import TokenizerLike
 from llms_on_kubernetes_tpu.server.metrics import Registry, engine_metrics
+from llms_on_kubernetes_tpu.server.router import DEADLINE_HEADER
+
+
+def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
+    """Absolute monotonic deadline for this request, or None.
+
+    The router's ``X-LLMK-Deadline-Ms`` header (milliseconds of budget
+    REMAINING, already decremented for gateway time) takes precedence over
+    the body's OpenAI-style ``timeout`` field (seconds). A malformed header
+    means no deadline rather than a 400: deadlines are best-effort shedding,
+    not an input-validation surface.
+    """
+    raw = request.headers.get(DEADLINE_HEADER)
+    if raw is not None:
+        try:
+            return time.monotonic() + float(raw) / 1000.0
+        except ValueError:
+            return None
+    t = body.get("timeout")
+    if isinstance(t, (int, float)) and not isinstance(t, bool) and t > 0:
+        return time.monotonic() + float(t)
+    return None
 
 
 class EngineLoop(threading.Thread):
-    """Drives Engine.step() whenever there is work; sleeps otherwise."""
+    """Drives Engine.step() whenever there is work; sleeps otherwise.
+
+    ``stop()`` begins a GRACEFUL drain: work already admitted or queued
+    keeps stepping to completion (bounded by ``drain_timeout_s``) so
+    streaming clients receive their final events during the preStop
+    window; the API layer refuses new submissions while draining."""
+
+    # must stay under _stop_loop's 60 s join so shutdown never wedges on
+    # a pathological backlog
+    drain_timeout_s = 55.0
 
     def __init__(self, engine: Engine, metrics: Optional[dict] = None):
         super().__init__(daemon=True, name="engine-loop")
@@ -76,8 +107,15 @@ class EngineLoop(threading.Thread):
 
     def _run(self) -> None:
         eng = self.engine
-        while not self._stop_evt.is_set():
-            if not eng.has_work():
+        drain_deadline = None
+        while True:
+            if self._stop_evt.is_set():
+                if drain_deadline is None:
+                    drain_deadline = time.monotonic() + self.drain_timeout_s
+                if (not eng.has_work() or getattr(eng, "wedged", False)
+                        or time.monotonic() >= drain_deadline):
+                    return
+            elif not eng.has_work():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -100,6 +138,11 @@ class EngineLoop(threading.Thread):
                     if ev.finished:
                         m["requests_finished"].inc()
                     r = ev.request
+                    if ev.finished and ev.finish_reason == "timeout":
+                        # queue = shed before ever being prefilled;
+                        # decode = aborted mid-generation at its deadline
+                        phase = "queue" if r.admitted_at is None else "decode"
+                        m["deadline_exceeded"].labels(phase=phase).inc()
                     if r.first_token_at and r.id not in self._ttft_seen:
                         self._ttft_seen.add(r.id)
                         m["ttft"].observe(r.first_token_at - r.submitted_at)
@@ -328,6 +371,12 @@ class OpenAIServer:
         # draining (preStop window) or wedged pulls the pod from Service
         # endpoints without restarting it.
         state = self.state
+        from llms_on_kubernetes_tpu import faults
+        flap = faults.get_float("flappy_replica", 1.0)
+        if flap and state == "serving" and int(time.monotonic() / flap) % 2:
+            # injected fault: readiness flaps while the engine keeps
+            # serving — a replica repeatedly joining/leaving endpoints
+            state = "draining"
         self.metrics["engine_state"].set(self.STATE_CODES.get(state, 0))
         if state == "serving":
             return web.json_response({"state": state})
@@ -855,6 +904,24 @@ class OpenAIServer:
             EngineStallError, QueueFullError)
         from llms_on_kubernetes_tpu.engine.grammar import GrammarError
 
+        if self.state == "draining":
+            # shutdown in progress: in-flight streams run to completion,
+            # NEW work is refused so the client's retry lands on a live
+            # replica (the router's probe loop has already seen /ready 503)
+            return web.json_response(
+                {"error": {"message": "server is draining; not accepting "
+                           "new requests", "type": "service_unavailable",
+                           "code": "shutting_down"}},
+                status=503, headers={"Retry-After": "5"})
+        deadline = _deadline_from(request, body)
+        if deadline is not None and deadline <= time.monotonic():
+            # expired before we touched the engine: never submitted, so
+            # count it as a queue-phase shed (the client gave up already)
+            self.metrics["deadline_exceeded"].labels(phase="queue").inc()
+            return web.json_response(
+                {"error": {"message": "deadline expired before processing",
+                           "type": "timeout", "code": "deadline_exceeded"}},
+                status=504)
         try:
             params = self._sampling_from_body(body, chat=chat)
         except (ValueError, TypeError) as e:  # bad seed/temperature/... -> 400
@@ -924,7 +991,7 @@ class OpenAIServer:
                     q: asyncio.Queue = asyncio.Queue()
                     req = self.loop_thread.submit(
                         prompt_ids, p, on_event=_event_pusher(loop, q),
-                        images=images)
+                        images=images, deadline=deadline)
                     req._aq = q
                     reqs.append(req)
         except EngineStallError as e:
@@ -937,9 +1004,15 @@ class OpenAIServer:
         except QueueFullError as e:
             for r in reqs:
                 self.loop_thread.abort(r)
+            # Retry-After from the actual backlog — queue depth times the
+            # observed step time — so a saturated replica says "come back
+            # when the queue has drained" instead of inviting a thundering
+            # herd at 1 s intervals
+            est = len(self.engine.waiting) * max(self.engine._est_step, 1e-3)
+            retry_after = max(1, min(60, int(est + 0.999)))
             return web.json_response(
                 {"error": {"message": str(e), "type": "rate_limit_exceeded"}},
-                status=429, headers={"Retry-After": "1"})
+                status=429, headers={"Retry-After": str(retry_after)})
         except ValueError as e:
             for r in reqs:
                 self.loop_thread.abort(r)
@@ -1177,6 +1250,18 @@ class OpenAIServer:
                            "type": "service_unavailable",
                            "code": "engine_stalled"}},
                 status=503, headers={"Retry-After": "30"})
+
+        if results and all(r[2] == "timeout" and not r[1] for r in results):
+            # every choice hit its end-to-end deadline before producing a
+            # single token: there is no useful partial output, so answer
+            # with the same 504 the router would have produced. (Any choice
+            # WITH partial text falls through to a 200 whose finish_reason
+            # is "timeout" — the client sees what was generated in budget.)
+            return web.json_response(
+                {"error": {"message": "deadline exceeded before any output "
+                           "was generated", "type": "timeout",
+                           "code": "deadline_exceeded"}},
+                status=504)
 
         if best_of > n:
             # keep the n best candidates per prompt by mean token logprob;

@@ -10,8 +10,9 @@ SURVEY §3.1) with its defects fixed:
   against the configured model names; no/unknown model falls back to the
   default backend (model-gateway.yaml:51-75). Unlike the reference's silent
   fallback, ``strict=True`` turns unknown models into a 404 with an
-  OpenAI-style error (SURVEY §7 router item: "404-or-default as a config
-  choice").
+  OpenAI-style error, and the non-strict fallback is logged + counted
+  (``llm_router_unknown_model_fallback_total``) so misrouted traffic is
+  visible.
 - ``GET /health`` -> 200 "OK" (model-gateway.yaml:84-86).
 - Everything else is proxied to the selected backend **streaming**, chunk
   by chunk — the reference's Python gateway buffered entire responses and
@@ -19,17 +20,31 @@ SURVEY §3.1) with its defects fixed:
 - 502 with a JSON error on upstream failure (api-gateway.yaml:100-104).
 
 Fault tolerance (the layer the pulled vLLM image got from its ingress for
-free, SURVEY §5 / ISSUE 1):
+free, SURVEY §5 / ISSUE 1 + ISSUE 2):
 
+- each model maps to a **replica set** (one or more upstream base URLs),
+  balanced with power-of-two-choices over the healthy members;
+- a **per-replica circuit breaker**: after ``breaker_threshold``
+  consecutive transport failures the replica is OPEN for
+  ``breaker_open_s`` seconds, then one half-open probe decides close vs
+  re-open; a request is 503'd only when every replica is open;
+- optional active background ``GET /ready`` **health probes**
+  (``probe_interval_s``) eject replicas that are unreachable or report
+  503 (the engine's ``draining``/``wedged`` states) and re-admit them
+  when they recover, exported as ``llm_replica_healthy{model,replica}``;
 - per-request **connect/read timeouts** (connect default 5 s, sock-read
   default 120 s between chunks, total default 300 s);
 - **bounded retries** with exponential backoff + jitter, only on
   connect-phase failures (no response head received yet — the request
-  body is fully buffered, so a resend cannot double-apply);
-- a per-upstream **circuit breaker**: after ``breaker_threshold``
-  consecutive transport failures the upstream is OPEN for
-  ``breaker_open_s`` seconds (503 + ``Retry-After``), then one half-open
-  probe decides close vs re-open;
+  body is fully buffered, so a resend cannot double-apply). A retry
+  prefers a *different* healthy replica (failover, counted in
+  ``llm_failover_total``) and fails over immediately; only a retry
+  against the same replica backs off. Read-phase failures are never
+  resent.
+- an **end-to-end deadline**: ``X-LLMK-Deadline-Ms`` (or a ``timeout``
+  body field, in seconds) carries the client's remaining budget; the
+  router rejects already-expired requests with 504 and forwards the
+  decremented budget so the server/engine can shed doomed work;
 - consistent OpenAI-style error JSON for every gateway-generated failure.
 
 A native C++ implementation with identical semantics lives in
@@ -42,12 +57,20 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
+import math
 import random
 import time
-from typing import Optional
+from typing import Optional, Union
 
 import aiohttp
 from aiohttp import web
+
+from llms_on_kubernetes_tpu.server.metrics import Registry, router_metrics
+
+log = logging.getLogger("llmk.router")
+
+DEADLINE_HEADER = "X-LLMK-Deadline-Ms"
 
 HOP_BY_HOP = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
@@ -74,7 +97,7 @@ def error_body(message: str, type_: str, code: str = "") -> dict:
 
 
 class CircuitBreaker:
-    """Per-upstream consecutive-failure breaker (closed → open → half-open).
+    """Per-replica consecutive-failure breaker (closed → open → half-open).
 
     ``allow()`` gates requests; callers report outcomes via
     ``record_success``/``record_failure``. While OPEN every request is
@@ -94,6 +117,20 @@ class CircuitBreaker:
         self.failures = 0
         self.opened_at = 0.0
         self._probe_started: Optional[float] = None
+
+    def blocked(self) -> bool:
+        """Non-mutating peek: would ``allow()`` reject right now?
+
+        Used for replica *selection* so that considering a candidate does
+        not consume its half-open probe slot.
+        """
+        now = self.clock()
+        if self.state == self.OPEN:
+            return now - self.opened_at < self.open_s
+        if self.state == self.HALF_OPEN:
+            return (self._probe_started is not None
+                    and now - self._probe_started < self.open_s)
+        return False
 
     def allow(self) -> bool:
         now = self.clock()
@@ -126,10 +163,38 @@ class CircuitBreaker:
         return max(0.0, self.open_s - (self.clock() - self.opened_at))
 
 
+class Replica:
+    """One upstream of a model's replica set, with its routing state."""
+
+    def __init__(self, model: str, url: str, breaker: CircuitBreaker):
+        self.model = model
+        self.url = url                 # base URL, no trailing slash
+        self.breaker = breaker
+        self.healthy = True            # last active-probe verdict
+        self.inflight = 0              # requests currently relayed through it
+
+    def __repr__(self) -> str:
+        return f"Replica({self.model!r}, {self.url!r})"
+
+
+def _normalize_backends(
+        backends: "dict[str, Union[str, list[str]]]") -> dict[str, list[str]]:
+    """Accept both the legacy name→url and the name→[urls] config shapes."""
+    out: dict[str, list[str]] = {}
+    for name, urls in backends.items():
+        if isinstance(urls, str):
+            urls = [urls]
+        urls = [u.rstrip("/") for u in urls if u]
+        if not urls:
+            raise ValueError(f"model {name!r} has an empty replica list")
+        out[name] = urls
+    return out
+
+
 class Router:
     def __init__(
         self,
-        backends: dict[str, str],
+        backends: "dict[str, Union[str, list[str]]]",
         default_model: Optional[str] = None,
         strict: bool = False,
         upstream_timeout: float = 300.0,
@@ -139,14 +204,21 @@ class Router:
         retry_backoff_s: float = 0.2,
         breaker_threshold: int = 5,
         breaker_open_s: float = 10.0,
+        probe_interval_s: Optional[float] = None,
+        probe_timeout_s: float = 2.0,
+        probe_path: str = "/ready",
         clock=time.monotonic,
     ):
-        """backends: model name -> base URL (e.g. http://svc:8080)."""
+        """backends: model name -> base URL or list of replica base URLs.
+
+        ``probe_interval_s=None`` disables the active health prober (the
+        default for embedded/test use); ``run_router`` enables it.
+        """
         if not backends:
             raise ValueError("router needs at least one backend")
-        self.backends = dict(backends)
-        self.default_model = default_model or next(iter(backends))
-        if self.default_model not in backends:
+        self.backends = _normalize_backends(backends)
+        self.default_model = default_model or next(iter(self.backends))
+        if self.default_model not in self.backends:
             raise ValueError(f"default model {self.default_model!r} not in backends")
         self.strict = strict
         self.timeout = aiohttp.ClientTimeout(
@@ -155,15 +227,34 @@ class Router:
         )
         self.retry_attempts = max(1, retry_attempts)
         self.retry_backoff_s = retry_backoff_s
-        self.breakers = {
-            name: CircuitBreaker(breaker_threshold, breaker_open_s, clock)
-            for name in backends
-        }
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.probe_path = probe_path
+        self.clock = clock
+        self.registry = Registry()
+        self.metrics = router_metrics(self.registry)
+        # per-replica state; breakers indexed by replica URL for inspection
+        self.replicas: dict[str, list[Replica]] = {}
+        self.breakers: dict[str, CircuitBreaker] = {}
+        for name, urls in self.backends.items():
+            reps = []
+            for url in urls:
+                breaker = self.breakers.get(url)
+                if breaker is None:
+                    breaker = self.breakers[url] = CircuitBreaker(
+                        breaker_threshold, breaker_open_s, clock)
+                rep = Replica(name, url, breaker)
+                reps.append(rep)
+                self.metrics["replica_healthy"].labels(
+                    model=name, replica=url).set(1)
+            self.replicas[name] = reps
         self._session: Optional[aiohttp.ClientSession] = None
+        self._probe_task: Optional[asyncio.Task] = None
 
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics_endpoint)
         app.router.add_get("/v1/models", self.models)
         app.router.add_route("*", "/{path:.*}", self.proxy)
         app.on_startup.append(self._startup)
@@ -172,15 +263,69 @@ class Router:
 
     async def _startup(self, app) -> None:
         self._session = aiohttp.ClientSession(timeout=self.timeout)
+        if self.probe_interval_s:
+            self._probe_task = asyncio.get_event_loop().create_task(
+                self._probe_loop())
 
     async def _cleanup(self, app) -> None:
+        if self._probe_task:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
         if self._session:
             await self._session.close()
+
+    # ------------------------------------------------------------------
+    # active health probing
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.probe_interval_s)
+            await self.probe_all()
+
+    async def probe_all(self) -> None:
+        """One probe sweep over every replica (also callable from tests)."""
+        await asyncio.gather(*(
+            self._probe_one(rep)
+            for reps in self.replicas.values() for rep in reps
+        ), return_exceptions=True)
+
+    async def _probe_one(self, rep: Replica) -> None:
+        # A replica is ejected when it is unreachable or its readiness
+        # endpoint answers 503 (the engine's loading/draining/wedged
+        # states). Any other status — including 404 from upstreams that
+        # expose no /ready — counts as reachable, so plain HTTP backends
+        # stay routable.
+        try:
+            async with self._session.get(
+                rep.url + self.probe_path,
+                timeout=aiohttp.ClientTimeout(total=self.probe_timeout_s),
+            ) as resp:
+                await resp.read()
+                healthy = resp.status != 503
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            healthy = False
+        self._set_health(rep, healthy)
+
+    def _set_health(self, rep: Replica, healthy: bool) -> None:
+        if healthy != rep.healthy:
+            log.warning("replica %s of model %r %s", rep.url, rep.model,
+                        "re-admitted" if healthy else "ejected")
+        rep.healthy = healthy
+        self.metrics["replica_healthy"].labels(
+            model=rep.model, replica=rep.url).set(1 if healthy else 0)
 
     # ------------------------------------------------------------------
 
     async def health(self, request: web.Request) -> web.Response:
         return web.Response(text="OK")
+
+    async def metrics_endpoint(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.registry.render(),
+                            content_type="text/plain")
 
     async def models(self, request: web.Request) -> web.Response:
         """Synthesized exactly like the reference gateway (no backend hop)."""
@@ -194,54 +339,125 @@ class Router:
             ],
         })
 
+    @staticmethod
+    def _json_doc(body: bytes) -> Optional[dict]:
+        if not body:
+            return None
+        try:
+            data = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        return data if isinstance(data, dict) else None
+
     def select_backend(self, body: bytes) -> tuple[str, Optional[str]]:
         """Exact-match routing on the JSON `model` field.
 
         Returns (model_name, error); error is set only in strict mode.
         """
-        model = None
-        if body:
-            try:
-                data = json.loads(body)
-                if isinstance(data, dict):
-                    model = data.get("model")
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                model = None
+        return self._select(self._json_doc(body))
+
+    def _select(self, doc: Optional[dict]) -> tuple[str, Optional[str]]:
+        model = doc.get("model") if doc else None
         if isinstance(model, str) and model in self.backends:
             return model, None
-        if self.strict and model is not None:
-            return self.default_model, f"model {model!r} not found"
+        if model is not None:
+            if self.strict:
+                return self.default_model, f"model {model!r} not found"
+            self.metrics["unknown_model_fallback"].inc()
+            log.warning("unknown model %r: falling back to default %r",
+                        model, self.default_model)
         return self.default_model, None
+
+    def _deadline_from(self, request: web.Request, doc: Optional[dict],
+                       now: float) -> Optional[float]:
+        """Absolute deadline on ``self.clock``, or None when the client
+        set no budget. Header takes precedence over the body field."""
+        raw = request.headers.get(DEADLINE_HEADER)
+        if raw is not None:
+            try:
+                return now + float(raw) / 1000.0
+            except ValueError:
+                return None
+        timeout = doc.get("timeout") if doc else None
+        if isinstance(timeout, (int, float)) and not isinstance(timeout, bool):
+            return now + float(timeout)
+        return None
+
+    def _pick(self, model: str, exclude: set) -> Optional[Replica]:
+        """Power-of-two-choices over the model's routable replicas.
+
+        Replicas in ``exclude`` (already failed this request) are skipped
+        unless nothing else is routable; breaker half-open slots are only
+        claimed for the final choice (``blocked()`` peeks first).
+        """
+        reps = self.replicas[model]
+        cands = [r for r in reps
+                 if r.url not in exclude and r.healthy
+                 and not r.breaker.blocked()]
+        if not cands and exclude:
+            cands = [r for r in reps
+                     if r.healthy and not r.breaker.blocked()]
+        if not cands:
+            return None
+        if len(cands) == 1:
+            choice = cands[0]
+        else:
+            a, b = random.sample(cands, 2)
+            choice = a if a.inflight <= b.inflight else b
+        return choice if choice.breaker.allow() else None
+
+    def _unroutable_response(self, model: str) -> web.Response:
+        reps = self.replicas[model]
+        healthy = [r for r in reps if r.healthy]
+        if healthy:
+            retry_after = max(1, math.ceil(
+                min(r.breaker.retry_after_s() for r in healthy)))
+            return web.json_response(
+                error_body(
+                    f"all {len(healthy)} replica(s) of {model!r} unavailable "
+                    f"(circuit open)",
+                    "service_unavailable", "upstream_circuit_open"),
+                status=503, headers={"Retry-After": str(retry_after)},
+            )
+        retry_after = max(1, math.ceil(self.probe_interval_s or 1))
+        return web.json_response(
+            error_body(
+                f"no healthy replicas for {model!r} "
+                f"({len(reps)} ejected by health probes)",
+                "service_unavailable", "no_healthy_upstream"),
+            status=503, headers={"Retry-After": str(retry_after)},
+        )
+
+    def _deadline_response(self) -> web.Response:
+        self.metrics["deadline_rejected"].inc()
+        return web.json_response(
+            error_body("deadline expired before the request could be "
+                       "forwarded", "timeout", "deadline_exceeded"),
+            status=504,
+        )
 
     # ------------------------------------------------------------------
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
+        t0 = self.clock()
         body = await request.read()
-        model, err = self.select_backend(body)
+        doc = self._json_doc(body)
+        model, err = self._select(doc)
         if err:
             return web.json_response(
                 error_body(err, "invalid_request_error", "model_not_found"),
                 status=404,
             )
-        breaker = self.breakers[model]
-        if not breaker.allow():
-            retry_after = max(1, int(breaker.retry_after_s() + 0.999))
-            return web.json_response(
-                error_body(
-                    f"upstream {model!r} unavailable (circuit open after "
-                    f"{breaker.failures} consecutive failures)",
-                    "service_unavailable", "upstream_circuit_open"),
-                status=503,
-                headers={"Retry-After": str(retry_after)},
-            )
-        base = self.backends[model].rstrip("/")
-        url = f"{base}/{request.match_info['path']}"
-        if request.query_string:
-            url += f"?{request.query_string}"
+        deadline = self._deadline_from(request, doc, t0)
+        if deadline is not None and self.clock() >= deadline:
+            return self._deadline_response()
 
+        # the inbound deadline header is consumed here; a decremented copy
+        # is re-added per attempt below (never the client's raw value)
         headers = {
             k: v for k, v in request.headers.items()
             if k.lower() not in HOP_BY_HOP
+            and k.lower() != DEADLINE_HEADER.lower()
         }
         peername = request.transport.get_extra_info("peername") if request.transport else None
         client_ip = peername[0] if peername else ""
@@ -253,29 +469,63 @@ class Router:
         # --- connect/request phase: bounded retries with backoff+jitter.
         # Only failures BEFORE a response head are retried (the buffered
         # body makes the resend safe); each transport failure feeds the
-        # breaker, so a dead upstream trips open instead of burning the
-        # full retry budget on every request.
+        # replica's breaker. A retry prefers a different healthy replica
+        # (failover, immediate); retrying the same replica backs off.
         upstream: Optional[aiohttp.ClientResponse] = None
+        active: Optional[Replica] = None
+        prev: Optional[Replica] = None
         last_err: Optional[BaseException] = None
+        tried: set = set()
+        never_picked = True
         for attempt in range(1, self.retry_attempts + 1):
+            replica = self._pick(model, tried)
+            if replica is None:
+                break
+            never_picked = False
+            if prev is not None and replica.url != prev.url:
+                self.metrics["failover"].inc()
+                log.warning("failing over %r from %s to %s", model,
+                            prev.url, replica.url)
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    return self._deadline_response()
+                headers[DEADLINE_HEADER] = str(int(remaining * 1000))
+            url = f"{replica.url}/{request.match_info['path']}"
+            if request.query_string:
+                url += f"?{request.query_string}"
+            replica.inflight += 1
             try:
                 upstream = await self._session.request(
                     request.method, url, data=body or None, headers=headers,
                 )
-                breaker.record_success()
+                replica.breaker.record_success()
+                active = replica
                 break
             except RETRYABLE_ERRORS as e:
-                breaker.record_failure()
+                replica.inflight -= 1
+                replica.breaker.record_failure()
                 last_err = e
-                if attempt >= self.retry_attempts or not breaker.allow():
+                tried.add(replica.url)
+                prev = replica
+                if attempt >= self.retry_attempts:
                     break
-                backoff = self.retry_backoff_s * (2 ** (attempt - 1))
-                await asyncio.sleep(backoff * (1.0 + random.random()))
+                # back off only when no untried alternate exists (a
+                # failover to a different replica is immediate)
+                alternates = [r for r in self.replicas[model]
+                              if r.url not in tried and r.healthy
+                              and not r.breaker.blocked()]
+                if not alternates:
+                    backoff = self.retry_backoff_s * (2 ** (attempt - 1))
+                    await asyncio.sleep(backoff * (1.0 + random.random()))
             except (aiohttp.ClientError, TimeoutError, OSError) as e:
-                breaker.record_failure()
+                replica.inflight -= 1
+                replica.breaker.record_failure()
                 last_err = e
                 break
-        if upstream is None:
+        if upstream is None or active is None:
+            if never_picked and last_err is None:
+                return self._unroutable_response(model)
             return web.json_response(
                 error_body(f"upstream error: {last_err}", "bad_gateway",
                            "upstream_error"),
@@ -297,7 +547,7 @@ class Router:
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, TimeoutError, OSError) as e:
-            breaker.record_failure()
+            active.breaker.record_failure()
             if resp is None or not resp.prepared:
                 return web.json_response(
                     error_body(f"upstream error: {e}", "bad_gateway",
@@ -310,15 +560,19 @@ class Router:
             if request.transport is not None:
                 request.transport.close()
             return resp
+        finally:
+            active.inflight -= 1
 
 
 def run_router(
-    backends: dict[str, str],
+    backends: "dict[str, Union[str, list[str]]]",
     default_model: Optional[str] = None,
     strict: bool = False,
     host: str = "0.0.0.0",
     port: int = 8080,
+    probe_interval_s: Optional[float] = 2.0,
 ) -> None:
-    router = Router(backends, default_model, strict)
+    router = Router(backends, default_model, strict,
+                    probe_interval_s=probe_interval_s)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

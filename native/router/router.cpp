@@ -24,7 +24,7 @@
 // Config: JSON file (--config) with the same schema the Helm chart's
 // ConfigMap emits for the python router (k8s/*/templates/router-config.yaml,
 // deploy/manifests.py:router_config):
-//   {"backends": {"<name>": "http://host:port", ...},
+//   {"backends": {"<name>": ["http://host:port", ...], ...},
 //    "default_model": "<name>",       // optional; first model otherwise
 //    "strict": false,                 // optional; 404 unknown models
 //    "upstream_timeout_s": 300,       // optional; reference used 300s
@@ -32,11 +32,26 @@
 //    "retry_attempts": 3,             // optional; connect-phase retries
 //    "retry_backoff_ms": 200,         // optional; x2 per attempt + jitter
 //    "breaker_threshold": 5,          // optional; consecutive failures
-//    "breaker_open_s": 10}            // optional; open duration / probe gap
-// ("models"/"default" are accepted as aliases.) Or inline
-// --models "name=url,name2=url2" (tests, quick runs). A leading "router"
+//    "breaker_open_s": 10,            // optional; open duration / probe gap
+//    "probe_interval_s": 2}           // optional; /ready probe period (0=off)
+// (backend values may be a single URL string or an array of replica URLs;
+// "models"/"default" are accepted as aliases.) Or inline
+// --models "name=url|url2,name2=url" (tests, quick runs). A leading "router"
 // subcommand token is accepted and ignored so the binary is invocable with
 // the exact argv the chart passes the python CLI (`router --config ...`).
+//
+// Replica failover (mirrors server/router.py): each model maps to a replica
+// SET. A background prober GETs every replica's /ready each probe interval;
+// connect failure or HTTP 503 (draining/wedged) ejects the replica from
+// selection, any other answer re-admits it. Selection is power-of-two-
+// choices on in-flight count over healthy, breaker-unblocked replicas; a
+// connect-phase failure (refused / zero response bytes, not timed out)
+// fails over to a DIFFERENT replica immediately. End-to-end deadlines: an
+// X-LLMK-Deadline-Ms request header (or a "timeout" seconds body field) is
+// decremented by gateway time and forwarded; an expired budget answers 504
+// without an upstream hop. GET /metrics exposes llm_replica_healthy,
+// llm_failover_total, llm_router_unknown_model_fallback_total and
+// llm_router_deadline_rejected_total.
 //
 // Threading: one detached thread per connection (the gateway is I/O-bound;
 // per-model backends do the heavy work). Client keep-alive is honored.
@@ -74,10 +89,18 @@ namespace llkt {
 
 struct Config {
   // insertion-ordered: first model is the default (like the reference's
-  // `default_backend` = first entry, model-gateway.yaml:20-22)
-  std::vector<std::pair<std::string, Url>> models;
+  // `default_backend` = first entry, model-gateway.yaml:20-22). Each model
+  // maps to its replica SET (usually one URL; k8s headless Services or
+  // explicit lists give more).
+  std::vector<std::pair<std::string, std::vector<Url>>> models;
   std::string default_model;
   bool strict = false;
+  // active /ready probing period per replica; <= 0 disables (replicas then
+  // stay selectable and only the breaker ejects them). Off by default for
+  // inline --models runs (mirrors the python Router constructor); the
+  // rendered router.json always sets it.
+  double probe_interval_s = 0.0;
+  int probe_timeout_s = 2;
   int upstream_timeout_s = 300;
   // total budget for reading one client request (slowloris defense, see
   // SockReader::set_deadline); also the keep-alive idle timeout
@@ -95,7 +118,7 @@ struct Config {
   int port = 8080;
   bool quiet = false;
 
-  const Url* find(const std::string& name) const {
+  const std::vector<Url>* find(const std::string& name) const {
     for (const auto& kv : models)
       if (kv.first == name) return &kv.second;
     return nullptr;
@@ -113,6 +136,14 @@ static void logf(const Config& cfg, const char* fmt, ...) {
   va_end(ap);
   fputc('\n', stderr);
 }
+
+// ---------------------------------------------------------------------------
+// Gateway counters (GET /metrics)
+// ---------------------------------------------------------------------------
+
+static std::atomic<long> g_failover_total{0};
+static std::atomic<long> g_unknown_model_fallback_total{0};
+static std::atomic<long> g_deadline_rejected_total{0};
 
 // ---------------------------------------------------------------------------
 // Routing (the Lua access_by_lua_block equivalent)
@@ -136,7 +167,14 @@ static std::string select_backend(const Config& cfg, const std::string& body,
     *not_found = true;
     return cfg.default_model;
   }
-  return cfg.default_model;  // silent fallback, like the reference
+  if (!requested.empty()) {
+    // non-strict fallback is no longer silent: the reference's quiet
+    // default-routing hid client typos for weeks (SURVEY §3.1)
+    g_unknown_model_fallback_total.fetch_add(1, std::memory_order_relaxed);
+    logf(cfg, "unknown model %s: falling back to default %s",
+         requested.c_str(), cfg.default_model.c_str());
+  }
+  return cfg.default_model;  // fallback, like the reference (but counted)
 }
 
 // ---------------------------------------------------------------------------
@@ -269,6 +307,31 @@ class Breaker {
     return true;
   }
 
+  // non-mutating peek for replica SELECTION: true while the breaker would
+  // reject a request right now. Unlike allow(), never claims the half-open
+  // probe slot, so scanning candidates does not consume probe budget.
+  bool blocked(double open_s, double* retry_after_s = nullptr) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto now = std::chrono::steady_clock::now();
+    if (state_ == kOpen) {
+      double elapsed = std::chrono::duration<double>(now - opened_at_).count();
+      if (elapsed < open_s) {
+        if (retry_after_s) *retry_after_s = open_s - elapsed;
+        return true;
+      }
+      return false;
+    }
+    if (state_ == kHalfOpen && probe_inflight_) {
+      double since =
+          std::chrono::duration<double>(now - probe_started_).count();
+      if (since < open_s) {
+        if (retry_after_s) *retry_after_s = open_s - since;
+        return true;
+      }
+    }
+    return false;
+  }
+
   void record_success() {
     std::lock_guard<std::mutex> lock(mu_);
     state_ = kClosed;
@@ -315,6 +378,137 @@ class BreakerRegistry {
 };
 
 static BreakerRegistry g_breakers;
+
+// ---------------------------------------------------------------------------
+// Replica health + selection (mirrors server/router.py Replica/_pick)
+// ---------------------------------------------------------------------------
+
+struct ReplicaHealth {
+  std::atomic<bool> healthy{true};   // last active-probe verdict
+  std::atomic<int> inflight{0};      // requests currently proxied to it
+};
+
+class HealthRegistry {
+ public:
+  ReplicaHealth& get(const std::string& host, int port) {
+    std::lock_guard<std::mutex> lock(mu_);
+    return map_[{host, port}];  // std::map nodes are pointer-stable
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::pair<std::string, int>, ReplicaHealth> map_;
+};
+
+static HealthRegistry g_health;
+
+static thread_local unsigned g_pick_seed = 0;
+
+static unsigned pick_rand(unsigned bound) {
+  if (g_pick_seed == 0) {
+    g_pick_seed = static_cast<unsigned>(
+                      std::chrono::steady_clock::now()
+                          .time_since_epoch().count()) ^
+                  static_cast<unsigned>(
+                      std::hash<std::thread::id>{}(std::this_thread::get_id()));
+  }
+  return static_cast<unsigned>(rand_r(&g_pick_seed)) % bound;
+}
+
+// Picks the next replica to try: healthy (per the active prober) and not
+// breaker-blocked, preferring ones not already tried this request;
+// power-of-two-choices on in-flight count among the survivors. When the
+// exclusion leaves nothing but replicas HAVE been tried, any healthy
+// unblocked replica may be retried (single-replica retry path). Unhealthy
+// or breaker-blocked replicas are never picked — the caller answers 503.
+static const Url* pick_replica(const Config& cfg, const std::vector<Url>& reps,
+                               const std::vector<const Url*>& tried) {
+  auto is_tried = [&](const Url& u) {
+    for (const Url* t : tried)
+      if (t == &u) return true;
+    return false;
+  };
+  auto routable = [&](const Url& u) {
+    return g_health.get(u.host, u.port)
+               .healthy.load(std::memory_order_relaxed) &&
+           !g_breakers.get(u.host, u.port).blocked(cfg.breaker_open_s);
+  };
+  std::vector<const Url*> pool;
+  for (const auto& u : reps)
+    if (!is_tried(u) && routable(u)) pool.push_back(&u);
+  if (pool.empty() && !tried.empty()) {
+    for (const auto& u : reps)
+      if (routable(u)) pool.push_back(&u);
+  }
+  if (pool.empty()) return nullptr;
+  if (pool.size() == 1) return pool[0];
+  size_t a = pick_rand(static_cast<unsigned>(pool.size()));
+  size_t b = pick_rand(static_cast<unsigned>(pool.size() - 1));
+  if (b >= a) ++b;
+  int ia = g_health.get(pool[a]->host, pool[a]->port)
+               .inflight.load(std::memory_order_relaxed);
+  int ib = g_health.get(pool[b]->host, pool[b]->port)
+               .inflight.load(std::memory_order_relaxed);
+  return ib < ia ? pool[b] : pool[a];
+}
+
+// True while an UNTRIED routable replica exists: failover to it skips the
+// retry backoff (the new replica owes nothing for the old one's failure).
+static bool has_untried_alternate(const Config& cfg,
+                                  const std::vector<Url>& reps,
+                                  const std::vector<const Url*>& tried) {
+  for (const auto& u : reps) {
+    bool t = false;
+    for (const Url* p : tried)
+      if (p == &u) { t = true; break; }
+    if (t) continue;
+    if (!g_health.get(u.host, u.port).healthy.load(std::memory_order_relaxed))
+      continue;
+    if (g_breakers.get(u.host, u.port).blocked(cfg.breaker_open_s)) continue;
+    return true;
+  }
+  return false;
+}
+
+// One active health probe: GET <base>/ready. A replica is unhealthy iff
+// the probe cannot CONNECT/read a response head, or the server answered
+// 503 (draining/wedged — the engine's own readiness contract). Any other
+// status (200, 404 from a bare backend without /ready) keeps it routable.
+static bool probe_replica(const Config& cfg, const Url& u) {
+  int fd = connect_to(u.host, u.port, cfg.probe_timeout_s,
+                      cfg.probe_timeout_s);
+  if (fd < 0) return false;
+  std::ostringstream out;
+  out << "GET " << (u.path == "/" ? "" : u.path) << "/ready HTTP/1.1\r\n"
+      << "Host: " << u.host << ":" << u.port << "\r\n"
+      << "Connection: close\r\n\r\n";
+  bool ok = send_all(fd, out.str());
+  if (ok) {
+    SockReader r(fd);
+    r.set_deadline(std::chrono::steady_clock::now() +
+                   std::chrono::seconds(cfg.probe_timeout_s));
+    ResponseHead head;
+    ok = read_response_head(r, head) && head.status != 503;
+  }
+  ::close(fd);
+  return ok;
+}
+
+// Probes every replica of every model once, flipping health verdicts and
+// logging ejections/re-admissions. Called by the prober thread; exposed as
+// a single sweep so it stays deterministic to exercise.
+static void probe_all(const Config& cfg) {
+  for (const auto& kv : cfg.models) {
+    for (const Url& u : kv.second) {
+      bool ok = probe_replica(cfg, u);
+      auto& h = g_health.get(u.host, u.port);
+      bool was = h.healthy.exchange(ok, std::memory_order_relaxed);
+      if (was != ok)
+        logf(cfg, "replica %s:%d (%s): %s", u.host.c_str(), u.port,
+             kv.first.c_str(), ok ? "re-admitted" : "ejected");
+    }
+  }
+}
 
 // exponential backoff with full jitter: base * 2^attempt * (1 + U[0,1))
 static void backoff_sleep(const Config& cfg, int attempt) {
@@ -414,80 +608,140 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
 // reused for another request.
 static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                           const std::string& client_ip, const std::string& model) {
-  const Url* base = cfg.find(model);
-  Url target = *base;
-  // join upstream base path with the request target
-  std::string path = target.path == "/" ? req.target : target.path + req.target;
+  const std::vector<Url>& replicas = *cfg.find(model);
+  const auto t0 = std::chrono::steady_clock::now();
 
-  // build upstream request (keep-alive: the connection goes back to the
-  // pool when the response framing completes)
-  std::ostringstream out;
-  out << req.method << " " << path << " HTTP/1.1\r\n";
-  out << "Host: " << target.host << ":" << target.port << "\r\n";
-  for (const auto& kv : req.headers.items) {
-    std::string n = lower(kv.first);
-    if (is_hop_by_hop(n) || n == "x-real-ip" || n == "x-forwarded-proto")
-      continue;
-    if (n == "x-forwarded-for") continue;  // re-added with client appended
-    out << kv.first << ": " << kv.second << "\r\n";
+  // end-to-end deadline: the X-LLMK-Deadline-Ms header (ms of budget
+  // remaining) wins over the body's OpenAI-style "timeout" seconds field;
+  // whatever is left after gateway time is forwarded upstream
+  double budget_ms = -1.0;
+  if (const std::string* dl = req.headers.get("x-llmk-deadline-ms")) {
+    try {
+      budget_ms = std::stod(*dl);
+    } catch (...) {
+      budget_ms = -1.0;  // malformed header = no deadline, not a 400
+    }
+  } else if (!req.body.empty()) {
+    JsonPtr parsed = JsonParser::parse(req.body);
+    if (parsed && parsed->is_object()) {
+      const Json* t = parsed->get("timeout");
+      if (t && t->type == Json::Type::Number && t->number > 0)
+        budget_ms = t->number * 1000.0;
+    }
   }
-  out << "X-Real-IP: " << client_ip << "\r\n";
-  const std::string* fwd = req.headers.get("x-forwarded-for");
-  out << "X-Forwarded-For: " << (fwd ? *fwd + ", " + client_ip : client_ip)
-      << "\r\n";
-  out << "X-Forwarded-Proto: http\r\n";
-  out << "Content-Length: " << req.body.size() << "\r\n";
-  out << "Connection: keep-alive\r\n\r\n";
-  const std::string head_bytes = out.str();
-
-  // circuit breaker: a tripped upstream 503s immediately (with Retry-After)
-  // instead of burning connect-timeout x retries on every request
-  Breaker& breaker = g_breakers.get(target.host, target.port);
-  double retry_after_s = 0.0;
-  if (!breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
-                     &retry_after_s)) {
-    int ra = static_cast<int>(retry_after_s) + 1;
-    std::string body = error_json(
-        "upstream " + model + " unavailable (circuit open after " +
-            std::to_string(breaker.failures()) + " consecutive failures)",
-        "service_unavailable", "upstream_circuit_open");
+  auto remaining_ms = [&]() -> double {
+    return budget_ms - std::chrono::duration<double, std::milli>(
+                           std::chrono::steady_clock::now() - t0).count();
+  };
+  auto deadline_response = [&]() {
+    g_deadline_rejected_total.fetch_add(1, std::memory_order_relaxed);
+    std::string body = error_json("deadline expired before upstream dispatch",
+                                  "timeout", "deadline_exceeded");
     send_all(client_fd,
-             simple_response(503, "Service Unavailable", "application/json",
-                             body, req.keep_alive,
-                             "Retry-After: " + std::to_string(ra) + "\r\n"));
-    logf(cfg, "-> 503 (circuit open: %s)", model.c_str());
+             simple_response(504, "Gateway Timeout", "application/json", body,
+                             req.keep_alive));
+    logf(cfg, "-> 504 (deadline expired: %s)", model.c_str());
     return req.keep_alive;
-  }
+  };
+  if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
 
-  // connect/request phase with bounded retries. Retried failures: connect
-  // refused/timed out, and connection death with ZERO response bytes and
-  // no read timeout (the buffered body makes a resend safe; a TIMEOUT is
-  // excluded — the upstream may still be executing the request). Pooled
-  // idle-connection death retries for free (the upstream closing idle
-  // keep-alives is routine, not a failure).
+  // upstream request head, rebuilt per attempt so the forwarded deadline
+  // reflects time already burned on failed replicas
+  auto build_head = [&](const Url& target) {
+    std::string path =
+        target.path == "/" ? req.target : target.path + req.target;
+    std::ostringstream out;
+    out << req.method << " " << path << " HTTP/1.1\r\n";
+    out << "Host: " << target.host << ":" << target.port << "\r\n";
+    for (const auto& kv : req.headers.items) {
+      std::string n = lower(kv.first);
+      if (is_hop_by_hop(n) || n == "x-real-ip" || n == "x-forwarded-proto")
+        continue;
+      if (n == "x-forwarded-for") continue;  // re-added with client appended
+      if (n == "x-llmk-deadline-ms") continue;  // re-added decremented
+      out << kv.first << ": " << kv.second << "\r\n";
+    }
+    out << "X-Real-IP: " << client_ip << "\r\n";
+    const std::string* fwd = req.headers.get("x-forwarded-for");
+    out << "X-Forwarded-For: " << (fwd ? *fwd + ", " + client_ip : client_ip)
+        << "\r\n";
+    out << "X-Forwarded-Proto: http\r\n";
+    if (budget_ms >= 0) {
+      double rem = remaining_ms();
+      out << "X-LLMK-Deadline-Ms: "
+          << static_cast<long>(rem > 0 ? rem : 0) << "\r\n";
+    }
+    out << "Content-Length: " << req.body.size() << "\r\n";
+    out << "Connection: keep-alive\r\n\r\n";
+    return out.str();
+  };
+
+  // connect/request phase with bounded retries over the replica set.
+  // Retried failures: connect refused/timed out, and connection death with
+  // ZERO response bytes and no read timeout (the buffered body makes a
+  // resend safe; a TIMEOUT is excluded — the upstream may still be
+  // executing the request). A failed replica is excluded from the next
+  // pick, so the retry FAILS OVER to a sibling — immediately, without
+  // backoff, when an untried one exists. Pooled idle-connection death
+  // retries for free (upstreams closing idle keep-alives is routine).
   int up_fd = -1;
   ResponseHead head;
   std::optional<SockReader> up;
   bool got_head = false;
+  bool attempted = false;
   int pooled_retries = 0;
   std::string fail_msg = "upstream error";
+  const Url* target = nullptr;
+  const Url* prev = nullptr;
+  std::vector<const Url*> tried;
+  ReplicaHealth* health = nullptr;
   int max_attempts = std::max(1, cfg.retry_attempts);
   for (int attempt = 0; attempt < max_attempts; ++attempt) {
+    if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
+    target = pick_replica(cfg, replicas, tried);
+    if (!target) break;
+    Breaker& breaker = g_breakers.get(target->host, target->port);
+    double retry_after_s = 0.0;
+    if (!breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
+                       &retry_after_s)) {
+      // raced shut since the selection peek: skip without burning an
+      // attempt (no network I/O happened); bounded because the replica
+      // joins `tried` and a re-pick of a tried replica breaks out here
+      bool seen = false;
+      for (const Url* p : tried)
+        if (p == target) { seen = true; break; }
+      if (seen) break;
+      tried.push_back(target);
+      --attempt;
+      continue;
+    }
+    if (prev && prev != target) {
+      g_failover_total.fetch_add(1, std::memory_order_relaxed);
+      logf(cfg, "failover %s: %s:%d -> %s:%d", model.c_str(),
+           prev->host.c_str(), prev->port, target->host.c_str(),
+           target->port);
+    }
+    attempted = true;
+    health = &g_health.get(target->host, target->port);
+    health->inflight.fetch_add(1, std::memory_order_relaxed);
+    const std::string head_bytes = build_head(*target);
     bool pooled = false;
-    up_fd = g_upstream_pool.acquire(target.host, target.port);
+    up_fd = g_upstream_pool.acquire(target->host, target->port);
     if (up_fd >= 0) {
       pooled = true;
     } else {
-      up_fd = connect_to(target.host, target.port, cfg.upstream_timeout_s,
+      up_fd = connect_to(target->host, target->port, cfg.upstream_timeout_s,
                          cfg.connect_timeout_s);
       if (up_fd < 0) {
+        health->inflight.fetch_sub(1, std::memory_order_relaxed);
         breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
-        fail_msg = "upstream connect failed: " + target.host + ":" +
-                   std::to_string(target.port);
-        if (attempt + 1 < max_attempts &&
-            breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
-                          &retry_after_s)) {
-          backoff_sleep(cfg, attempt);
+        fail_msg = "upstream connect failed: " + target->host + ":" +
+                   std::to_string(target->port);
+        prev = target;
+        tried.push_back(target);
+        if (attempt + 1 < max_attempts) {
+          if (!has_untried_alternate(cfg, replicas, tried))
+            backoff_sleep(cfg, attempt);
           continue;
         }
         break;
@@ -505,21 +759,61 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     bool virgin = !up->consumed_any();
     ::close(up_fd);
     up_fd = -1;
+    health->inflight.fetch_sub(1, std::memory_order_relaxed);
     if (pooled && virgin && pooled_retries++ < 2) {
+      prev = target;
       --attempt;  // idle-death: free retry, no breaker hit, no backoff
       continue;
     }
     breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
     fail_msg = timed_out ? "upstream read timed out" : "upstream error";
-    if (virgin && !timed_out && attempt + 1 < max_attempts &&
-        breaker.allow(cfg.breaker_threshold, cfg.breaker_open_s,
-                      &retry_after_s)) {
-      backoff_sleep(cfg, attempt);
+    prev = target;
+    tried.push_back(target);
+    if (virgin && !timed_out && attempt + 1 < max_attempts) {
+      if (!has_untried_alternate(cfg, replicas, tried))
+        backoff_sleep(cfg, attempt);
       continue;
     }
     break;
   }
   if (!got_head) {
+    if (!attempted) {
+      // never reached the network: the replica set is unroutable right
+      // now. Distinguish "breakers open" (retry when one half-opens) from
+      // "every replica probe-ejected" (retry after the next probe sweep).
+      bool any_healthy = false;
+      double min_ra = cfg.breaker_open_s;
+      for (const auto& u : replicas) {
+        if (!g_health.get(u.host, u.port)
+                 .healthy.load(std::memory_order_relaxed))
+          continue;
+        any_healthy = true;
+        double ra = 0.0;
+        if (g_breakers.get(u.host, u.port).blocked(cfg.breaker_open_s, &ra))
+          min_ra = std::min(min_ra, ra);
+      }
+      int ra_s;
+      std::string body;
+      if (any_healthy) {
+        ra_s = static_cast<int>(min_ra) + 1;
+        body = error_json(
+            "upstream " + model + " unavailable (circuit open)",
+            "service_unavailable", "upstream_circuit_open");
+      } else {
+        ra_s = cfg.probe_interval_s > 0
+                   ? static_cast<int>(cfg.probe_interval_s) + 1
+                   : 1;
+        body = error_json("no healthy replica for " + model,
+                          "service_unavailable", "no_healthy_upstream");
+      }
+      send_all(client_fd,
+               simple_response(503, "Service Unavailable", "application/json",
+                               body, req.keep_alive,
+                               "Retry-After: " + std::to_string(ra_s) +
+                                   "\r\n"));
+      logf(cfg, "-> 503 (unroutable: %s)", model.c_str());
+      return req.keep_alive;
+    }
     std::string body = error_json(fail_msg, "bad_gateway", "upstream_error");
     send_all(client_fd,
              simple_response(502, "Bad Gateway", "application/json", body,
@@ -542,6 +836,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   rh << "Connection: " << (reusable ? "keep-alive" : "close") << "\r\n\r\n";
   if (!send_all(client_fd, rh.str())) {
     ::close(up_fd);
+    health->inflight.fetch_sub(1, std::memory_order_relaxed);
     return false;
   }
 
@@ -554,9 +849,10 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   bool up_keep = head.status_line.compare(0, 8, "HTTP/1.1") == 0 &&
                  (!up_conn || lower(*up_conn).find("close") == std::string::npos);
   if (body_done && has_framing && up_keep && !up->has_buffered())
-    g_upstream_pool.release(target.host, target.port, up_fd);
+    g_upstream_pool.release(target->host, target->port, up_fd);
   else
     ::close(up_fd);
+  health->inflight.fetch_sub(1, std::memory_order_relaxed);
   return reusable && body_done;
 }
 
@@ -633,6 +929,34 @@ static void handle_connection(const Config& cfg, int client_fd,
                                       models_json(cfg), req.keep_alive)) &&
              req.keep_alive;
       logf(cfg, "GET /v1/models -> 200 (synthesized)");
+    } else if (path == "/metrics" && req.method == "GET") {
+      std::ostringstream m;
+      m << "# TYPE llm_failover_total counter\n"
+        << "llm_failover_total "
+        << g_failover_total.load(std::memory_order_relaxed) << "\n"
+        << "# TYPE llm_router_unknown_model_fallback_total counter\n"
+        << "llm_router_unknown_model_fallback_total "
+        << g_unknown_model_fallback_total.load(std::memory_order_relaxed)
+        << "\n"
+        << "# TYPE llm_router_deadline_rejected_total counter\n"
+        << "llm_router_deadline_rejected_total "
+        << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n"
+        << "# TYPE llm_replica_healthy gauge\n";
+      for (const auto& kv : cfg.models)
+        for (const Url& u : kv.second)
+          m << "llm_replica_healthy{model=\"" << kv.first << "\",replica=\""
+            << "http://" << u.host << ":" << u.port << "\"} "
+            << (g_health.get(u.host, u.port)
+                        .healthy.load(std::memory_order_relaxed)
+                    ? 1
+                    : 0)
+            << "\n";
+      keep = send_all(client_fd,
+                      simple_response(200, "OK",
+                                      "text/plain; version=0.0.4", m.str(),
+                                      req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "GET /metrics -> 200 (local)");
     } else {
       bool not_found = false;
       std::string model = select_backend(cfg, req.body, &not_found);
@@ -681,14 +1005,33 @@ static bool load_config_json(const std::string& file, Config& cfg) {
     return false;
   }
   for (const auto& kv : models->obj) {
-    if (!kv.second->is_string()) return false;
-    auto url = parse_url(kv.second->str);
-    if (!url) {
-      fprintf(stderr, "llkt-router: bad backend url %s\n",
-              kv.second->str.c_str());
+    // a value may be one URL string or an array of replica URLs
+    std::vector<Url> urls;
+    std::vector<const std::string*> raw;
+    if (kv.second->is_string()) {
+      raw.push_back(&kv.second->str);
+    } else if (kv.second->type == Json::Type::Array) {
+      for (const auto& item : kv.second->arr) {
+        if (!item->is_string()) return false;
+        raw.push_back(&item->str);
+      }
+    } else {
       return false;
     }
-    cfg.models.emplace_back(kv.first, *url);
+    for (const std::string* s : raw) {
+      auto url = parse_url(*s);
+      if (!url) {
+        fprintf(stderr, "llkt-router: bad backend url %s\n", s->c_str());
+        return false;
+      }
+      urls.push_back(*url);
+    }
+    if (urls.empty()) {
+      fprintf(stderr, "llkt-router: model %s has an empty replica list\n",
+              kv.first.c_str());
+      return false;
+    }
+    cfg.models.emplace_back(kv.first, std::move(urls));
   }
   const Json* d = root->get("default_model");
   if (!d) d = root->get("default");
@@ -716,9 +1059,13 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("breaker_open_s");
       t && t->type == Json::Type::Number)
     cfg.breaker_open_s = t->number;
+  if (const Json* t = root->get("probe_interval_s");
+      t && t->type == Json::Type::Number)
+    cfg.probe_interval_s = t->number;
   return true;
 }
 
+// "name=url[|url...],name2=url" — | separates replica URLs of one model
 static bool load_models_inline(const std::string& spec, Config& cfg) {
   size_t start = 0;
   while (start < spec.size()) {
@@ -726,9 +1073,24 @@ static bool load_models_inline(const std::string& spec, Config& cfg) {
     std::string item = spec.substr(start, comma - start);
     size_t eq = item.find('=');
     if (eq == std::string::npos) return false;
-    auto url = parse_url(item.substr(eq + 1));
-    if (!url) return false;
-    cfg.models.emplace_back(item.substr(0, eq), *url);
+    std::vector<Url> urls;
+    std::string rest = item.substr(eq + 1);
+    size_t p = 0;
+    while (p <= rest.size()) {
+      size_t bar = rest.find('|', p);
+      std::string one = rest.substr(p, bar == std::string::npos
+                                           ? std::string::npos
+                                           : bar - p);
+      if (!one.empty()) {
+        auto url = parse_url(one);
+        if (!url) return false;
+        urls.push_back(*url);
+      }
+      if (bar == std::string::npos) break;
+      p = bar + 1;
+    }
+    if (urls.empty()) return false;
+    cfg.models.emplace_back(item.substr(0, eq), std::move(urls));
     if (comma == std::string::npos) break;
     start = comma + 1;
   }
@@ -743,11 +1105,13 @@ namespace llkt {
 // of its accept loop and exits normally — so static destruction never runs
 // in signal context, and LeakSanitizer's end-of-process check still fires
 // in sanitizer builds.
-volatile sig_atomic_t g_shutdown = 0;
+// atomic, not volatile sig_atomic_t: the flag is also read by the prober
+// thread, and a lock-free atomic store is still async-signal-safe
+std::atomic<int> g_shutdown{0};
 int g_listen_fd = -1;
 
 extern "C" void handle_shutdown_signal(int) {
-  g_shutdown = 1;
+  g_shutdown.store(1, std::memory_order_relaxed);
   // shutdown(2), not close(2): on Linux closing a socket does NOT wake a
   // thread already blocked in accept() on it (the signal may have been
   // delivered to a worker thread), but shutdown() does
@@ -818,13 +1182,18 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       cfg.breaker_open_s = atof(v);
+    } else if (a == "--probe-interval") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.probe_interval_s = atof(v);
     } else {
       fprintf(stderr,
-              "usage: llkt-router (--config FILE | --models n=url,...) "
+              "usage: llkt-router (--config FILE | --models n=url|url2,...) "
               "[--port P] [--default NAME] [--strict] [--quiet] "
               "[--upstream-timeout S] [--client-timeout S] "
               "[--connect-timeout S] [--retries N] [--retry-backoff-ms MS] "
-              "[--breaker-threshold N] [--breaker-open S]\n");
+              "[--breaker-threshold N] [--breaker-open S] "
+              "[--probe-interval S]\n");
       return 2;
     }
   }
@@ -871,6 +1240,28 @@ int main(int argc, char** argv) {
   fprintf(stderr, "llkt-router: listening on :%d (%zu models, default=%s%s)\n",
           cfg.port, cfg.models.size(), cfg.default_model.c_str(),
           cfg.strict ? ", strict" : "");
+
+  if (cfg.probe_interval_s > 0) {
+    // background /ready prober: ejects draining/wedged/unreachable
+    // replicas from selection and re-admits recovered ones. Counted in
+    // g_live_connections so main's drain loop waits for it (it wakes
+    // within ~100 ms of g_shutdown) and it never outlives cfg.
+    g_live_connections.fetch_add(1, std::memory_order_acquire);
+    std::thread([&cfg]() {
+      struct Live {
+        ~Live() { g_live_connections.fetch_sub(1, std::memory_order_release); }
+      } live;
+      while (!g_shutdown) {
+        probe_all(cfg);
+        double left = cfg.probe_interval_s;
+        while (left > 0 && !g_shutdown) {
+          double slice = std::min(left, 0.1);
+          std::this_thread::sleep_for(std::chrono::duration<double>(slice));
+          left -= slice;
+        }
+      }
+    }).detach();
+  }
 
   while (!g_shutdown) {
     struct sockaddr_in peer {};

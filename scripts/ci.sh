@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# CI gate: fast unit tests, native router build + integration tests, and an
+# ASan/UBSan pass over the native router (new concurrency — the prober
+# thread — and the failover/deadline paths get sanitizer coverage on every
+# run). Finishes with the entry-point contract checks.
+#
+# Usage: scripts/ci.sh
+# Env:   PYTHON=python3.12 scripts/ci.sh   # alternate interpreter
+#
+# Exits nonzero if any gate fails. Gates that need a missing toolchain
+# (make/g++) are skipped with a notice, not failed, so the script stays
+# useful on python-only machines.
+set -u
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+PY="${PYTHON:-python3}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+fails=0
+
+note() { printf '\n== %s ==\n' "$*"; }
+
+note "unit tests (pytest -m unit)"
+if ! "$PY" -m pytest "$REPO/tests" -q -m unit \
+    -p no:cacheprovider --continue-on-collection-errors; then
+  echo "ci: unit test gate FAILED"
+  fails=$((fails + 1))
+fi
+
+if command -v make >/dev/null 2>&1 && command -v g++ >/dev/null 2>&1; then
+  note "native router build"
+  if make -C "$REPO/native/router"; then
+    note "native router integration tests"
+    if ! "$PY" -m pytest "$REPO/tests/test_native_router.py" -q \
+        -p no:cacheprovider; then
+      echo "ci: native router tests FAILED"
+      fails=$((fails + 1))
+    fi
+  else
+    echo "ci: native router build FAILED"
+    fails=$((fails + 1))
+  fi
+
+  note "native router under ASan/UBSan"
+  # the test skips itself if the sanitizer runtime is not installed
+  if ! "$PY" -m pytest \
+      "$REPO/tests/test_native_sanitizers.py::test_router_under_asan_ubsan" \
+      -q -p no:cacheprovider; then
+    echo "ci: sanitizer gate FAILED"
+    fails=$((fails + 1))
+  fi
+else
+  echo "ci: no C++ toolchain (make/g++) — skipping native gates"
+fi
+
+note "entry-point contracts"
+if ! "$REPO/scripts/check_entrypoints.sh"; then
+  echo "ci: entry-point checks FAILED"
+  fails=$((fails + 1))
+fi
+
+echo
+if [ "$fails" -ne 0 ]; then
+  echo "ci: $fails gate(s) failed"
+  exit 1
+fi
+echo "ci: all gates passed"

@@ -287,7 +287,8 @@ def test_router_breaker_open_halfopen_close():
         assert (await r.json())["served_by"] == "flaky"
         r = await client.post("/v1/chat/completions", json={"model": "m"})
         assert r.status == 200             # closed again
-        assert router.breakers["m"].state == CircuitBreaker.CLOSED
+        assert (router.breakers[f"http://127.0.0.1:{up.port}"].state
+                == CircuitBreaker.CLOSED)
 
     try:
         _drive_router(router, body)
@@ -436,6 +437,202 @@ def test_wedged_engine_503s_submissions():
             assert r.headers.get("Retry-After")
         finally:
             srv.engine.wedged = False
+            await client.close()
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end deadlines: queue shed, in-flight abort, API 504
+# ---------------------------------------------------------------------------
+
+@pytest.mark.e2e
+def test_queue_stall_deadline_sheds_without_admission(monkeypatch):
+    """LLMK_FAULT=queue_stall wedges admission; an expired deadline sheds
+    the waiting request with finish_reason 'timeout' WITHOUT it ever being
+    admitted (no prefill burned: admitted_at stays None)."""
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    eng = _mk_engine()
+    monkeypatch.setenv("LLMK_FAULT", "queue_stall")
+    req = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=8),
+                     deadline=time.monotonic() + 0.1)
+    deadline = time.monotonic() + 30
+    while not req.finished:
+        assert time.monotonic() < deadline, "queue shed never happened"
+        eng.step()
+        time.sleep(0.01)
+    assert req.finish_reason == "timeout"
+    assert req.admitted_at is None          # never admitted
+    assert req.output == []                 # no tokens burned
+
+
+@pytest.mark.e2e
+def test_inflight_deadline_aborts_with_timeout_reason(monkeypatch):
+    """A request admitted in time but still decoding at its deadline is
+    aborted mid-flight with finish_reason 'timeout'. slow_step paces the
+    decode so the budget deterministically runs out mid-generation."""
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    monkeypatch.setenv("LLMK_FAULT", "slow_step:0.05")
+    eng = _mk_engine()
+    req = eng.submit([1, 2, 3],
+                     SamplingParams(temperature=0.0, max_tokens=4096))
+    hard = time.monotonic() + 120
+    while req.admitted_at is None:
+        assert time.monotonic() < hard, "never admitted"
+        eng.step()
+    req.deadline = time.monotonic()         # budget exhausted mid-flight
+    while not req.finished:
+        assert time.monotonic() < hard, "deadline abort never happened"
+        eng.step()
+    assert req.finish_reason == "timeout"
+    assert req.admitted_at is not None      # it WAS generating
+
+
+@pytest.mark.e2e
+def test_api_rejects_expired_deadline_504():
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "debug-tiny", "prompt": "hi", "max_tokens": 4},
+                headers={"X-LLMK-Deadline-Ms": "0"})
+            assert r.status == 504
+            err = await r.json()
+            assert err["error"]["code"] == "deadline_exceeded"
+            text = await (await client.get("/metrics")).text()
+            assert 'llm_deadline_exceeded_total{phase="queue"} 1' in text
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+@pytest.mark.e2e
+def test_queue_full_429_retry_after_tracks_backlog(monkeypatch):
+    """429 Retry-After = queue depth x observed step time (clamped to
+    [1, 60]), not a constant inviting a thundering herd."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    eng = _mk_engine(max_waiting=2)
+    srv = OpenAIServer(eng, ByteTokenizer(), "debug-tiny")
+    # queue_stall keeps the two queued requests unadmitted so the third
+    # submission deterministically hits QueueFullError
+    monkeypatch.setenv("LLMK_FAULT", "queue_stall")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            # the queued requests carry a deadline so they shed themselves
+            # (504) once the test is done with them
+            body = {"model": "debug-tiny", "prompt": "hi", "max_tokens": 4,
+                    "timeout": 3.0}
+            t1 = asyncio.create_task(client.post("/v1/completions", json=body))
+            t2 = asyncio.create_task(client.post("/v1/completions", json=body))
+            deadline = time.monotonic() + 5
+            while len(eng.waiting) < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            assert len(eng.waiting) == 2
+            eng._est_step = 5.0             # 2 waiting x 5 s -> Retry-After 10
+            r3 = await client.post("/v1/completions", json=body)
+            assert r3.status == 429
+            assert (await r3.json())["error"]["type"] == "rate_limit_exceeded"
+            assert r3.headers["Retry-After"] == "10"
+            r1, r2 = await t1, await t2     # shed at their own deadline
+            assert r1.status == r2.status == 504
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# readiness flapping + drain lifecycle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.e2e
+def test_flappy_replica_readiness_alternates(monkeypatch):
+    """LLMK_FAULT=flappy_replica:P makes /ready alternate serving/draining
+    every P seconds while the engine itself keeps serving — the CPU stand-in
+    for a replica repeatedly joining and leaving Service endpoints."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+    monkeypatch.setenv("LLMK_FAULT", "flappy_replica:0.1")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            statuses = set()
+            deadline = time.monotonic() + 5
+            while len(statuses) < 2 and time.monotonic() < deadline:
+                r = await client.get("/ready")
+                statuses.add(r.status)
+                if r.status == 503:
+                    assert (await r.json())["state"] == "draining"
+                assert (await client.get("/health")).status == 200
+                await asyncio.sleep(0.025)
+            assert statuses == {200, 503}, statuses
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+@pytest.mark.e2e
+def test_drain_lifecycle_completes_inflight_stream():
+    """The preStop drain contract end-to-end: once shutdown begins,
+    /ready flips to 503 draining, NEW submissions are refused with
+    code shutting_down, and the in-flight SSE stream still runs to
+    completion (graceful drain in the engine loop)."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "hello", "max_tokens": 8,
+                "stream": True})
+            assert resp.status == 200
+            # wait for the first SSE payload: the request is now in flight
+            first = b""
+            while b"data:" not in first:
+                first = await resp.content.readline()
+
+            stop_task = asyncio.create_task(srv._stop_loop(None))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:     # _stop_loop task has run
+                r = await client.get("/ready")
+                if r.status == 503:
+                    break
+                await asyncio.sleep(0.01)
+            assert r.status == 503 and (await r.json())["state"] == "draining"
+
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "new", "max_tokens": 4})
+            assert r.status == 503
+            err = await r.json()
+            assert err["error"]["code"] == "shutting_down"
+            assert r.headers.get("Retry-After")
+
+            rest = await resp.content.read()       # stream runs to the end
+            text = (first + rest).decode()
+            assert '"finish_reason": "length"' in text
+            assert "data: [DONE]" in text
+            await stop_task
+        finally:
             await client.close()
     asyncio.run(go())
 

@@ -114,8 +114,9 @@ def test_router_semantics_and_config_hash_rollout():
     assert cfg["default_model"] == "llama-3-8b"  # first model, like reference
     assert cfg["strict"] is True
     assert set(cfg["backends"]) == {"llama-3-8b", "mistral-7b"}
-    assert cfg["backends"]["mistral-7b"] == (
-        "http://model-mistral-7b.tpu-models.svc.cluster.local:8080")
+    # backend values are replica LISTS now (failover-capable routing)
+    assert cfg["backends"]["mistral-7b"] == [
+        "http://model-mistral-7b.tpu-models.svc.cluster.local:8080"]
     # config-hash annotation rolls the router on model changes (SURVEY §3.2
     # gap: the reference's gateway kept stale routes until restarted)
     dep = by_name(ms, "Deployment", "api-gateway")
@@ -123,6 +124,67 @@ def test_router_semantics_and_config_hash_rollout():
     assert h1 == config_hash(spec)
     spec2 = load_spec(BASE_YAML.replace("mistral-7b", "qwen3-8b"))
     assert config_hash(spec2) != h1
+
+
+REPLICAS_YAML = """
+namespace: tpu-models
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    pvcShared: true
+    replicas: 2
+    tpu: {accelerator: v5e, chips: 8}
+  - modelName: mistral-7b
+    huggingfaceId: mistralai/Mistral-7B-Instruct-v0.2
+    tpu: {accelerator: v5e, chips: 8}
+"""
+
+
+def test_replicated_model_gets_headless_service_and_replica_backends():
+    """replicas > 1 adds a headless -replicas Service (DNS answers with the
+    ready pod IPs, so a router failover reconnect can land on a different
+    pod) and the router.json backend entry routes through it."""
+    ms = render_manifests(load_spec(REPLICAS_YAML))
+    headless = by_name(ms, "Service", "model-llama-3-8b-replicas")
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["selector"] == {"app": "model-llama-3-8b"}
+    cfg = json.loads(by_name(ms, "ConfigMap", "api-gateway-config")
+                     ["data"]["router.json"])
+    assert cfg["backends"]["llama-3-8b"] == [
+        "http://model-llama-3-8b-replicas.tpu-models.svc.cluster.local:8080"]
+    # single-replica models keep the plain ClusterIP Service, no headless
+    assert cfg["backends"]["mistral-7b"] == [
+        "http://model-mistral-7b.tpu-models.svc.cluster.local:8080"]
+    assert not [s for s in kinds(ms, "Service")
+                if s["metadata"]["name"] == "model-mistral-7b-replicas"]
+    assert cfg["probe_interval_s"] == 2.0
+
+
+def test_drain_budget_prestop_and_grace():
+    """Every workload ships the drain budget: preStop sleep holds SIGTERM
+    until endpoint removal propagates; the grace period covers in-flight
+    generations (engine) / relays (router)."""
+    ms = render_manifests(load_spec(BASE_YAML))
+    model = by_name(ms, "Deployment", "model-llama-3-8b")
+    pod = model["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 330
+    assert pod["containers"][0]["lifecycle"]["preStop"]["exec"]["command"] \
+        == ["sh", "-c", "sleep 5"]
+    gw = by_name(ms, "Deployment", "api-gateway")
+    gw_pod = gw["spec"]["template"]["spec"]
+    assert gw_pod["terminationGracePeriodSeconds"] == 30
+    assert gw_pod["containers"][0]["lifecycle"]["preStop"]["exec"]["command"] \
+        == ["sh", "-c", "sleep 5"]
+    # multi-host pod groups get the engine grace too
+    spec = load_spec("""
+models:
+  - modelName: llama-3-70b
+    huggingfaceId: meta-llama/Meta-Llama-3-70B-Instruct
+    pvcShared: true
+    tpu: {accelerator: v5p, chips: 16}
+""")
+    sts = by_name(render_manifests(spec), "StatefulSet", "model-llama-3-70b")
+    assert sts["spec"]["template"]["spec"]["terminationGracePeriodSeconds"] == 330
 
 
 def test_istio_routes_match_reference_shape():

@@ -94,6 +94,7 @@ class FakeBackend(http.server.BaseHTTPRequestHandler):
             "model": body.get("model"),
             "x_real_ip": self.headers.get("X-Real-IP", ""),
             "x_fwd": self.headers.get("X-Forwarded-For", ""),
+            "deadline_ms": self.headers.get("X-LLMK-Deadline-Ms", ""),
         }).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -119,10 +120,14 @@ def binary():
 
 
 class RouterProc:
-    def __init__(self, binary, backends: dict[str, int], strict=False,
+    def __init__(self, binary, backends: dict, strict=False,
                  extra_args=()):
+        """backends: name -> port, or name -> raw value string (so replica
+        sets can be passed as "url|url")."""
         self.port = free_port()
-        spec = ",".join(f"{n}=http://127.0.0.1:{p}" for n, p in backends.items())
+        spec = ",".join(
+            f"{n}={v}" if isinstance(v, str) else f"{n}=http://127.0.0.1:{v}"
+            for n, v in backends.items())
         args = [str(binary), "--models", spec, "--port", str(self.port),
                 "--quiet", *extra_args]
         if strict:
@@ -595,6 +600,203 @@ def test_native_breaker_open_halfopen_close(binary):
         router.stop()
         if srv is not None:
             srv.shutdown()
+
+
+def _metrics(router) -> str:
+    status, data = router.request("GET", "/metrics")
+    assert status == 200
+    return data.decode()
+
+
+def _metric_value(text: str, line_prefix: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(line_prefix):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{line_prefix!r} not in metrics:\n{text}")
+
+
+def test_native_replica_failover_zero_5xx(binary):
+    """Inline ``name=url|url`` replica sets: with one replica refusing
+    connections every request still succeeds via connect-phase failover
+    (zero 5xx reaches the client), llm_failover_total counts the reroutes
+    and llm_replica_healthy exports one gauge line per replica."""
+    srv = start_backend("live")
+    dead_port = free_port()
+    live_port = srv.server_address[1]
+    router = RouterProc(
+        binary,
+        {"m": f"http://127.0.0.1:{dead_port}|http://127.0.0.1:{live_port}"},
+        extra_args=("--retries", "3", "--retry-backoff-ms", "10",
+                    "--breaker-threshold", "1"))
+    try:
+        for _ in range(10):
+            status, data = router.request("POST", "/v1/chat/completions",
+                                          {"model": "m"})
+            assert status == 200, data
+            assert json.loads(data)["served_by"] == "live"
+        text = _metrics(router)
+        assert _metric_value(text, "llm_failover_total") >= 1
+        assert (f'llm_replica_healthy{{model="m",'
+                f'replica="http://127.0.0.1:{dead_port}"}}') in text
+        assert (f'llm_replica_healthy{{model="m",'
+                f'replica="http://127.0.0.1:{live_port}"}}') in text
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_probe_ejects_and_readmits(binary):
+    """--probe-interval drives active GET /ready probes: a replica whose
+    readiness answers 503 (draining/wedged) is ejected — traffic flows
+    only to the healthy replica, the gauge drops to 0 — and a recovery
+    re-admits it. Replicas without /ready (501 here) stay routable."""
+    state = {"ready": 200}
+
+    class ProbedBackend(FakeBackend):
+        name = "probed"
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/ready":
+                self.send_response(state["ready"])
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                self.send_error(404)
+
+    srv1 = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ProbedBackend)
+    threading.Thread(target=srv1.serve_forever, daemon=True).start()
+    srv2 = start_backend("plain")       # no do_GET: /ready -> 501, routable
+    u1 = f"http://127.0.0.1:{srv1.server_address[1]}"
+    u2 = f"http://127.0.0.1:{srv2.server_address[1]}"
+    router = RouterProc(binary, {"m": f"{u1}|{u2}"},
+                        extra_args=("--probe-interval", "0.1"))
+    gauge1 = f'llm_replica_healthy{{model="m",replica="{u1}"}}'
+
+    def wait_gauge(value: float):
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if _metric_value(_metrics(router), gauge1) == value:
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"{gauge1} never became {value}")
+
+    try:
+        wait_gauge(1.0)
+        state["ready"] = 503            # draining: eject
+        wait_gauge(0.0)
+        for _ in range(6):              # all traffic avoids the ejected one
+            status, data = router.request("POST", "/v1/chat/completions",
+                                          {"model": "m"})
+            assert status == 200, data
+            assert json.loads(data)["served_by"] == "plain"
+        state["ready"] = 200            # recovered: re-admit
+        wait_gauge(1.0)
+        seen = set()
+        deadline = time.monotonic() + 5
+        while len(seen) < 2 and time.monotonic() < deadline:
+            status, data = router.request("POST", "/v1/chat/completions",
+                                          {"model": "m"})
+            assert status == 200
+            seen.add(json.loads(data)["served_by"])
+        assert seen == {"probed", "plain"}
+    finally:
+        router.stop()
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_native_deadline_rejected_and_forwarded(binary):
+    srv = start_backend("live")
+    router = RouterProc(binary, {"m": srv.server_address[1]})
+    try:
+        # expired budget: 504 before any upstream dispatch
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            headers={"Content-Type": "application/json",
+                     "X-LLMK-Deadline-Ms": "0"})
+        assert status == 504
+        assert json.loads(data)["error"]["code"] == "deadline_exceeded"
+        assert _metric_value(_metrics(router),
+                             "llm_router_deadline_rejected_total") == 1
+
+        # live budget is forwarded, decremented
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            headers={"Content-Type": "application/json",
+                     "X-LLMK-Deadline-Ms": "30000"})
+        assert status == 200
+        fwd = json.loads(data)["deadline_ms"]
+        assert fwd and 0 < int(fwd) <= 30000
+
+        # body timeout (seconds) is the alternative carrier
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "m", "timeout": 30})
+        assert status == 200
+        fwd = json.loads(data)["deadline_ms"]
+        assert fwd and 0 < int(fwd) <= 30000
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_unknown_model_fallback_counted(binary):
+    srv = start_backend("dflt")
+    router = RouterProc(binary, {"m": srv.server_address[1]})
+    try:
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "nope"})
+        assert status == 200 and json.loads(data)["served_by"] == "dflt"
+        assert _metric_value(
+            _metrics(router),
+            "llm_router_unknown_model_fallback_total") == 1
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_config_file_replica_arrays(binary, tmp_path):
+    """router.json backends values may be ARRAYS of replica URLs (the
+    schema the Helm charts and deploy/manifests.py render)."""
+    srv = start_backend("arr")
+    dead_port = free_port()
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {"arr": [f"http://127.0.0.1:{dead_port}",
+                             f"http://127.0.0.1:{srv.server_address[1]}"]},
+        "default_model": "arr",
+        "strict": False,
+        "probe_interval_s": 0,
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
+                             "--port", str(port), "--quiet",
+                             "--retries", "3", "--retry-backoff-ms", "10"])
+    try:
+        deadline = time.monotonic() + 5
+        up = False
+        while time.monotonic() < deadline and not up:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+                conn.request("GET", "/health")
+                up = conn.getresponse().read() == b"OK"
+                conn.close()
+            except OSError:
+                time.sleep(0.02)
+        assert up
+        for _ in range(4):              # failover across the array works
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/v1/chat/completions",
+                         body=json.dumps({"model": "arr"}).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200, body
+            assert body["served_by"] == "arr"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        srv.shutdown()
 
 
 def test_native_retry_rides_out_connection_resets(binary):

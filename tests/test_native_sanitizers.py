@@ -191,6 +191,67 @@ def _drive(binary: Path):
         assert "runtime error:" not in (br_err or ""), br_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (br_err or ""), br_err[-3000:]
 
+        # replica failover + the active prober thread under the sanitizer:
+        # the prober shares replica-health state with every request
+        # thread, and the failover loop exercises the tried-set/deadline
+        # bookkeeping that only multi-replica configs reach
+        fo_port = free_port()
+        fo = subprocess.Popen(
+            [str(binary), "--models",
+             f"sanmodel=http://127.0.0.1:{free_port()}"
+             f"|http://127.0.0.1:{backend.server_address[1]}",
+             "--port", str(fo_port), "--quiet",
+             "--retries", "3", "--retry-backoff-ms", "10",
+             "--connect-timeout", "1", "--probe-interval", "0.1"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", fo_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            for _ in range(6):          # failover path: dead first replica
+                c = http.client.HTTPConnection("127.0.0.1", fo_port,
+                                               timeout=15)
+                c.request("POST", "/v1/chat/completions",
+                          body=json.dumps({"model": "sanmodel",
+                                           "timeout": 30}).encode(),
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                body = json.loads(r.read())
+                c.close()
+                assert r.status == 200, body
+                assert body["served_by"] == "sanmodel"
+            c = http.client.HTTPConnection("127.0.0.1", fo_port, timeout=15)
+            c.request("POST", "/v1/chat/completions",
+                      body=json.dumps({"model": "sanmodel"}).encode(),
+                      headers={"Content-Type": "application/json",
+                               "X-LLMK-Deadline-Ms": "0"})
+            assert c.getresponse().status == 504  # deadline-reject path
+            c.close()
+            c = http.client.HTTPConnection("127.0.0.1", fo_port, timeout=15)
+            c.request("GET", "/metrics")
+            text = c.getresponse().read().decode()
+            c.close()
+            assert "llm_replica_healthy" in text
+            time.sleep(0.3)             # a few prober sweeps run
+        finally:
+            fo.terminate()
+            try:
+                _, fo_err = fo.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                fo.kill()
+                _, fo_err = fo.communicate()
+        assert "ERROR: " not in (fo_err or ""), fo_err[-3000:]
+        assert "runtime error:" not in (fo_err or ""), fo_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (fo_err or ""), fo_err[-3000:]
+
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")
     finally:

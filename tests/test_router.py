@@ -8,6 +8,7 @@ passthrough (the reference's Python gateway buffered; api-gateway.yaml:99).
 
 import asyncio
 import json
+import socket
 
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
@@ -26,6 +27,7 @@ def make_backend(name: str) -> web.Application:
             "model": body.get("model"),
             "x_real_ip": request.headers.get("X-Real-IP", ""),
             "x_fwd": request.headers.get("X-Forwarded-For", ""),
+            "deadline_hdr": request.headers.get("X-LLMK-Deadline-Ms", ""),
         })
 
     async def stream(request: web.Request) -> web.StreamResponse:
@@ -133,6 +135,179 @@ def test_streaming_passthrough():
         text = await r.text()
         assert "data: modelB-0" in text and "data: [DONE]" in text
     run_with_router(body)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_failover_to_healthy_replica_zero_5xx():
+    """Two-replica set, one refusing connections: every request succeeds
+    via failover (no 5xx reaches the client) and the failover counter
+    records the reroutes."""
+    async def go():
+        b1 = TestClient(TestServer(make_backend("live")))
+        await b1.start_server()
+        dead_url = f"http://127.0.0.1:{_free_port()}"
+        router = Router(
+            {"m": [dead_url, str(b1.make_url("")).rstrip("/")]},
+            retry_attempts=3, retry_backoff_s=0.01, breaker_threshold=1,
+        )
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            for _ in range(20):
+                r = await client.post("/v1/chat/completions",
+                                      json={"model": "m"})
+                assert r.status == 200, await r.text()
+                assert (await r.json())["served_by"] == "live"
+                if router.metrics["failover"].value >= 1:
+                    break
+            assert router.metrics["failover"].value >= 1
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
+def test_active_probe_ejects_and_readmits():
+    """/ready 503 (draining/wedged) ejects a replica from routing; a
+    recovering probe re-admits it. Replicas without a /ready endpoint
+    (404) stay routable."""
+    async def go():
+        flap = {"status": 200}
+        app = make_backend("r1")
+
+        async def ready(request):
+            return web.Response(status=flap["status"], text="{}")
+
+        app.router.add_get("/ready", ready)
+        b1 = TestClient(TestServer(app))
+        b2 = TestClient(TestServer(make_backend("r2")))
+        await b1.start_server()
+        await b2.start_server()
+        u1 = str(b1.make_url("")).rstrip("/")
+        u2 = str(b2.make_url("")).rstrip("/")
+        router = Router({"m": [u1, u2]})
+        healthy = router.metrics["replica_healthy"]
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await router.probe_all()
+            assert healthy.labeled_value(model="m", replica=u1) == 1
+            assert healthy.labeled_value(model="m", replica=u2) == 1  # 404 ok
+
+            flap["status"] = 503           # draining: eject
+            await router.probe_all()
+            assert healthy.labeled_value(model="m", replica=u1) == 0
+            for _ in range(8):             # all traffic avoids the ejected one
+                r = await client.post("/v1/chat/completions",
+                                      json={"model": "m"})
+                assert r.status == 200
+                assert (await r.json())["served_by"] == "r2"
+
+            flap["status"] = 200           # recovered: re-admit
+            await router.probe_all()
+            assert healthy.labeled_value(model="m", replica=u1) == 1
+            seen = set()
+            for _ in range(40):
+                r = await client.post("/v1/chat/completions",
+                                      json={"model": "m"})
+                seen.add((await r.json())["served_by"])
+                if len(seen) == 2:
+                    break
+            assert seen == {"r1", "r2"}
+        finally:
+            await client.close()
+            await b1.close()
+            await b2.close()
+    asyncio.run(go())
+
+
+def test_all_replicas_ejected_503_no_healthy_upstream():
+    async def go():
+        b1 = TestClient(TestServer(make_backend("r1")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")},
+                        probe_interval_s=5.0)
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            router._set_health(router.replicas["m"][0], False)
+            r = await client.post("/v1/chat/completions", json={"model": "m"})
+            assert r.status == 503
+            err = await r.json()
+            assert err["error"]["code"] == "no_healthy_upstream"
+            assert int(r.headers["Retry-After"]) >= 1
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
+def test_deadline_header_rejected_forwarded_and_decremented():
+    async def go():
+        b1 = TestClient(TestServer(make_backend("live")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")})
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            # expired budget: 504 before any upstream connect
+            r = await client.post("/v1/chat/completions", json={"model": "m"},
+                                  headers={"X-LLMK-Deadline-Ms": "0"})
+            assert r.status == 504
+            err = await r.json()
+            assert err["error"]["code"] == "deadline_exceeded"
+            assert router.metrics["deadline_rejected"].value == 1
+
+            # body timeout (seconds) is an alternative carrier
+            r = await client.post("/v1/chat/completions",
+                                  json={"model": "m", "timeout": -1})
+            assert r.status == 504
+
+            # malformed header = no deadline, not a 400
+            r = await client.post("/v1/chat/completions", json={"model": "m"},
+                                  headers={"X-LLMK-Deadline-Ms": "bogus"})
+            assert r.status == 200
+            assert (await r.json())["deadline_hdr"] == ""
+
+            # live budget is forwarded, decremented
+            r = await client.post("/v1/chat/completions", json={"model": "m"},
+                                  headers={"X-LLMK-Deadline-Ms": "30000"})
+            assert r.status == 200
+            fwd = (await r.json())["deadline_hdr"]
+            assert fwd and 0 < int(fwd) <= 30000
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
+def test_unknown_model_fallback_counted():
+    async def go():
+        b1 = TestClient(TestServer(make_backend("dflt")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")}, strict=False)
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions",
+                                  json={"model": "nope"})
+            assert (await r.json())["served_by"] == "dflt"
+            assert router.metrics["unknown_model_fallback"].value == 1
+            # a known model does not count
+            r = await client.post("/v1/chat/completions", json={"model": "m"})
+            assert r.status == 200
+            assert router.metrics["unknown_model_fallback"].value == 1
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
 
 
 def test_upstream_down_returns_502():
