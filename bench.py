@@ -31,7 +31,9 @@ partial failure. Exit code is 0 whenever at least one phase produced a
 number.
 
 Smaller fallback model (env BENCH_MODEL, e.g. debug-tiny) exists so the
-bench also runs on CPU-only dev machines.
+bench also runs on CPU-only dev machines; ``bench.py --smoke`` runs that
+CPU-sized config end-to-end (engine + native-router gateway + the one-line
+JSON contract) as a CI gate — it validates the pipeline, not the numbers.
 """
 
 from __future__ import annotations
@@ -273,15 +275,67 @@ def measure_engine(eng, cfg, prompt_len, gen_len, rng) -> dict:
     }
 
 
+def start_native_router(model_name: str, upstream_port: int):
+    """Spawn the native C++ router (native/router/llkt-router) in front of
+    the OpenAI server. Returns ``(proc, port)`` once /health answers OK,
+    or None when the binary is missing/unbuildable or never comes up —
+    the caller falls back to the in-process Python router.
+    """
+    import http.client
+    import shutil
+    import socket
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    router_dir = os.path.join(repo, "native", "router")
+    binary = os.path.join(router_dir, "llkt-router")
+    if not os.path.exists(binary):
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            return None
+        r = subprocess.run(["make", "-C", router_dir], capture_output=True)
+        if r.returncode != 0 or not os.path.exists(binary):
+            return None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [binary, "--models",
+         f"{model_name}=http://127.0.0.1:{upstream_port}",
+         "--port", str(port), "--quiet"],
+        stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return None
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+            conn.request("GET", "/health")
+            ok = conn.getresponse().read() == b"OK"
+            conn.close()
+            if ok:
+                return proc, port
+        except OSError:
+            time.sleep(0.02)
+    proc.terminate()
+    proc.wait(timeout=5)
+    return None
+
+
 def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     """Measure the BASELINE.md metric definition: client -> multi-model
     router -> OpenAI server -> engine (the in-cluster portion of the Istio
-    gateway path). Returns {"gateway_p50_ttft_ms", "gateway_tokens_per_sec"}.
+    gateway path). Returns {"gateway_p50_ttft_ms", "gateway_tokens_per_sec",
+    "gateway_router", ...}.
 
-    Runs the real aiohttp OpenAI server and the real Python router
-    in-process on localhost; TTFT is the client-side time to the first SSE
-    data chunk of a streaming completion, measured while the engine also
-    carries background decode load — "new request joins a busy server".
+    Runs the real aiohttp OpenAI server in-process and fronts it with the
+    NATIVE router (llkt-router — what the charts actually deploy), falling
+    back to the in-process Python router with a logged warning when the
+    binary is unavailable; which one carried the traffic is recorded in
+    the ``gateway_router`` key. TTFT is the client-side time to the first
+    SSE data chunk of a streaming completion, measured while the engine
+    also carries background decode load — "new request joins a busy
+    server".
     """
     import http.client
     import json as _json
@@ -313,6 +367,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
             s_site = web.TCPSite(s_runner, "127.0.0.1", 0)
             await s_site.start()
             sport = s_runner.addresses[0][1]
+            ports["server"] = sport
             router = Router({model_name: f"http://127.0.0.1:{sport}"},
                             default_model=model_name, strict=False)
             r_runner = web.AppRunner(router.make_app())
@@ -331,7 +386,16 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     t.start()
     if not ready.wait(timeout=60):
         raise RuntimeError("gateway bench: apps failed to start")
-    port = ports["router"]
+    native = start_native_router(model_name, ports["server"])
+    if native is not None:
+        native_proc, port = native
+        router_kind = "native"
+    else:
+        print("gateway bench: native llkt-router unavailable — "
+              "falling back to the in-process Python router",
+              file=sys.stderr, flush=True)
+        native_proc, port = None, ports["router"]
+        router_kind = "python"
     rng = np.random.default_rng(1)
 
     def body(max_tokens, stream):
@@ -358,8 +422,9 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     # short gens churn the admission queue every ~0.5 s and the probe then
     # mostly measures competition with re-admission waves rather than
     # prefill-under-load (median serving outputs are longer than 48).
-    n_load = max(8, eng.config.max_decode_slots - 2)
-    gen = 96
+    smoke = bool(os.environ.get("LLMK_BENCH_SMOKE"))
+    n_load = 3 if smoke else max(8, eng.config.max_decode_slots - 2)
+    gen = 16 if smoke else 96
     load_done = threading.Event()
     load_wall_box: dict = {}
 
@@ -404,7 +469,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         return req
 
     ttfts, engine_ttfts = [], []
-    for _ in range(6):
+    for _ in range(2 if smoke else 6):
         server.loop_thread.submit = tracking_submit
         probe_reqs.clear()
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
@@ -425,12 +490,16 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     load_done.wait(timeout=300)
     load_wall = load_wall_box.get("wall", float("inf"))
 
+    if native_proc is not None:
+        native_proc.terminate()
+        native_proc.wait(timeout=5)
     if stop is not None:
         loop_holder["loop"].call_soon_threadsafe(stop.set)
     t.join(timeout=30)
     ttfts.sort()
     engine_ttfts.sort()
     return {
+        "gateway_router": router_kind,
         "gateway_p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
         # the same probes measured inside the engine (submit -> first
         # token); the difference to the number above is the HTTP/asyncio
@@ -491,7 +560,8 @@ def make_configs():
             page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
             prefill_buckets=(32,),
         )
-        prompt_len, gen_len = 8, 32
+        prompt_len = 8
+        gen_len = 12 if os.environ.get("LLMK_BENCH_SMOKE") else 32
     return ecfg, get_config(model), prompt_len, gen_len
 
 
@@ -515,6 +585,14 @@ def main() -> int:
 
 
 def _main() -> int:
+    # --smoke: a fast CPU-sized end-to-end pass (debug-tiny unless
+    # BENCH_MODEL overrides) whose job is exercising the full pipeline —
+    # engine, gateway, JSON contract — in CI, not producing numbers.
+    smoke = "--smoke" in sys.argv[1:]
+    if smoke:
+        os.environ["LLMK_BENCH_SMOKE"] = "1"
+        os.environ.setdefault("BENCH_MODEL", "debug-tiny")
+
     # Fault-isolated backend probe FIRST: if the accelerator runtime is
     # wedged, fail here with a bounded timeout instead of hanging in the
     # first in-process jax.devices() below.
@@ -587,6 +665,8 @@ def _main() -> int:
         "platform": platform,
         "on_tpu": on_tpu,
     }
+    if smoke:
+        result["smoke"] = True
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

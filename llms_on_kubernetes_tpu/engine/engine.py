@@ -966,6 +966,19 @@ class Engine:
         # device-resident zero vectors for the packed steps (uploaded once)
         self._zeros_B = jnp.zeros((B,), jnp.int32)
         self._zeros_1 = jnp.zeros((1,), jnp.int32)
+        # decode-row template cache (PR 3, profile-guided): the decode
+        # packed array is mostly request-STATIC sampling columns, and
+        # rebuilding every one of them per step in a Python loop (plus
+        # _pack_bias over the whole bias block) was the top non-kernel
+        # slice of the decode step. The static columns are written once
+        # per slot OCCUPANCY into this template; each step memcpys it and
+        # fills only the dynamic columns (_dec_template below).
+        self._dec_rows = np.zeros(
+            (B, _DEC_COLS + engine_config.pages_per_slot), np.int32)
+        self._dec_rows[:, 1] = 1                               # src: host
+        self._dec_rows[:, 5] = np.float32(1.0).view(np.int32)  # top_p off
+        self._dec_rows[:, _FSM_DEC] = -1                       # no grammar
+        self._dec_row_owner: list = [None] * B
         # grammar-constrained decoding: resident-grammar registry + device
         # tables, created lazily on the first constrained admission
         # (engine/grammar.py; _ensure_grammar/_fsm_args below)
@@ -1874,6 +1887,46 @@ class Engine:
         with self._lock:
             self.waiting.appendleft(victim)
 
+    def _dec_template(self, active) -> np.ndarray:
+        """Fresh copy of the decode packed array with every request-STATIC
+        column (sampling params, seed, mrope delta, grammar row, logit
+        bias block) filled from the per-slot template cache. A slot's
+        template rebuilds only when its occupant — or that occupant's
+        grammar row — changes; vacated slots reset to the idle defaults.
+        Callers write only the per-step dynamic columns (length, token
+        source/value, prefill row, fsm force, page tables)."""
+        tmpl = self._dec_rows
+        owners = self._dec_row_owner
+        occupant = dict(active)
+        for i in range(self.config.max_decode_slots):
+            r = occupant.get(i)
+            if r is None:
+                if owners[i] is not None:   # vacated: back to idle defaults
+                    tmpl[i, :] = 0
+                    tmpl[i, 1] = 1
+                    tmpl[i, 5] = np.float32(1.0).view(np.int32)
+                    tmpl[i, _FSM_DEC] = -1
+                    owners[i] = None
+                continue
+            fsm_row = r.fsm_row if r.fsm_row >= 0 else -1
+            if owners[i] is r and tmpl[i, _FSM_DEC] == fsm_row:
+                continue
+            tmpl[i, :] = 0
+            tmpl[i, 1] = 1
+            tmpl[i, 3] = r.params.top_k
+            tmpl[i, 4] = np.float32(r.params.temperature).view(np.int32)
+            tmpl[i, 5] = np.float32(r.params.top_p).view(np.int32)
+            tmpl[i, 6] = r.seed
+            tmpl[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
+            tmpl[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
+            tmpl[i, 10] = r.mrope_delta
+            tmpl[i, _FSM_DEC] = fsm_row
+            _pack_bias(tmpl, i, _BIAS_DEC, r.params)
+            owners[i] = r
+        packed = tmpl.copy()
+        packed[:, _DEC_COLS:] = self.allocator.page_tables
+        return packed
+
     def _decode_once(self) -> list[StepEvent]:
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
@@ -1897,30 +1950,14 @@ class Engine:
 
         from llms_on_kubernetes_tpu.engine.multihost import MSG_DECODE
 
-        B = self.config.max_decode_slots
-        pps = self.allocator.pages_per_slot
-        packed = np.zeros((B, _DEC_COLS + pps), np.int32)
-        packed[:, 1] = 1                               # src: host value
-        packed[:, 5] = np.float32(1.0).view(np.int32)  # top_p disabled
-        packed[:, _FSM_DEC] = -1                       # unconstrained
+        packed = self._dec_template(active)
         for i, r in active:
             packed[i, 0] = self.slot_len[i] + 1
             packed[i, 2] = r.pending_token
-            packed[i, 3] = r.params.top_k
-            packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
-            packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
-            packed[i, 6] = r.seed
-            packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
-            packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
-            packed[i, 10] = r.mrope_delta
-            if r.fsm_row >= 0:
-                packed[i, _FSM_DEC] = r.fsm_row
-                if r.pending_fsm_state is not None:  # resume: force state
-                    packed[i, _FSM_DEC + 1] = 1
-                    packed[i, _FSM_DEC + 2] = r.pending_fsm_state
-                    r.pending_fsm_state = None
-            _pack_bias(packed, i, _BIAS_DEC, r.params)
-        packed[:, _DEC_COLS:] = self.allocator.page_tables
+            if r.fsm_row >= 0 and r.pending_fsm_state is not None:
+                packed[i, _FSM_DEC + 1] = 1      # resume: force state
+                packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                r.pending_fsm_state = None
 
         use_fsm = self._fsm_any_active()
         self._mh_send(MSG_DECODE, dec_packed=packed, fsm_used=use_fsm)
@@ -2165,28 +2202,14 @@ class Engine:
         if not active:
             return "idle"
 
-        pps = self.allocator.pages_per_slot
-        packed = np.zeros((B, _DEC_COLS + pps), np.int32)
-        packed[:, 1] = 1                                   # src: host value
-        packed[:, 5] = np.float32(1.0).view(np.int32)      # top_p disabled
-        packed[:, _FSM_DEC] = -1                           # unconstrained
+        packed = self._dec_template(active)
         for i, r in active:
             need = int(self.slot_len[i]) + infl.get(i, 0) + 1
             packed[i, 0] = 0 if need > max_len else need
-            packed[i, 3] = r.params.top_k
-            packed[i, 4] = np.float32(r.params.temperature).view(np.int32)
-            packed[i, 5] = np.float32(r.params.top_p).view(np.int32)
-            packed[i, 6] = r.seed
-            packed[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
-            packed[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
-            packed[i, 10] = r.mrope_delta
-            if r.fsm_row >= 0:
-                packed[i, _FSM_DEC] = r.fsm_row
-                if r.pending_fsm_state is not None:  # resume: force state
-                    packed[i, _FSM_DEC + 1] = 1
-                    packed[i, _FSM_DEC + 2] = r.pending_fsm_state
-                    r.pending_fsm_state = None
-            _pack_bias(packed, i, _BIAS_DEC, r.params)
+            if r.fsm_row >= 0 and r.pending_fsm_state is not None:
+                packed[i, _FSM_DEC + 1] = 1      # resume: force state
+                packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                r.pending_fsm_state = None
             if admitted is not None and i in admitted["slots"]:
                 resumed, host_val, row = admitted["slots"][i]
                 if resumed:              # resumed: host-known pending token
@@ -2197,7 +2220,6 @@ class Engine:
                 packed[i, 1] = 0         # newest in-flight step's output
             else:
                 packed[i, 1], packed[i, 2] = 1, r.pending_token
-        packed[:, _DEC_COLS:] = self.allocator.page_tables
 
         from llms_on_kubernetes_tpu.engine.multihost import MSG_DECODE
 
@@ -2407,19 +2429,16 @@ class Engine:
         cache-free (decoder.forward_score — writes go to a private dummy
         trash pool), touches no donated engine state, and the device
         serializes it between scheduler steps. Unsupported on seq-parallel
-        meshes (the scoring pool is unsharded) and under multi-host (a
-        coordinator-only program over globally sharded params would
-        deadlock the pod group — scoring is not in the broadcast
-        protocol)."""
+        meshes (the scoring pool is unsharded). Under multi-host the call
+        is announced over the packed broadcast protocol (MSG_SCORE) like
+        any other step, so every process enters the same forward_score
+        executable and the pod group never deadlocks."""
         from llms_on_kubernetes_tpu.engine.sampling import LOGPROB_TOPK
         from llms_on_kubernetes_tpu.parallel.mesh import AXIS_SEQ
 
         if self.mesh is not None and int(self.mesh.shape.get(AXIS_SEQ, 1)) > 1:
             raise ValueError("prompt scoring is not supported under "
                              "sequence-parallel serving")
-        if self.config.multihost:
-            raise ValueError("prompt scoring is not supported under "
-                             "multi-host serving")
         if len(prompt) > self.config.max_model_len:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds max_model_len="
@@ -2437,6 +2456,14 @@ class Engine:
             bucket = -(-n // big) * big
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
+        if self.config.multihost:
+            # announce + ship the token row so follower pods enter the
+            # same forward_score executable (SPMD — a coordinator-only
+            # program over globally sharded params would deadlock)
+            from llms_on_kubernetes_tpu.engine import multihost as mh
+
+            self._mh_send(mh.MSG_SCORE, score=(bucket, n))
+            mh.send_score_payload(tokens)
         nxt_lp, top_ids, top_lp = self._score_jit(
             self.params, self.model_config, jnp.asarray(tokens),
             jnp.asarray([n], jnp.int32), LOGPROB_TOPK)

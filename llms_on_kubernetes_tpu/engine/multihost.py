@@ -28,7 +28,8 @@ rendezvous, SURVEY §2.4 / §5 "Distributed communication backend"):
 
 Message layout (all int32; floats ride bitcast, as in the packed steps):
 
-  ctrl[8]    = [op, k_rows, bucket, last_valid, use_prefill, 0, 0, 0]
+  ctrl[8]    = [op, k_rows, bucket, last_valid, use_prefill, fsm_used,
+                score_width, score_len]
   pre_tokens [admit_batch, max_bucket]   prefill/chunk token ids
   pre_packed [admit_batch, _CHK_COLS + pages_per_slot]
   dec_packed [max_decode_slots, _DEC_COLS + pages_per_slot]
@@ -60,6 +61,12 @@ MSG_MM_PREFILL = 5
 # multimodal pixel payload, the common step path stays a single broadcast.
 # Sent only when the resident-grammar SET changes (admission-time).
 MSG_GRAMMAR = 6
+# prompt scoring (echo+logprobs): the control word carries the padded
+# width and true length in ctrl[6:8], then ONE extra broadcast ships the
+# [1, width] token row (width can exceed max_bucket — scoring pads to a
+# multiple of the largest bucket — so it can't ride pre_tokens). Followers
+# enter the same forward_score executable and discard the result.
+MSG_SCORE = 7
 
 CTRL_LEN = 8
 
@@ -166,10 +173,13 @@ def send_message(
     last_valid: bool = False,
     use_prefill: bool = False,
     fsm_used: bool = False,
+    score: "Optional[tuple[int, int]]" = None,
 ) -> None:
     """Coordinator: announce one device call in ONE broadcast.
     ``fsm_used`` tells followers to enter the grammar-constrained variant
-    of the step executable (same trace decision as the coordinator)."""
+    of the step executable (same trace decision as the coordinator).
+    ``score`` = (padded width, true length) for MSG_SCORE — the payload
+    broadcast that follows is shaped from the width."""
     msg = shapes.zeros()
     k = bucket = 0
     if pre_tokens is not None:
@@ -180,6 +190,8 @@ def send_message(
         msg["dec_packed"][:, :] = dec_packed
     msg["ctrl"][:6] = (op, k, bucket, int(last_valid), int(use_prefill),
                        int(fsm_used))
+    if score is not None:
+        msg["ctrl"][6:8] = score
     _broadcast(msg)
 
 
@@ -234,6 +246,17 @@ def receive_mm_payload(shapes: ProtoShapes, channels: int,
             row += 1
     pos3 = np.asarray(out["pos3"])[:, :bucket] if shapes.mrope else None
     return images, pos3
+
+
+def send_score_payload(tokens: np.ndarray) -> None:
+    """Coordinator: ship the padded [1, width] score-token row right
+    after its MSG_SCORE control word."""
+    _broadcast(np.asarray(tokens, np.int32))
+
+
+def receive_score_payload(width: int) -> np.ndarray:
+    """Follower: receive the [1, width] token row (width from ctrl[6])."""
+    return np.asarray(_broadcast(np.zeros((1, width), np.int32)))
 
 
 def send_grammar_payload(shapes: ProtoShapes, class_h: np.ndarray,
@@ -292,6 +315,18 @@ def follower_loop(engine: Any) -> None:
                     (engine.config.max_decode_slots,), -1, jnp.int32)
             engine._g_dev = (jnp.asarray(engine._g_class_h),
                              jnp.asarray(engine._g_trans_h))
+            continue
+        if op == MSG_SCORE:
+            # mirror the coordinator's forward_score entry (cache-free,
+            # trash-pool writes) and discard the result — SPMD only needs
+            # every process inside the same executable
+            from llms_on_kubernetes_tpu.engine.sampling import LOGPROB_TOPK
+
+            width, n = int(m["ctrl"][6]), int(m["ctrl"][7])
+            toks = receive_score_payload(width)
+            engine._score_jit(engine.params, engine.model_config,
+                              jnp.asarray(toks), jnp.asarray([n], jnp.int32),
+                              LOGPROB_TOPK)
             continue
         if op == MSG_MM_PREFILL:
             images, pos3 = receive_mm_payload(

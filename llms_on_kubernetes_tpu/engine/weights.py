@@ -400,7 +400,9 @@ def load_hf_params(
             return GroupQTensor(np.stack([v.data for v in vals]),
                                 np.stack([v.scale for v in vals]),
                                 np.stack([v.zero_scaled for v in vals]),
-                                vals[0].out_shape)
+                                vals[0].out_shape,
+                                packed=vals[0].packed,
+                                group_axis=vals[0].group_axis)
         if isinstance(vals[0], QTensor):
             return QTensor(np.stack([v.data for v in vals]),
                            np.stack([v.scale for v in vals]))

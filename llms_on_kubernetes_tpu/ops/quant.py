@@ -92,45 +92,101 @@ class GroupQTensor:
     re-quantization to int8 per-channel (round-3 verdict: that was an
     accuracy approximation, vLLM executes the group format natively).
 
-    data        [..., G, gs, O] int4 (or int8): CENTERED quantized values
-                (q - 2^(bits-1)); int4 storage streams 0.5 byte/param
+    data        [..., G, gs, O] int8 CENTERED quantized values
+                (q - 2^(bits-1)). With ``packed=True`` the stored axis is
+                gs/2: two 4-bit nibbles per int8 lane (element 2i in the
+                low nibble, 2i+1 in the high nibble of lane i), so 4-bit
+                weights stream 0.5 byte/param on every backend — the TPU
+                runtime accepts the carrier int8 array even though it
+                rejects int4 arrays outright.
     scale       [..., G, O] float32
     zero_scaled [..., G, O] float32 = scale * (zero - 2^(bits-1))
     out_shape   logical output dims (prod == O); the logical weight is
                 w[i, o] = data[g, i % gs, o] * scale[g, o]
                           - zero_scaled[g, o],  g = i // gs
+    group_axis  mesh axis name when the GROUP axis is sharded
+                (row-parallel wo/w_down under TP): ``group_qeinsum`` then
+                computes per-device partial sums over the local groups and
+                psums across the axis. None when unsharded/column-parallel.
     Leading axes (the engine's layer stack) ride along; lax.scan slices
-    them per layer like any other leaf.
+    them per layer like any other leaf. ``packed``/``group_axis`` are
+    pytree AUX data — static at trace time, so the kernel specializes.
     """
 
-    def __init__(self, data, scale, zero_scaled, out_shape: tuple):
+    def __init__(self, data, scale, zero_scaled, out_shape: tuple,
+                 packed: bool = False, group_axis=None):
         self.data = data
         self.scale = scale
         self.zero_scaled = zero_scaled
         self.out_shape = tuple(out_shape)
+        self.packed = bool(packed)
+        self.group_axis = group_axis
+
+    @property
+    def group_size(self):  # LOGICAL gs (stored axis is gs/2 when packed)
+        return self.data.shape[-2] * (2 if self.packed else 1)
 
     @property
     def shape(self):  # logical [in, *out_shape]
-        g, gs = self.data.shape[-3], self.data.shape[-2]
-        return tuple(self.data.shape[:-3]) + (g * gs,) + self.out_shape
+        g = self.data.shape[-3]
+        return tuple(self.data.shape[:-3]) + (g * self.group_size,) \
+            + self.out_shape
 
     def dequantize(self, dtype=jnp.bfloat16) -> jnp.ndarray:
-        w = (self.data.astype(jnp.float32) * self.scale[..., None, :]
+        data = self.data
+        if self.packed:
+            data = unpack_int4_lanes(jnp.asarray(data))
+        w = (data.astype(jnp.float32) * self.scale[..., None, :]
              - self.zero_scaled[..., None, :])
         lead = self.data.shape[:-3]
-        g, gs, o = self.data.shape[-3:]
-        return w.reshape(lead + (g * gs,) + self.out_shape).astype(dtype)
+        g, o = self.data.shape[-3], self.data.shape[-1]
+        return w.reshape(lead + (g * self.group_size,)
+                         + self.out_shape).astype(dtype)
 
     def tree_flatten(self):
-        return (self.data, self.scale, self.zero_scaled), self.out_shape
+        return ((self.data, self.scale, self.zero_scaled),
+                (self.out_shape, self.packed, self.group_axis))
 
     @classmethod
-    def tree_unflatten(cls, out_shape, children):
-        return cls(*children, out_shape)
+    def tree_unflatten(cls, aux, children):
+        if isinstance(aux, tuple) and aux and isinstance(aux[0], tuple):
+            out_shape, packed, group_axis = aux
+        else:  # pre-packing aux format (out_shape only)
+            out_shape, packed, group_axis = aux, False, None
+        return cls(*children, out_shape, packed, group_axis)
 
     def __repr__(self):
         return (f"GroupQTensor(data={tuple(self.data.shape)} "
-                f"{self.data.dtype}, out={self.out_shape})")
+                f"{self.data.dtype}, out={self.out_shape}, "
+                f"packed={self.packed}, group_axis={self.group_axis})")
+
+
+def pack_int4_lanes(q):
+    """Centered int4-range values [..., gs, O] int8 -> [..., gs/2, O] int8
+    with two's-complement nibbles lane-packed: element 2i in the low
+    nibble, 2i+1 in the high nibble. Host numpy in, host numpy out
+    (checkpoint loading packs before device placement)."""
+    import numpy as np
+
+    gs = q.shape[-2]
+    assert gs % 2 == 0, f"group_size {gs} must be even to nibble-pack"
+    u = np.asarray(q, np.int8).view(np.uint8)
+    lo = u[..., 0::2, :] & np.uint8(0xF)
+    hi = (u[..., 1::2, :] & np.uint8(0xF)) << np.uint8(4)
+    return (lo | hi).view(np.int8)
+
+
+def unpack_int4_lanes(p: jnp.ndarray) -> jnp.ndarray:
+    """Device-side inverse of ``pack_int4_lanes``: [..., gsp, O] int8 ->
+    [..., 2*gsp, O] int8. Shift-left then arithmetic-shift-right
+    sign-extends the low nibble; a plain arithmetic shift extracts the
+    high one. Both are cheap elementwise ops XLA fuses into the consuming
+    matmul's operand load, so the unpacked weight never round-trips HBM.
+    """
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    stacked = jnp.stack((lo, hi), axis=-2)   # [..., gsp, 2, O]
+    return stacked.reshape(p.shape[:-2] + (2 * p.shape[-2], p.shape[-1]))
 
 
 def awq_group_tensors(qweight, qzeros, scales, bits: int = 4,
@@ -138,12 +194,14 @@ def awq_group_tensors(qweight, qzeros, scales, bits: int = 4,
     """AWQ gemm tensors -> a GroupQTensor (native execution; exact).
 
     qweight int32 [in, out*bits/32], qzeros int32 [G, out*bits/32],
-    scales f16/f32 [G, out]. ``storage`` overrides the packed dtype
-    (default: int4 for 4-bit — half the HBM stream of int8 — int8 for
-    8-bit; env LLMK_AWQ_STORAGE=int8 forces int8 if a backend lacks
-    int4 support). Leaves are HOST numpy arrays (ml_dtypes int4) so
-    checkpoint loading stacks layers in host RAM before device placement
-    (same policy as ``quantize``)."""
+    scales f16/f32 [G, out]. ``storage`` overrides the on-device layout:
+    "packed4" (the 4-bit default on every backend) lane-packs two
+    nibbles per int8 byte — half the HBM stream of int8 on a carrier
+    dtype every runtime accepts; "int4" keeps ml_dtypes int4 elements
+    (rejected by the current TPU runtime); "int8" widens (exact, double
+    the stream). Env LLMK_AWQ_STORAGE picks among the three. Leaves are
+    HOST numpy arrays so checkpoint loading stacks layers in host RAM
+    before device placement (same policy as ``quantize``)."""
     import ml_dtypes
     import numpy as np
 
@@ -155,20 +213,25 @@ def awq_group_tensors(qweight, qzeros, scales, bits: int = 4,
     if storage is None:
         storage = os.environ.get("LLMK_AWQ_STORAGE")
     if storage is None:
-        # int4 storage halves the weight HBM stream, but the current TPU
-        # runtime rejects int4 arrays outright (probed: transfer/convert
-        # both fail); int8 keeps the group math EXACT at the int8-class
-        # stream. CPU defaults to int4 so the packed path stays tested;
-        # LLMK_AWQ_STORAGE=int4 opts in on runtimes that support it.
-        storage = ("int8" if bits == 8 or jax.default_backend() == "tpu"
-                   else "int4")
-    dt = ml_dtypes.int4 if storage == "int4" else np.int8
+        storage = "int8" if bits == 8 else "packed4"
+    if storage not in ("int8", "int4", "packed4"):
+        raise ValueError(f"unsupported AWQ storage {storage!r}")
+    if storage == "packed4" and (bits != 4 or gs % 2):
+        storage = "int8"  # nibble-packing needs 4-bit values, even gs
+    centered = (q - center).astype(np.int8).reshape(G, gs, O)
+    if storage == "packed4":
+        data = pack_int4_lanes(centered)
+    elif storage == "int4":
+        data = centered.astype(ml_dtypes.int4)
+    else:
+        data = centered
     s = np.asarray(scales, np.float32)
     return GroupQTensor(
-        (q - center).astype(np.int8).reshape(G, gs, O).astype(dt),
+        data,
         s,
         (z.astype(np.float32) - center) * s,
         out_shape=tuple(out_shape) if out_shape is not None else (O,),
+        packed=(storage == "packed4"),
     )
 
 
@@ -180,8 +243,17 @@ def group_qeinsum(eq: str, x: jnp.ndarray, w: GroupQTensor) -> jnp.ndarray:
                 - sum_g  zs[g, o] * sum_i x[., g, i]
     computed as a ``lax.scan`` over groups with an f32 accumulator, so
     peak memory is one [batch, O] buffer and the weight streams once at
-    its packed width. Decoder contract (asserted): the weight's
-    contraction axis is its FIRST logical axis and x's LAST.
+    its packed width — half a byte per param for lane-packed int4, whose
+    nibble unpack fuses into the group matmul's operand load. Decoder
+    contract (asserted): the weight's contraction axis is its FIRST
+    logical axis and x's LAST.
+
+    Group-axis-sharded weights (``w.group_axis``, row-parallel wo/w_down
+    under TP): the scan runs inside a ``shard_map`` over that mesh axis —
+    each device scans only its LOCAL G/n groups of weight AND activation,
+    then a single f32 ``psum`` combines the partial sums. Both terms of
+    the algebra (the matmul part and the zero-point correction) are plain
+    sums over groups, so partial-summing them per device is exact.
     """
     lhs, out_sub = eq.split("->")
     x_sub, w_sub = lhs.split(",")
@@ -189,21 +261,50 @@ def group_qeinsum(eq: str, x: jnp.ndarray, w: GroupQTensor) -> jnp.ndarray:
     assert x_sub[-n_con:] == w_sub[:n_con] and all(
         c not in out_sub for c in w_sub[:n_con]), (
         f"group_qeinsum: {eq} does not contract the weight's leading axes")
-    G, gs, O = w.data.shape[-3:]
+    G, O = w.data.shape[-3], w.data.shape[-1]
+    gs = w.group_size
     lead = x.shape[:-n_con]
     xg = x.reshape(lead + (G, gs))
     xs_x = jnp.moveaxis(xg, -2, 0)                     # [G, ..., gs]
 
     def body(acc, per_g):
         xg_, qg, sg, zg = per_g                        # [..., gs] / [gs, O]
+        if w.packed:
+            qg = unpack_int4_lanes(qg)
         part = jnp.einsum("...i,io->...o", xg_, qg.astype(x.dtype),
                           preferred_element_type=jnp.float32)
         xsum = xg_.sum(axis=-1).astype(jnp.float32)[..., None]
         return acc + part * sg - xsum * zg, None
 
-    acc0 = jnp.zeros(lead + (O,), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0,
-                          (xs_x, w.data, w.scale, w.zero_scaled))
+    def scan_groups(xs, data, scale, zero_scaled):
+        acc0 = jnp.zeros(lead + (O,), jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, (xs, data, scale, zero_scaled))
+        return acc
+
+    ax = w.group_axis
+    mesh = None
+    if ax is not None:
+        from llms_on_kubernetes_tpu.parallel.mesh import get_active_mesh
+
+        mesh = get_active_mesh()
+    if mesh is not None and mesh.shape.get(ax, 1) > 1 \
+            and G % mesh.shape[ax] == 0:
+        from jax.sharding import PartitionSpec as P
+
+        from llms_on_kubernetes_tpu.ops.shard_map_compat import shard_map
+
+        def local(xs, data, scale, zero_scaled):
+            return jax.lax.psum(
+                scan_groups(xs, data, scale, zero_scaled), ax)
+
+        acc = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(ax, *(None,) * (xs_x.ndim - 1)),
+                      P(ax, None, None), P(ax, None), P(ax, None)),
+            out_specs=P(*(None,) * (len(lead) + 1)),
+        )(xs_x, w.data, w.scale, w.zero_scaled)
+    else:
+        acc = scan_groups(xs_x, w.data, w.scale, w.zero_scaled)
     return acc.reshape(lead + w.out_shape).astype(x.dtype)
 
 

@@ -127,25 +127,57 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
     def put(x, s):
         if isinstance(x, GroupQTensor):
-            # group-quantized (AWQ-native) weight: shard only the FLAT
-            # OUTPUT axis, with the model axis the original spec put on
-            # any of the logical out dims (column-parallel preserved).
-            # Contraction-sharded (row-parallel) originals — wo/w_down —
-            # replicate instead: their group axis cannot shard without a
-            # partial-sum rework of group_qeinsum (PARITY known gap).
+            # group-quantized (AWQ-native) weight: shard the FLAT OUTPUT
+            # axis with the model axis the original spec put on any of
+            # the logical out dims (column-parallel preserved). When the
+            # original spec is CONTRACTION-sharded instead (row-parallel
+            # wo/w_down), shard the GROUP axis over that mesh axis —
+            # group_qeinsum partial-sums the local groups and psums
+            # (group_axis below) — so TP actually divides the per-device
+            # weight bytes for those tensors instead of replicating.
             k = len(x.out_shape)
             out_axes = tuple(s)[-k:] if len(tuple(s)) >= k else ()
             m = next((a for a in out_axes if a is not None), None)
             if m is not None and x.data.shape[-1] % mesh.shape[m] != 0:
                 m = None
-            def spec_for(arr):
-                return P(*([None] * (arr.ndim - 1)), m)
+            if m is not None:
+                def spec_for(arr):
+                    return P(*([None] * (arr.ndim - 1)), m)
+                return GroupQTensor(
+                    jax.device_put(x.data,
+                                   NamedSharding(mesh, spec_for(x.data))),
+                    jax.device_put(x.scale,
+                                   NamedSharding(mesh, spec_for(x.scale))),
+                    jax.device_put(
+                        x.zero_scaled,
+                        NamedSharding(mesh, spec_for(x.zero_scaled))),
+                    x.out_shape, packed=x.packed)
+            # row-parallel: the contraction part of the original spec
+            # (between the layer-stack dim and the out dims) names the
+            # mesh axis; the G axis must divide it.
+            con = tuple(s)[1:len(tuple(s)) - k]
+            ax = next((a for a in reversed(con) if a is not None), None)
+            G = x.data.shape[-3]
+            if ax is not None and mesh.shape[ax] > 1 \
+                    and G % mesh.shape[ax] == 0:
+                def gspec(arr, tail):  # shard the G axis (ndim - tail - 1)
+                    return P(*([None] * (arr.ndim - 1 - tail)), ax,
+                             *([None] * tail))
+                return GroupQTensor(
+                    jax.device_put(
+                        x.data, NamedSharding(mesh, gspec(x.data, 2))),
+                    jax.device_put(
+                        x.scale, NamedSharding(mesh, gspec(x.scale, 1))),
+                    jax.device_put(
+                        x.zero_scaled,
+                        NamedSharding(mesh, gspec(x.zero_scaled, 1))),
+                    x.out_shape, packed=x.packed, group_axis=ax)
+            # no shardable axis: replicate (degenerate-mesh fallback)
             return GroupQTensor(
-                jax.device_put(x.data, NamedSharding(mesh, spec_for(x.data))),
-                jax.device_put(x.scale, NamedSharding(mesh, spec_for(x.scale))),
-                jax.device_put(x.zero_scaled,
-                               NamedSharding(mesh, spec_for(x.zero_scaled))),
-                x.out_shape)
+                jax.device_put(x.data, NamedSharding(mesh, P())),
+                jax.device_put(x.scale, NamedSharding(mesh, P())),
+                jax.device_put(x.zero_scaled, NamedSharding(mesh, P())),
+                x.out_shape, packed=x.packed)
         if isinstance(x, QTensor):
             data = jax.device_put(x.data, NamedSharding(mesh, s))
             scale = jax.device_put(

@@ -2,7 +2,8 @@
 # CI gate: fast unit tests, native router build + integration tests, and an
 # ASan/UBSan pass over the native router (new concurrency — the prober
 # thread — and the failover/deadline paths get sanitizer coverage on every
-# run). Finishes with the entry-point contract checks.
+# run). Then a CPU-mode bench.py --smoke (full engine->gateway pipeline +
+# the one-line JSON stdout contract) and the entry-point contract checks.
 #
 # Usage: scripts/ci.sh
 # Env:   PYTHON=python3.12 scripts/ci.sh   # alternate interpreter
@@ -50,6 +51,20 @@ if command -v make >/dev/null 2>&1 && command -v g++ >/dev/null 2>&1; then
   fi
 else
   echo "ci: no C++ toolchain (make/g++) — skipping native gates"
+fi
+
+# after the native block so the smoke's gateway phase finds a built
+# llkt-router when the toolchain exists (it falls back to the Python
+# router — with a warning — when it doesn't)
+note "bench smoke (CPU end-to-end: engine + gateway + JSON contract)"
+if smoke_out="$(JAX_PLATFORMS=cpu "$PY" "$REPO/bench.py" --smoke)" \
+    && printf '%s\n' "$smoke_out" | tail -n 1 \
+       | "$PY" -c 'import json, sys; json.loads(sys.stdin.readline())'; then
+  printf '%s\n' "$smoke_out" | tail -n 1
+  echo "ci: bench smoke OK"
+else
+  echo "ci: bench smoke FAILED"
+  fails=$((fails + 1))
 fi
 
 note "entry-point contracts"

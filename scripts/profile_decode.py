@@ -10,6 +10,13 @@ has published a breakdown. This script measures, on the real chip:
    at full batch (what bench.py measures), isolating host/scheduler cost.
 3. An op-level breakdown from a jax.profiler trace over the chained
    window (device "X" events summed by op name).
+4. A per-step KERNEL / DISPATCH / COLLECTIVE / HARVEST breakdown (PR 3):
+   kernel = chained device step, dispatch = host enqueue time, collective
+   = trace ops matching the collective families (psum/all-*), harvest =
+   the synchronizing read. Plus the host packed-array build time (the
+   template-cached fast path). Emitted both as a table and as one
+   machine-readable ``PROFILE:{...}`` JSON line (PARITY.md carries the
+   table).
 
 Usage (real TPU):  python scripts/profile_decode.py [--steps 40]
 Env: BENCH_SLOTS/BENCH_PAGE/BENCH_KV/BENCH_MODEL as bench.py.
@@ -89,6 +96,7 @@ def main():
     toks = jnp.asarray(np.full((B,), 17, np.int32))
 
     def chain(n):
+        """Dispatch n chained steps; returns (enqueue wall, sync wall)."""
         nonlocal toks
         t0 = time.monotonic()
         for _ in range(n):
@@ -96,12 +104,13 @@ def main():
              _state) = eng._decode_packed(
                 eng.params, cfg, packed, toks, eng._zeros_1, eng.k_pages,
                 eng.v_pages, eng.token_counts, eng._key, None)
+        t1 = time.monotonic()
         np.asarray(toks)  # ONE synchronizing read
-        return time.monotonic() - t0
+        return t1 - t0, time.monotonic() - t1
 
     chain(4)  # warm this exact shape/chain
-    wall = chain(args.steps)
-    rtt_probe = chain(1)  # ~dispatch + RTT + 1 step
+    wall = sum(chain(args.steps))
+    rtt_probe = sum(chain(1))  # ~dispatch + RTT + 1 step
     per_step = (wall - rtt_probe) / (args.steps - 1)
     print(f"pure-device decode step: {1000 * per_step:.2f} ms "
           f"({args.steps} chained; 1-step probe {1000 * rtt_probe:.1f} ms)",
@@ -109,8 +118,29 @@ def main():
     print(f"  => {B / per_step:.0f} tok/s/chip device ceiling at B={B}",
           flush=True)
 
+    # dispatch (host enqueue, overlaps the device on TPU) and harvest
+    # (the synchronizing read) measured separately for the breakdown
+    enq, har = chain(args.steps)
+    dispatch_ms = 1000 * enq / args.steps
+    harvest_ms = 1000 * har
+
+    # host packed-array build: the template-cached _dec_template path plus
+    # the per-step dynamic columns (what the engine loop pays per step)
+    active = [(i, r) for i, r in enumerate(eng.slots) if r is not None]
+    host_pack_ms = 0.0
+    if active:
+        reps = 200
+        t0 = time.monotonic()
+        for _ in range(reps):
+            p = eng._dec_template(active)
+            for i, r in active:
+                p[i, 0] = int(eng.slot_len[i]) + 1
+                p[i, 2] = r.pending_token
+        host_pack_ms = 1000 * (time.monotonic() - t0) / reps
+
     # --- op-level trace over a chained window -------------------------
     os.makedirs(args.trace, exist_ok=True)
+    collective_ms = 0.0
     try:
         jax.profiler.start_trace(args.trace)
         chain(10)
@@ -118,7 +148,29 @@ def main():
     except Exception as e:
         print(f"trace failed: {e}", flush=True)
     else:
-        report_trace(args.trace, n_steps=10)
+        collective_ms = report_trace(args.trace, n_steps=10)
+
+    breakdown = {
+        "kernel_ms": round(1000 * per_step, 4),
+        "dispatch_ms": round(dispatch_ms, 4),
+        "collective_ms": round(collective_ms, 4),
+        "harvest_ms": round(harvest_ms, 4),
+        "host_pack_ms": round(host_pack_ms, 4),
+        "batch": B,
+        "ctx": args.ctx,
+    }
+    print("-- decode-step breakdown (ms/step) --", flush=True)
+    print(f"  kernel      {breakdown['kernel_ms']:8.3f}  "
+          "(chained device window)", flush=True)
+    print(f"  dispatch    {breakdown['dispatch_ms']:8.3f}  "
+          "(host enqueue; overlaps the device on TPU)", flush=True)
+    print(f"  collective  {breakdown['collective_ms']:8.3f}  "
+          "(trace: psum/all-* families; 0 on one chip)", flush=True)
+    print(f"  harvest     {breakdown['harvest_ms']:8.3f}  "
+          "(synchronizing read / tunnel RTT)", flush=True)
+    print(f"  host-pack   {breakdown['host_pack_ms']:8.3f}  "
+          "(packed-array build; template-cached)", flush=True)
+    print("PROFILE:" + json.dumps(breakdown), flush=True)
 
     # --- engine-loop comparison ---------------------------------------
     for r in reqs:
@@ -149,13 +201,15 @@ def main():
     print(f"total wall {time.monotonic() - t0:.1f}s", flush=True)
 
 
-def report_trace(trace_dir: str, n_steps: int) -> None:
-    """Sum device-track "X" events by op name across the trace."""
+def report_trace(trace_dir: str, n_steps: int) -> float:
+    """Sum device-track "X" events by op name across the trace; returns
+    the collective-op families' total in ms/step (the breakdown's
+    'collective' slice)."""
     files = glob.glob(os.path.join(
         trace_dir, "plugins/profile/*/*.trace.json.gz"))
     if not files:
         print("no trace files found", flush=True)
-        return
+        return 0.0
     path = max(files, key=os.path.getmtime)
     with gzip.open(path, "rt") as f:
         data = json.load(f)
@@ -192,6 +246,10 @@ def report_trace(trace_dir: str, n_steps: int) -> None:
         print(f"  {dur / 1000 / n_steps:8.3f} ms/step  "
               f"{100 * dur / max(total, 1e-9):5.1f}%  x{counts[fam]:<5d} "
               f"{fam[:80]}", flush=True)
+    coll = re.compile(r"all-reduce|all-gather|all-to-all|reduce-scatter"
+                      r"|collective|permute|psum")
+    coll_us = sum(d for f, d in agg.items() if coll.search(f))
+    return coll_us / 1000 / n_steps
 
 
 if __name__ == "__main__":

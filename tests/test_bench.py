@@ -126,3 +126,30 @@ class TestPartialEmission:
         assert data["value"] > 0
         assert "p50_ttft_ms" in data
         assert out.returncode == 0
+
+    def test_smoke_mode_emits_json_and_names_router(self):
+        """``bench.py --smoke`` (the CI gate) must exit 0 with one parseable
+        JSON line that says which router carried the gateway traffic — the
+        native llkt-router when its binary is present, else the Python
+        fallback."""
+        import json
+        import os
+        import subprocess
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("LLMK_TEST_TPU", None)
+        env.pop("LLMK_BENCH_SMOKE", None)
+        out = subprocess.run(
+            [sys.executable, str(pathlib.Path(bench.__file__)), "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env)
+        line = out.stdout.strip().splitlines()[-1]
+        data = json.loads(line)
+        assert data["smoke"] is True
+        assert data["value"] > 0
+        repo = pathlib.Path(bench.__file__).resolve().parent
+        binary = repo / "native" / "router" / "llkt-router"
+        if binary.exists():
+            assert data["gateway_router"] == "native"
+        else:
+            assert data["gateway_router"] == "python"
+        assert out.returncode == 0

@@ -140,6 +140,112 @@ def test_two_process_spmd_serving_matches_single_process(async_sched):
     assert got == want, (got, want)
 
 
+WORKER_SCORE = r"""
+import json, os, sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.engine.multihost import follower_loop
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+cfg = EngineConfig(
+    model="debug-tiny", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+    multihost=True,
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+
+if pid == 0:
+    # score between generates: the MSG_SCORE broadcast must keep the
+    # protocol state machine in sync with ordinary steps on both sides
+    out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=4))
+    lp, top_ids, top_lp = eng.score_prompt([1, 5, 9, 42, 17, 3])
+    out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=4))
+    eng.stop_followers()
+    print("RESULT:" + json.dumps([out, lp, top_ids, top_lp, out2]), flush=True)
+else:
+    follower_loop(eng)
+    print("FOLLOWER done", flush=True)
+"""
+
+REFERENCE_SCORE = r"""
+import json, sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams
+from llms_on_kubernetes_tpu.parallel.mesh import make_mesh
+
+cfg = EngineConfig(
+    model="debug-tiny", dtype="float32", max_decode_slots=2,
+    page_size=8, num_pages=33, pages_per_slot=8, prefill_buckets=(16,),
+)
+mesh = make_mesh(data=1, expert=1, model=4)
+eng = Engine(cfg, mesh=mesh)
+out = eng.generate([1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=4))
+lp, top_ids, top_lp = eng.score_prompt([1, 5, 9, 42, 17, 3])
+out2 = eng.generate([9, 8, 7], SamplingParams(temperature=0.0, max_tokens=4))
+print("RESULT:" + json.dumps([out, lp, top_ids, top_lp, out2]), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_prompt_scoring_matches_single_process():
+    """echo+logprobs prompt scoring under multi-host (PR 3 satellite —
+    the former hard 400): MSG_SCORE announces the cache-free forward and
+    ships the padded token row; the follower mirrors the executable. The
+    coordinator's per-position logprobs and top-k are pinned against a
+    single-process run, with generates before and after proving the
+    broadcast sequence stays aligned."""
+    import numpy as np
+
+    ref = subprocess.run(
+        [sys.executable, "-c", REFERENCE_SCORE], env=_env(4),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    want = _extract(ref.stdout)
+
+    coord = f"127.0.0.1:{free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER_SCORE, str(pid), coord],
+            env=_env(2),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=600)
+            assert p.returncode == 0, stderr[-2000:]
+            outs.append(stdout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    got = _extract(outs[0])
+    assert "FOLLOWER done" in outs[1]
+    assert got[0] == want[0] and got[4] == want[4]          # token ids
+    assert got[2] == want[2]                                # top-k ids
+    np.testing.assert_allclose(got[1], want[1], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[3], want[3], rtol=1e-5, atol=1e-5)
+
+
 WORKER_MM = r"""
 import json, os, sys
 

@@ -149,6 +149,71 @@ def test_engine_greedy_identical_under_pallas(monkeypatch):
     assert out == ref, f"pallas diverged: {out} vs {ref}"
 
 
+def run_fused_write_case(rng, lengths_np, *, n_kv, group, d, page, pps,
+                         interpret, rtol=2e-5, atol=2e-5):
+    """One fused write+attend case against the DUS reference: same
+    attention rows (active slots), finite output everywhere (idle rows
+    must not NaN), and byte-identical pools outside the never-read trash
+    page 0. Shared with the hardware suite (test_tpu_hardware.py) so the
+    interpret-mode and Mosaic-lowered paths pin the SAME cases."""
+    from llms_on_kubernetes_tpu.engine.cache import KVPool, write_tokens
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_attention_write,
+    )
+
+    lengths_np = np.asarray(lengths_np, np.int32)
+    B, n_q = len(lengths_np), n_kv * group
+    k_pages, v_pages, table = _paged_setup(rng, B, n_kv, d, page, pps,
+                                           lengths_np)
+    q = jnp.asarray(rng.normal(size=(B, n_q, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(B, n_kv, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, n_kv, d)), jnp.float32)
+    lengths = jnp.asarray(lengths_np)
+
+    wp = np.where(lengths_np > 0, lengths_np - 1, -1)[:, None].astype(np.int32)
+    kp_ref, vp_ref = write_tokens(
+        KVPool(k_pages), KVPool(v_pages), k_new[:, None], v_new[:, None],
+        table, jnp.asarray(wp))
+    ref = paged_attention(q, kp_ref.data, vp_ref.data, table, lengths,
+                          scale=d ** -0.5)
+
+    out, kp2, vp2 = pallas_paged_attention_write(
+        q, k_pages, v_pages, table, lengths, k_new, v_new,
+        scale=d ** -0.5, interpret=interpret)
+    act = lengths_np > 0
+    np.testing.assert_allclose(np.asarray(out)[act], np.asarray(ref)[act],
+                               rtol=rtol, atol=atol)
+    assert np.isfinite(np.asarray(out)).all()
+    # pool bytes are DMA'd, not computed — exact equality holds on
+    # hardware too (the DUS reference writes idle rows to the trash page;
+    # the fused kernel skips them entirely, hence [:, 1:])
+    np.testing.assert_array_equal(np.asarray(kp2)[:, 1:],
+                                  np.asarray(kp_ref.data)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(vp2)[:, 1:],
+                                  np.asarray(vp_ref.data)[:, 1:])
+
+
+def test_paged_fused_write_page_boundary(rng):
+    """Writes landing on the LAST row of a page (length % page == 0) and
+    the FIRST row of a freshly-allocated page (length % page == 1) — both
+    edges of the kernel's 8-row aligned read-modify-write block."""
+    page, pps = 8, 4
+    run_fused_write_case(
+        rng, [page, page + 1, 3 * page, 3 * page + 1],
+        n_kv=2, group=2, d=8, page=page, pps=pps, interpret=True)
+
+
+def test_paged_fused_write_idle_rows(rng):
+    """Idle rows (length 0): no NaN, no pool write. Both the all-idle
+    batch (every program skips its write) and idle rows interleaved with
+    active ones."""
+    # page >= 8: the kernel's read-modify-write block is 8 rows deep
+    run_fused_write_case(rng, [0, 0, 0],
+                         n_kv=1, group=2, d=8, page=8, pps=2, interpret=True)
+    run_fused_write_case(rng, [0, 5, 0, 8, 1],
+                         n_kv=2, group=2, d=8, page=8, pps=2, interpret=True)
+
+
 @pytest.mark.parametrize("window,softcap", [(None, None), (9, None), (None, 40.0)])
 def test_paged_decode_fused_write_matches_reference(rng, window, softcap):
     """The fused write+attend kernel (decode KV append folded into the

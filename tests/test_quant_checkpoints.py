@@ -223,13 +223,16 @@ def test_awq_checkpoint_loads_with_logit_parity(tmp_path):
     cfg = from_hf_config(base_cfg, name="awq-tiny")
     params_awq = load_hf_params(cfg, str(tmp_path / "awq"), dtype="float32",
                                 quantization="awq")
-    # round 4: AWQ executes NATIVELY (GroupQTensor int4 + group scales/
-    # zeros — ops/quant.py), no int8 re-quantization approximation
+    # round 4: AWQ executes NATIVELY (GroupQTensor + group scales/zeros —
+    # ops/quant.py), no int8 re-quantization approximation. Round 6 (PR 3):
+    # default 4-bit storage is nibble LANE-PACKING — int8 carrier with the
+    # stored group axis halved, 0.5 byte/param on every backend.
     from llms_on_kubernetes_tpu.ops.quant import GroupQTensor
 
     wq = params_awq["layers"]["wq"]
     assert isinstance(wq, GroupQTensor)
-    assert str(wq.data.dtype) == "int4"
+    assert wq.packed and str(wq.data.dtype) == "int8"
+    assert wq.data.shape[-2] * 2 == group == wq.group_size
     # the group path is algebraically exact vs the full-precision dequant
     # of the same tensors (fp association tolerance only)
     params_ref = load_hf_params(cfg, str(ref_dir), dtype="float32")
@@ -238,7 +241,8 @@ def test_awq_checkpoint_loads_with_logit_parity(tmp_path):
     logits_ref = _prefill_logits(cfg, params_ref, prompt)
     np.testing.assert_allclose(logits_awq, logits_ref, rtol=2e-4, atol=2e-4)
 
-    # int8 storage override serves the same numbers (backends w/o int4)
+    # packed-vs-unpacked logit parity: the int8 (unpacked) storage
+    # override must serve the same numbers as the lane-packed default
     import os as _os
     _os.environ["LLMK_AWQ_STORAGE"] = "int8"
     try:
@@ -268,7 +272,8 @@ def test_unsupported_quant_method_rejected(tmp_path):
 def test_awq_native_engine_e2e_and_tp_sharded(tmp_path):
     """The native AWQ path through the FULL engine (layer-stacked
     GroupQTensors riding the lax.scan) and under a TP mesh (flat output
-    axis column-parallel, contraction replicated)."""
+    axis column-parallel, row-parallel wo/w_down group-axis sharded with
+    the in-kernel psum)."""
     group = 16
     seed_dir, _hf = _seed_model(tmp_path)
     base_cfg = json.loads((seed_dir / "config.json").read_text())
@@ -313,3 +318,59 @@ def test_awq_native_engine_e2e_and_tp_sharded(tmp_path):
 
     tp = gen(make_mesh(data=1, expert=1, model=2))
     assert tp == single  # TP sharding must not change greedy output
+
+
+def test_awq_tp2_group_axis_sharding_halves_row_parallel_bytes(tmp_path):
+    """Tentpole (PR 3): under TP the row-parallel AWQ tensors (wo/w_down)
+    shard their GROUP axis over the model mesh axis instead of
+    replicating — per-device weight bytes provably halve at TP=2, and the
+    partial-sum + psum path in group_qeinsum keeps logit parity with the
+    unsharded engine on the virtual CPU mesh. group_size=8 so every
+    linear's group count (including w_down's F=48 contraction) divides
+    the 2-way model axis."""
+    group = 8
+    seed_dir, _hf = _seed_model(tmp_path)
+    base_cfg = json.loads((seed_dir / "config.json").read_text())
+    tensors = _load_tensors(seed_dir)
+    awq_tensors = {}
+    for name, w in tensors.items():
+        if any(lin in name for lin in LINEARS):
+            qweight, qzeros, scales, _ = _awq_pack(w, group)
+            base = name[:-len("weight")]
+            awq_tensors[base + "qweight"] = qweight
+            awq_tensors[base + "qzeros"] = qzeros
+            awq_tensors[base + "scales"] = scales
+        else:
+            awq_tensors[name] = w
+    _write_ckpt(tmp_path / "awq", awq_tensors, base_cfg,
+                {"quant_method": "awq", "bits": 4, "group_size": group,
+                 "version": "gemm"})
+
+    cfg = from_hf_config(base_cfg, name="awq-tiny")
+    params = load_hf_params(cfg, str(tmp_path / "awq"), dtype="float32",
+                            quantization="awq")
+    prompt = [1, 5, 9, 42, 17, 3]
+    want = _prefill_logits(cfg, params, prompt)
+
+    from llms_on_kubernetes_tpu.parallel.mesh import make_mesh, set_active_mesh
+    from llms_on_kubernetes_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(data=1, expert=1, model=2)
+    sharded = shard_params(params, cfg, mesh)
+    for name in ("wo", "w_down"):
+        t = sharded["layers"][name]
+        assert t.group_axis == "model", name
+        # per-device bytes HALVE at TP=2 (asserted, not claimed) — for
+        # the packed data and the group scales/zeros alike
+        for leaf in (t.data, t.scale, t.zero_scaled):
+            local = leaf.addressable_shards[0].data.nbytes
+            assert local * 2 == leaf.nbytes, (name, leaf.shape)
+    # column-parallel tensors keep the flat-output sharding (unchanged)
+    assert sharded["layers"]["wq"].group_axis is None
+
+    set_active_mesh(mesh)
+    try:
+        got = _prefill_logits(cfg, sharded, prompt)
+    finally:
+        set_active_mesh(None)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

@@ -118,6 +118,62 @@ def test_multihost_message_struct_fixed_shape():
     assert all(v.dtype == np.int32 for v in z.values())
 
 
+def test_multihost_score_message_roundtrip(monkeypatch):
+    """MSG_SCORE framing (PR 3): ctrl[6:8] carries (padded width, true
+    length); the follow-up payload broadcast ships the [1, width] token
+    row. Coordinator-side sends are replayed through the follower-side
+    receive helpers — same bytes out, same bytes in."""
+    from llms_on_kubernetes_tpu.engine import multihost as mh
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+
+    sent = []
+    monkeypatch.setattr(mh, "_broadcast", lambda v: (sent.append(v), v)[1])
+    cfg = EngineConfig(max_decode_slots=2, pages_per_slot=8,
+                       prefill_buckets=(16,), admit_batch=2)
+    shapes = mh.ProtoShapes.from_engine_config(cfg)
+    toks = np.zeros((1, 32), np.int32)
+    toks[0, :5] = (1, 5, 9, 42, 17)
+    mh.send_message(shapes, mh.MSG_SCORE, score=(32, 5))
+    mh.send_score_payload(toks)
+    assert len(sent) == 2
+
+    replay = iter(list(sent))
+    monkeypatch.setattr(mh, "_broadcast", lambda v: next(replay))
+    m = mh.receive_message(shapes)
+    assert int(m["ctrl"][0]) == mh.MSG_SCORE
+    width, n = int(m["ctrl"][6]), int(m["ctrl"][7])
+    assert (width, n) == (32, 5)
+    got = mh.receive_score_payload(width)
+    np.testing.assert_array_equal(got, toks)
+
+
+def test_multihost_score_prompt_broadcasts_and_matches_single_host(
+        monkeypatch):
+    """score_prompt under multihost=True (the former hard 400): announces
+    MSG_SCORE + ships the padded token row, then returns the same scores
+    as a plain single-host engine."""
+    from llms_on_kubernetes_tpu.engine import multihost as mh
+    from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
+
+    kw = dict(model="debug-tiny", dtype="float32", max_decode_slots=2,
+              page_size=16, num_pages=64, pages_per_slot=8,
+              prefill_buckets=(16,))
+    prompt = [1, 5, 9, 42, 17, 3]
+    want = Engine(EngineConfig(**kw)).score_prompt(prompt)
+
+    sent = []
+    monkeypatch.setattr(mh, "_broadcast", lambda v: (sent.append(v), v)[1])
+    got = Engine(EngineConfig(multihost=True, **kw)).score_prompt(prompt)
+    assert len(sent) == 2  # one control word + one token payload
+    ctrl = sent[0]["ctrl"]
+    assert int(ctrl[0]) == mh.MSG_SCORE
+    assert (int(ctrl[6]), int(ctrl[7])) == (16, len(prompt))
+    assert sent[1].shape == (1, 16)
+    np.testing.assert_allclose(got[0], want[0], rtol=1e-6)
+    assert got[1] == want[1]
+    np.testing.assert_allclose(got[2], want[2], rtol=1e-6)
+
+
 def test_engine_single_host_unaffected_by_multihost_flag_default():
     """multihost=False (default) must not touch broadcast machinery."""
     from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig, SamplingParams

@@ -70,6 +70,25 @@ def test_paged_decode_int8_kernel_matches_xla_on_tpu():
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
 
 
+def test_paged_fused_write_kernel_matches_xla_on_tpu():
+    """The default-on fused KV-append + attend kernel
+    (pallas_paged_attention_write) on the real Mosaic lowering. Cases:
+    mid-page, page-boundary writes (last row of a page at 64, first row
+    of a fresh page at 65), length-1, idle (length 0) and near-capacity
+    rows — the 8-sublane-aligned read-modify-write of the target block is
+    the part that can only regress on hardware."""
+    from test_pallas import run_fused_write_case
+
+    rng = np.random.default_rng(3)
+    run_fused_write_case(
+        rng, np.asarray([45, 64, 65, 1, 0, 250], np.int32),
+        n_kv=8, group=4, d=128, page=32, pps=8,
+        interpret=False,
+        # attention rows at MXU f32 (bf16-ish) precision; the pool-byte
+        # comparison inside the helper stays EXACT — writes are DMAs
+        rtol=2e-2, atol=2e-2)
+
+
 def test_flash_prefill_kernel_matches_xla_on_tpu():
     from llms_on_kubernetes_tpu.ops.attention import prefill_attention
     from llms_on_kubernetes_tpu.ops.pallas_flash import flash_prefill_attention
