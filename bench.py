@@ -490,6 +490,45 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     load_done.wait(timeout=300)
     load_wall = load_wall_box.get("wall", float("inf"))
 
+    def fetch(p, path):
+        conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+        conn.request("GET", path)
+        data = conn.getresponse().read()
+        conn.close()
+        return data
+
+    # per-phase breakdown from the API server's trace ring: p50 wall time
+    # per span (admission/queue/prefill/decode/stream) across everything
+    # the bench just pushed through — says WHERE gateway latency lives
+    phase_p50: dict = {}
+    try:
+        traces = _json.loads(fetch(ports["server"],
+                                   "/debug/traces?limit=200")).get("traces", [])
+        acc: dict = {}
+        for tr in traces:
+            for sp in tr.get("spans", []):
+                if sp.get("duration_ms") is not None:
+                    acc.setdefault(sp["name"], []).append(sp["duration_ms"])
+        phase_p50 = {name: round(sorted(v)[len(v) // 2], 2)
+                     for name, v in sorted(acc.items())}
+    except (OSError, ValueError):
+        pass
+
+    # CI metrics-lint hook: dump the exposition text of both scrape
+    # targets (API server + whichever gateway carried the traffic) for
+    # scripts/metrics_lint.py to validate after the smoke run
+    dump_dir = os.environ.get("LLMK_METRICS_DUMP")
+    if dump_dir:
+        for label, p in (("api", ports["server"]), ("gateway", port)):
+            try:
+                text = fetch(p, "/metrics")
+                with open(os.path.join(dump_dir, f"{label}_metrics.txt"),
+                          "wb") as f:
+                    f.write(text)
+            except OSError as e:
+                print(f"gateway bench: metrics dump for {label} failed: {e}",
+                      file=sys.stderr, flush=True)
+
     if native_proc is not None:
         native_proc.terminate()
         native_proc.wait(timeout=5)
@@ -507,6 +546,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         "gateway_engine_p50_ttft_ms": round(
             1000 * engine_ttfts[len(engine_ttfts) // 2], 1) if engine_ttfts else None,
         "gateway_tokens_per_sec": round(n_load * gen / load_wall, 1),
+        "gateway_phase_p50_ms": phase_p50,
     }
 
 

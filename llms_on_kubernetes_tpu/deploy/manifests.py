@@ -182,12 +182,12 @@ def _volumes(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
     return []
 
 
-def _scrape_annotations() -> dict[str, str]:
-    """Prometheus scrape hints for the engine's /metrics (SURVEY §5: the
+def _scrape_annotations(port: int = ENGINE_PORT) -> dict[str, str]:
+    """Prometheus scrape hints for a pod's /metrics (SURVEY §5: the
     reference never scraped its engines' metrics endpoints)."""
     return {
         "prometheus.io/scrape": "true",
-        "prometheus.io/port": str(ENGINE_PORT),
+        "prometheus.io/port": str(port),
         "prometheus.io/path": "/metrics",
     }
 
@@ -398,7 +398,10 @@ def render_router(spec: DeploySpec) -> list[Manifest]:
                     "labels": _labels("api-gateway", "router"),
                     # config-hash annotation: rolls the router pods whenever
                     # the models[] list changes (reference gap, SURVEY §3.2)
-                    "annotations": {"checksum/router-config": config_hash(spec)},
+                    "annotations": {
+                        "checksum/router-config": config_hash(spec),
+                        **_scrape_annotations(ROUTER_PORT),
+                    },
                 },
                 "spec": {
                     "terminationGracePeriodSeconds": ROUTER_GRACE_S,
@@ -507,7 +510,13 @@ def render_webui(spec: DeploySpec) -> list[Manifest]:
             "replicas": 1,
             "selector": {"matchLabels": {"app": "webui"}},
             "template": {
-                "metadata": {"labels": _labels("webui", "webui")},
+                "metadata": {
+                    "labels": _labels("webui", "webui"),
+                    # explicit opt-out: OpenWebUI exposes no Prometheus
+                    # endpoint, so the annotation documents the decision
+                    # instead of leaving the pod silently unscraped
+                    "annotations": {"prometheus.io/scrape": "false"},
+                },
                 "spec": {
                     "containers": [{
                         "name": "webui",

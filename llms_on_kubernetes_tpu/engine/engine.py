@@ -251,6 +251,12 @@ class Request:
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
     admitted_at: Optional[float] = None  # prefill dispatched (TTFT breakdown)
     first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None  # terminal event recorded (_finish)
+    # server-side trace sink (duck-typed: anything with .event(name, **kv));
+    # the API layer points this at the request's Trace so engine-side
+    # preemption/deadline/stall land on the distributed timeline. None for
+    # direct engine use — the engine never requires it.
+    trace: Optional[Any] = None
     events: "queue.SimpleQueue[tuple[list[int], bool, Optional[str]]]" = dataclasses.field(
         default_factory=queue.SimpleQueue
     )
@@ -1813,6 +1819,10 @@ class Engine:
         """Release a request's slot/pages and mark it finished."""
         req.finished = True
         req.finish_reason = reason
+        req.finished_at = time.monotonic()
+        if req.trace is not None:
+            req.trace.event("finish", request=req.id, reason=reason,
+                            tokens=len(req.output))
         self._g_release(req)
         if req.slot >= 0:
             self.allocator.free(req.slot)
@@ -1845,10 +1855,10 @@ class Engine:
         terminal event instead of a hang, and mark the engine wedged —
         submit() rejects from here on and the server flips readiness; a
         process restart is the only recovery."""
-        import sys
+        from llms_on_kubernetes_tpu.server.tracing import jlog
 
-        print(f"[engine] WATCHDOG: {why} — shedding all requests and "
-              f"marking engine wedged", file=sys.stderr, flush=True)
+        jlog("engine_wedged", why=why, waiting=len(self.waiting),
+             active=sum(r is not None for r in self.slots))
         self.wedged = True
         events: list[StepEvent] = []
         with self._lock:
@@ -1875,6 +1885,9 @@ class Engine:
             raise MemoryError("KV pool exhausted with no preemptable request")
         victim = max(victims, key=lambda r: r.submitted_at)
         self.preemptions += 1
+        if victim.trace is not None:
+            victim.trace.event("preempted", request=victim.id,
+                               tokens=len(victim.output))
         slot = victim.slot
         self.allocator.free(slot)
         self.slot_len[slot] = 0

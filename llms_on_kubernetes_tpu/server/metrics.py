@@ -14,6 +14,19 @@ import threading
 from typing import Optional
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping for label VALUES: backslash, the
+    double quote, and newline must be escaped or the series line is
+    unparseable (model names and replica URLs are operator input)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(names: tuple, values: tuple) -> str:
+    return ",".join(f'{n}="{escape_label_value(v)}"'
+                    for n, v in zip(names, values))
+
+
 class _LabeledValue:
     """One child time series of a labeled Counter/Gauge."""
 
@@ -68,8 +81,7 @@ class _Metric:
             out.append(f"{self.name} {self.value}")
         else:
             for key in sorted(self._children):
-                lbl = ",".join(f'{n}="{v}"'
-                               for n, v in zip(self.label_names, key))
+                lbl = _label_str(self.label_names, key)
                 out.append(f"{self.name}{{{lbl}}} {self._children[key].value}")
         return "\n".join(out) + "\n"
 
@@ -88,14 +100,14 @@ class Gauge(_Metric):
         self.value = v
 
 
-class Histogram:
-    def __init__(self, name: str, help_: str, buckets: tuple[float, ...], registry: "Registry"):
-        self.name, self.help = name, help_
-        self.buckets = tuple(sorted(buckets))
-        self.counts = [0] * (len(self.buckets) + 1)
+class _HistogramSeries:
+    """One histogram time series: the bucket counts + sum + count."""
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.n = 0
-        registry._add(self)
 
     def observe(self, v: float) -> None:
         self.total += v
@@ -118,19 +130,58 @@ class Histogram:
                 return b
         return float("inf")
 
+    def _render_series(self, name: str, labels: str) -> list[str]:
+        """Series lines with ``labels`` ('' or 'k="v",...') merged into the
+        bucket's le label set."""
+        pre = labels + "," if labels else ""
+        out = []
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += self.counts[i]
+            out.append(f'{name}_bucket{{{pre}le="{b}"}} {acc}')
+        acc += self.counts[-1]
+        out.append(f'{name}_bucket{{{pre}le="+Inf"}} {acc}')
+        suffix = f"{{{labels}}}" if labels else ""
+        out.append(f"{name}_sum{suffix} {self.total}")
+        out.append(f"{name}_count{suffix} {self.n}")
+        return out
+
+
+class Histogram(_HistogramSeries):
+    """Scalar-or-labeled histogram, mirroring _Metric's labels() shape.
+
+    Without ``label_names`` the parent IS the single series (the original
+    behavior). With them, ``labels(**kv)`` returns (creating on first use)
+    a child series; the parent's own counters stay untouched and are not
+    rendered.
+    """
+
+    def __init__(self, name: str, help_: str, buckets: tuple[float, ...],
+                 registry: "Registry", label_names: tuple[str, ...] = ()):
+        super().__init__(tuple(sorted(buckets)))
+        self.name, self.help = name, help_
+        self.label_names = tuple(label_names)
+        self._children: dict[tuple[str, ...], _HistogramSeries] = {}
+        registry._add(self)
+
+    def labels(self, **kv: str) -> _HistogramSeries:
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            child = self._children[key] = _HistogramSeries(self.buckets)
+        return child
+
     def render(self) -> str:
         out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
-        acc = 0
-        for i, b in enumerate(self.buckets):
-            acc += self.counts[i]
-            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
-        acc += self.counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
-        out.append(f"{self.name}_sum {self.total}")
-        out.append(f"{self.name}_count {self.n}")
+        if not self.label_names:
+            out += self._render_series(self.name, "")
+        else:
+            for key in sorted(self._children):
+                out += self._children[key]._render_series(
+                    self.name, _label_str(self.label_names, key))
         return "\n".join(out) + "\n"
 
 
@@ -163,10 +214,17 @@ def engine_metrics(registry: Registry) -> dict:
             "llm_preemptions_total", "Requests preempted for KV memory", registry),
         "ttft": Histogram(
             "llm_ttft_seconds", "Time to first token",
-            (0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0), registry),
+            (0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0), registry,
+            label_names=("model",)),
+        "e2e_latency": Histogram(
+            "llm_e2e_latency_seconds",
+            "Request latency, submit to finish",
+            (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+            registry, label_names=("model",)),
         "decode_step": Histogram(
             "llm_decode_step_seconds", "Per-decode-step latency",
-            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5), registry),
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5), registry,
+            label_names=("model",)),
         "batch_occupancy": Gauge(
             "llm_decode_batch_occupancy", "Active decode slots", registry),
         "kv_pages_used": Gauge(

@@ -34,8 +34,10 @@ from aiohttp import web
 
 from llms_on_kubernetes_tpu.engine.engine import Engine, Request, SamplingParams
 from llms_on_kubernetes_tpu.engine.tokenizer import TokenizerLike
+from llms_on_kubernetes_tpu.server import tracing
 from llms_on_kubernetes_tpu.server.metrics import Registry, engine_metrics
 from llms_on_kubernetes_tpu.server.router import DEADLINE_HEADER
+from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
 
 def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
@@ -71,14 +73,19 @@ class EngineLoop(threading.Thread):
     # a pathological backlog
     drain_timeout_s = 55.0
 
-    def __init__(self, engine: Engine, metrics: Optional[dict] = None):
+    def __init__(self, engine: Engine, metrics: Optional[dict] = None,
+                 model_name: str = "",
+                 flight: Optional[tracing.FlightRecorder] = None):
         super().__init__(daemon=True, name="engine-loop")
         self.engine = engine
         self.metrics = metrics
+        self.model_name = model_name
+        self.flight = flight
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
         self._preempt_seen = 0
+        self._shed_total = 0
 
     def submit(self, *args, **kw) -> Request:
         req = self.engine.submit(*args, **kw)
@@ -122,22 +129,31 @@ class EngineLoop(threading.Thread):
             t0 = time.monotonic()
             events = eng.step()
             dt = time.monotonic() - t0
+            occupancy = sum(r is not None for r in eng.slots)
+            pages_used = eng.config.num_pages - 1 - eng.allocator.num_free_pages
+            step_tokens = sum(len(ev.new_tokens) for ev in events)
+            step_finished = sum(1 for ev in events if ev.finished)
+            self._shed_total += sum(
+                1 for ev in events
+                if ev.finished and ev.finish_reason in ("timeout", "stalled"))
             if self.metrics:
                 m = self.metrics
-                m["decode_step"].observe(dt)
+                m["decode_step"].labels(model=self.model_name).observe(dt)
                 if eng.preemptions > self._preempt_seen:
                     m["preemptions"].inc(eng.preemptions - self._preempt_seen)
                     self._preempt_seen = eng.preemptions
-                m["batch_occupancy"].set(sum(r is not None for r in eng.slots))
-                m["kv_pages_used"].set(
-                    eng.config.num_pages - 1 - eng.allocator.num_free_pages)
+                m["batch_occupancy"].set(occupancy)
+                m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))
                 m["prefix_hit_tokens"].set(eng.allocator.hit_tokens_total)
                 for ev in events:
                     m["tokens_generated"].inc(len(ev.new_tokens))
+                    r = ev.request
                     if ev.finished:
                         m["requests_finished"].inc()
-                    r = ev.request
+                        m["e2e_latency"].labels(model=self.model_name).observe(
+                            (r.finished_at or time.monotonic())
+                            - r.submitted_at)
                     if ev.finished and ev.finish_reason == "timeout":
                         # queue = shed before ever being prefilled;
                         # decode = aborted mid-generation at its deadline
@@ -145,9 +161,26 @@ class EngineLoop(threading.Thread):
                         m["deadline_exceeded"].labels(phase=phase).inc()
                     if r.first_token_at and r.id not in self._ttft_seen:
                         self._ttft_seen.add(r.id)
-                        m["ttft"].observe(r.first_token_at - r.submitted_at)
+                        m["ttft"].labels(model=self.model_name).observe(
+                            r.first_token_at - r.submitted_at)
                     if ev.finished:
                         self._ttft_seen.discard(r.id)
+            if self.flight is not None:
+                # one flight-recorder frame per engine step: enough to
+                # reconstruct "what was the engine doing" after a stall
+                # or latency spike without a profiler attached
+                self.flight.record(
+                    step_ms=round(dt * 1000.0, 3),
+                    occupancy=occupancy,
+                    kv_pages_used=pages_used,
+                    waiting=len(eng.waiting),
+                    tokens=step_tokens,
+                    tokens_per_s=round(step_tokens / dt, 1) if dt > 0 else 0.0,
+                    finished=step_finished,
+                    preemptions=eng.preemptions,
+                    shed=self._shed_total,
+                    wedged=bool(getattr(eng, "wedged", False)),
+                )
 
 
 def _event_pusher(loop: asyncio.AbstractEventLoop, q: "asyncio.Queue"):
@@ -280,7 +313,16 @@ class OpenAIServer:
         self.model_name = model_name
         self.registry = registry or Registry()
         self.metrics = engine_metrics(self.registry)
-        self.loop_thread = EngineLoop(engine, self.metrics)
+        # observability surfaces: recent completed traces (/debug/traces)
+        # and the engine flight recorder (/debug/engine)
+        import os
+        self.traces = tracing.TraceStore(
+            int(os.environ.get("LLMK_TRACE_RING", "256")))
+        self.flight = tracing.FlightRecorder(
+            int(os.environ.get("LLMK_FLIGHT_STEPS", "512")))
+        self.loop_thread = EngineLoop(engine, self.metrics,
+                                      model_name=model_name,
+                                      flight=self.flight)
         self.engine = engine
         # readiness lifecycle: loading -> serving -> draining; "wedged" is
         # derived from the engine watchdog and overrides everything.
@@ -303,8 +345,27 @@ class OpenAIServer:
     # under this)
     MAX_BODY_BYTES = 32 * 1024 * 1024
 
+    @web.middleware
+    async def _request_id_middleware(self, request, handler):
+        """Read-or-mint the request id at the edge of this process and echo
+        it on every response (Dapper-style propagation: both routers
+        forward the inbound header verbatim, so the id a client quotes
+        matches the engine's trace)."""
+        rid, _ = tracing.request_id_from(request.headers)
+        request["llmk_request_id"] = rid
+        try:
+            resp = await handler(request)
+        except web.HTTPException as ex:
+            ex.headers.setdefault(REQUEST_ID_HEADER, rid)
+            raise
+        if not resp.prepared:
+            # streamed responses set the header themselves before prepare()
+            resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+        return resp
+
     def make_app(self) -> web.Application:
-        app = web.Application(client_max_size=self.MAX_BODY_BYTES)
+        app = web.Application(client_max_size=self.MAX_BODY_BYTES,
+                              middlewares=[self._request_id_middleware])
         app.router.add_get("/health", self.health)
         app.router.add_get("/ready", self.ready)
         app.router.add_get("/v1/models", self.models)
@@ -321,6 +382,8 @@ class OpenAIServer:
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/debug/profile/start", self.profile_start)
         app.router.add_post("/debug/profile/stop", self.profile_stop)
+        app.router.add_get("/debug/traces", self.debug_traces)
+        app.router.add_get("/debug/engine", self.debug_engine)
         app.on_startup.append(self._start_loop)
         app.on_cleanup.append(self._stop_loop)
         return app
@@ -421,6 +484,37 @@ class OpenAIServer:
             return web.json_response(
                 {"error": {"message": f"stop failed: {e}"}}, status=500)
         return web.json_response({"status": "stopped"})
+
+    @staticmethod
+    def _int_query(request: web.Request, key: str, default: int) -> int:
+        try:
+            return int(request.query.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """Recent completed request traces, newest first.
+
+        ``?id=<request id>`` / ``?model=<name>`` filter; ``?limit=N`` caps
+        the answer (default 50). Span times are milliseconds relative to
+        the request's arrival at this server.
+        """
+        traces = self.traces.snapshot(
+            request_id=request.query.get("id"),
+            model=request.query.get("model"),
+            limit=self._int_query(request, "limit", 50))
+        return web.json_response({"traces": traces})
+
+    async def debug_engine(self, request: web.Request) -> web.Response:
+        """Engine flight recorder: the last N decode steps (step time,
+        occupancy, KV pages, shed/preempted counts, token throughput) so a
+        wedged or slow engine can be diagnosed post-hoc. ``?limit=N``
+        trims to the most recent N steps."""
+        snap = self.flight.snapshot(
+            limit=self._int_query(request, "limit", 0) or None)
+        snap["state"] = self.state
+        snap["model"] = self.model_name
+        return web.json_response(snap)
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response({
@@ -900,6 +994,63 @@ class OpenAIServer:
     async def _serve(self, request, body, prompts, *, chat: bool,
                      images=None, tools_on: bool = False,
                      tool_grammar=None) -> web.StreamResponse:
+        """Trace-managed wrapper around the serving path: every request —
+        success, client error, or crash — leaves a completed trace in the
+        /debug/traces ring and a one-line JSON access log with its id."""
+        rid = request.get("llmk_request_id") or tracing.new_request_id()
+        trace = tracing.Trace(rid, model=self.model_name)
+        trace.engine_reqs = []  # engine Requests serving this HTTP request
+        status = "error"
+        resp = None
+        try:
+            resp = await self._serve_inner(
+                request, body, prompts, trace, chat=chat, images=images,
+                tools_on=tools_on, tool_grammar=tool_grammar)
+            status = "ok" if resp.status < 400 else f"http_{resp.status}"
+            return resp
+        finally:
+            self._finalize_trace(trace, status, resp)
+
+    def _finalize_trace(self, trace, status: str, resp) -> None:
+        """Derive the request's span timeline from the engine Request
+        timestamps (single writer each: submit/admit/first-token/finish)
+        and publish it. Spans are disjoint by construction, so their
+        durations sum to at most the end-to-end latency."""
+        now = time.monotonic()
+        many = len(trace.engine_reqs) > 1
+        for i, req in enumerate(trace.engine_reqs):
+            meta = {"choice": i} if many else {}
+            sub = req.submitted_at
+            adm = req.admitted_at
+            ft = req.first_token_at
+            fin = req.finished_at
+            fin = now if fin is None else min(fin, now)
+            trace.add_span("admission", trace.t0, sub, **meta)
+            trace.add_span("queue", sub, adm if adm is not None else fin,
+                           **meta)
+            if adm is not None:
+                trace.add_span("prefill", adm,
+                               ft if ft is not None else fin, **meta)
+            if ft is not None:
+                trace.add_span("decode", ft, fin,
+                               tokens=len(req.output), **meta)
+            if fin < now:
+                # engine finished before the response flushed: the tail is
+                # stream/serialization time on the API side
+                trace.add_span("stream", fin, now, **meta)
+        trace.finish(status)
+        self.traces.add(trace)
+        tracing.jlog(
+            "request", request_id=trace.request_id, component="api",
+            model=self.model_name, status=status,
+            http_status=getattr(resp, "status", None),
+            e2e_ms=round(trace.e2e_ms() or 0.0, 3),
+            tokens=sum(len(r.output) for r in trace.engine_reqs))
+        tracing.maybe_log_slow(trace, "api")
+
+    async def _serve_inner(self, request, body, prompts, trace, *,
+                           chat: bool, images=None, tools_on: bool = False,
+                           tool_grammar=None) -> web.StreamResponse:
         from llms_on_kubernetes_tpu.engine.engine import (
             EngineStallError, QueueFullError)
         from llms_on_kubernetes_tpu.engine.grammar import GrammarError
@@ -989,9 +1140,15 @@ class OpenAIServer:
                         p = dataclasses.replace(
                             params, seed=(params.seed + j) & 0x7FFFFFFF)
                     q: asyncio.Queue = asyncio.Queue()
+                    # the engine request carries the distributed request id
+                    # (suffixed per choice so engine-side ids stay unique)
+                    eng_id = (trace.request_id if len(prompts) * best_of == 1
+                              else f"{trace.request_id}/{len(reqs)}")
                     req = self.loop_thread.submit(
                         prompt_ids, p, on_event=_event_pusher(loop, q),
-                        images=images, deadline=deadline)
+                        images=images, deadline=deadline, request_id=eng_id)
+                    req.trace = trace
+                    trace.engine_reqs.append(req)
                     req._aq = q
                     reqs.append(req)
         except EngineStallError as e:
@@ -1340,6 +1497,11 @@ class OpenAIServer:
                 "X-Accel-Buffering": "no",
             },
         )
+        rid_header = request.get("llmk_request_id")
+        if rid_header:
+            # set before prepare(): the middleware cannot add headers to an
+            # already-prepared streaming response
+            resp.headers[REQUEST_ID_HEADER] = rid_header
         await resp.prepare(request)
         obj = "chat.completion.chunk" if chat else "text_completion"
         write_lock = asyncio.Lock()

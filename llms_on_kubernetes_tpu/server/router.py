@@ -57,8 +57,8 @@ from __future__ import annotations
 
 import asyncio
 import json
-import logging
 import math
+import os
 import random
 import time
 from typing import Optional, Union
@@ -66,9 +66,9 @@ from typing import Optional, Union
 import aiohttp
 from aiohttp import web
 
+from llms_on_kubernetes_tpu.server import tracing
 from llms_on_kubernetes_tpu.server.metrics import Registry, router_metrics
-
-log = logging.getLogger("llmk.router")
+from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER, jlog
 
 DEADLINE_HEADER = "X-LLMK-Deadline-Ms"
 
@@ -233,6 +233,8 @@ class Router:
         self.clock = clock
         self.registry = Registry()
         self.metrics = router_metrics(self.registry)
+        self.traces = tracing.TraceStore(
+            int(os.environ.get("LLMK_TRACE_RING", "256")))
         # per-replica state; breakers indexed by replica URL for inspection
         self.replicas: dict[str, list[Replica]] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
@@ -255,6 +257,7 @@ class Router:
         app = web.Application()
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics_endpoint)
+        app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_get("/v1/models", self.models)
         app.router.add_route("*", "/{path:.*}", self.proxy)
         app.on_startup.append(self._startup)
@@ -312,8 +315,9 @@ class Router:
 
     def _set_health(self, rep: Replica, healthy: bool) -> None:
         if healthy != rep.healthy:
-            log.warning("replica %s of model %r %s", rep.url, rep.model,
-                        "re-admitted" if healthy else "ejected")
+            jlog("replica_health", component="router", model=rep.model,
+                 replica=rep.url,
+                 verdict="re-admitted" if healthy else "ejected")
         rep.healthy = healthy
         self.metrics["replica_healthy"].labels(
             model=rep.model, replica=rep.url).set(1 if healthy else 0)
@@ -364,8 +368,8 @@ class Router:
             if self.strict:
                 return self.default_model, f"model {model!r} not found"
             self.metrics["unknown_model_fallback"].inc()
-            log.warning("unknown model %r: falling back to default %r",
-                        model, self.default_model)
+            jlog("unknown_model_fallback", component="router",
+                 model=str(model), default=self.default_model)
         return self.default_model, None
 
     def _deadline_from(self, request: web.Request, doc: Optional[dict],
@@ -406,7 +410,7 @@ class Router:
             choice = a if a.inflight <= b.inflight else b
         return choice if choice.breaker.allow() else None
 
-    def _unroutable_response(self, model: str) -> web.Response:
+    def _unroutable_response(self, model: str, rid: str = "") -> web.Response:
         reps = self.replicas[model]
         healthy = [r for r in reps if r.healthy]
         if healthy:
@@ -417,7 +421,8 @@ class Router:
                     f"all {len(healthy)} replica(s) of {model!r} unavailable "
                     f"(circuit open)",
                     "service_unavailable", "upstream_circuit_open"),
-                status=503, headers={"Retry-After": str(retry_after)},
+                status=503, headers=self._rid_headers(
+                    rid, {"Retry-After": str(retry_after)}),
             )
         retry_after = max(1, math.ceil(self.probe_interval_s or 1))
         return web.json_response(
@@ -425,40 +430,84 @@ class Router:
                 f"no healthy replicas for {model!r} "
                 f"({len(reps)} ejected by health probes)",
                 "service_unavailable", "no_healthy_upstream"),
-            status=503, headers={"Retry-After": str(retry_after)},
+            status=503, headers=self._rid_headers(
+                rid, {"Retry-After": str(retry_after)}),
         )
 
-    def _deadline_response(self) -> web.Response:
+    def _deadline_response(self, rid: str = "") -> web.Response:
         self.metrics["deadline_rejected"].inc()
         return web.json_response(
             error_body("deadline expired before the request could be "
                        "forwarded", "timeout", "deadline_exceeded"),
-            status=504,
+            status=504, headers=self._rid_headers(rid),
         )
+
+    @staticmethod
+    def _rid_headers(rid: str, extra: Optional[dict] = None) -> dict:
+        headers = dict(extra) if extra else {}
+        if rid:
+            headers[REQUEST_ID_HEADER] = rid
+        return headers
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        return web.json_response({"traces": self.traces.snapshot(
+            request_id=request.query.get("id"),
+            model=request.query.get("model"),
+            limit=limit,
+        )})
 
     # ------------------------------------------------------------------
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
-        t0 = self.clock()
+        rid, _ = tracing.request_id_from(request.headers)
+        trace = tracing.Trace(rid, clock=self.clock)
+        resp: Optional[web.StreamResponse] = None
+        status = "error"
+        try:
+            resp = await self._proxy_inner(request, trace, rid)
+            status = "ok" if resp.status < 400 else f"http_{resp.status}"
+            return resp
+        finally:
+            trace.finish(status)
+            self.traces.add(trace)
+            jlog("request", request_id=rid, component="router",
+                 model=trace.model, status=status,
+                 http_status=getattr(resp, "status", None),
+                 method=request.method, path=request.path,
+                 e2e_ms=round(trace.e2e_ms() or 0.0, 3))
+            tracing.maybe_log_slow(trace, "router")
+
+    async def _proxy_inner(self, request: web.Request,
+                           trace: "tracing.Trace",
+                           rid: str) -> web.StreamResponse:
+        t0 = trace.t0
         body = await request.read()
         doc = self._json_doc(body)
         model, err = self._select(doc)
+        trace.model = model
+        trace.add_span("receive", t0, self.clock(), bytes=len(body))
         if err:
             return web.json_response(
                 error_body(err, "invalid_request_error", "model_not_found"),
-                status=404,
+                status=404, headers=self._rid_headers(rid),
             )
         deadline = self._deadline_from(request, doc, t0)
         if deadline is not None and self.clock() >= deadline:
-            return self._deadline_response()
+            return self._deadline_response(rid)
 
         # the inbound deadline header is consumed here; a decremented copy
         # is re-added per attempt below (never the client's raw value)
         headers = {
             k: v for k, v in request.headers.items()
             if k.lower() not in HOP_BY_HOP
-            and k.lower() != DEADLINE_HEADER.lower()
+            and k.lower() not in (DEADLINE_HEADER.lower(),
+                                  REQUEST_ID_HEADER.lower())
         }
+        headers[REQUEST_ID_HEADER] = rid
         peername = request.transport.get_extra_info("peername") if request.transport else None
         client_ip = peername[0] if peername else ""
         headers["X-Real-IP"] = client_ip
@@ -477,6 +526,8 @@ class Router:
         last_err: Optional[BaseException] = None
         tried: set = set()
         never_picked = True
+        t_connect0 = self.clock()
+        attempt = 0
         for attempt in range(1, self.retry_attempts + 1):
             replica = self._pick(model, tried)
             if replica is None:
@@ -484,12 +535,12 @@ class Router:
             never_picked = False
             if prev is not None and replica.url != prev.url:
                 self.metrics["failover"].inc()
-                log.warning("failing over %r from %s to %s", model,
-                            prev.url, replica.url)
+                jlog("failover", request_id=rid, component="router",
+                     model=model, src=prev.url, dst=replica.url)
             if deadline is not None:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
-                    return self._deadline_response()
+                    return self._deadline_response(rid)
                 headers[DEADLINE_HEADER] = str(int(remaining * 1000))
             url = f"{replica.url}/{request.match_info['path']}"
             if request.query_string:
@@ -501,6 +552,8 @@ class Router:
                 )
                 replica.breaker.record_success()
                 active = replica
+                trace.add_span("connect", t_connect0, self.clock(),
+                               replica=replica.url, attempts=attempt)
                 break
             except RETRYABLE_ERRORS as e:
                 replica.inflight -= 1
@@ -525,34 +578,49 @@ class Router:
                 break
         if upstream is None or active is None:
             if never_picked and last_err is None:
-                return self._unroutable_response(model)
+                return self._unroutable_response(model, rid)
+            trace.add_span("connect", t_connect0, self.clock(),
+                           error=str(last_err), attempts=attempt)
             return web.json_response(
                 error_body(f"upstream error: {last_err}", "bad_gateway",
                            "upstream_error"),
-                status=502,
+                status=502, headers=self._rid_headers(rid),
             )
 
         # --- relay phase: stream the response; never retried.
         resp: Optional[web.StreamResponse] = None
+        t_head = self.clock()
+        t_first: Optional[float] = None
+        relayed = 0
         try:
             async with upstream:
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in HOP_BY_HOP:
                         resp.headers[k] = v
+                # echo the id even when the upstream is not LLMK-aware
+                resp.headers.setdefault(REQUEST_ID_HEADER, rid)
                 await resp.prepare(request)
                 # never buffer: relay chunks as they arrive (SSE-safe)
                 async for chunk in upstream.content.iter_any():
+                    if t_first is None:
+                        t_first = self.clock()
+                        trace.add_span("first_byte", t_head, t_first)
+                    relayed += len(chunk)
                     await resp.write(chunk)
                 await resp.write_eof()
+                trace.add_span("stream", t_first if t_first is not None
+                               else t_head, self.clock(), bytes=relayed,
+                               upstream_status=upstream.status)
                 return resp
         except (aiohttp.ClientError, TimeoutError, OSError) as e:
             active.breaker.record_failure()
+            trace.event("relay_error", error=str(e), bytes=relayed)
             if resp is None or not resp.prepared:
                 return web.json_response(
                     error_body(f"upstream error: {e}", "bad_gateway",
                                "upstream_error"),
-                    status=502,
+                    status=502, headers=self._rid_headers(rid),
                 )
             # Upstream died mid-stream: headers are already on the wire, so a
             # 502 can't be sent. Close the downstream connection so the client

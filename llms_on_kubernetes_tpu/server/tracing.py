@@ -1,0 +1,262 @@
+"""Dependency-free per-request tracing, structured logs, flight recorder.
+
+The reference stack could not answer "where did request X spend its 3
+seconds?": the gateway suppressed logs entirely and nothing correlated a
+router log line with an engine step (SURVEY §5). This module is the shared
+observability substrate for every serving layer:
+
+- **Request IDs**: ``X-LLMK-Request-Id`` is generated at the edge (either
+  router, or the API server itself for direct traffic) and propagated
+  through every hop, Dapper-style. Both routers and the API echo it on the
+  response so a client can quote the id when reporting a slow request.
+- **Traces**: a :class:`Trace` collects named :class:`Span` windows
+  (router receive/connect/first-byte/stream-done; API queue/prefill/
+  decode/stream) plus point events (preemption, deadline, stall). Completed
+  traces land in a :class:`TraceStore` ring served at ``GET /debug/traces``.
+- **Structured logs**: :func:`jlog` emits one-line JSON records (with the
+  request id on every line) instead of ad-hoc prints; requests slower than
+  ``LLMK_SLOW_REQUEST_MS`` get their full trace dumped automatically.
+- **Flight recorder**: a fixed-size ring of the last N engine decode steps
+  (:class:`FlightRecorder`), served at ``GET /debug/engine`` — enough to
+  diagnose a wedged or slow engine post-hoc without a profiler attached.
+
+Everything here is stdlib-only and lock-protected: spans are recorded from
+the engine thread, the asyncio event loop, and router worker tasks.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Optional
+
+REQUEST_ID_HEADER = "X-LLMK-Request-Id"
+
+# requests slower than this (ms, end to end) get their whole trace logged;
+# 0 disables the dump. Read per-call so tests can flip it cheaply.
+SLOW_REQUEST_ENV = "LLMK_SLOW_REQUEST_MS"
+SLOW_REQUEST_DEFAULT_MS = 10_000.0
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex
+
+
+def request_id_from(headers, generate: bool = True) -> tuple[str, bool]:
+    """(request id, was_generated) from a mapping with ``.get``.
+
+    The inbound header is forwarded verbatim when present (so an id minted
+    by an outer proxy survives the whole path); absent or blank means this
+    hop is the edge and mints one.
+    """
+    rid = headers.get(REQUEST_ID_HEADER) or headers.get(
+        REQUEST_ID_HEADER.lower())
+    if rid:
+        return rid, False
+    if not generate:
+        return "", False
+    return new_request_id(), True
+
+
+def slow_threshold_ms() -> float:
+    raw = os.environ.get(SLOW_REQUEST_ENV)
+    if raw is None:
+        return SLOW_REQUEST_DEFAULT_MS
+    try:
+        return float(raw)
+    except ValueError:
+        return SLOW_REQUEST_DEFAULT_MS
+
+
+class Span:
+    """One named time window inside a trace (monotonic-clock endpoints)."""
+
+    __slots__ = ("name", "start", "end", "meta")
+
+    def __init__(self, name: str, start: float, end: Optional[float] = None,
+                 meta: Optional[dict] = None):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.meta = meta
+
+    def duration_ms(self) -> Optional[float]:
+        if self.end is None:
+            return None
+        return max(0.0, (self.end - self.start) * 1000.0)
+
+
+class Trace:
+    """Spans + point events of one request's path through this process."""
+
+    def __init__(self, request_id: str, model: str = "",
+                 clock=time.monotonic):
+        self.request_id = request_id
+        self.model = model
+        self.clock = clock
+        self.started_wall = time.time()
+        self.t0 = clock()
+        self.finished_at: Optional[float] = None
+        self.status: Optional[str] = None
+        self._spans: list[Span] = []
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+
+    # -- recording (any thread) ----------------------------------------
+
+    def add_span(self, name: str, start: float, end: Optional[float] = None,
+                 **meta) -> None:
+        """Record a completed (or still-open) window on this trace's clock."""
+        with self._lock:
+            self._spans.append(Span(name, start, end, meta or None))
+
+    def event(self, name: str, **fields) -> None:
+        ev = {"name": name,
+              "t_ms": round((self.clock() - self.t0) * 1000.0, 3)}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+
+    def finish(self, status: str = "ok") -> None:
+        with self._lock:
+            if self.finished_at is None:
+                self.finished_at = self.clock()
+                self.status = status
+
+    # -- reading -------------------------------------------------------
+
+    def e2e_ms(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return max(0.0, (self.finished_at - self.t0) * 1000.0)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            spans = []
+            for s in self._spans:
+                d = {
+                    "name": s.name,
+                    "start_ms": round(max(0.0, (s.start - self.t0) * 1e3), 3),
+                    "duration_ms": (None if s.duration_ms() is None
+                                    else round(s.duration_ms(), 3)),
+                }
+                if s.meta:
+                    d.update(s.meta)
+                spans.append(d)
+            out = {
+                "id": self.request_id,
+                "model": self.model,
+                "started": round(self.started_wall, 3),
+                "status": self.status,
+                "e2e_ms": (None if self.finished_at is None
+                           else round((self.finished_at - self.t0) * 1e3, 3)),
+                "spans": spans,
+                "events": list(self._events),
+            }
+        return out
+
+
+class TraceStore:
+    """Ring of recently completed traces (``GET /debug/traces``)."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: "collections.deque[Trace]" = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+
+    def snapshot(self, request_id: Optional[str] = None,
+                 model: Optional[str] = None, limit: int = 50) -> list[dict]:
+        """Most-recent-first trace dicts, optionally filtered by id/model."""
+        with self._lock:
+            traces = list(self._ring)
+        out = []
+        for t in reversed(traces):
+            if request_id and t.request_id != request_id:
+                continue
+            if model and t.model != model:
+                continue
+            out.append(t.to_dict())
+            if len(out) >= max(1, limit):
+                break
+        return out
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-decode-step engine snapshots.
+
+    The engine loop records one entry per ``step()`` (step time, batch
+    occupancy, KV pages, admitted/shed/preempted counters, tokens emitted);
+    ``GET /debug/engine`` serves the ring so a wedged or slow engine can be
+    diagnosed after the fact without a profiler attached.
+    """
+
+    def __init__(self, capacity: int = 512):
+        self._ring: "collections.deque[dict]" = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            entry = {"step": self._seq, "ts": round(time.time(), 3)}
+            entry.update(fields)
+            self._ring.append(entry)
+
+    def snapshot(self, limit: Optional[int] = None) -> dict:
+        with self._lock:
+            steps = list(self._ring)
+            total = self._seq
+        if limit is not None and limit > 0:
+            steps = steps[-limit:]
+        return {"steps_recorded": total, "capacity": self._ring.maxlen,
+                "steps": steps}
+
+
+# ---------------------------------------------------------------------------
+# structured one-line-JSON logging
+# ---------------------------------------------------------------------------
+
+_log_lock = threading.Lock()
+
+
+def jlog(event: str, request_id: Optional[str] = None, stream=None,
+         **fields) -> None:
+    """One JSON object per line on stderr: machine-greppable, and every
+    line of a request's life carries its id. Never raises — logging must
+    not take down the serving path."""
+    rec: dict = {"ts": round(time.time(), 3), "event": event}
+    if request_id:
+        rec["request_id"] = request_id
+    rec.update(fields)
+    try:
+        line = json.dumps(rec, separators=(",", ":"), default=str)
+    except (TypeError, ValueError):
+        line = json.dumps({"ts": rec["ts"], "event": event,
+                           "error": "unserializable log record"})
+    out = stream if stream is not None else sys.stderr
+    with _log_lock:
+        try:
+            out.write(line + "\n")
+            out.flush()
+        except (OSError, ValueError):
+            pass
+
+
+def maybe_log_slow(trace: Trace, component: str) -> None:
+    """Dump the full trace of a request slower than the threshold."""
+    threshold = slow_threshold_ms()
+    e2e = trace.e2e_ms()
+    if threshold <= 0 or e2e is None or e2e < threshold:
+        return
+    jlog("slow_request", request_id=trace.request_id, component=component,
+         threshold_ms=threshold, trace=trace.to_dict())

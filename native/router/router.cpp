@@ -145,6 +145,60 @@ static std::atomic<long> g_failover_total{0};
 static std::atomic<long> g_unknown_model_fallback_total{0};
 static std::atomic<long> g_deadline_rejected_total{0};
 
+// Prometheus exposition escaping for label VALUES (backslash, double
+// quote, newline) — model names and replica URLs are operator input.
+static std::string prom_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Request IDs + structured access log (mirrors server/tracing.py)
+// ---------------------------------------------------------------------------
+
+// X-LLMK-Request-Id: forwarded verbatim when the client (or an outer
+// proxy) sent one; minted here otherwise, so every hop of a request's
+// life can be grepped by one id.
+static const char kRequestIdHeader[] = "X-LLMK-Request-Id";
+
+static std::string gen_request_id();
+
+static std::string request_id_from(const Request& req) {
+  const std::string* rid = req.headers.get("x-llmk-request-id");
+  if (rid && !rid->empty()) return *rid;
+  return gen_request_id();
+}
+
+// One-line JSON access record per proxied request: the native twin of the
+// python router's tracing.jlog("request", ...) line. Strings go through
+// the Json builder so ids/models containing quotes cannot break the line.
+static void jlog_request(const Config& cfg, const std::string& rid,
+                         const std::string& model, const std::string& replica,
+                         int status, double connect_ms, double ttfb_ms,
+                         double total_ms) {
+  if (cfg.quiet) return;
+  auto root = Json::make(Json::Type::Object);
+  root->set("ts", Json::of_number(static_cast<double>(time(nullptr))));
+  root->set("event", Json::of_string("request"));
+  root->set("request_id", Json::of_string(rid));
+  root->set("component", Json::of_string("native_router"));
+  root->set("model", Json::of_string(model));
+  root->set("replica", Json::of_string(replica));
+  root->set("status", Json::of_number(status));
+  root->set("connect_ms", Json::of_number(connect_ms));
+  root->set("ttfb_ms", Json::of_number(ttfb_ms));
+  root->set("total_ms", Json::of_number(total_ms));
+  std::lock_guard<std::mutex> lock(g_log_mu);
+  fprintf(stderr, "%s\n", root->dump().c_str());
+}
+
 // ---------------------------------------------------------------------------
 // Routing (the Lua access_by_lua_block equivalent)
 // ---------------------------------------------------------------------------
@@ -415,6 +469,15 @@ static unsigned pick_rand(unsigned bound) {
   return static_cast<unsigned>(rand_r(&g_pick_seed)) % bound;
 }
 
+// 32 lowercase hex chars, the same shape python's uuid4().hex gives the
+// python router — unique enough for log correlation, no entropy syscalls.
+static std::string gen_request_id() {
+  static const char hex[] = "0123456789abcdef";
+  std::string out(32, '0');
+  for (int i = 0; i < 32; ++i) out[i] = hex[pick_rand(16)];
+  return out;
+}
+
 // Picks the next replica to try: healthy (per the active prober) and not
 // breaker-blocked, preferring ones not already tried this request;
 // power-of-two-choices on in-flight count among the survivors. When the
@@ -542,8 +605,17 @@ static bool is_hop_by_hop(const std::string& name) {
 // Relays the upstream response body downstream with the upstream's own
 // framing, writing every chunk as soon as it is read (SSE-safe).
 // Returns true if the body completed per its framing (downstream may be
-// kept alive), false if the connection must close.
-static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) {
+// kept alive), false if the connection must close. `first_at`, when
+// given, is stamped once at the first relayed body byte (TTFB for the
+// access log).
+static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head,
+                       std::chrono::steady_clock::time_point* first_at =
+                           nullptr) {
+  auto mark = [&]() {
+    if (first_at &&
+        *first_at == std::chrono::steady_clock::time_point{})
+      *first_at = std::chrono::steady_clock::now();
+  };
   char buf[16 * 1024];
   const std::string* te = head.headers.get("transfer-encoding");
   if (te && lower(*te).find("chunked") != std::string::npos) {
@@ -553,6 +625,7 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
     while (true) {
       if (!r.read_line(line)) return false;
       std::string wire = line + "\r\n";
+      mark();
       if (!send_all(client_fd, wire)) return false;
       unsigned long sz = 0;
       try {
@@ -590,6 +663,7 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
     while (left > 0) {
       ssize_t n = up.read_some(buf, std::min(left, sizeof buf));
       if (n <= 0) return false;
+      mark();
       if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
       left -= static_cast<unsigned long>(n);
     }
@@ -600,6 +674,7 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
     ssize_t n = up.read_some(buf, sizeof buf);
     if (n < 0) return false;
     if (n == 0) return false;  // report "must close" — framing was EOF
+    mark();
     if (!send_all(client_fd, buf, static_cast<size_t>(n))) return false;
   }
 }
@@ -607,9 +682,16 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head) 
 // Proxies one request; returns true iff the client connection can be
 // reused for another request.
 static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
-                          const std::string& client_ip, const std::string& model) {
+                          const std::string& client_ip, const std::string& model,
+                          const std::string& rid) {
   const std::vector<Url>& replicas = *cfg.find(model);
   const auto t0 = std::chrono::steady_clock::now();
+  const std::string rid_header =
+      std::string(kRequestIdHeader) + ": " + rid + "\r\n";
+  auto ms_since = [](std::chrono::steady_clock::time_point a) {
+    return std::chrono::duration<double, std::milli>(
+               std::chrono::steady_clock::now() - a).count();
+  };
 
   // end-to-end deadline: the X-LLMK-Deadline-Ms header (ms of budget
   // remaining) wins over the body's OpenAI-style "timeout" seconds field;
@@ -639,8 +721,8 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                                   "timeout", "deadline_exceeded");
     send_all(client_fd,
              simple_response(504, "Gateway Timeout", "application/json", body,
-                             req.keep_alive));
-    logf(cfg, "-> 504 (deadline expired: %s)", model.c_str());
+                             req.keep_alive, rid_header));
+    jlog_request(cfg, rid, model, "", 504, 0.0, 0.0, ms_since(t0));
     return req.keep_alive;
   };
   if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
@@ -659,8 +741,10 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
         continue;
       if (n == "x-forwarded-for") continue;  // re-added with client appended
       if (n == "x-llmk-deadline-ms") continue;  // re-added decremented
+      if (n == "x-llmk-request-id") continue;  // re-added canonicalized
       out << kv.first << ": " << kv.second << "\r\n";
     }
+    out << kRequestIdHeader << ": " << rid << "\r\n";
     out << "X-Real-IP: " << client_ip << "\r\n";
     const std::string* fwd = req.headers.get("x-forwarded-for");
     out << "X-Forwarded-For: " << (fwd ? *fwd + ", " + client_ip : client_ip)
@@ -695,6 +779,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   const Url* prev = nullptr;
   std::vector<const Url*> tried;
   ReplicaHealth* health = nullptr;
+  std::chrono::steady_clock::time_point connected_at{};
   int max_attempts = std::max(1, cfg.retry_attempts);
   for (int attempt = 0; attempt < max_attempts; ++attempt) {
     if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
@@ -729,9 +814,11 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     up_fd = g_upstream_pool.acquire(target->host, target->port);
     if (up_fd >= 0) {
       pooled = true;
+      connected_at = std::chrono::steady_clock::now();
     } else {
       up_fd = connect_to(target->host, target->port, cfg.upstream_timeout_s,
                          cfg.connect_timeout_s);
+      if (up_fd >= 0) connected_at = std::chrono::steady_clock::now();
       if (up_fd < 0) {
         health->inflight.fetch_sub(1, std::memory_order_relaxed);
         breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
@@ -810,14 +897,18 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                simple_response(503, "Service Unavailable", "application/json",
                                body, req.keep_alive,
                                "Retry-After: " + std::to_string(ra_s) +
-                                   "\r\n"));
-      logf(cfg, "-> 503 (unroutable: %s)", model.c_str());
+                                   "\r\n" + rid_header));
+      jlog_request(cfg, rid, model, "", 503, ms_since(t0), 0.0, ms_since(t0));
       return req.keep_alive;
     }
     std::string body = error_json(fail_msg, "bad_gateway", "upstream_error");
     send_all(client_fd,
              simple_response(502, "Bad Gateway", "application/json", body,
-                             req.keep_alive));
+                             req.keep_alive, rid_header));
+    jlog_request(cfg, rid, model,
+                 target ? target->host + ":" + std::to_string(target->port)
+                        : "",
+                 502, ms_since(t0), 0.0, ms_since(t0));
     return req.keep_alive;
   }
 
@@ -825,6 +916,15 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   // (Transfer-Encoding/Content-Length) so the relayed body matches
   bool has_framing = head.headers.get("content-length") ||
                      head.headers.get("transfer-encoding");
+  // connect_ms: arrival -> upstream socket established (incl. failover
+  // attempts); head_ms: arrival -> response head received (the upstream's
+  // processing time for non-streaming responses)
+  double connect_ms =
+      connected_at == std::chrono::steady_clock::time_point{}
+          ? ms_since(t0)
+          : std::chrono::duration<double, std::milli>(connected_at - t0)
+                .count();
+  double head_ms = ms_since(t0);
   std::ostringstream rh;
   rh << head.status_line << "\r\n";
   for (const auto& kv : head.headers.items) {
@@ -832,6 +932,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     if (n == "connection" || n == "keep-alive") continue;
     rh << kv.first << ": " << kv.second << "\r\n";
   }
+  // echo the id even when the upstream is not LLMK-aware; an upstream
+  // that already answered with one (the API echoes) wins
+  if (!head.headers.get("x-llmk-request-id")) rh << rid_header;
   bool reusable = req.keep_alive && has_framing;
   rh << "Connection: " << (reusable ? "keep-alive" : "close") << "\r\n\r\n";
   if (!send_all(client_fd, rh.str())) {
@@ -840,10 +943,18 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     return false;
   }
 
+  std::chrono::steady_clock::time_point first_at{};
   bool body_done = (req.method == "HEAD" || head.status == 204 ||
                     head.status == 304)
                        ? true
-                       : relay_body(*up, client_fd, head);
+                       : relay_body(*up, client_fd, head, &first_at);
+  double ttfb_ms =
+      first_at == std::chrono::steady_clock::time_point{}
+          ? head_ms
+          : std::chrono::duration<double, std::milli>(first_at - t0).count();
+  jlog_request(cfg, rid, model,
+               target->host + ":" + std::to_string(target->port),
+               head.status, connect_ms, ttfb_ms, ms_since(t0));
   // pool the upstream socket when its framing completed and it allows it
   const std::string* up_conn = head.headers.get("connection");
   bool up_keep = head.status_line.compare(0, 8, "HTTP/1.1") == 0 &&
@@ -931,20 +1042,29 @@ static void handle_connection(const Config& cfg, int client_fd,
       logf(cfg, "GET /v1/models -> 200 (synthesized)");
     } else if (path == "/metrics" && req.method == "GET") {
       std::ostringstream m;
-      m << "# TYPE llm_failover_total counter\n"
+      m << "# HELP llm_failover_total Requests retried on a different "
+           "replica after a connect-phase failure\n"
+        << "# TYPE llm_failover_total counter\n"
         << "llm_failover_total "
         << g_failover_total.load(std::memory_order_relaxed) << "\n"
+        << "# HELP llm_router_unknown_model_fallback_total Requests naming "
+           "an unknown model that were routed to the default backend\n"
         << "# TYPE llm_router_unknown_model_fallback_total counter\n"
         << "llm_router_unknown_model_fallback_total "
         << g_unknown_model_fallback_total.load(std::memory_order_relaxed)
         << "\n"
+        << "# HELP llm_router_deadline_rejected_total Requests rejected at "
+           "the gateway with an already-expired deadline\n"
         << "# TYPE llm_router_deadline_rejected_total counter\n"
         << "llm_router_deadline_rejected_total "
         << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n"
+        << "# HELP llm_replica_healthy Active /ready probe verdict per "
+           "replica (1=routable)\n"
         << "# TYPE llm_replica_healthy gauge\n";
       for (const auto& kv : cfg.models)
         for (const Url& u : kv.second)
-          m << "llm_replica_healthy{model=\"" << kv.first << "\",replica=\""
+          m << "llm_replica_healthy{model=\"" << prom_escape(kv.first)
+            << "\",replica=\""
             << "http://" << u.host << ":" << u.port << "\"} "
             << (g_health.get(u.host, u.port)
                         .healthy.load(std::memory_order_relaxed)
@@ -960,19 +1080,19 @@ static void handle_connection(const Config& cfg, int client_fd,
     } else {
       bool not_found = false;
       std::string model = select_backend(cfg, req.body, &not_found);
+      std::string rid = request_id_from(req);
       if (not_found) {
         std::string body = error_json("model not found", "invalid_request_error",
                                       "model_not_found");
         keep = send_all(client_fd,
                         simple_response(404, "Not Found", "application/json",
-                                        body, req.keep_alive)) &&
+                                        body, req.keep_alive,
+                                        std::string(kRequestIdHeader) + ": " +
+                                            rid + "\r\n")) &&
                req.keep_alive;
-        logf(cfg, "%s %s -> 404 (unknown model)", req.method.c_str(),
-             req.target.c_str());
+        jlog_request(cfg, rid, model, "", 404, 0.0, 0.0, 0.0);
       } else {
-        keep = proxy_request(cfg, req, client_fd, client_ip, model);
-        logf(cfg, "%s %s -> %s", req.method.c_str(), req.target.c_str(),
-             model.c_str());
+        keep = proxy_request(cfg, req, client_fd, client_ip, model, rid);
       }
     }
     if (!keep) break;

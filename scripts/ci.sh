@@ -57,11 +57,28 @@ fi
 # llkt-router when the toolchain exists (it falls back to the Python
 # router — with a warning — when it doesn't)
 note "bench smoke (CPU end-to-end: engine + gateway + JSON contract)"
-if smoke_out="$(JAX_PLATFORMS=cpu "$PY" "$REPO/bench.py" --smoke)" \
+# the smoke's gateway phase dumps both /metrics scrape targets (API
+# server + gateway) here for the exposition-format lint gate below
+metrics_dump="$(mktemp -d)"
+trap 'rm -rf "$metrics_dump"' EXIT
+if smoke_out="$(JAX_PLATFORMS=cpu LLMK_METRICS_DUMP="$metrics_dump" \
+      "$PY" "$REPO/bench.py" --smoke)" \
     && printf '%s\n' "$smoke_out" | tail -n 1 \
        | "$PY" -c 'import json, sys; json.loads(sys.stdin.readline())'; then
   printf '%s\n' "$smoke_out" | tail -n 1
   echo "ci: bench smoke OK"
+
+  note "metrics lint (Prometheus exposition format on scraped /metrics)"
+  if [ -s "$metrics_dump/api_metrics.txt" ] \
+      && [ -s "$metrics_dump/gateway_metrics.txt" ] \
+      && "$PY" "$REPO/scripts/metrics_lint.py" \
+           "$metrics_dump/api_metrics.txt" \
+           "$metrics_dump/gateway_metrics.txt"; then
+    echo "ci: metrics lint OK"
+  else
+    echo "ci: metrics lint FAILED"
+    fails=$((fails + 1))
+  fi
 else
   echo "ci: bench smoke FAILED"
   fails=$((fails + 1))

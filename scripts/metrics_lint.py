@@ -1,0 +1,186 @@
+#!/usr/bin/env python3
+"""Prometheus exposition-format linter for the CI smoke gate.
+
+Validates scraped /metrics text (files passed as argv) against the parts
+of the text exposition format that have actually bitten this repo:
+
+- every sample's metric family declares both ``# HELP`` and ``# TYPE``
+  before its first sample (a family that renders samples without them is
+  invisible to scrapers that enforce the format);
+- the ``# TYPE`` value is one of the known kinds;
+- label blocks are well-formed: ``name="value"`` pairs, values quoted,
+  escapes limited to ``\\\\``, ``\\"`` and ``\\n`` (a raw quote or stray
+  backslash in a model name makes the whole scrape unparseable);
+- no duplicate series (same name + same label set twice);
+- sample values parse as floats (inf/NaN included).
+
+stdlib-only by design — it runs inside scripts/ci.sh on machines with no
+prometheus tooling installed. Exit 0 when every file is clean; exit 1
+with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+
+# histogram/summary samples whose family is declared under the base name
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_labels(s: str) -> tuple[list, str]:
+    """Parse a ``{k="v",...}`` block at the start of ``s``.
+
+    Returns (pairs, rest-after-the-block); raises ValueError with a lint
+    message on malformed syntax, bad quoting, or invalid escapes.
+    """
+    assert s[0] == "{"
+    pos = 1
+    pairs = []
+    if s[pos:pos + 1] == "}":  # empty label set: legal
+        return pairs, s[2:]
+    while True:
+        m = _LABEL_NAME_RE.match(s, pos)
+        if not m:
+            raise ValueError(f"bad label name at {s[pos:pos + 20]!r}")
+        name = m.group(0)
+        pos = m.end()
+        if s[pos:pos + 2] != '="':
+            raise ValueError(f'label {name!r} value not quoted '
+                             f'(at {s[pos:pos + 20]!r})')
+        pos += 2
+        value = []
+        while True:
+            if pos >= len(s):
+                raise ValueError(f"unterminated label value for {name!r}")
+            c = s[pos]
+            if c == "\\":
+                esc = s[pos:pos + 2]
+                if esc not in ('\\\\', '\\"', "\\n"):
+                    raise ValueError(
+                        f"invalid escape {esc!r} in label {name!r}")
+                value.append(esc)
+                pos += 2
+                continue
+            if c == '"':
+                pos += 1
+                break
+            if c == "\n":
+                raise ValueError(f"raw newline in label {name!r}")
+            value.append(c)
+            pos += 1
+        pairs.append((name, "".join(value)))
+        if s[pos:pos + 1] == ",":
+            pos += 1
+            continue
+        if s[pos:pos + 1] == "}":
+            return pairs, s[pos + 1:]
+        raise ValueError(f"expected ',' or '}}' after label {name!r} "
+                         f"(at {s[pos:pos + 20]!r})")
+
+
+def family_of(sample_name: str, declared: dict) -> str:
+    """Map a sample name to its declared family: histogram/summary series
+    suffixes fold into the base name when the base carries the TYPE."""
+    if sample_name in declared:
+        return sample_name
+    for suffix in _SERIES_SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if declared.get(base) in ("histogram", "summary"):
+                return base
+    return sample_name
+
+
+def lint(text: str, where: str) -> list[str]:
+    problems: list[str] = []
+    helped: set = set()
+    typed: dict = {}
+    seen_series: set = set()
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        loc = f"{where}:{lineno}"
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "HELP":
+                helped.add(parts[2])
+            elif len(parts) >= 3 and parts[1] == "TYPE":
+                kind = parts[3].strip() if len(parts) > 3 else ""
+                if kind not in VALID_TYPES:
+                    problems.append(f"{loc}: TYPE {parts[2]} is {kind!r}, "
+                                    f"not one of {sorted(VALID_TYPES)}")
+                if parts[2] in typed:
+                    problems.append(f"{loc}: duplicate TYPE for {parts[2]}")
+                typed[parts[2]] = kind
+            # other comments are legal and ignored
+            continue
+        m = _NAME_RE.match(line)
+        if not m:
+            problems.append(f"{loc}: unparseable sample line {line[:40]!r}")
+            continue
+        name = m.group(0)
+        rest = line[m.end():]
+        labels: list = []
+        if rest.startswith("{"):
+            try:
+                labels, rest = parse_labels(rest)
+            except ValueError as e:
+                problems.append(f"{loc}: {e}")
+                continue
+        fields = rest.split()
+        if not fields:
+            problems.append(f"{loc}: sample {name} has no value")
+            continue
+        try:
+            float(fields[0].replace("+Inf", "inf").replace("-Inf", "-inf"))
+        except ValueError:
+            problems.append(f"{loc}: sample {name} value {fields[0]!r} "
+                            f"is not a number")
+        family = family_of(name, typed)
+        if family not in typed:
+            problems.append(f"{loc}: sample {name} has no # TYPE")
+        if family not in helped:
+            problems.append(f"{loc}: sample {name} has no # HELP")
+        series = (name, tuple(sorted(labels)))
+        if series in seen_series:
+            problems.append(f"{loc}: duplicate series {name}"
+                            f"{dict(labels) if labels else ''}")
+        seen_series.add(series)
+
+    if not seen_series and not problems:
+        problems.append(f"{where}: no samples at all (empty scrape?)")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print("usage: metrics_lint.py FILE [FILE...]", file=sys.stderr)
+        return 2
+    failures = 0
+    for path in argv:
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"metrics-lint: cannot read {path}: {e}")
+            failures += 1
+            continue
+        problems = lint(text, path)
+        for p in problems:
+            print(f"metrics-lint: {p}")
+        if problems:
+            failures += 1
+        else:
+            print(f"metrics-lint: {path} OK")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

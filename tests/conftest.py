@@ -34,7 +34,7 @@ import pytest
 # Everything keeps working unmarked; tiers are additive selection aids.
 _UNIT_MODULES = {
     "test_faults", "test_grammar", "test_helm_golden", "test_hub",
-    "test_manifests", "test_router", "test_tools",
+    "test_manifests", "test_router", "test_tools", "test_tracing",
 }
 _E2E_MODULES = {
     "test_bench", "test_cold_start", "test_entrypoints", "test_kind_e2e",

@@ -95,6 +95,7 @@ class FakeBackend(http.server.BaseHTTPRequestHandler):
             "x_real_ip": self.headers.get("X-Real-IP", ""),
             "x_fwd": self.headers.get("X-Forwarded-For", ""),
             "deadline_ms": self.headers.get("X-LLMK-Deadline-Ms", ""),
+            "rid": self.headers.get("X-LLMK-Request-Id", ""),
         }).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -368,6 +369,81 @@ def test_upstream_down_returns_502(binary):
         assert json.loads(data)["error"]["type"] == "bad_gateway"
     finally:
         router.stop()
+
+
+def _request_with_headers(port, method, path, body=None, headers=None):
+    """Like RouterProc.request but also returns the response headers."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    payload = json.dumps(body).encode() if body is not None else None
+    hdrs = dict(headers or {})
+    if payload is not None:
+        hdrs.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    resp_headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, resp_headers
+
+
+def test_native_request_id_generated_forwarded_and_echoed(stack):
+    # absent: the router mints a 32-hex id, forwards it upstream (the
+    # backend echoes it in the JSON body) and adds it to the response head
+    status, data, rh = _request_with_headers(
+        stack.port, "POST", "/v1/chat/completions", {"model": "modelA"})
+    assert status == 200
+    rid = rh.get("X-LLMK-Request-Id")
+    assert rid and len(rid) == 32 and all(c in "0123456789abcdef" for c in rid)
+    assert json.loads(data)["rid"] == rid
+
+    # present: forwarded VERBATIM, echoed verbatim
+    status, data, rh = _request_with_headers(
+        stack.port, "POST", "/v1/chat/completions", {"model": "modelA"},
+        headers={"X-LLMK-Request-Id": "outer-proxy-9"})
+    assert status == 200
+    assert rh.get("X-LLMK-Request-Id") == "outer-proxy-9"
+    assert json.loads(data)["rid"] == "outer-proxy-9"
+
+
+def test_native_request_id_on_router_generated_errors(binary):
+    backend = start_backend("modelA")
+    router = RouterProc(binary, {"modelA": backend.server_address[1]},
+                        strict=True)
+    dead = RouterProc(binary, {"dead": free_port()})
+    try:
+        # strict 404 is router-local and still carries the id
+        status, _, rh = _request_with_headers(
+            router.port, "POST", "/v1/chat/completions", {"model": "nope"},
+            headers={"X-LLMK-Request-Id": "err-id"})
+        assert status == 404
+        assert rh.get("X-LLMK-Request-Id") == "err-id"
+        # dead upstream 502 mints one when the client sent none
+        status, _, rh = _request_with_headers(
+            dead.port, "POST", "/v1/chat/completions", {"model": "dead"})
+        assert status == 502
+        assert rh.get("X-LLMK-Request-Id")
+        # expired deadline 504 echoes the client's id
+        status, _, rh = _request_with_headers(
+            router.port, "POST", "/v1/chat/completions", {"model": "modelA"},
+            headers={"X-LLMK-Request-Id": "dl-id",
+                     "X-LLMK-Deadline-Ms": "0"})
+        assert status == 504
+        assert rh.get("X-LLMK-Request-Id") == "dl-id"
+    finally:
+        router.stop()
+        dead.stop()
+        backend.shutdown()
+
+
+def test_native_metrics_exposition_has_help_and_type(stack):
+    status, data = stack.request("GET", "/metrics")
+    assert status == 200
+    text = data.decode()
+    for family in ("llm_failover_total", "llm_router_deadline_rejected_total",
+                   "llm_router_unknown_model_fallback_total",
+                   "llm_replica_healthy"):
+        assert f"# HELP {family} " in text, family
+        assert f"# TYPE {family} " in text, family
 
 
 def test_strict_mode_404s_unknown_model(binary):

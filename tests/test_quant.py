@@ -58,14 +58,49 @@ def _greedy(model, quantization):
 
 
 def test_quantized_engine_tracks_dense():
-    """Greedy decode from int8 weights stays close to the fp32 engine: same
-    model, same seed — most tokens should agree (int8 rounding can flip
-    near-ties, so exact match is not required)."""
+    """Int8 weights track the fp32 model wherever fp32 has a decisive
+    preference.
+
+    The old form of this test compared two *autoregressive* greedy streams
+    and demanded >=4/8 token agreement — brittle by construction: debug-tiny
+    is random-weights, so near-ties abound, and the first near-tie flip
+    feeds a different context to every later step (observed failing 3/8 at
+    seed HEAD with the flip at a 0.007-nat margin). Teacher-forcing both
+    models on the SAME token sequence removes the cascade: int8 must agree
+    with fp32's argmax at every position where fp32's top-1/top-2 logprob
+    margin is decisive, and the next-token logprobs must stay close
+    everywhere.
+    """
+    from llms_on_kubernetes_tpu.models.decoder import forward_score
+
+    # the engine-level int8 path still runs end-to-end
     dense = _greedy("debug-tiny", None)
     quant = _greedy("debug-tiny", "int8")
     assert len(dense) == len(quant) == 8
-    agree = sum(d == q for d, q in zip(dense, quant))
-    assert agree >= 4, f"int8 diverged from fp32: {dense} vs {quant}"
+
+    cfg = get_config("debug-tiny")
+    params = init_params(cfg, jax.random.key(0), dtype="float32")
+    qparams = quantize_params(params)
+    seq = [1, 2, 3, 4, 5] + dense
+    tokens = jnp.asarray([seq], jnp.int32)
+    lengths = jnp.asarray([len(seq)], jnp.int32)
+    d_lp, d_ids, d_top = forward_score(params, cfg, tokens, lengths, top_k=2)
+    q_lp, q_ids, _ = forward_score(qparams, cfg, tokens, lengths, top_k=2)
+
+    # int8 rounding can flip genuine near-ties; 0.05 nats is far above the
+    # observed int8 perturbation (~0.005) and far below typical margins
+    margin = np.asarray(d_top[0, :, 0] - d_top[0, :, 1])
+    decisive = margin > 0.05
+    agree = np.asarray(d_ids[0, :, 0] == q_ids[0, :, 0])
+    positions = range(4, len(seq) - 1)  # predictions for generated tokens
+    for t in positions:
+        if decisive[t]:
+            assert agree[t], (
+                f"int8 flipped a decisive (margin {margin[t]:.3f}) argmax "
+                f"at position {t}: {d_ids[0, t, 0]} -> {q_ids[0, t, 0]}")
+    assert sum(decisive[t] for t in positions) >= 4  # test has teeth
+    # teacher-forced next-token logprobs stay close everywhere
+    np.testing.assert_allclose(np.asarray(q_lp), np.asarray(d_lp), atol=0.1)
 
 
 def test_quantized_moe_engine_runs():

@@ -28,6 +28,7 @@ def make_backend(name: str) -> web.Application:
             "x_real_ip": request.headers.get("X-Real-IP", ""),
             "x_fwd": request.headers.get("X-Forwarded-For", ""),
             "deadline_hdr": request.headers.get("X-LLMK-Deadline-Ms", ""),
+            "rid": request.headers.get("X-LLMK-Request-Id", ""),
         })
 
     async def stream(request: web.Request) -> web.StreamResponse:
@@ -320,6 +321,67 @@ def test_upstream_down_returns_502():
             assert r.status == 502
             err = await r.json()
             assert err["error"]["type"] == "bad_gateway"
+            # router-generated errors still carry a request id
+            assert r.headers.get("X-LLMK-Request-Id")
         finally:
             await client.close()
+    asyncio.run(go())
+
+
+def test_request_id_generated_forwarded_and_echoed():
+    async def body(client):
+        # absent: the router mints one, forwards it upstream, echoes it back
+        r = await client.post("/v1/chat/completions", json={"model": "modelA"})
+        rid = r.headers.get("X-LLMK-Request-Id")
+        assert rid and len(rid) == 32
+        assert (await r.json())["rid"] == rid
+        # present: forwarded VERBATIM and echoed verbatim
+        r = await client.post("/v1/chat/completions", json={"model": "modelA"},
+                              headers={"X-LLMK-Request-Id": "outer-proxy-7"})
+        assert r.headers["X-LLMK-Request-Id"] == "outer-proxy-7"
+        assert (await r.json())["rid"] == "outer-proxy-7"
+    run_with_router(body)
+
+
+def test_request_id_on_router_generated_errors():
+    async def body(client):
+        # strict 404 (router-local response) still echoes the id
+        r = await client.post("/v1/chat/completions", json={"model": "nope"},
+                              headers={"X-LLMK-Request-Id": "err-id"})
+        assert r.status == 404
+        assert r.headers["X-LLMK-Request-Id"] == "err-id"
+        # expired-deadline 504 too
+        r = await client.post("/v1/chat/completions", json={"model": "modelA"},
+                              headers={"X-LLMK-Request-Id": "dl-id",
+                                       "X-LLMK-Deadline-Ms": "0"})
+        assert r.status == 504
+        assert r.headers["X-LLMK-Request-Id"] == "dl-id"
+    run_with_router(body, strict=True)
+
+
+def test_router_trace_ring_records_spans():
+    async def go():
+        b1 = TestClient(TestServer(make_backend("live")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")})
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={"model": "m"},
+                                  headers={"X-LLMK-Request-Id": "traced-1"})
+            assert r.status == 200
+            r = await client.get("/debug/traces", params={"id": "traced-1"})
+            traces = (await r.json())["traces"]
+            assert len(traces) == 1
+            t = traces[0]
+            assert t["id"] == "traced-1" and t["model"] == "m"
+            assert t["status"] == "ok" and t["e2e_ms"] >= 0
+            names = [s["name"] for s in t["spans"]]
+            for expected in ("receive", "connect", "stream"):
+                assert expected in names, names
+            for s in t["spans"]:
+                assert s["duration_ms"] is None or s["duration_ms"] >= 0
+        finally:
+            await client.close()
+            await b1.close()
     asyncio.run(go())

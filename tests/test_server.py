@@ -145,7 +145,9 @@ def test_metrics_endpoint_counts():
             await asyncio.sleep(0.02)
         assert "llm_requests_total 1.0" in text
         assert "llm_tokens_generated_total 3.0" in text
-        assert "llm_ttft_seconds_count 1" in text
+        # TTFT and e2e histograms carry a per-model label now
+        assert 'llm_ttft_seconds_count{model="debug-tiny"} 1' in text
+        assert 'llm_e2e_latency_seconds_count{model="debug-tiny"} 1' in text
     with_client(body)
 
 
@@ -352,4 +354,73 @@ def test_completions_n_choices_and_usage():
         # unique prompt counted ONCE in usage even with n=2
         assert data["usage"]["prompt_tokens"] == 3
         assert data["usage"]["completion_tokens"] <= 8
+    with_client(body)
+
+
+def test_request_id_echo_and_trace_spans():
+    """PR4 acceptance path: every response carries X-LLMK-Request-Id
+    (minted when absent, forwarded verbatim when present) and
+    /debug/traces?id= returns the per-phase spans whose durations are
+    non-negative and sum to no more than the measured e2e latency."""
+    import time
+
+    async def body(client):
+        # minted id
+        r = await client.post("/v1/completions", json={
+            "prompt": "abc", "max_tokens": 3, "temperature": 0})
+        assert r.status == 200
+        minted = r.headers.get("X-LLMK-Request-Id")
+        assert minted and len(minted) == 32
+
+        # forwarded verbatim + traced
+        t0 = time.monotonic()
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "abc", "max_tokens": 4, "temperature": 0},
+            headers={"X-LLMK-Request-Id": "trace-me-7"})
+        assert r.status == 200
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        assert r.headers["X-LLMK-Request-Id"] == "trace-me-7"
+
+        r = await client.get("/debug/traces", params={"id": "trace-me-7"})
+        traces = (await r.json())["traces"]
+        assert len(traces) == 1
+        tr = traces[0]
+        assert tr["id"] == "trace-me-7"
+        assert tr["model"] == "debug-tiny"
+        assert tr["status"] == "ok"
+        spans = {s["name"]: s for s in tr["spans"]}
+        for phase in ("queue", "prefill", "decode"):
+            assert phase in spans, f"missing {phase} span: {sorted(spans)}"
+        durations = [s["duration_ms"] for s in tr["spans"]
+                     if s["duration_ms"] is not None]
+        assert all(d >= 0.0 for d in durations)
+        # spans are disjoint phases of one request, so their total can
+        # never exceed the client-observed wall time
+        assert sum(durations) <= wall_ms
+        assert 0.0 <= tr["e2e_ms"] <= wall_ms
+
+        # error responses carry an id too
+        r = await client.post("/v1/chat/completions", data=b"{not json")
+        assert r.status == 400
+        assert r.headers.get("X-LLMK-Request-Id")
+    with_client(body)
+
+
+def test_debug_engine_flight_recorder():
+    async def body(client):
+        await client.post("/v1/completions", json={
+            "prompt": "abc", "max_tokens": 3, "temperature": 0})
+        r = await client.get("/debug/engine")
+        assert r.status == 200
+        snap = await r.json()
+        assert snap["model"] == "debug-tiny"
+        assert snap["state"] in ("loading", "serving", "draining")
+        assert snap["steps_recorded"] >= 1
+        assert len(snap["steps"]) >= 1
+        step = snap["steps"][-1]
+        assert step["step"] == snap["steps_recorded"]
+        # limit trims the window
+        r = await client.get("/debug/engine", params={"limit": 1})
+        assert len((await r.json())["steps"]) == 1
     with_client(body)

@@ -1,0 +1,271 @@
+"""Unit tests for the observability substrate (PR 4).
+
+Covers the pieces the serving-path tests only exercise incidentally:
+
+- Prometheus label-value escaping and labeled-series rendering in
+  server/metrics.py (model names and replica URLs are operator input —
+  a raw quote must not produce an unparseable exposition);
+- Histogram.percentile edge cases (empty, single bucket, +Inf overflow)
+  and labeled-histogram rendering (``le`` merged after the series labels,
+  ``_sum``/``_count`` suffixed per child);
+- server/tracing.py primitives: request-id extraction, Span/Trace
+  clamping, TraceStore filtering, FlightRecorder ring, jlog output shape,
+  and the slow-request dump threshold;
+- scripts/metrics_lint.py itself: clean input passes, each violation
+  class is caught (the linter gates CI — a linter that passes garbage is
+  worse than none).
+"""
+
+import io
+import json
+import sys
+from pathlib import Path
+
+from llms_on_kubernetes_tpu.server import tracing
+from llms_on_kubernetes_tpu.server.metrics import (
+    Counter, Gauge, Histogram, Registry, escape_label_value,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+import metrics_lint  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# metrics: escaping + labeled rendering
+# ---------------------------------------------------------------------------
+
+def test_escape_label_value():
+    assert escape_label_value('pla"in') == 'pla\\"in'
+    assert escape_label_value("back\\slash") == "back\\\\slash"
+    assert escape_label_value("new\nline") == "new\\nline"
+    # order matters: the backslash introduced by quote-escaping must not
+    # itself get re-escaped
+    assert escape_label_value('\\"') == '\\\\\\"'
+
+
+def test_labeled_counter_render_escapes_values():
+    reg = Registry()
+    c = Counter("llm_test_total", "help", reg, label_names=("model",))
+    c.labels(model='we"ird\\name').inc()
+    text = reg.render()
+    assert 'llm_test_total{model="we\\"ird\\\\name"} 1.0' in text
+    # the rendered exposition must survive the repo's own linter
+    assert metrics_lint.lint(text, "inline") == []
+
+
+def test_labeled_gauge_children_are_independent():
+    reg = Registry()
+    g = Gauge("llm_g", "help", reg, label_names=("model", "replica"))
+    g.labels(model="a", replica="r1").set(1)
+    g.labels(model="a", replica="r2").set(0)
+    assert g.labeled_value(model="a", replica="r1") == 1
+    assert g.labeled_value(model="a", replica="r2") == 0
+    assert g.labeled_value(model="b", replica="r1") is None
+
+
+def test_histogram_percentile_empty_returns_none():
+    reg = Registry()
+    h = Histogram("llm_h", "help", (0.1, 1.0), reg)
+    assert h.percentile(0.5) is None
+
+
+def test_histogram_percentile_single_bucket():
+    reg = Registry()
+    h = Histogram("llm_h", "help", (0.5,), reg)
+    h.observe(0.2)
+    # every quantile answers the only bucket's upper bound
+    assert h.percentile(0.01) == 0.5
+    assert h.percentile(0.99) == 0.5
+
+
+def test_histogram_percentile_overflow_is_inf():
+    reg = Registry()
+    h = Histogram("llm_h", "help", (0.1, 1.0), reg)
+    h.observe(0.05)
+    h.observe(50.0)   # beyond the last bucket: +Inf overflow bucket
+    assert h.percentile(0.25) == 0.1
+    assert h.percentile(0.99) == float("inf")
+
+
+def test_labeled_histogram_renders_per_child_with_le_merged():
+    reg = Registry()
+    h = Histogram("llm_h", "help", (0.1, 1.0), reg, label_names=("model",))
+    h.labels(model="m1").observe(0.05)
+    h.labels(model="m1").observe(5.0)
+    h.labels(model="m2").observe(0.5)
+    text = reg.render()
+    assert 'llm_h_bucket{model="m1",le="0.1"} 1' in text
+    assert 'llm_h_bucket{model="m1",le="+Inf"} 2' in text
+    assert 'llm_h_sum{model="m1"} 5.05' in text
+    assert 'llm_h_count{model="m1"} 2' in text
+    assert 'llm_h_count{model="m2"} 1' in text
+    assert metrics_lint.lint(text, "inline") == []
+    # labeled children keep independent percentile state
+    assert h.labels(model="m2").percentile(0.5) == 1.0
+
+
+def test_unlabeled_histogram_renders_scalar_series():
+    reg = Registry()
+    h = Histogram("llm_h", "help", (0.1,), reg)
+    h.observe(0.05)
+    text = reg.render()
+    assert 'llm_h_bucket{le="0.1"} 1' in text
+    assert "llm_h_sum 0.05" in text
+    assert "llm_h_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# tracing primitives
+# ---------------------------------------------------------------------------
+
+def test_request_id_forwarded_verbatim_or_generated():
+    rid, generated = tracing.request_id_from({"X-LLMK-Request-Id": "abc"})
+    assert (rid, generated) == ("abc", False)
+    rid, generated = tracing.request_id_from({"x-llmk-request-id": "low"})
+    assert (rid, generated) == ("low", False)
+    rid, generated = tracing.request_id_from({})
+    assert generated and len(rid) == 32
+    rid, generated = tracing.request_id_from({}, generate=False)
+    assert (rid, generated) == ("", False)
+
+
+def test_trace_spans_events_and_clamping():
+    clock_now = [100.0]
+    t = tracing.Trace("rid-1", model="m", clock=lambda: clock_now[0])
+    t.add_span("queue", 100.0, 100.5, note="x")
+    t.add_span("weird", 100.9, 100.2)   # end < start: clamped, not negative
+    t.add_span("open", 101.0, None)     # still-open span: duration None
+    clock_now[0] = 102.0
+    t.event("preempted", tokens=3)
+    t.finish("ok")
+    t.finish("error")  # idempotent: first status wins
+    d = t.to_dict()
+    assert d["id"] == "rid-1" and d["model"] == "m" and d["status"] == "ok"
+    assert d["e2e_ms"] == 2000.0
+    by_name = {s["name"]: s for s in d["spans"]}
+    assert by_name["queue"]["duration_ms"] == 500.0
+    assert by_name["queue"]["note"] == "x"
+    assert by_name["weird"]["duration_ms"] == 0.0
+    assert by_name["open"]["duration_ms"] is None
+    assert d["events"][0]["name"] == "preempted"
+    assert d["events"][0]["t_ms"] == 2000.0
+
+
+def test_trace_store_ring_filter_and_limit():
+    store = tracing.TraceStore(capacity=3)
+    for i in range(5):
+        t = tracing.Trace(f"id-{i}", model="m-even" if i % 2 == 0 else "m-odd")
+        t.finish()
+        store.add(t)
+    snap = store.snapshot()
+    # ring keeps the 3 most recent, most-recent-first
+    assert [t["id"] for t in snap] == ["id-4", "id-3", "id-2"]
+    assert [t["id"] for t in store.snapshot(request_id="id-3")] == ["id-3"]
+    assert [t["id"] for t in store.snapshot(model="m-even")] == ["id-4", "id-2"]
+    assert len(store.snapshot(limit=1)) == 1
+
+
+def test_flight_recorder_ring():
+    fr = tracing.FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record(step_ms=float(i), occupancy=i % 3)
+    snap = fr.snapshot()
+    assert snap["steps_recorded"] == 10
+    assert snap["capacity"] == 4
+    assert [s["step"] for s in snap["steps"]] == [7, 8, 9, 10]
+    assert len(fr.snapshot(limit=2)["steps"]) == 2
+
+
+def test_jlog_emits_one_json_line():
+    buf = io.StringIO()
+    tracing.jlog("test_event", request_id="rid-9", stream=buf, n=3,
+                 why='quo"te')
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["event"] == "test_event"
+    assert rec["request_id"] == "rid-9"
+    assert rec["n"] == 3 and rec["why"] == 'quo"te'
+
+
+def test_slow_request_threshold(monkeypatch):
+    clock_now = [0.0]
+    t = tracing.Trace("slow-1", clock=lambda: clock_now[0])
+    clock_now[0] = 1.0   # 1000 ms e2e
+    t.finish()
+    monkeypatch.setenv(tracing.SLOW_REQUEST_ENV, "500")
+    assert tracing.slow_threshold_ms() == 500.0
+    # below threshold: no dump
+    monkeypatch.setenv(tracing.SLOW_REQUEST_ENV, "5000")
+    err = io.StringIO()
+    monkeypatch.setattr(sys, "stderr", err)
+    tracing.maybe_log_slow(t, "api")
+    assert err.getvalue() == ""
+    # above: full trace dumped as one JSON line
+    monkeypatch.setenv(tracing.SLOW_REQUEST_ENV, "500")
+    tracing.maybe_log_slow(t, "api")
+    rec = json.loads(err.getvalue().splitlines()[0])
+    assert rec["event"] == "slow_request"
+    assert rec["trace"]["id"] == "slow-1"
+    # 0 disables
+    monkeypatch.setenv(tracing.SLOW_REQUEST_ENV, "0")
+    err.truncate(0)
+    err.seek(0)
+    tracing.maybe_log_slow(t, "api")
+    assert err.getvalue() == ""
+
+
+# ---------------------------------------------------------------------------
+# the metrics linter itself
+# ---------------------------------------------------------------------------
+
+CLEAN = """\
+# HELP llm_x_total things
+# TYPE llm_x_total counter
+llm_x_total 3
+# HELP llm_h stuff
+# TYPE llm_h histogram
+llm_h_bucket{model="m",le="0.1"} 1
+llm_h_bucket{model="m",le="+Inf"} 2
+llm_h_sum{model="m"} 5.0
+llm_h_count{model="m"} 2
+"""
+
+
+def test_lint_accepts_clean_exposition():
+    assert metrics_lint.lint(CLEAN, "t") == []
+
+
+def test_lint_catches_missing_help_and_type():
+    problems = metrics_lint.lint("llm_orphan 1\n", "t")
+    assert any("no # TYPE" in p for p in problems)
+    assert any("no # HELP" in p for p in problems)
+
+
+def test_lint_catches_duplicate_series():
+    text = ("# HELP llm_d d\n# TYPE llm_d gauge\n"
+            'llm_d{a="1"} 1\nllm_d{a="1"} 2\n')
+    assert any("duplicate series" in p for p in metrics_lint.lint(text, "t"))
+
+
+def test_lint_catches_bad_label_quoting():
+    text = ("# HELP llm_q q\n# TYPE llm_q gauge\n"
+            "llm_q{model=unquoted} 1\n")
+    assert any("not quoted" in p for p in metrics_lint.lint(text, "t"))
+
+
+def test_lint_catches_invalid_escape_and_raw_newline():
+    text = ('# HELP llm_e e\n# TYPE llm_e gauge\n'
+            'llm_e{model="a\\q"} 1\n')
+    assert any("invalid escape" in p for p in metrics_lint.lint(text, "t"))
+
+
+def test_lint_catches_non_numeric_value_and_bad_type():
+    text = ("# HELP llm_v v\n# TYPE llm_v thermometer\nllm_v NaNope\n")
+    problems = metrics_lint.lint(text, "t")
+    assert any("not one of" in p for p in problems)
+    assert any("is not a number" in p for p in problems)
+
+
+def test_lint_flags_empty_scrape():
+    assert metrics_lint.lint("", "t") == ["t: no samples at all (empty scrape?)"]
