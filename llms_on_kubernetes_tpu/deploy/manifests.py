@@ -594,7 +594,17 @@ def render_manifests(spec: DeploySpec) -> list[Manifest]:
     out += render_router(spec)
     out += render_istio(spec)
     out += render_webui(spec)
+    out += render_monitoring_manifests(spec)
     return out
+
+
+def render_monitoring_manifests(spec: DeploySpec) -> list[Manifest]:
+    """Alert-rules + Grafana-dashboard ConfigMaps (deploy.monitoring is
+    the source of truth; deferred import keeps this module importable
+    without pulling the dashboard payloads in)."""
+    from llms_on_kubernetes_tpu.deploy.monitoring import render_monitoring
+
+    return render_monitoring(spec)
 
 
 def to_yaml(manifests: list[Manifest]) -> str:

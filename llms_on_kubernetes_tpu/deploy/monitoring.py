@@ -1,0 +1,295 @@
+"""Shipping monitoring artifacts: Prometheus alert rules + Grafana dashboard.
+
+Telemetry that nobody alerts on is a dashboard screenshot, not monitoring.
+This module is the single source of truth for the two artifacts the
+deployment ships alongside the serving topology:
+
+- ``alert_rules()``: a Prometheus rule file (dict form) with the
+  multi-window SLO burn-rate alerts over the router's ``llm_slo_*``
+  gauges, a wedged-engine page on ``llm_engine_state``, replica-health
+  and cluster-scrape-error tickets;
+- ``grafana_dashboard()``: a Grafana dashboard (dict form) for the same
+  series plus the runtime telemetry (device memory, compile cache,
+  kernel-vs-host step split) added in this PR.
+
+``render_monitoring(spec)`` wraps both in ConfigMaps so
+``deploy.manifests.render_manifests`` ships them with everything else.
+The Helm charts carry byte-identical copies under
+``helm-chart/files/`` (templates/monitoring.yaml mounts them via
+``.Files.Get``); ``scripts/check_monitoring.py`` regenerates those
+copies and CI fails if they drift from this module.
+
+Every ``llm_*`` name referenced by an alert expression must be a series
+this repo actually emits — ``scripts/check_monitoring.py`` cross-checks
+them against ``scripts/metrics_lint.known_emitted_names()`` so a renamed
+metric can't silently orphan its alert.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+ALERT_RULES_CONFIGMAP = "llmk-alert-rules"
+ALERT_RULES_KEY = "llmk-alerts.yaml"
+DASHBOARD_CONFIGMAP = "llmk-grafana-dashboard"
+DASHBOARD_KEY = "llmk-dashboard.json"
+
+_METRIC_NAME_RE = re.compile(r"\bllm_[a-z0-9_]+")
+
+
+def alert_rules() -> dict[str, Any]:
+    """Prometheus rule file covering the SLOs and the failure modes the
+    fault-tolerance PRs introduced detection for.
+
+    Burn-rate thresholds follow the standard multi-window pairing
+    (SRE workbook ch.5): a fast window that pages when the monthly
+    budget would be gone in hours, and a slow window that tickets a
+    steady leak. The ``llm_slo_*`` gauges are already windowed by the
+    router (LLMK_SLO_WINDOW_S), so the rules use plain ``for:`` holds
+    rather than recording-rule window math.
+    """
+    return {
+        "groups": [
+            {
+                "name": "llmk-slo",
+                "rules": [
+                    {
+                        "alert": "LLMKErrorBudgetFastBurn",
+                        "expr": "llm_slo_error_budget_burn_rate > 14",
+                        "for": "5m",
+                        "labels": {"severity": "page"},
+                        "annotations": {
+                            "summary": "error budget burning >14x",
+                            "description": (
+                                "Availability error budget on "
+                                "{{ $labels.instance }} is burning at "
+                                "{{ $value }}x the sustainable rate; at "
+                                "14x a 30-day budget is gone in ~2 days."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKErrorBudgetSlowBurn",
+                        "expr": "llm_slo_error_budget_burn_rate > 2",
+                        "for": "1h",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "error budget burning >2x",
+                            "description": (
+                                "Sustained burn rate {{ $value }}x on "
+                                "{{ $labels.instance }} will exhaust the "
+                                "budget well before the window ends."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKTTFTObjectiveMissed",
+                        "expr": "llm_slo_ttft_ok_ratio < 0.95",
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "TTFT SLO below target",
+                            "description": (
+                                "Only {{ $value }} of recent requests met "
+                                "the TTFT objective (target 0.95) on "
+                                "{{ $labels.instance }}."
+                            ),
+                        },
+                    },
+                ],
+            },
+            {
+                "name": "llmk-serving",
+                "rules": [
+                    {
+                        "alert": "LLMKEngineWedged",
+                        # state enum: 0 idle, 1 active, 2 draining, 3 wedged
+                        # (server/metrics.py llm_engine_state)
+                        "expr": "llm_engine_state == 3",
+                        "for": "1m",
+                        "labels": {"severity": "page"},
+                        "annotations": {
+                            "summary": "engine wedged (watchdog)",
+                            "description": (
+                                "Engine on {{ $labels.instance }} has "
+                                "reported the wedged state for 1m; decode "
+                                "progress has stalled past the watchdog "
+                                "budget."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKReplicaUnhealthy",
+                        "expr": "llm_replica_healthy == 0",
+                        "for": "2m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "replica failing health probes",
+                            "description": (
+                                "Router marks replica "
+                                "{{ $labels.replica }} of model "
+                                "{{ $labels.model }} unhealthy; traffic "
+                                "is failing over to peers."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKClusterScrapeErrors",
+                        "expr": (
+                            "rate(llm_cluster_scrape_errors_total[5m])"
+                            " > 0"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "cluster metrics aggregation "
+                                       "degraded",
+                            "description": (
+                                "/metrics/cluster on "
+                                "{{ $labels.instance }} has been failing "
+                                "to scrape at least one replica for 10m; "
+                                "the merged view is incomplete."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKDeadlineExceeded",
+                        "expr": (
+                            "rate(llm_deadline_exceeded_total[5m]) > 1"
+                        ),
+                        "for": "5m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "requests blowing their deadline",
+                            "description": (
+                                "More than one request per second on "
+                                "{{ $labels.instance }} is exceeding its "
+                                "end-to-end deadline."
+                            ),
+                        },
+                    },
+                ],
+            },
+        ],
+    }
+
+
+def _panel(panel_id: int, title: str, exprs: list[str],
+           x: int, y: int, unit: str = "short") -> dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [
+            {"expr": expr, "refId": chr(ord("A") + i)}
+            for i, expr in enumerate(exprs)
+        ],
+    }
+
+
+def grafana_dashboard() -> dict[str, Any]:
+    """One dashboard, four rows: SLO health, traffic/latency, engine
+    runtime (device memory + compile cache + step split), and fleet
+    state. Expressions stick to series validated by check_monitoring."""
+    panels = [
+        _panel(1, "SLO: availability / TTFT ok ratio",
+               ["llm_slo_availability", "llm_slo_ttft_ok_ratio"],
+               0, 0, unit="percentunit"),
+        _panel(2, "SLO: error budget burn rate",
+               ["llm_slo_error_budget_burn_rate"], 12, 0),
+        _panel(3, "Request rate",
+               ["rate(llm_requests_total[5m])",
+                "rate(llm_requests_finished_total[5m])"], 0, 8,
+               unit="reqps"),
+        _panel(4, "TTFT p50/p95",
+               ["histogram_quantile(0.5, "
+                "rate(llm_ttft_seconds_bucket[5m]))",
+                "histogram_quantile(0.95, "
+                "rate(llm_ttft_seconds_bucket[5m]))"], 12, 8,
+               unit="s"),
+        _panel(5, "Device memory",
+               ['llm_device_memory_bytes{kind="bytes_in_use"}',
+                'llm_device_memory_bytes{kind="bytes_limit"}',
+                "llm_device_live_buffer_bytes"], 0, 16,
+               unit="bytes"),
+        _panel(6, "JIT compiles vs cache hits",
+               ["rate(llm_jit_compiles_total[5m])",
+                "rate(llm_jit_cache_hits_total[5m])"], 12, 16),
+        _panel(7, "Step time split: device vs host",
+               ["rate(llm_step_device_seconds_total[5m])",
+                "rate(llm_step_host_seconds_total[5m])"], 0, 24,
+               unit="percentunit"),
+        _panel(8, "Fleet: replica health / engine state",
+               ["llm_replica_healthy", "llm_engine_state",
+                "llm_cluster_replica_up"], 12, 24),
+        _panel(9, "Tokens generated",
+               ["rate(llm_tokens_generated_total[5m])"], 0, 32),
+        _panel(10, "KV pages used / waiting requests",
+               ["llm_kv_pages_used", "llm_waiting_requests"], 12, 32),
+    ]
+    return {
+        "title": "LLM serving on TPU — cluster overview",
+        "uid": "llmk-overview",
+        "tags": ["llmk", "tpu", "slo"],
+        "timezone": "utc",
+        "schemaVersion": 39,
+        "refresh": "30s",
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": panels,
+    }
+
+
+def alert_rules_yaml() -> str:
+    """Rule file as YAML text — the exact bytes shipped in the ConfigMap
+    and committed under each chart's files/ directory."""
+    import yaml
+
+    return yaml.safe_dump(alert_rules(), sort_keys=False,
+                          default_flow_style=False)
+
+
+def dashboard_json() -> str:
+    return json.dumps(grafana_dashboard(), indent=2, sort_keys=True) + "\n"
+
+
+def referenced_metric_names() -> set[str]:
+    """Every llm_* series name referenced by an alert expression or a
+    dashboard panel target. check_monitoring verifies this set is a
+    subset of what the servers actually emit."""
+    names: set[str] = set()
+    for group in alert_rules()["groups"]:
+        for rule in group["rules"]:
+            names.update(_METRIC_NAME_RE.findall(rule["expr"]))
+    for panel in grafana_dashboard()["panels"]:
+        for target in panel["targets"]:
+            names.update(_METRIC_NAME_RE.findall(target["expr"]))
+    return names
+
+
+def render_monitoring(spec) -> list[dict[str, Any]]:
+    """The two monitoring ConfigMaps, in the same Manifest dict form as
+    the rest of deploy.manifests. The Grafana ConfigMap carries the
+    conventional ``grafana_dashboard: "1"`` label that the Grafana
+    sidecar provisioner watches for."""
+    from llms_on_kubernetes_tpu.deploy.manifests import _meta
+
+    alerts_cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": _meta(ALERT_RULES_CONFIGMAP, spec, "monitoring"),
+        "data": {ALERT_RULES_KEY: alert_rules_yaml()},
+    }
+    dash_meta = _meta(DASHBOARD_CONFIGMAP, spec, "monitoring")
+    dash_meta["labels"] = dict(dash_meta["labels"])
+    dash_meta["labels"]["grafana_dashboard"] = "1"
+    dashboard_cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": dash_meta,
+        "data": {DASHBOARD_KEY: dashboard_json()},
+    }
+    return [alerts_cm, dashboard_cm]

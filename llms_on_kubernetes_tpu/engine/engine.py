@@ -323,6 +323,11 @@ class _Harvester(threading.Thread):
         # otherwise every wait_done/wait_key blocks forever (observed as a
         # bench hang). First error wins; all waiters re-raise it.
         self._error: Optional[BaseException] = None
+        # cumulative seconds this thread spent blocked in device_get —
+        # the engine folds it into its kernel-vs-host attribution; plain
+        # float += is safe: only this thread writes, readers tolerate a
+        # slightly stale value
+        self.device_time_s = 0.0
         # small batches + overlapped readers: one huge batched read would
         # couple every completion to the newest dispatch and mark done in
         # lumps; overlapping 2+ reads pipelines the tunnel RTT instead
@@ -369,7 +374,9 @@ class _Harvester(threading.Thread):
                 from llms_on_kubernetes_tpu import faults
                 faults.inject_hang("engine_stall")
                 faults.inject_delay("slow_step", 0.2)
+                t0 = time.perf_counter()
                 host = jax.device_get([r for _, r in batch])
+                self.device_time_s += time.perf_counter() - t0
             except BaseException as e:  # noqa: BLE001 — must not die silent
                 with self._cv:
                     if self._error is None:
@@ -915,6 +922,10 @@ class Engine:
         self._seed_rng = np.random.default_rng(engine_config.seed)
         self._lock = threading.Lock()
         self.preemptions = 0  # total KV-pressure preemptions (metrics)
+        # seconds the ENGINE thread spent blocked on device reads (sync
+        # path); async-path device waits land on the harvester thread's
+        # own counter — device_wait_s() sums both for step attribution
+        self._device_time_s = 0.0
 
         self._prefill_packed = jax.jit(
             _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
@@ -1229,6 +1240,16 @@ class Engine:
     # ------------------------------------------------------------------
     # scheduler iteration
     # ------------------------------------------------------------------
+
+    def device_wait_s(self) -> float:
+        """Cumulative seconds spent blocked on device work across the
+        engine thread (sync reads) and the harvester (async reads). The
+        serving loop differences this around each step() to attribute
+        step wall time kernel-vs-host for the flight recorder."""
+        total = self._device_time_s
+        if self._harvester is not None:
+            total += self._harvester.device_time_s
+        return total
 
     def step(self) -> list[StepEvent]:
         # re-assert THIS engine's mesh for any trace this step triggers:
@@ -1792,7 +1813,9 @@ class Engine:
         if resumed:
             req.pending_token = req.output[-1]
             return []
+        t0 = time.perf_counter()
         host = HostSample(np.asarray(jax.device_get(pack)))
+        self._device_time_s += time.perf_counter() - t0
         first = int(host.tokens[0])
         req.pending_token = first
         req.first_token_at = time.monotonic()
@@ -1983,7 +2006,9 @@ class Engine:
         )
         if new_state is not None:
             self._fsm_state = new_state
+        t0 = time.perf_counter()
         host = HostSample(np.asarray(jax.device_get(pack)))
+        self._device_time_s += time.perf_counter() - t0
 
         events: list[StepEvent] = []
         for i, r in active:

@@ -1,0 +1,322 @@
+"""Cluster-level metrics: replica exposition merging and SLO tracking.
+
+The routers front N replicas that each expose ``/metrics``; Prometheus
+can scrape them individually, but operators (and the alert rules this
+repo ships) also want one cluster-wide view without running a federation
+layer. ``merge_expositions`` implements the aggregation contract:
+
+- **counters and histograms are summed** across replicas on identical
+  label sets (a request served is a request served, whoever served it);
+- **gauges (and untyped series) are per-replica-labeled** — averaging a
+  gauge like ``llm_engine_state`` would destroy exactly the signal an
+  operator needs (WHICH replica is wedged), so each sample gains a
+  ``replica="<url>"`` label instead;
+- ``llm_cluster_replica_up{replica=...}`` records which replicas
+  answered the scrape; failures additionally bump the router's
+  ``llm_cluster_scrape_errors_total`` (never silently dropped).
+
+``SLOTracker`` is the sliding-window objective monitor behind the
+``llm_slo_*`` gauges: every proxied request contributes an availability
+sample (HTTP status < 500) and, when a first byte was relayed, a TTFT
+sample, over a configurable window. Burn rate follows the standard SRE
+definition: (observed error rate) / (error budget), so >1 means the
+budget is being consumed faster than the objective allows and the
+multi-window alert rules in deploy/monitoring.py fire on it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Iterable, Optional
+
+from llms_on_kubernetes_tpu.server.metrics import escape_label_value
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition: parse + merge
+# ---------------------------------------------------------------------------
+
+
+class Sample:
+    """One parsed series line: name + ordered (label, value) pairs + value."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: tuple, value: float):
+        self.name, self.labels, self.value = name, labels, value
+
+
+def _parse_labels(raw: str) -> tuple:
+    """'a="x",b="y"' -> (("a","x"),("b","y")), honoring escapes."""
+    out = []
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.index("=", i)
+        key = raw[i:eq].strip().lstrip(",").strip()
+        i = eq + 1
+        if i >= n or raw[i] != '"':
+            raise ValueError(f"unquoted label value near {raw[i:i+20]!r}")
+        i += 1
+        buf = []
+        while i < n:
+            c = raw[i]
+            if c == "\\" and i + 1 < n:
+                nxt = raw[i + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            buf.append(c)
+            i += 1
+        out.append((key, "".join(buf)))
+        while i < n and raw[i] in ", ":
+            i += 1
+    return tuple(out)
+
+
+def parse_exposition(text: str) -> tuple[list[Sample], dict, dict]:
+    """Parse Prometheus text format -> (samples, types, helps).
+
+    types/helps map family name -> TYPE/HELP string. Malformed lines are
+    skipped (a half-written replica exposition shouldn't kill the whole
+    cluster view); the caller decides whether zero samples counts as a
+    scrape error.
+    """
+    samples: list[Sample] = []
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                helps[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                raw_labels, valpart = rest.rsplit("}", 1)
+                labels = _parse_labels(raw_labels)
+                value = float(valpart.split()[0])
+            else:
+                name, valpart = line.split(None, 1)
+                labels = ()
+                value = float(valpart.split()[0])
+        except (ValueError, IndexError):
+            continue
+        samples.append(Sample(name.strip(), labels, value))
+    return samples, types, helps
+
+
+def _family_of(name: str, types: dict) -> tuple[str, str]:
+    """(family, type) for a series name, folding histogram suffixes onto
+    their parent family so _bucket/_sum/_count inherit 'histogram'."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base, "histogram"
+    return name, types.get(name, "untyped")
+
+
+def render_sample(name: str, labels: tuple, value: float) -> str:
+    if labels:
+        lbl = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
+        return f"{name}{{{lbl}}} {value}"
+    return f"{name} {value}"
+
+
+def merge_expositions(replica_texts: dict[str, Optional[str]]) -> str:
+    """Merge per-replica expositions into one cluster exposition.
+
+    replica_texts maps replica url -> exposition text, or None for a
+    replica whose scrape failed (still reported via
+    llm_cluster_replica_up=0). Counters/histograms sum on identical
+    label sets; gauges/untyped gain a leading replica= label. Output is
+    grouped by family with HELP/TYPE emitted once, families sorted by
+    name for deterministic tests/diffs.
+    """
+    summed: dict[tuple, float] = {}          # (name, labels) -> value
+    labeled: list[tuple[str, tuple, float]] = []
+    fam_types: dict[str, str] = {}
+    fam_helps: dict[str, str] = {}
+    fam_of_series: dict[str, str] = {}
+    up: list[tuple[str, int]] = []
+
+    for replica, text in sorted(replica_texts.items()):
+        if text is None:
+            up.append((replica, 0))
+            continue
+        up.append((replica, 1))
+        samples, types, helps = parse_exposition(text)
+        for fam, t in types.items():
+            fam_types.setdefault(fam, t)
+        for fam, h in helps.items():
+            fam_helps.setdefault(fam, h)
+        for s in samples:
+            fam, kind = _family_of(s.name, types)
+            fam_of_series.setdefault(s.name, fam)
+            fam_types.setdefault(fam, kind)
+            if kind in ("counter", "histogram"):
+                key = (s.name, s.labels)
+                summed[key] = summed.get(key, 0.0) + s.value
+            else:
+                labeled.append(
+                    (s.name, (("replica", replica),) + s.labels, s.value))
+
+    # Group output lines by family for single HELP/TYPE headers
+    by_family: dict[str, list[str]] = {}
+    for (name, labels), value in summed.items():
+        by_family.setdefault(fam_of_series[name], []).append(
+            render_sample(name, labels, value))
+    for name, labels, value in labeled:
+        by_family.setdefault(fam_of_series[name], []).append(
+            render_sample(name, labels, value))
+
+    out: list[str] = []
+    for fam in sorted(by_family):
+        help_ = fam_helps.get(fam, f"aggregated from replicas: {fam}")
+        out.append(f"# HELP {fam} {help_}")
+        out.append(f"# TYPE {fam} {fam_types.get(fam, 'untyped')}")
+        out.extend(sorted(by_family[fam]))
+
+    out.append("# HELP llm_cluster_replica_up Replica /metrics scrape "
+               "succeeded during cluster aggregation (1=merged)")
+    out.append("# TYPE llm_cluster_replica_up gauge")
+    for replica, ok in up:
+        out.append(render_sample("llm_cluster_replica_up",
+                                 (("replica", replica),), float(ok)))
+    out.append("# HELP llm_cluster_replicas Replicas known to the router")
+    out.append("# TYPE llm_cluster_replicas gauge")
+    out.append(f"llm_cluster_replicas {float(len(up))}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# SLO tracking
+# ---------------------------------------------------------------------------
+
+
+class SLOTracker:
+    """Sliding-window SLO monitor over proxied-request outcomes.
+
+    Objectives come from env (set once per deployment, read at
+    construction):
+
+    - ``LLMK_SLO_WINDOW_S``        observation window (default 300)
+    - ``LLMK_SLO_TTFT_MS``         TTFT objective per request (default 2000)
+    - ``LLMK_SLO_TTFT_TARGET``     fraction of requests that must meet it
+                                   (default 0.95)
+    - ``LLMK_SLO_AVAILABILITY_TARGET`` availability objective
+                                   (default 0.99)
+
+    With no traffic in the window both ratios report 1.0 (meeting an SLO
+    vacuously — "no data" must not page anyone) and burn rate 0.
+    """
+
+    def __init__(self,
+                 window_s: Optional[float] = None,
+                 ttft_objective_ms: Optional[float] = None,
+                 ttft_target: Optional[float] = None,
+                 availability_target: Optional[float] = None):
+        def envf(key: str, default: float) -> float:
+            try:
+                return float(os.environ.get(key, default))
+            except ValueError:
+                return default
+        self.window_s = window_s if window_s is not None else envf(
+            "LLMK_SLO_WINDOW_S", 300.0)
+        self.ttft_objective_ms = (ttft_objective_ms
+                                  if ttft_objective_ms is not None
+                                  else envf("LLMK_SLO_TTFT_MS", 2000.0))
+        self.ttft_target = (ttft_target if ttft_target is not None
+                            else envf("LLMK_SLO_TTFT_TARGET", 0.95))
+        self.availability_target = (
+            availability_target if availability_target is not None
+            else envf("LLMK_SLO_AVAILABILITY_TARGET", 0.99))
+        # samples: (ts, ok, ttft_ok) with ttft_ok None when no first byte
+        self._samples: deque = deque()
+        self._lock = threading.Lock()
+
+    def observe(self, status: int, ttft_ms: Optional[float] = None,
+                now: Optional[float] = None) -> None:
+        """Fold one finished request in. ``status`` 0 means the proxy
+        failed before any upstream status existed (counts as unavailable);
+        5xx counts as unavailable; everything else — including 4xx, which
+        is the caller's fault, per SRE convention — counts as available."""
+        ts = now if now is not None else time.time()
+        ok = 1 if 0 < status < 500 else 0
+        ttft_ok = None
+        if ttft_ms is not None:
+            ttft_ok = 1 if ttft_ms <= self.ttft_objective_ms else 0
+        with self._lock:
+            self._samples.append((ts, ok, ttft_ok))
+            self._evict(ts)
+
+    def _evict(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        ts = now if now is not None else time.time()
+        with self._lock:
+            self._evict(ts)
+            samples = list(self._samples)
+        n = len(samples)
+        ok = sum(s[1] for s in samples)
+        ttft_samples = [s[2] for s in samples if s[2] is not None]
+        availability = (ok / n) if n else 1.0
+        ttft_ok_ratio = (sum(ttft_samples) / len(ttft_samples)
+                         if ttft_samples else 1.0)
+        budget = 1.0 - self.availability_target
+        burn = ((1.0 - availability) / budget) if budget > 0 else 0.0
+        return {
+            "window_s": self.window_s,
+            "requests": n,
+            "availability": availability,
+            "ttft_ok_ratio": ttft_ok_ratio,
+            "error_budget_burn_rate": burn,
+        }
+
+
+def slo_gauges(registry, tracker: SLOTracker) -> dict:
+    """Register the llm_slo_* CallbackGauges reading ``tracker`` at scrape
+    time. Shared by the Python router; the native router mirrors the same
+    series names in C++."""
+    from llms_on_kubernetes_tpu.server.metrics import CallbackGauge
+
+    return {
+        "ttft_ok_ratio": CallbackGauge(
+            "llm_slo_ttft_ok_ratio",
+            "Fraction of recent requests whose TTFT met the objective "
+            "(sliding window; 1.0 with no traffic)", registry,
+            lambda: tracker.snapshot()["ttft_ok_ratio"]),
+        "availability": CallbackGauge(
+            "llm_slo_availability",
+            "Fraction of recent requests that did not fail 5xx/transport "
+            "(sliding window; 1.0 with no traffic)", registry,
+            lambda: tracker.snapshot()["availability"]),
+        "burn_rate": CallbackGauge(
+            "llm_slo_error_budget_burn_rate",
+            "Observed error rate over the error budget; >1 burns budget "
+            "faster than the availability objective allows", registry,
+            lambda: tracker.snapshot()["error_budget_burn_rate"]),
+        "window_requests": CallbackGauge(
+            "llm_slo_window_requests",
+            "Requests in the current SLO observation window", registry,
+            lambda: float(tracker.snapshot()["requests"])),
+    }

@@ -11,7 +11,12 @@ occupancy, KV-page usage.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
+
+# Stamped once at import: every exposition in this process reports the same
+# start time, and uptime is derived from it at scrape time.
+_PROCESS_START_WALL = time.time()
 
 
 def escape_label_value(v: str) -> str:
@@ -98,6 +103,28 @@ class Gauge(_Metric):
 
     def set(self, v: float) -> None:
         self.value = v
+
+
+class CallbackGauge(Gauge):
+    """Gauge whose value is recomputed by ``fn()`` at every render.
+
+    For quantities that must be fresh at scrape time without a poller:
+    process uptime, sliding-window SLO ratios. A callback failure keeps
+    the previous value — a scrape must never 500 because a derived
+    quantity hiccupped.
+    """
+
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 fn: Callable[[], float]):
+        super().__init__(name, help_, registry)
+        self._fn = fn
+
+    def render(self) -> str:
+        try:
+            self.value = float(self._fn())
+        except Exception:
+            pass
+        return super().render()
 
 
 class _HistogramSeries:
@@ -199,6 +226,39 @@ class Registry:
             return "".join(m.render() for m in self._metrics)
 
 
+def build_info_metrics(registry: Registry, backend: str = "none",
+                       jax_version: Optional[str] = None) -> dict:
+    """Identity + lifetime series every exposition must carry (engine, API
+    server, both routers): which build/runtime answered this scrape, when
+    the process started, and how long it has been up. ``backend`` is the
+    serving backend ("tpu"/"cpu" for engines, "python-router"/
+    "native-router" for gateways); ``jax_version`` defaults to the
+    installed jax distribution WITHOUT importing (and thereby
+    initializing) jax — routers must stay accelerator-free."""
+    from llms_on_kubernetes_tpu import __version__
+
+    if jax_version is None:
+        try:
+            from importlib import metadata
+            jax_version = metadata.version("jax")
+        except Exception:
+            jax_version = "none"
+    info = Gauge(
+        "llm_build_info",
+        "Build/runtime identity of this process (value is always 1)",
+        registry, label_names=("version", "jax", "backend"))
+    info.labels(version=__version__, jax=jax_version, backend=backend).set(1)
+    start = Gauge(
+        "llm_process_start_time_seconds",
+        "Unix time this process started", registry)
+    start.set(round(_PROCESS_START_WALL, 3))
+    uptime = CallbackGauge(
+        "llm_process_uptime_seconds",
+        "Seconds since process start (recomputed at scrape)", registry,
+        lambda: round(time.time() - _PROCESS_START_WALL, 3))
+    return {"build_info": info, "start_time": start, "uptime": uptime}
+
+
 def engine_metrics(registry: Registry) -> dict:
     """The standard serving metric set (SURVEY §5 gap list)."""
     return {
@@ -266,4 +326,8 @@ def router_metrics(registry: Registry) -> dict:
             "llm_router_deadline_rejected_total",
             "Requests rejected at the gateway with an already-expired "
             "deadline", registry),
+        "cluster_scrape_errors": Counter(
+            "llm_cluster_scrape_errors_total",
+            "Replica /metrics scrapes that failed during /metrics/cluster "
+            "aggregation (unreachable replica, bad exposition)", registry),
     }

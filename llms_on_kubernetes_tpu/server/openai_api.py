@@ -35,7 +35,11 @@ from aiohttp import web
 from llms_on_kubernetes_tpu.engine.engine import Engine, Request, SamplingParams
 from llms_on_kubernetes_tpu.engine.tokenizer import TokenizerLike
 from llms_on_kubernetes_tpu.server import tracing
-from llms_on_kubernetes_tpu.server.metrics import Registry, engine_metrics
+from llms_on_kubernetes_tpu.server.metrics import (
+    Registry, build_info_metrics, engine_metrics,
+)
+from llms_on_kubernetes_tpu.server.profiling import ProfileManager
+from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
 from llms_on_kubernetes_tpu.server.router import DEADLINE_HEADER
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
@@ -75,12 +79,14 @@ class EngineLoop(threading.Thread):
 
     def __init__(self, engine: Engine, metrics: Optional[dict] = None,
                  model_name: str = "",
-                 flight: Optional[tracing.FlightRecorder] = None):
+                 flight: Optional[tracing.FlightRecorder] = None,
+                 telemetry: Optional[RuntimeTelemetry] = None):
         super().__init__(daemon=True, name="engine-loop")
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
         self.flight = flight
+        self.telemetry = telemetry
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
@@ -126,9 +132,20 @@ class EngineLoop(threading.Thread):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            dw0 = eng.device_wait_s() if hasattr(eng, "device_wait_s") else 0.0
             t0 = time.monotonic()
             events = eng.step()
             dt = time.monotonic() - t0
+            # kernel-vs-host attribution: how much of this step's wall
+            # time was spent blocked on the device (dispatch + harvest
+            # reads) vs host-side scheduling. Clamped to [0, dt] — the
+            # harvester runs concurrently, so its delta can exceed this
+            # step's own wall time.
+            device_s = 0.0
+            if hasattr(eng, "device_wait_s"):
+                device_s = max(0.0, min(eng.device_wait_s() - dw0, dt))
+            if self.telemetry is not None:
+                self.telemetry.record_step_split(dt, device_s)
             occupancy = sum(r is not None for r in eng.slots)
             pages_used = eng.config.num_pages - 1 - eng.allocator.num_free_pages
             step_tokens = sum(len(ev.new_tokens) for ev in events)
@@ -171,6 +188,8 @@ class EngineLoop(threading.Thread):
                 # or latency spike without a profiler attached
                 self.flight.record(
                     step_ms=round(dt * 1000.0, 3),
+                    device_ms=round(device_s * 1000.0, 3),
+                    host_ms=round((dt - device_s) * 1000.0, 3),
                     occupancy=occupancy,
                     kv_pages_used=pages_used,
                     waiting=len(eng.waiting),
@@ -313,6 +332,17 @@ class OpenAIServer:
         self.model_name = model_name
         self.registry = registry or Registry()
         self.metrics = engine_metrics(self.registry)
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = "none"
+        build_info_metrics(self.registry, backend=backend)
+        # runtime telemetry (device memory, live buffers, jit compile
+        # counters) refreshed at scrape time by the /metrics handler
+        self.telemetry = RuntimeTelemetry(self.registry)
+        # on-demand bounded profile captures (POST/GET /debug/profile)
+        self.profiles = ProfileManager()
         # observability surfaces: recent completed traces (/debug/traces)
         # and the engine flight recorder (/debug/engine)
         import os
@@ -322,7 +352,8 @@ class OpenAIServer:
             int(os.environ.get("LLMK_FLIGHT_STEPS", "512")))
         self.loop_thread = EngineLoop(engine, self.metrics,
                                       model_name=model_name,
-                                      flight=self.flight)
+                                      flight=self.flight,
+                                      telemetry=self.telemetry)
         self.engine = engine
         # readiness lifecycle: loading -> serving -> draining; "wedged" is
         # derived from the engine watchdog and overrides everything.
@@ -380,6 +411,10 @@ class OpenAIServer:
         app.router.add_post("/detokenize", self.detokenize)
         app.router.add_get("/version", self.version)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/debug/profile", self.profile_capture)
+        app.router.add_get("/debug/profile", self.profile_list)
+        app.router.add_get("/debug/profile/{capture_id}",
+                           self.profile_download)
         app.router.add_post("/debug/profile/start", self.profile_start)
         app.router.add_post("/debug/profile/stop", self.profile_stop)
         app.router.add_get("/debug/traces", self.debug_traces)
@@ -449,18 +484,67 @@ class OpenAIServer:
                        "type": "service_unavailable"}},
             status=503)
 
-    # JAX profiler hooks (SURVEY §5 tracing gap: the reference exposed no
-    # profiling at all). Traces land under the operator-configured
-    # LLMK_PROFILE_DIR (never a caller-supplied path — the endpoint is on
-    # the serving port) in the layout TensorBoard/XProf reads; start/stop
-    # so a trace can span exactly the traffic of interest.
+    # On-demand bounded profiling (SURVEY §5 tracing gap: the reference
+    # exposed no profiling at all). POST /debug/profile captures a trace
+    # of fixed duration on the LIVE engine — jax.profiler when it starts,
+    # host-stack sampler otherwise — under the operator-configured
+    # LLMK_PROFILE_DIR (never a caller-supplied path; the endpoint is on
+    # the serving port). GET lists captures; GET /debug/profile/<id>
+    # downloads one as .tar.gz for TensorBoard/XProf on a workstation.
+    async def profile_capture(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        duration_ms = body.get("duration_ms", 500)
+        if not isinstance(duration_ms, (int, float)) or duration_ms <= 0:
+            return web.json_response(
+                {"error": {"message": "duration_ms must be a positive "
+                                      "number"}}, status=400)
+        if getattr(self, "_profiling", False):
+            return web.json_response(
+                {"error": {"message": "manual profiler session running "
+                                      "(/debug/profile/stop first)"}},
+                status=409)
+        try:
+            # blocking capture runs off the event loop: streams keep
+            # flowing, and that live traffic is what gets profiled
+            meta = await asyncio.get_running_loop().run_in_executor(
+                None, self.profiles.capture, float(duration_ms))
+        except RuntimeError:
+            return web.json_response(
+                {"error": {"message": "capture already in progress"}},
+                status=409)
+        return web.json_response(meta)
+
+    async def profile_list(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "dir": self.profiles.base_dir,
+            "busy": self.profiles.busy or getattr(self, "_profiling", False),
+            "captures": self.profiles.list_captures(),
+        })
+
+    async def profile_download(self, request: web.Request) -> web.Response:
+        cap_id = request.match_info["capture_id"]
+        data = self.profiles.open_archive(cap_id)
+        if data is None:
+            return web.json_response(
+                {"error": {"message": f"no such capture: {cap_id}"}},
+                status=404)
+        return web.Response(
+            body=data, content_type="application/gzip",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{cap_id}.tar.gz"'})
+
+    # Manual start/stop pair for traces that must span exactly the
+    # traffic of interest (the bounded POST above is the common path).
     async def profile_start(self, request: web.Request) -> web.Response:
         import os
 
         import jax
 
         log_dir = os.environ.get("LLMK_PROFILE_DIR", "/tmp/jax-profile")
-        if getattr(self, "_profiling", False):
+        if getattr(self, "_profiling", False) or self.profiles.busy:
             return web.json_response(
                 {"error": {"message": "profiler already running"}}, status=409)
         try:
@@ -591,6 +675,8 @@ class OpenAIServer:
     async def prometheus(self, request: web.Request) -> web.Response:
         self.metrics["engine_state"].set(
             self.STATE_CODES.get(self.state, 0))
+        # scrape-time freshness for device memory / live buffers
+        self.telemetry.refresh()
         return web.Response(
             text=self.registry.render(),
             content_type="text/plain", charset="utf-8",

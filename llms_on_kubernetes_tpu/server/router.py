@@ -67,7 +67,12 @@ import aiohttp
 from aiohttp import web
 
 from llms_on_kubernetes_tpu.server import tracing
-from llms_on_kubernetes_tpu.server.metrics import Registry, router_metrics
+from llms_on_kubernetes_tpu.server.cluster_metrics import (
+    SLOTracker, merge_expositions, slo_gauges,
+)
+from llms_on_kubernetes_tpu.server.metrics import (
+    Registry, build_info_metrics, router_metrics,
+)
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER, jlog
 
 DEADLINE_HEADER = "X-LLMK-Deadline-Ms"
@@ -233,6 +238,12 @@ class Router:
         self.clock = clock
         self.registry = Registry()
         self.metrics = router_metrics(self.registry)
+        build_info_metrics(self.registry, backend="python-router")
+        # sliding-window SLO over proxied outcomes (llm_slo_* gauges read
+        # it at scrape time); objectives from LLMK_SLO_* env vars
+        self.slo = SLOTracker()
+        slo_gauges(self.registry, self.slo)
+        self.scrape_timeout_s = 5.0
         self.traces = tracing.TraceStore(
             int(os.environ.get("LLMK_TRACE_RING", "256")))
         # per-replica state; breakers indexed by replica URL for inspection
@@ -257,6 +268,7 @@ class Router:
         app = web.Application()
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics_endpoint)
+        app.router.add_get("/metrics/cluster", self.metrics_cluster)
         app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_get("/v1/models", self.models)
         app.router.add_route("*", "/{path:.*}", self.proxy)
@@ -330,6 +342,38 @@ class Router:
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
         return web.Response(text=self.registry.render(),
                             content_type="text/plain")
+
+    async def _scrape_replica(self, url: str) -> Optional[str]:
+        """One replica's /metrics text, or None on any failure (counted —
+        an unreachable replica must be visible in the cluster view, not
+        silently absent from it)."""
+        try:
+            async with self._session.get(
+                url + "/metrics",
+                timeout=aiohttp.ClientTimeout(total=self.scrape_timeout_s),
+            ) as resp:
+                text = await resp.text()
+                if resp.status != 200:
+                    raise aiohttp.ClientResponseError(
+                        resp.request_info, (), status=resp.status)
+                return text
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            self.metrics["cluster_scrape_errors"].inc()
+            jlog("cluster_scrape_error", component="router", replica=url)
+            return None
+
+    async def metrics_cluster(self, request: web.Request) -> web.Response:
+        """Merged cluster exposition: every distinct replica's /metrics
+        aggregated per the contract in cluster_metrics.merge_expositions
+        (counters/histograms summed, gauges per-replica-labeled). The
+        router's OWN series stay on /metrics — mixing them here would
+        duplicate family headers for names both layers emit
+        (llm_build_info et al.)."""
+        urls = sorted({rep.url for reps in self.replicas.values()
+                       for rep in reps})
+        texts = await asyncio.gather(*(self._scrape_replica(u) for u in urls))
+        merged = merge_expositions(dict(zip(urls, texts)))
+        return web.Response(text=merged, content_type="text/plain")
 
     async def models(self, request: web.Request) -> web.Response:
         """Synthesized exactly like the reference gateway (no backend hop)."""
@@ -474,6 +518,10 @@ class Router:
         finally:
             trace.finish(status)
             self.traces.add(trace)
+            # SLO sample: availability from the downstream status (0 =
+            # failed before any status), TTFT from the first relayed byte
+            self.slo.observe(int(getattr(resp, "status", 0) or 0),
+                             request.get("llmk_ttft_ms"))
             jlog("request", request_id=rid, component="router",
                  model=trace.model, status=status,
                  http_status=getattr(resp, "status", None),
@@ -606,6 +654,7 @@ class Router:
                     if t_first is None:
                         t_first = self.clock()
                         trace.add_span("first_byte", t_head, t_first)
+                        request["llmk_ttft_ms"] = (t_first - t0) * 1000.0
                     relayed += len(chunk)
                     await resp.write(chunk)
                 await resp.write_eof()

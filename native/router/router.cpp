@@ -62,6 +62,7 @@
 // one (the upstream closed an idle connection under us — the Go
 // http.Transport convention).
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <chrono>
@@ -71,6 +72,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <deque>
 #include <fstream>
 #include <functional>
 #include <map>
@@ -144,6 +146,109 @@ static void logf(const Config& cfg, const char* fmt, ...) {
 static std::atomic<long> g_failover_total{0};
 static std::atomic<long> g_unknown_model_fallback_total{0};
 static std::atomic<long> g_deadline_rejected_total{0};
+// replica /metrics scrapes that failed during /metrics/cluster aggregation
+// — an unreachable replica must be VISIBLE in the cluster view (ISSUE 5
+// satellite), never silently dropped from it
+static std::atomic<long> g_cluster_scrape_errors_total{0};
+
+// build identity: must match the python package __version__ so
+// llm_build_info{version=...} agrees across the serving path
+static const char kLlmkVersion[] = "0.1.0";
+// process start stamps for llm_process_start_time_seconds / uptime
+static const time_t g_start_wall = time(nullptr);
+static const std::chrono::steady_clock::time_point g_start_steady =
+    std::chrono::steady_clock::now();
+
+// ---------------------------------------------------------------------------
+// Sliding-window SLO tracker (mirrors server/cluster_metrics.SLOTracker)
+// ---------------------------------------------------------------------------
+
+static double env_double(const char* name, double fallback) {
+  const char* raw = getenv(name);
+  if (!raw || !*raw) return fallback;
+  char* end = nullptr;
+  double v = strtod(raw, &end);
+  return end && *end == '\0' ? v : fallback;
+}
+
+// Every proxied request contributes an availability sample (status < 500;
+// 0 = transport failure before any status) and, when a first body byte
+// was relayed, a TTFT sample, over a configurable window. Burn rate is
+// (observed error rate)/(error budget): >1 consumes budget faster than
+// the availability objective allows. Objectives come from the same
+// LLMK_SLO_* env vars the python router reads.
+class SloTracker {
+ public:
+  SloTracker()
+      : window_s_(env_double("LLMK_SLO_WINDOW_S", 300.0)),
+        ttft_objective_ms_(env_double("LLMK_SLO_TTFT_MS", 2000.0)),
+        availability_target_(
+            env_double("LLMK_SLO_AVAILABILITY_TARGET", 0.99)) {}
+
+  // ttfb_ms < 0 means no first byte was relayed (no TTFT sample)
+  void observe(int status, double ttfb_ms) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto now = std::chrono::steady_clock::now();
+    Sample s;
+    s.ts = now;
+    s.ok = status > 0 && status < 500;
+    s.ttft_ok = ttfb_ms < 0 ? -1 : (ttfb_ms <= ttft_objective_ms_ ? 1 : 0);
+    samples_.push_back(s);
+    evict(now);
+  }
+
+  struct Snap {
+    long requests = 0;
+    double availability = 1.0;      // 1.0 with no traffic (vacuous pass)
+    double ttft_ok_ratio = 1.0;
+    double burn_rate = 0.0;
+  };
+
+  Snap snapshot() {
+    std::lock_guard<std::mutex> lock(mu_);
+    evict(std::chrono::steady_clock::now());
+    Snap out;
+    out.requests = static_cast<long>(samples_.size());
+    if (out.requests == 0) return out;
+    long ok = 0, ttft_n = 0, ttft_ok = 0;
+    for (const Sample& s : samples_) {
+      if (s.ok) ++ok;
+      if (s.ttft_ok >= 0) {
+        ++ttft_n;
+        ttft_ok += s.ttft_ok;
+      }
+    }
+    out.availability = static_cast<double>(ok) / out.requests;
+    out.ttft_ok_ratio =
+        ttft_n ? static_cast<double>(ttft_ok) / ttft_n : 1.0;
+    double budget = 1.0 - availability_target_;
+    out.burn_rate = budget > 0 ? (1.0 - out.availability) / budget : 0.0;
+    return out;
+  }
+
+ private:
+  struct Sample {
+    std::chrono::steady_clock::time_point ts;
+    bool ok;
+    int ttft_ok;  // -1 = no TTFT sample
+  };
+
+  void evict(std::chrono::steady_clock::time_point now) {
+    auto horizon = now - std::chrono::duration_cast<
+        std::chrono::steady_clock::duration>(
+            std::chrono::duration<double>(window_s_));
+    while (!samples_.empty() && samples_.front().ts < horizon)
+      samples_.pop_front();
+  }
+
+  const double window_s_;
+  const double ttft_objective_ms_;
+  const double availability_target_;
+  std::mutex mu_;
+  std::deque<Sample> samples_;
+};
+
+static SloTracker g_slo;
 
 // Prometheus exposition escaping for label VALUES (backslash, double
 // quote, newline) — model names and replica URLs are operator input.
@@ -573,6 +678,230 @@ static void probe_all(const Config& cfg) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// Cluster metrics aggregation (mirrors server/cluster_metrics.py)
+// ---------------------------------------------------------------------------
+
+// GET <base>/metrics from one replica into *body_out. Connection: close is
+// requested so an upstream without Content-Length terminates by EOF.
+static bool scrape_metrics(const Config& cfg, const Url& u,
+                           std::string* body_out) {
+  int fd = connect_to(u.host, u.port, cfg.probe_timeout_s,
+                      cfg.probe_timeout_s);
+  if (fd < 0) return false;
+  std::ostringstream out;
+  out << "GET " << (u.path == "/" ? "" : u.path) << "/metrics HTTP/1.1\r\n"
+      << "Host: " << u.host << ":" << u.port << "\r\n"
+      << "Connection: close\r\n\r\n";
+  bool ok = send_all(fd, out.str());
+  if (ok) {
+    SockReader r(fd);
+    r.set_deadline(std::chrono::steady_clock::now() +
+                   std::chrono::seconds(cfg.probe_timeout_s + 3));
+    ResponseHead head;
+    ok = read_response_head(r, head) && head.status == 200;
+    if (ok) {
+      char buf[16 * 1024];
+      if (const std::string* cl = head.headers.get("content-length")) {
+        unsigned long left = 0;
+        try {
+          left = std::stoul(*cl);
+        } catch (...) {
+          ok = false;
+        }
+        while (ok && left > 0) {
+          ssize_t n = r.read_some(buf, std::min(left, sizeof buf));
+          if (n <= 0) {
+            ok = false;
+            break;
+          }
+          body_out->append(buf, static_cast<size_t>(n));
+          left -= static_cast<unsigned long>(n);
+        }
+      } else {
+        while (true) {  // EOF-terminated (Connection: close honored)
+          ssize_t n = r.read_some(buf, sizeof buf);
+          if (n < 0) {
+            ok = false;
+            break;
+          }
+          if (n == 0) break;
+          body_out->append(buf, static_cast<size_t>(n));
+        }
+      }
+    }
+  }
+  ::close(fd);
+  return ok;
+}
+
+// The aggregation contract shared with the python router: counters and
+// histogram series are SUMMED across replicas on identical label sets; a
+// gauge averaged across replicas would destroy the per-replica signal an
+// operator needs (WHICH replica is wedged), so gauges/untyped gain a
+// leading replica="<url>" label instead. llm_cluster_replica_up records
+// which replicas answered; failures also bump
+// llm_cluster_scrape_errors_total on this router's own /metrics.
+struct ClusterAgg {
+  std::map<std::string, std::string> fam_type;   // family -> TYPE
+  std::map<std::string, std::string> fam_help;   // family -> HELP
+  std::map<std::string, std::string> series_fam; // series name -> family
+  // (series name, raw label string) -> summed value, for counters/histos
+  std::map<std::pair<std::string, std::string>, double> summed;
+  // fully-labeled gauge/untyped lines: name, labels-with-replica, value
+  std::vector<std::tuple<std::string, std::string, double>> labeled;
+};
+
+// family of a series name: _bucket/_sum/_count fold onto a parent whose
+// TYPE is histogram; everything else is its own family
+static std::string family_of(const std::string& name,
+                             const std::map<std::string, std::string>& types) {
+  static const char* kSuffixes[] = {"_bucket", "_sum", "_count"};
+  for (const char* suf : kSuffixes) {
+    size_t n = strlen(suf);
+    if (name.size() > n && name.compare(name.size() - n, n, suf) == 0) {
+      std::string base = name.substr(0, name.size() - n);
+      auto it = types.find(base);
+      if (it != types.end() && it->second == "histogram") return base;
+    }
+  }
+  return name;
+}
+
+// fold one replica's exposition text into the aggregate; malformed lines
+// are skipped (a half-written exposition must not kill the cluster view)
+static void merge_exposition(ClusterAgg& agg, const std::string& replica,
+                             const std::string& text) {
+  std::map<std::string, std::string> types;  // this replica's TYPE map
+  size_t pos = 0;
+  // pass 1: TYPE lines (a sample may precede its TYPE across replicas)
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    std::string line = text.substr(pos, eol == std::string::npos
+                                            ? std::string::npos
+                                            : eol - pos);
+    pos = eol == std::string::npos ? text.size() : eol + 1;
+    if (line.compare(0, 7, "# TYPE ") == 0) {
+      std::istringstream ss(line.substr(7));
+      std::string name, type;
+      if (ss >> name >> type) {
+        types[name] = type;
+        agg.fam_type.emplace(name, type);
+      }
+    } else if (line.compare(0, 7, "# HELP ") == 0) {
+      std::string rest = line.substr(7);
+      size_t sp = rest.find(' ');
+      if (sp != std::string::npos)
+        agg.fam_help.emplace(rest.substr(0, sp), rest.substr(sp + 1));
+    }
+  }
+  // pass 2: samples
+  pos = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    std::string line = text.substr(pos, eol == std::string::npos
+                                            ? std::string::npos
+                                            : eol - pos);
+    pos = eol == std::string::npos ? text.size() : eol + 1;
+    if (line.empty() || line[0] == '#') continue;
+    std::string name, labels, valstr;
+    size_t brace = line.find('{');
+    if (brace != std::string::npos) {
+      size_t close = line.rfind('}');
+      if (close == std::string::npos || close < brace) continue;
+      name = line.substr(0, brace);
+      labels = line.substr(brace + 1, close - brace - 1);
+      valstr = line.substr(close + 1);
+    } else {
+      size_t sp = line.find(' ');
+      if (sp == std::string::npos) continue;
+      name = line.substr(0, sp);
+      valstr = line.substr(sp);
+    }
+    char* end = nullptr;
+    double value = strtod(valstr.c_str(), &end);
+    if (end == valstr.c_str()) continue;
+    std::string fam = family_of(name, types);
+    agg.series_fam.emplace(name, fam);
+    auto t = agg.fam_type.find(fam);
+    std::string type = t != agg.fam_type.end() ? t->second : "untyped";
+    if (type == "counter" || type == "histogram") {
+      agg.summed[{name, labels}] += value;
+    } else {
+      std::string relabeled =
+          "replica=\"" + prom_escape(replica) + "\"" +
+          (labels.empty() ? "" : "," + labels);
+      agg.labeled.emplace_back(name, relabeled, value);
+    }
+  }
+}
+
+// Scrapes every distinct replica and renders the merged exposition.
+// Families are emitted sorted with single HELP/TYPE headers, matching the
+// python router's /metrics/cluster output shape.
+static std::string cluster_metrics_text(const Config& cfg) {
+  std::map<std::string, const Url*> replicas;  // url string -> Url, deduped
+  for (const auto& kv : cfg.models)
+    for (const Url& u : kv.second)
+      replicas.emplace("http://" + u.host + ":" + std::to_string(u.port), &u);
+
+  ClusterAgg agg;
+  std::vector<std::pair<std::string, bool>> up;
+  for (const auto& kv : replicas) {
+    std::string body;
+    bool ok = scrape_metrics(cfg, *kv.second, &body);
+    up.emplace_back(kv.first, ok);
+    if (!ok) {
+      g_cluster_scrape_errors_total.fetch_add(1, std::memory_order_relaxed);
+      logf(cfg, "cluster scrape failed: %s", kv.first.c_str());
+      continue;
+    }
+    merge_exposition(agg, kv.first, body);
+  }
+
+  // group rendered sample lines by family
+  std::map<std::string, std::vector<std::string>> by_family;
+  for (const auto& kv : agg.summed) {
+    const std::string& name = kv.first.first;
+    const std::string& labels = kv.first.second;
+    std::ostringstream line;
+    line << name;
+    if (!labels.empty()) line << "{" << labels << "}";
+    line << " " << kv.second;
+    by_family[agg.series_fam[name]].push_back(line.str());
+  }
+  for (const auto& t : agg.labeled) {
+    std::ostringstream line;
+    line << std::get<0>(t) << "{" << std::get<1>(t) << "} " << std::get<2>(t);
+    by_family[agg.series_fam[std::get<0>(t)]].push_back(line.str());
+  }
+
+  std::ostringstream out;
+  for (auto& fam : by_family) {
+    auto h = agg.fam_help.find(fam.first);
+    out << "# HELP " << fam.first << " "
+        << (h != agg.fam_help.end()
+                ? h->second
+                : "aggregated from replicas: " + fam.first)
+        << "\n";
+    auto t = agg.fam_type.find(fam.first);
+    out << "# TYPE " << fam.first << " "
+        << (t != agg.fam_type.end() ? t->second : "untyped") << "\n";
+    std::sort(fam.second.begin(), fam.second.end());
+    for (const std::string& line : fam.second) out << line << "\n";
+  }
+  out << "# HELP llm_cluster_replica_up Replica /metrics scrape succeeded "
+         "during cluster aggregation (1=merged)\n"
+      << "# TYPE llm_cluster_replica_up gauge\n";
+  for (const auto& kv : up)
+    out << "llm_cluster_replica_up{replica=\"" << prom_escape(kv.first)
+        << "\"} " << (kv.second ? 1 : 0) << "\n";
+  out << "# HELP llm_cluster_replicas Replicas known to the router\n"
+      << "# TYPE llm_cluster_replicas gauge\n"
+      << "llm_cluster_replicas " << up.size() << "\n";
+  return out.str();
+}
+
 // exponential backoff with full jitter: base * 2^attempt * (1 + U[0,1))
 static void backoff_sleep(const Config& cfg, int attempt) {
   static thread_local unsigned seed =
@@ -722,6 +1051,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     send_all(client_fd,
              simple_response(504, "Gateway Timeout", "application/json", body,
                              req.keep_alive, rid_header));
+    g_slo.observe(504, -1.0);
     jlog_request(cfg, rid, model, "", 504, 0.0, 0.0, ms_since(t0));
     return req.keep_alive;
   };
@@ -898,6 +1228,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                                body, req.keep_alive,
                                "Retry-After: " + std::to_string(ra_s) +
                                    "\r\n" + rid_header));
+      g_slo.observe(503, -1.0);
       jlog_request(cfg, rid, model, "", 503, ms_since(t0), 0.0, ms_since(t0));
       return req.keep_alive;
     }
@@ -905,6 +1236,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     send_all(client_fd,
              simple_response(502, "Bad Gateway", "application/json", body,
                              req.keep_alive, rid_header));
+    g_slo.observe(502, -1.0);
     jlog_request(cfg, rid, model,
                  target ? target->host + ":" + std::to_string(target->port)
                         : "",
@@ -952,6 +1284,10 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       first_at == std::chrono::steady_clock::time_point{}
           ? head_ms
           : std::chrono::duration<double, std::milli>(first_at - t0).count();
+  g_slo.observe(head.status,
+                first_at == std::chrono::steady_clock::time_point{}
+                    ? -1.0
+                    : ttfb_ms);
   jlog_request(cfg, rid, model,
                target->host + ":" + std::to_string(target->port),
                head.status, connect_ms, ttfb_ms, ms_since(t0));
@@ -1040,9 +1376,62 @@ static void handle_connection(const Config& cfg, int client_fd,
                                       models_json(cfg), req.keep_alive)) &&
              req.keep_alive;
       logf(cfg, "GET /v1/models -> 200 (synthesized)");
+    } else if (path == "/metrics/cluster" && req.method == "GET") {
+      // merged view of every replica's /metrics (counters summed, gauges
+      // replica-labeled); scrape failures feed
+      // llm_cluster_scrape_errors_total on this router's own /metrics
+      keep = send_all(client_fd,
+                      simple_response(200, "OK",
+                                      "text/plain; version=0.0.4",
+                                      cluster_metrics_text(cfg),
+                                      req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "GET /metrics/cluster -> 200 (aggregated)");
     } else if (path == "/metrics" && req.method == "GET") {
+      SloTracker::Snap slo = g_slo.snapshot();
+      double uptime_s = std::chrono::duration<double>(
+          std::chrono::steady_clock::now() - g_start_steady).count();
       std::ostringstream m;
-      m << "# HELP llm_failover_total Requests retried on a different "
+      m << "# HELP llm_build_info Build/runtime identity of this process "
+           "(value is always 1)\n"
+        << "# TYPE llm_build_info gauge\n"
+        << "llm_build_info{version=\"" << kLlmkVersion
+        << "\",jax=\"none\",backend=\"native-router\"} 1\n"
+        << "# HELP llm_process_start_time_seconds Unix time this process "
+           "started\n"
+        << "# TYPE llm_process_start_time_seconds gauge\n"
+        << "llm_process_start_time_seconds "
+        << static_cast<double>(g_start_wall) << "\n"
+        << "# HELP llm_process_uptime_seconds Seconds since process start "
+           "(recomputed at scrape)\n"
+        << "# TYPE llm_process_uptime_seconds gauge\n"
+        << "llm_process_uptime_seconds " << uptime_s << "\n"
+        << "# HELP llm_cluster_scrape_errors_total Replica /metrics "
+           "scrapes that failed during /metrics/cluster aggregation "
+           "(unreachable replica, bad exposition)\n"
+        << "# TYPE llm_cluster_scrape_errors_total counter\n"
+        << "llm_cluster_scrape_errors_total "
+        << g_cluster_scrape_errors_total.load(std::memory_order_relaxed)
+        << "\n"
+        << "# HELP llm_slo_ttft_ok_ratio Fraction of recent requests whose "
+           "TTFT met the objective (sliding window; 1.0 with no traffic)\n"
+        << "# TYPE llm_slo_ttft_ok_ratio gauge\n"
+        << "llm_slo_ttft_ok_ratio " << slo.ttft_ok_ratio << "\n"
+        << "# HELP llm_slo_availability Fraction of recent requests that "
+           "did not fail 5xx/transport (sliding window; 1.0 with no "
+           "traffic)\n"
+        << "# TYPE llm_slo_availability gauge\n"
+        << "llm_slo_availability " << slo.availability << "\n"
+        << "# HELP llm_slo_error_budget_burn_rate Observed error rate over "
+           "the error budget; >1 burns budget faster than the availability "
+           "objective allows\n"
+        << "# TYPE llm_slo_error_budget_burn_rate gauge\n"
+        << "llm_slo_error_budget_burn_rate " << slo.burn_rate << "\n"
+        << "# HELP llm_slo_window_requests Requests in the current SLO "
+           "observation window\n"
+        << "# TYPE llm_slo_window_requests gauge\n"
+        << "llm_slo_window_requests " << slo.requests << "\n"
+        << "# HELP llm_failover_total Requests retried on a different "
            "replica after a connect-phase failure\n"
         << "# TYPE llm_failover_total counter\n"
         << "llm_failover_total "
@@ -1090,6 +1479,7 @@ static void handle_connection(const Config& cfg, int client_fd,
                                         std::string(kRequestIdHeader) + ": " +
                                             rid + "\r\n")) &&
                req.keep_alive;
+        g_slo.observe(404, -1.0);
         jlog_request(cfg, rid, model, "", 404, 0.0, 0.0, 0.0);
       } else {
         keep = proxy_request(cfg, req, client_fd, client_ip, model, rid);

@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Compare the two most recent bench runs and flag >20% regressions.
+
+The repo accumulates one ``BENCH_rNN.json`` per session (shape: ``{"n",
+"cmd", "rc", "tail", "parsed"}`` where ``parsed`` is bench.py's one-line
+JSON stdout contract, or null when the run crashed). This script diffs
+the latest run that produced a usable ``parsed`` payload against the
+previous such run, prints a per-metric delta table, and exits non-zero
+when any metric moved more than the threshold in the BAD direction:
+
+- latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``): higher is
+  worse;
+- throughput-ish metrics (``*tokens_per_sec*``, ``*throughput*``,
+  ``value`` — bench.py's headline tokens/s): lower is worse;
+- anything else is reported but never gates (no direction known).
+
+With fewer than two comparable runs it prints a notice and exits 0 —
+a fresh repo must not fail CI. Wired into scripts/ci.sh as an ADVISORY
+step: regressions are printed loudly but do not fail the gate, because
+sandbox bench numbers are noisy across container generations; the
+exit code is for operators running it on stable hardware.
+
+Usage: bench_compare.py [--threshold 0.20] [--dir REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sys
+
+_LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit)")
+_HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit)")
+
+
+def _numeric_items(parsed: dict) -> dict[str, float]:
+    out = {}
+    for k, v in parsed.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def _direction(name: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 unknown (never gates)."""
+    if _LOWER_BETTER.search(name):
+        return -1
+    if _HIGHER_BETTER.search(name):
+        return +1
+    return 0
+
+
+def load_runs(root: pathlib.Path) -> list[tuple[str, dict]]:
+    """(filename, parsed) for every run with a usable parsed dict,
+    ordered oldest -> newest by run number."""
+    runs = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and _numeric_items(parsed):
+            runs.append((path.name, parsed))
+    return runs
+
+
+def compare(prev: dict, cur: dict, threshold: float) -> tuple[list, list]:
+    """(table_rows, regressions). Rows: (metric, prev, cur, delta_pct,
+    verdict)."""
+    rows, regressions = [], []
+    prev_n, cur_n = _numeric_items(prev), _numeric_items(cur)
+    for name in sorted(set(prev_n) & set(cur_n)):
+        p, c = prev_n[name], cur_n[name]
+        if p == 0:
+            rows.append((name, p, c, None, "n/a (prev=0)"))
+            continue
+        delta = (c - p) / abs(p)
+        d = _direction(name)
+        bad = (d == -1 and delta > threshold) or \
+              (d == +1 and delta < -threshold)
+        verdict = ("REGRESSION" if bad
+                   else "ok" if d else "info (no direction)")
+        rows.append((name, p, c, delta, verdict))
+        if bad:
+            regressions.append(name)
+    for name in sorted(set(cur_n) - set(prev_n)):
+        rows.append((name, None, cur_n[name], None, "new"))
+    for name in sorted(set(prev_n) - set(cur_n)):
+        rows.append((name, prev_n[name], None, None, "dropped"))
+    return rows, regressions
+
+
+def main(argv: list[str]) -> int:
+    threshold = 0.20
+    root = pathlib.Path(__file__).resolve().parent.parent
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == "--threshold" and args:
+            threshold = float(args.pop(0))
+        elif a == "--dir" and args:
+            root = pathlib.Path(args.pop(0))
+        else:
+            print(__doc__.strip().splitlines()[0], file=sys.stderr)
+            return 2
+
+    runs = load_runs(root)
+    if len(runs) < 2:
+        print(f"bench-compare: {len(runs)} usable bench run(s) under "
+              f"{root} — need 2 to compare; nothing to do")
+        return 0
+
+    (prev_name, prev), (cur_name, cur) = runs[-2], runs[-1]
+    print(f"bench-compare: {prev_name} -> {cur_name} "
+          f"(threshold {threshold:.0%})")
+    rows, regressions = compare(prev, cur, threshold)
+    width = max(len(r[0]) for r in rows) if rows else 10
+    for name, p, c, delta, verdict in rows:
+        ps = f"{p:.4g}" if p is not None else "-"
+        cs = f"{c:.4g}" if c is not None else "-"
+        ds = f"{delta:+.1%}" if delta is not None else "-"
+        print(f"  {name:<{width}}  {ps:>10}  {cs:>10}  {ds:>8}  {verdict}")
+    if regressions:
+        print(f"bench-compare: {len(regressions)} metric(s) regressed "
+              f">{threshold:.0%}: {', '.join(regressions)}")
+        return 1
+    print("bench-compare: no regressions beyond threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

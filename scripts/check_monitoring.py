@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""CI gate for the shipped monitoring artifacts (alert rules + dashboard).
+
+Checks, in order:
+
+1. the generated alert rule file is well-formed YAML with the Prometheus
+   rule-file shape (groups -> rules -> alert/expr) and the dashboard is
+   well-formed JSON with panels;
+2. every ``llm_*`` series referenced by an alert expression or dashboard
+   panel is one the servers actually emit
+   (``scripts.metrics_lint.known_emitted_names()``) — a metric rename
+   cannot silently orphan its alert;
+3. the copies committed under each Helm chart's ``files/`` directory are
+   byte-identical to what ``deploy.monitoring`` renders today (the charts
+   mount them via ``.Files.Get``, so drift means helm ships stale rules).
+
+``--write`` regenerates the chart files from the source of truth instead
+of failing on drift. Exit 0 clean, 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+CHART_FILE_DIRS = (
+    ROOT / "k8s" / "tpu-models" / "helm-chart" / "files",
+    ROOT / "k8s" / "local-models" / "helm-chart" / "files",
+)
+
+
+def _load_monitoring():
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    from llms_on_kubernetes_tpu.deploy import monitoring
+    return monitoring
+
+
+def check_shapes(mon) -> list[str]:
+    problems = []
+    import yaml
+
+    rules_text = mon.alert_rules_yaml()
+    try:
+        rules = yaml.safe_load(rules_text)
+    except yaml.YAMLError as e:
+        return [f"alert rules are not valid YAML: {e}"]
+    groups = rules.get("groups") if isinstance(rules, dict) else None
+    if not groups:
+        problems.append("alert rules: no 'groups' list")
+    else:
+        for g in groups:
+            for r in g.get("rules", []):
+                for field in ("alert", "expr"):
+                    if not r.get(field):
+                        problems.append(
+                            f"alert rule in group {g.get('name')!r} "
+                            f"missing {field!r}: {r}")
+
+    dash_text = mon.dashboard_json()
+    try:
+        dash = json.loads(dash_text)
+    except json.JSONDecodeError as e:
+        return problems + [f"dashboard is not valid JSON: {e}"]
+    if not dash.get("panels"):
+        problems.append("dashboard: no panels")
+    if not dash.get("uid"):
+        problems.append("dashboard: no uid (sidecar provisioning needs one)")
+    return problems
+
+
+def check_metric_names(mon) -> list[str]:
+    from metrics_lint import known_emitted_names
+
+    known = known_emitted_names()
+    unknown = sorted(mon.referenced_metric_names() - known)
+    return [
+        f"expression references series {name!r} that nothing emits "
+        f"(known names come from the metric constructors in "
+        f"llms_on_kubernetes_tpu/server/)"
+        for name in unknown
+    ]
+
+
+def check_chart_sync(mon, write: bool) -> list[str]:
+    problems = []
+    payloads = {
+        mon.ALERT_RULES_KEY: mon.alert_rules_yaml(),
+        mon.DASHBOARD_KEY: mon.dashboard_json(),
+    }
+    for d in CHART_FILE_DIRS:
+        for fname, want in payloads.items():
+            path = d / fname
+            have = path.read_text() if path.exists() else None
+            if have == want:
+                continue
+            if write:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                path.write_text(want)
+                print(f"check-monitoring: wrote {path.relative_to(ROOT)}")
+            else:
+                state = "missing" if have is None else "stale"
+                problems.append(
+                    f"{path.relative_to(ROOT)} is {state} — regenerate "
+                    f"with: python scripts/check_monitoring.py --write")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    write = "--write" in argv
+    sys.path.insert(0, str(ROOT / "scripts"))
+    mon = _load_monitoring()
+    problems = (check_shapes(mon) + check_metric_names(mon)
+                + check_chart_sync(mon, write))
+    for p in problems:
+        print(f"check-monitoring: {p}")
+    if not problems:
+        print("check-monitoring: alert rules, dashboard, and chart "
+              "copies OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

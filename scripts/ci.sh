@@ -84,6 +84,23 @@ else
   fails=$((fails + 1))
 fi
 
+note "bench regression compare (advisory — sandbox numbers are noisy)"
+# diff the two most recent BENCH_r*.json; a >20% regression prints loudly
+# but does not fail the gate (operators run this on stable hardware)
+if "$PY" "$REPO/scripts/bench_compare.py"; then
+  echo "ci: bench compare OK"
+else
+  echo "ci: bench compare flagged regressions (advisory only)"
+fi
+
+note "monitoring artifacts (alert rules + dashboard + chart sync)"
+if "$PY" "$REPO/scripts/check_monitoring.py"; then
+  echo "ci: monitoring artifacts OK"
+else
+  echo "ci: monitoring artifacts FAILED"
+  fails=$((fails + 1))
+fi
+
 note "entry-point contracts"
 if ! "$REPO/scripts/check_entrypoints.sh"; then
   echo "ci: entry-point checks FAILED"

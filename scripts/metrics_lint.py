@@ -32,6 +32,16 @@ _LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
 # histogram/summary samples whose family is declared under the base name
 _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
+# Identity series every llmk exposition must carry (ISSUE 5 satellite):
+# a scrape with no build info / process lifetime is a process we cannot
+# attribute. Enforced by main() on scraped files — NOT inside lint(), so
+# unit tests can lint small synthetic snippets.
+REQUIRED_SERIES = (
+    "llm_build_info",
+    "llm_process_start_time_seconds",
+    "llm_process_uptime_seconds",
+)
+
 
 def parse_labels(s: str) -> tuple[list, str]:
     """Parse a ``{k="v",...}`` block at the start of ``s``.
@@ -97,11 +107,15 @@ def family_of(sample_name: str, declared: dict) -> str:
     return sample_name
 
 
-def lint(text: str, where: str) -> list[str]:
+def lint(text: str, where: str, require: tuple = ()) -> list[str]:
+    """Lint one exposition. ``require`` lists family names that must have
+    at least one sample (empty by default so snippet-level callers are
+    unaffected; main() passes REQUIRED_SERIES for scraped files)."""
     problems: list[str] = []
     helped: set = set()
     typed: dict = {}
     seen_series: set = set()
+    seen_families: set = set()
 
     for lineno, line in enumerate(text.splitlines(), 1):
         loc = f"{where}:{lineno}"
@@ -153,10 +167,51 @@ def lint(text: str, where: str) -> list[str]:
             problems.append(f"{loc}: duplicate series {name}"
                             f"{dict(labels) if labels else ''}")
         seen_series.add(series)
+        seen_families.add(family)
 
     if not seen_series and not problems:
         problems.append(f"{where}: no samples at all (empty scrape?)")
+    for fam in require:
+        if fam not in seen_families:
+            problems.append(f"{where}: required series {fam} missing "
+                            f"(every llmk exposition must carry it)")
     return problems
+
+
+def known_emitted_names() -> set[str]:
+    """Every series name the servers can emit, derived from the actual
+    metric constructors (not a hand-maintained list, so a renamed metric
+    updates this automatically). Used by scripts/check_monitoring.py to
+    validate that alert/dashboard expressions reference real series.
+
+    Imports the package's metrics modules only — none of them import jax
+    at module level, so this stays cheap and accelerator-free.
+    """
+    import pathlib
+
+    root = str(pathlib.Path(__file__).resolve().parent.parent)
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from llms_on_kubernetes_tpu.server import metrics as m
+    from llms_on_kubernetes_tpu.server.cluster_metrics import (SLOTracker,
+                                                               slo_gauges)
+    from llms_on_kubernetes_tpu.server.runtime_telemetry import runtime_metrics
+
+    reg = m.Registry()
+    m.engine_metrics(reg)
+    m.router_metrics(reg)
+    m.build_info_metrics(reg)
+    runtime_metrics(reg)
+    slo_gauges(reg, SLOTracker())
+
+    names: set[str] = set()
+    for metric in reg._metrics:
+        names.add(metric.name)
+        if isinstance(metric, m.Histogram):
+            names.update(metric.name + s for s in _SERIES_SUFFIXES)
+    # synthesized during /metrics/cluster aggregation, not in a registry
+    names.update({"llm_cluster_replica_up", "llm_cluster_replicas"})
+    return names
 
 
 def main(argv: list[str]) -> int:
@@ -172,7 +227,7 @@ def main(argv: list[str]) -> int:
             print(f"metrics-lint: cannot read {path}: {e}")
             failures += 1
             continue
-        problems = lint(text, path)
+        problems = lint(text, path, require=REQUIRED_SERIES)
         for p in problems:
             print(f"metrics-lint: {p}")
         if problems:

@@ -109,3 +109,28 @@ def test_router_and_gateway_match(chart):
                      json.dumps(r.get("route"), sort_keys=True))
                     for r in routes]
         assert norm(hroutes) == norm(proutes), key
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_monitoring_configmaps_match(chart):
+    """ISSUE 5: the alert-rules and dashboard ConfigMaps must exist in
+    both renders and carry parse-equal payloads (helm mounts the files/
+    copies via .Files.Get; the Python renderer generates them directly —
+    scripts/check_monitoring.py keeps the two in lockstep)."""
+    import json
+
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+    for name in ("llmk-alert-rules", "llmk-grafana-dashboard"):
+        key = ("ConfigMap", name)
+        assert key in helm and key in py, key
+    halerts = helm[("ConfigMap", "llmk-alert-rules")]["data"]
+    palerts = py[("ConfigMap", "llmk-alert-rules")]["data"]
+    assert (yaml.safe_load(halerts["llmk-alerts.yaml"])
+            == yaml.safe_load(palerts["llmk-alerts.yaml"]))
+    hdash = helm[("ConfigMap", "llmk-grafana-dashboard")]
+    pdash = py[("ConfigMap", "llmk-grafana-dashboard")]
+    assert (json.loads(hdash["data"]["llmk-dashboard.json"])
+            == json.loads(pdash["data"]["llmk-dashboard.json"]))
+    assert hdash["metadata"]["labels"]["grafana_dashboard"] == "1"
+    assert pdash["metadata"]["labels"]["grafana_dashboard"] == "1"

@@ -289,6 +289,75 @@ def test_router_config_matches_python_router():
     assert err is not None  # strict
 
 
+def test_monitoring_configmaps_rendered():
+    """ISSUE 5: render_manifests ships the alert-rules and Grafana
+    dashboard ConfigMaps; payloads are well-formed and land in the
+    namespace like everything else."""
+    ms = render_manifests(load_spec(BASE_YAML))
+    alerts = by_name(ms, "ConfigMap", "llmk-alert-rules")
+    rules = yaml.safe_load(alerts["data"]["llmk-alerts.yaml"])
+    group_names = [g["name"] for g in rules["groups"]]
+    assert "llmk-slo" in group_names and "llmk-serving" in group_names
+    all_rules = [r for g in rules["groups"] for r in g["rules"]]
+    by_alert = {r["alert"]: r for r in all_rules}
+    # the alerts the issue names: SLO burn, wedged engine, replica health
+    assert "llm_slo_error_budget_burn_rate" in \
+        by_alert["LLMKErrorBudgetFastBurn"]["expr"]
+    assert by_alert["LLMKEngineWedged"]["expr"] == "llm_engine_state == 3"
+    assert by_alert["LLMKReplicaUnhealthy"]["expr"] == \
+        "llm_replica_healthy == 0"
+    assert all(r.get("for") and r["labels"]["severity"] in
+               ("page", "ticket") for r in all_rules)
+
+    dash = by_name(ms, "ConfigMap", "llmk-grafana-dashboard")
+    assert dash["metadata"]["labels"]["grafana_dashboard"] == "1"
+    board = json.loads(dash["data"]["llmk-dashboard.json"])
+    assert board["uid"] == "llmk-overview"
+    assert len(board["panels"]) >= 8
+    assert alerts["metadata"]["namespace"] == "tpu-models"
+
+
+def test_monitoring_alert_exprs_reference_emitted_series():
+    """Every llm_* name in an alert expr / dashboard target must be a
+    series the servers emit (metrics_lint's constructor-derived
+    inventory) — the lockstep check behind scripts/check_monitoring.py."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "scripts"))
+    from metrics_lint import known_emitted_names
+
+    from llms_on_kubernetes_tpu.deploy.monitoring import (
+        referenced_metric_names,
+    )
+
+    missing = referenced_metric_names() - known_emitted_names()
+    assert not missing, f"alerts reference non-emitted series: {missing}"
+
+
+def test_monitoring_chart_files_in_sync():
+    """The copies committed under each chart's files/ (mounted via
+    .Files.Get) must be byte-identical to what deploy.monitoring renders —
+    otherwise helm ships stale alert rules."""
+    import pathlib
+
+    from llms_on_kubernetes_tpu.deploy import monitoring
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    payloads = {
+        monitoring.ALERT_RULES_KEY: monitoring.alert_rules_yaml(),
+        monitoring.DASHBOARD_KEY: monitoring.dashboard_json(),
+    }
+    for chart in ("tpu-models", "local-models"):
+        for fname, want in payloads.items():
+            path = root / chart / "helm-chart" / "files" / fname
+            assert path.exists(), (
+                f"{path} missing — run scripts/check_monitoring.py --write")
+            assert path.read_text() == want, (
+                f"{path} stale — run scripts/check_monitoring.py --write")
+
+
 def test_values_schema_validates_chart_defaults():
     """Both charts' values.yaml must validate against their
     values.schema.json (the reference shipped no schema — SURVEY §5 gap),

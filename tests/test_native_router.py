@@ -875,6 +875,140 @@ def test_config_file_replica_arrays(binary, tmp_path):
         srv.shutdown()
 
 
+EXPO_TMPL = """\
+# HELP llm_requests_total Requests received
+# TYPE llm_requests_total counter
+llm_requests_total {requests}
+# HELP llm_waiting_requests Requests queued
+# TYPE llm_waiting_requests gauge
+llm_waiting_requests {waiting}
+# HELP llm_ttft_seconds Time to first token
+# TYPE llm_ttft_seconds histogram
+llm_ttft_seconds_bucket{{model="m",le="+Inf"}} {requests}
+llm_ttft_seconds_sum{{model="m"}} 0.5
+llm_ttft_seconds_count{{model="m"}} {requests}
+"""
+
+
+def _start_metrics_backend(name: str, exposition: str):
+    class MetricsBackend(FakeBackend):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_error(404)
+                return
+            payload = exposition.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    handler = type(f"Metrics_{name}", (MetricsBackend,), {"name": name})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_native_cluster_metrics_sums_counters_labels_gauges(binary):
+    """ISSUE 5 acceptance (native mirror of test_router.py): the C++
+    router fronting two replicas serves /metrics/cluster with counters and
+    histogram series summed and gauges per-replica labeled."""
+    s1 = _start_metrics_backend(
+        "r1", EXPO_TMPL.format(requests=3, waiting=2))
+    s2 = _start_metrics_backend(
+        "r2", EXPO_TMPL.format(requests=4, waiting=7))
+    u1 = f"http://127.0.0.1:{s1.server_address[1]}"
+    u2 = f"http://127.0.0.1:{s2.server_address[1]}"
+    router = RouterProc(binary, {"m": f"{u1}|{u2}"})
+    try:
+        status, data = router.request("GET", "/metrics/cluster")
+        assert status == 200
+        text = data.decode()
+        assert "llm_requests_total 7" in text
+        assert 'llm_ttft_seconds_count{model="m"} 7' in text
+        assert f'llm_waiting_requests{{replica="{u1}"}} 2' in text
+        assert f'llm_waiting_requests{{replica="{u2}"}} 7' in text
+        assert f'llm_cluster_replica_up{{replica="{u1}"}} 1' in text
+        assert f'llm_cluster_replica_up{{replica="{u2}"}} 1' in text
+        assert "llm_cluster_replicas 2" in text
+        # single HELP/TYPE per family in the merged view
+        assert text.count("# TYPE llm_requests_total counter") == 1
+        assert text.count("# TYPE llm_waiting_requests gauge") == 1
+    finally:
+        router.stop()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_native_cluster_scrape_errors_surfaced(binary):
+    """A dead replica shows up as replica_up=0 in the merged view AND
+    bumps llm_cluster_scrape_errors_total on the router's own /metrics —
+    never a silent drop."""
+    s1 = _start_metrics_backend(
+        "r1", EXPO_TMPL.format(requests=3, waiting=2))
+    u1 = f"http://127.0.0.1:{s1.server_address[1]}"
+    dead = f"http://127.0.0.1:{free_port()}"
+    router = RouterProc(binary, {"m": f"{u1}|{dead}"})
+    try:
+        status, data = router.request("GET", "/metrics/cluster")
+        assert status == 200
+        text = data.decode()
+        assert f'llm_cluster_replica_up{{replica="{u1}"}} 1' in text
+        assert f'llm_cluster_replica_up{{replica="{dead}"}} 0' in text
+        assert "llm_requests_total 3" in text  # live data still merged
+        own = _metrics(router)
+        assert _metric_value(own, "llm_cluster_scrape_errors_total") >= 1
+    finally:
+        router.stop()
+        s1.shutdown()
+
+
+def test_native_metrics_build_info_and_slo_series(stack):
+    """Every native exposition carries the build-info/uptime identity
+    series and the SLO gauges (vacuous-pass defaults with no traffic)."""
+    text = _metrics(stack)
+    assert 'llm_build_info{version="' in text
+    assert 'backend="native-router"' in text
+    assert _metric_value(text, "llm_process_start_time_seconds") > 0
+    assert _metric_value(text, "llm_process_uptime_seconds") >= 0
+    assert _metric_value(text, "llm_slo_ttft_ok_ratio") == 1.0
+    assert _metric_value(text, "llm_slo_availability") == 1.0
+    assert _metric_value(text, "llm_slo_error_budget_burn_rate") == 0.0
+    for family in ("llm_build_info", "llm_slo_availability",
+                   "llm_cluster_scrape_errors_total"):
+        assert f"# HELP {family} " in text, family
+        assert f"# TYPE {family} " in text, family
+
+
+def test_native_slo_tracker_observes_outcomes(binary):
+    """Proxied request outcomes feed the SLO window: successes keep
+    availability at 1.0; 502s (dead upstream) drag it down and start
+    burning error budget."""
+    srv = start_backend("live")
+    router = RouterProc(binary, {"m": srv.server_address[1]})
+    dead = RouterProc(binary, {"m": free_port()})
+    try:
+        for _ in range(3):
+            status, _ = router.request("POST", "/v1/chat/completions",
+                                       {"model": "m"})
+            assert status == 200
+        text = _metrics(router)
+        assert _metric_value(text, "llm_slo_window_requests") >= 3
+        assert _metric_value(text, "llm_slo_availability") == 1.0
+
+        for _ in range(2):
+            status, _ = dead.request("POST", "/v1/chat/completions",
+                                     {"model": "m"})
+            assert status == 502
+        text = _metrics(dead)
+        assert _metric_value(text, "llm_slo_availability") < 1.0
+        assert _metric_value(text, "llm_slo_error_budget_burn_rate") > 1.0
+    finally:
+        router.stop()
+        dead.stop()
+        srv.shutdown()
+
+
 def test_native_retry_rides_out_connection_resets(binary):
     """First two connections die with RST; the third succeeds — bounded
     retries with backoff turn a flapping upstream into one slow 200."""

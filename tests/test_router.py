@@ -359,6 +359,150 @@ def test_request_id_on_router_generated_errors():
     run_with_router(body, strict=True)
 
 
+def _metrics_backend(name: str, exposition: str) -> web.Application:
+    app = make_backend(name)
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=exposition, content_type="text/plain")
+
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+EXPO_A = """\
+# HELP llm_requests_total Requests received
+# TYPE llm_requests_total counter
+llm_requests_total 3
+# HELP llm_waiting_requests Requests queued
+# TYPE llm_waiting_requests gauge
+llm_waiting_requests 2
+# HELP llm_ttft_seconds Time to first token
+# TYPE llm_ttft_seconds histogram
+llm_ttft_seconds_bucket{model="m",le="+Inf"} 3
+llm_ttft_seconds_sum{model="m"} 0.5
+llm_ttft_seconds_count{model="m"} 3
+"""
+
+EXPO_B = EXPO_A.replace("llm_requests_total 3", "llm_requests_total 4") \
+               .replace("llm_waiting_requests 2", "llm_waiting_requests 7")
+
+
+def test_cluster_metrics_sums_counters_and_labels_gauges():
+    """ISSUE 5 acceptance: a router fronting two replicas serves
+    /metrics/cluster where counters (and histogram series) are the SUM
+    across replicas and gauges carry a replica= label per source."""
+    async def go():
+        b1 = TestClient(TestServer(_metrics_backend("r1", EXPO_A)))
+        b2 = TestClient(TestServer(_metrics_backend("r2", EXPO_B)))
+        await b1.start_server()
+        await b2.start_server()
+        u1 = str(b1.make_url("")).rstrip("/")
+        u2 = str(b2.make_url("")).rstrip("/")
+        router = Router({"m": [u1, u2]})
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/metrics/cluster")
+            assert r.status == 200
+            text = await r.text()
+            # counters summed across replicas (3 + 4)
+            assert "llm_requests_total 7.0" in text
+            # histogram series summed too
+            assert ('llm_ttft_seconds_count{model="m"} 6.0' in text
+                    or 'llm_ttft_seconds_count{model="m"} 6' in text)
+            # gauges per-replica labeled, value preserved per source
+            assert f'llm_waiting_requests{{replica="{u1}"}} 2.0' in text
+            assert f'llm_waiting_requests{{replica="{u2}"}} 7.0' in text
+            # scrape bookkeeping
+            assert f'llm_cluster_replica_up{{replica="{u1}"}} 1.0' in text
+            assert f'llm_cluster_replica_up{{replica="{u2}"}} 1.0' in text
+            assert "llm_cluster_replicas 2.0" in text
+            assert router.metrics["cluster_scrape_errors"].value == 0
+        finally:
+            await client.close()
+            await b1.close()
+            await b2.close()
+    asyncio.run(go())
+
+
+def test_cluster_metrics_counts_scrape_errors_not_silent():
+    """An unreachable replica must surface as replica_up=0 AND bump
+    llm_cluster_scrape_errors_total — never vanish from the merged view."""
+    async def go():
+        b1 = TestClient(TestServer(_metrics_backend("r1", EXPO_A)))
+        await b1.start_server()
+        u1 = str(b1.make_url("")).rstrip("/")
+        dead = f"http://127.0.0.1:{_free_port()}"
+        router = Router({"m": [u1, dead]})
+        router.scrape_timeout_s = 1.0
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/metrics/cluster")
+            text = await r.text()
+            assert f'llm_cluster_replica_up{{replica="{u1}"}} 1.0' in text
+            assert f'llm_cluster_replica_up{{replica="{dead}"}} 0.0' in text
+            # live replica's data still merged
+            assert "llm_requests_total 3.0" in text
+            assert router.metrics["cluster_scrape_errors"].value == 1
+            # the error is also visible on the router's own /metrics
+            own = await (await client.get("/metrics")).text()
+            assert "llm_cluster_scrape_errors_total 1.0" in own
+            assert "llm_build_info{" in own
+            assert "llm_slo_availability" in own
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
+def test_slo_tracker_window_and_burn_rate():
+    from llms_on_kubernetes_tpu.server.cluster_metrics import SLOTracker
+
+    tr = SLOTracker(window_s=60.0, ttft_objective_ms=100.0,
+                    availability_target=0.99)
+    # vacuous pass with no traffic
+    snap = tr.snapshot(now=1000.0)
+    assert snap["availability"] == 1.0
+    assert snap["ttft_ok_ratio"] == 1.0
+    assert snap["error_budget_burn_rate"] == 0.0
+
+    for _ in range(9):
+        tr.observe(200, ttft_ms=50.0, now=1000.0)
+    tr.observe(503, ttft_ms=500.0, now=1000.0)   # one 5xx, one slow TTFT
+    tr.observe(404, now=1000.0)                  # 4xx counts available
+    tr.observe(0, now=1000.0)                    # transport failure: not
+    snap = tr.snapshot(now=1000.0)
+    assert snap["requests"] == 12
+    assert snap["availability"] == 10 / 12
+    assert snap["ttft_ok_ratio"] == 9 / 10
+    expected_burn = (1 - 10 / 12) / 0.01
+    assert abs(snap["error_budget_burn_rate"] - expected_burn) < 1e-9
+
+    # samples age out of the window
+    snap = tr.snapshot(now=1100.0)
+    assert snap["requests"] == 0 and snap["availability"] == 1.0
+
+
+def test_router_proxy_feeds_slo_tracker():
+    async def go():
+        b1 = TestClient(TestServer(make_backend("live")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")})
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={"model": "m"})
+            assert r.status == 200
+            snap = router.slo.snapshot()
+            assert snap["requests"] >= 1
+            assert snap["availability"] == 1.0
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
 def test_router_trace_ring_records_spans():
     async def go():
         b1 = TestClient(TestServer(make_backend("live")))

@@ -407,6 +407,86 @@ def test_request_id_echo_and_trace_spans():
     with_client(body)
 
 
+def test_metrics_runtime_telemetry_series():
+    """ISSUE 5 acceptance: /metrics carries the device-memory and
+    compile-cache series (CPU fallback: live-buffer bytes per device) plus
+    build info and the kernel-vs-host step split counters."""
+    async def body(client):
+        await client.post("/v1/completions", json={
+            "prompt": "abc", "max_tokens": 3, "temperature": 0})
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "llm_build_info{" in text and 'jax="' in text
+        assert "llm_process_uptime_seconds" in text
+        assert "llm_device_memory_bytes{" in text
+        assert "llm_device_live_buffer_bytes{" in text
+        assert "llm_jit_compiles_total" in text
+        assert "llm_jit_cache_hits_total" in text
+        assert "llm_step_device_seconds_total" in text
+        assert "llm_step_host_seconds_total" in text
+    with_client(body)
+
+
+def test_debug_engine_reports_device_host_split():
+    """Flight frames attribute each step's wall time to device wait vs
+    host work; the two parts can never exceed the step itself."""
+    async def body(client):
+        await client.post("/v1/completions", json={
+            "prompt": "abc", "max_tokens": 3, "temperature": 0})
+        r = await client.get("/debug/engine")
+        snap = await r.json()
+        assert snap["steps"], "no flight frames recorded"
+        for step in snap["steps"]:
+            assert step["device_ms"] >= 0.0
+            assert step["host_ms"] >= 0.0
+            total = step["device_ms"] + step["host_ms"]
+            assert total <= step["step_ms"] + 1.0  # rounding slack
+    with_client(body)
+
+
+def test_debug_profile_capture_list_download(tmp_path, monkeypatch):
+    """ISSUE 5 acceptance (CPU e2e): POST /debug/profile answers a capture
+    id, GET lists a non-empty capture, GET /debug/profile/<id> downloads a
+    tar.gz of it; malformed ids and durations are rejected."""
+    import io
+    import tarfile
+
+    monkeypatch.setenv("LLMK_PROFILE_DIR", str(tmp_path))
+
+    async def body(client):
+        r = await client.post("/debug/profile", json={"duration_ms": 120})
+        assert r.status == 200, await r.text()
+        meta = await r.json()
+        assert meta["id"].startswith("cap-")
+        assert meta["source"] in ("jax-profiler", "py-sampler")
+        assert meta["files"], "capture produced no files"
+
+        r = await client.get("/debug/profile")
+        listing = await r.json()
+        assert listing["busy"] is False
+        mine = [c for c in listing["captures"] if c["id"] == meta["id"]]
+        assert mine and mine[0]["files"]
+
+        r = await client.get(f"/debug/profile/{meta['id']}")
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/gzip"
+        data = await r.read()
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+            names = tar.getnames()
+        assert any(n.endswith("capture.json") for n in names)
+
+        # unknown/malformed ids: 404, never a path traversal
+        r = await client.get("/debug/profile/cap-999-999")
+        assert r.status == 404
+        r = await client.get("/debug/profile/%2e%2e%2fetc")
+        assert r.status == 404
+
+        # non-positive duration: 400
+        r = await client.post("/debug/profile", json={"duration_ms": -5})
+        assert r.status == 400
+    with_client(body)
+
+
 def test_debug_engine_flight_recorder():
     async def body(client):
         await client.post("/v1/completions", json={

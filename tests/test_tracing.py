@@ -269,3 +269,94 @@ def test_lint_catches_non_numeric_value_and_bad_type():
 
 def test_lint_flags_empty_scrape():
     assert metrics_lint.lint("", "t") == ["t: no samples at all (empty scrape?)"]
+
+
+# ---------------------------------------------------------------------------
+# ring wraparound + limit clamping (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+def test_trace_store_limit_clamped_to_one():
+    """limit=0 or negative must still answer with one trace, not zero or
+    the whole ring (the HTTP layer forwards ?limit= unchecked)."""
+    store = tracing.TraceStore(capacity=4)
+    for i in range(3):
+        t = tracing.Trace(f"id-{i}")
+        t.finish()
+        store.add(t)
+    assert [t["id"] for t in store.snapshot(limit=0)] == ["id-2"]
+    assert [t["id"] for t in store.snapshot(limit=-5)] == ["id-2"]
+
+
+def test_trace_store_wraparound_keeps_only_newest():
+    """Filling the ring 3x over: evicted ids are gone (filtering by an
+    evicted id answers empty, never a stale trace) and insertion order is
+    preserved across the wrap."""
+    store = tracing.TraceStore(capacity=4)
+    for i in range(12):
+        t = tracing.Trace(f"id-{i}")
+        t.finish()
+        store.add(t)
+    snap = store.snapshot()
+    assert [t["id"] for t in snap] == ["id-11", "id-10", "id-9", "id-8"]
+    assert store.snapshot(request_id="id-3") == []
+
+
+def test_flight_recorder_limit_clamping_and_wraparound():
+    fr = tracing.FlightRecorder(capacity=3)
+    for i in range(7):
+        fr.record(step_ms=float(i))
+    # seq keeps counting past the wrap; the window holds the newest 3
+    snap = fr.snapshot()
+    assert snap["steps_recorded"] == 7
+    assert [s["step"] for s in snap["steps"]] == [5, 6, 7]
+    # limit larger than capacity: the full window, no padding/error
+    assert len(fr.snapshot(limit=99)["steps"]) == 3
+    # limit=0/None mean "no trim" (the /debug/engine default)
+    assert len(fr.snapshot(limit=0)["steps"]) == 3
+    assert len(fr.snapshot(limit=None)["steps"]) == 3
+    assert len(fr.snapshot(limit=1)["steps"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# required-series check + emitted-name inventory (ISSUE 5 satellites)
+# ---------------------------------------------------------------------------
+
+def test_lint_require_is_opt_in():
+    # snippet-level lint stays permissive...
+    assert metrics_lint.lint(CLEAN, "t") == []
+    # ...but with require= the identity series become mandatory
+    problems = metrics_lint.lint(CLEAN, "t",
+                                 require=metrics_lint.REQUIRED_SERIES)
+    missing = [p for p in problems if "required series" in p]
+    assert len(missing) == len(metrics_lint.REQUIRED_SERIES)
+
+
+def test_lint_require_satisfied_by_build_info_metrics():
+    from llms_on_kubernetes_tpu.server.metrics import (Registry,
+                                                       build_info_metrics)
+
+    reg = Registry()
+    build_info_metrics(reg, backend="test")
+    text = reg.render()
+    assert metrics_lint.lint(text, "t",
+                             require=metrics_lint.REQUIRED_SERIES) == []
+    assert 'backend="test"' in text
+    assert "llm_process_uptime_seconds" in text
+
+
+def test_known_emitted_names_covers_alert_expressions():
+    """Every series referenced by the shipped alert rules / dashboard must
+    come out of an actual metric constructor (a rename orphans its alert
+    and this is the test that catches it)."""
+    from llms_on_kubernetes_tpu.deploy.monitoring import (
+        referenced_metric_names,
+    )
+
+    known = metrics_lint.known_emitted_names()
+    # spot-check the inventory itself
+    for name in ("llm_requests_total", "llm_ttft_seconds_bucket",
+                 "llm_slo_error_budget_burn_rate",
+                 "llm_device_memory_bytes", "llm_jit_compiles_total",
+                 "llm_cluster_replica_up"):
+        assert name in known, name
+    assert referenced_metric_names() <= known
