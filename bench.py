@@ -275,7 +275,85 @@ def measure_engine(eng, cfg, prompt_len, gen_len, rng) -> dict:
     }
 
 
-def start_native_router(model_name: str, upstream_port: int):
+def write_tiny_adapters(out_dir: str, cfg, n: int, rank: int) -> dict:
+    """Write ``n`` synthetic PEFT LoRA checkpoints (q/k/v/o projections,
+    every layer) sized for ``cfg`` and return {name: dir}. Weights are
+    deterministic per adapter (seeded by index) — the bench measures the
+    batched heterogeneous-adapter decode path, not the values."""
+    from safetensors.numpy import save_file
+
+    D = cfg.hidden_size
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    shapes = {"q": (D, H * hd), "k": (D, KV * hd),
+              "v": (D, KV * hd), "o": (H * hd, D)}
+    refs = {}
+    for i in range(n):
+        rng = np.random.default_rng(100 + i)
+        d = os.path.join(out_dir, f"ad{i}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "adapter_config.json"), "w") as f:
+            json.dump({"r": rank, "lora_alpha": 2 * rank}, f)
+        tensors = {}
+        for layer in range(cfg.num_layers):
+            for mod, (fin, fout) in shapes.items():
+                pre = (f"base_model.model.model.layers.{layer}"
+                       f".self_attn.{mod}_proj")
+                tensors[pre + ".lora_A.weight"] = (
+                    0.02 * rng.standard_normal((rank, fin))).astype(np.float32)
+                tensors[pre + ".lora_B.weight"] = (
+                    0.02 * rng.standard_normal((fout, rank))).astype(np.float32)
+        save_file(tensors, os.path.join(d, "adapter_model.safetensors"))
+        refs[f"ad{i}"] = d
+    return refs
+
+
+def measure_adapter_decode(eng, cfg, prompt_len, gen_len, names, rng) -> dict:
+    """Multi-tenant decode throughput: every batch row carries a LoRA
+    adapter, round-robined over ``names`` so one decode step applies
+    heterogeneous adapters. Same steady-state window method as
+    ``measure_engine`` — the number is directly comparable to the
+    base-only ``tokens_per_sec`` headline. Also reports the adapter-cache
+    hit ratio accumulated over the engine's lifetime."""
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    B = eng.config.max_decode_slots
+    reqs = [
+        eng.submit(
+            list(rng.integers(1, cfg.vocab_size - 1, prompt_len)),
+            SamplingParams(temperature=0.0, max_tokens=gen_len),
+            adapter=names[i % len(names)],
+        )
+        for i in range(B - 1)
+    ]
+    window_start = window_end = None
+    tokens_at_start = tokens_at_end = 0
+    total_tokens = 0
+    while any(not r.finished for r in reqs):
+        events = eng.step()
+        now = time.monotonic()
+        step_tokens = sum(len(ev.new_tokens) for ev in events)
+        total_tokens += step_tokens
+        active = sum(r is not None for r in eng.slots)
+        if step_tokens and active >= B - 1:
+            if window_start is None:
+                window_start, tokens_at_start = now, total_tokens
+            window_end, tokens_at_end = now, total_tokens
+    decode_tokens = tokens_at_end - tokens_at_start
+    decode_time = (window_end - window_start) if window_start is not None else 0.0
+    stats = eng.adapters.stats
+    lookups = stats["hits"] + stats["misses"]
+    out = {
+        "adapter_decode_tokens_per_sec": (
+            round(decode_tokens / decode_time, 1) if decode_time > 0 else 0.0),
+        "adapter_count": len(names),
+    }
+    if lookups:
+        out["adapter_cache_hit_ratio"] = round(stats["hits"] / lookups, 3)
+    return out
+
+
+def start_native_router(model_name: str, upstream_port: int,
+                        adapter_names=None):
     """Spawn the native C++ router (native/router/llkt-router) in front of
     the OpenAI server. Returns ``(proc, port)`` once /health answers OK,
     or None when the binary is missing/unbuildable or never comes up —
@@ -299,11 +377,12 @@ def start_native_router(model_name: str, upstream_port: int):
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    proc = subprocess.Popen(
-        [binary, "--models",
-         f"{model_name}=http://127.0.0.1:{upstream_port}",
-         "--port", str(port), "--quiet"],
-        stderr=subprocess.DEVNULL)
+    args = [binary, "--models",
+            f"{model_name}=http://127.0.0.1:{upstream_port}",
+            "--port", str(port), "--quiet"]
+    if adapter_names:
+        args += ["--adapters", f"{model_name}={'|'.join(adapter_names)}"]
+    proc = subprocess.Popen(args, stderr=subprocess.DEVNULL)
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:
         if proc.poll() is not None:
@@ -322,11 +401,15 @@ def start_native_router(model_name: str, upstream_port: int):
     return None
 
 
-def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
+def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int,
+                  adapter_names=None) -> dict:
     """Measure the BASELINE.md metric definition: client -> multi-model
     router -> OpenAI server -> engine (the in-cluster portion of the Istio
     gateway path). Returns {"gateway_p50_ttft_ms", "gateway_tokens_per_sec",
-    "gateway_router", ...}.
+    "gateway_router", ...}. When ``adapter_names`` is set (the engine
+    serves LoRA adapters), one ``model=<base>:<adapter>`` request plus an
+    unknown-adapter 404 check go through the same router and the verdict
+    lands in ``gateway_adapter_ok``.
 
     Runs the real aiohttp OpenAI server in-process and fronts it with the
     NATIVE router (llkt-router — what the charts actually deploy), falling
@@ -369,7 +452,9 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
             sport = s_runner.addresses[0][1]
             ports["server"] = sport
             router = Router({model_name: f"http://127.0.0.1:{sport}"},
-                            default_model=model_name, strict=False)
+                            default_model=model_name, strict=False,
+                            adapters=({model_name: list(adapter_names)}
+                                      if adapter_names else None))
             r_runner = web.AppRunner(router.make_app())
             await r_runner.setup()
             r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
@@ -386,7 +471,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     t.start()
     if not ready.wait(timeout=60):
         raise RuntimeError("gateway bench: apps failed to start")
-    native = start_native_router(model_name, ports["server"])
+    native = start_native_router(model_name, ports["server"], adapter_names)
     if native is not None:
         native_proc, port = native
         router_kind = "native"
@@ -414,6 +499,30 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
 
     # warm the HTTP/engine path end-to-end
     fire(4)
+
+    # multi-tenant routing check: one base:adapter request must stream
+    # through the gateway, and an unconfigured adapter must 404 with the
+    # structured adapter_not_found error (NOT fall back to the base model)
+    adapter_ok = None
+    if adapter_names:
+        def post(doc, timeout=300):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=timeout)
+            conn.request("POST", "/v1/completions", _json.dumps(doc),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+
+        doc = {"prompt": [int(x) for x in
+                          rng.integers(1, vocab - 1, prompt_len)],
+               "max_tokens": 4, "temperature": 0.0, "stream": True}
+        st, data = post({**doc, "model": f"{model_name}:{adapter_names[0]}"})
+        adapter_ok = st == 200 and b"data:" in data
+        st, data = post({**doc, "model": f"{model_name}:no-such-adapter"},
+                        timeout=30)
+        adapter_ok = adapter_ok and st == 404 and b"adapter_not_found" in data
 
     # background load: fill the decode batch during the probes (throughput
     # through the gateway is only meaningful at capacity). ONE asyncio
@@ -537,7 +646,7 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
     t.join(timeout=30)
     ttfts.sort()
     engine_ttfts.sort()
-    return {
+    out = {
         "gateway_router": router_kind,
         "gateway_p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
         # the same probes measured inside the engine (submit -> first
@@ -548,6 +657,9 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int) -> dict:
         "gateway_tokens_per_sec": round(n_load * gen / load_wall, 1),
         "gateway_phase_p50_ms": phase_p50,
     }
+    if adapter_ok is not None:
+        out["gateway_adapter_ok"] = adapter_ok
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -650,6 +762,28 @@ def _main() -> int:
     on_tpu = platform != "cpu"
     errors: list[str] = []
 
+    # multi-tenant LoRA scenario: synthetic PEFT adapters round-robined
+    # across the decode batch. In smoke mode they ride on the ONE engine
+    # (pipeline validation — including the base:adapter gateway hop);
+    # in measurement mode they get their own engine AFTER the headline
+    # phases so the base-only number stays uncontaminated by the
+    # adapter-gather decode step.
+    import dataclasses
+    import tempfile
+
+    n_adapters = int(os.environ.get("BENCH_ADAPTERS", "3"))
+    adapter_rank = 4 if smoke else 8
+    adapter_refs: dict = {}
+    if n_adapters > 0:
+        adapter_dir = tempfile.mkdtemp(prefix="llmk-bench-adapters-")
+        adapter_refs = write_tiny_adapters(adapter_dir, cfg, n_adapters,
+                                           adapter_rank)
+    adapter_names = sorted(adapter_refs)
+    if smoke and adapter_refs:
+        ecfg = dataclasses.replace(
+            ecfg, adapters=adapter_refs,
+            adapter_slots=n_adapters, adapter_rank=adapter_rank)
+
     # --- phase 1: engine-level measure (fresh engine per attempt: a
     # failed device read leaves the old pipeline state unknown) ---------
     def engine_phase():
@@ -666,13 +800,17 @@ def _main() -> int:
     # retry the engine is rebuilt since the failure class is transport) --
     gw = {}
     if eng is not None:
+        gw_adapters = adapter_names if (smoke and adapter_refs) else None
+
         def gateway_phase():
-            return gateway_bench(eng, cfg.name, prompt_len, cfg.vocab_size)
+            return gateway_bench(eng, cfg.name, prompt_len, cfg.vocab_size,
+                                 adapter_names=gw_adapters)
 
         def gateway_phase_fresh():
             e2 = build_engine(ecfg, cfg)
             warm_engine(e2, cfg, prompt_len, np.random.default_rng(0))
-            return gateway_bench(e2, cfg.name, prompt_len, cfg.vocab_size)
+            return gateway_bench(e2, cfg.name, prompt_len, cfg.vocab_size,
+                                 adapter_names=gw_adapters)
 
         gw = with_retries("gateway", gateway_phase, errors, attempts=1)
         if gw is None:
@@ -688,6 +826,41 @@ def _main() -> int:
                               attempts=2)
         gw = gw or {}
 
+    # --- phase 3: multi-tenant adapter decode (vs the base-only value) --
+    adp = {}
+    if adapter_refs:
+        if eng is not None and eng.adapters is not None:
+            # smoke: the phase-1 engine already carries the adapters
+            def adapter_phase():
+                return measure_adapter_decode(
+                    eng, cfg, prompt_len, gen_len, adapter_names,
+                    np.random.default_rng(2))
+
+            adp = with_retries("adapters", adapter_phase, errors,
+                               attempts=1) or {}
+        else:
+            # slots = adapter count: every tenant resident, so the number
+            # measures heterogeneous-adapter decode, not cache churn
+            a_ecfg = dataclasses.replace(
+                ecfg, adapters=adapter_refs,
+                adapter_slots=n_adapters, adapter_rank=adapter_rank)
+
+            def adapter_phase_fresh():
+                e3 = build_engine(a_ecfg, cfg)
+                rng = np.random.default_rng(2)
+                warm_engine(e3, cfg, prompt_len, rng)
+                return measure_adapter_decode(
+                    e3, cfg, prompt_len, gen_len, adapter_names, rng)
+
+            # drop the base engine first — two full-size engines cannot
+            # coexist on one 16 GB chip
+            import gc
+            eng = None
+            eng_out = None  # noqa: F841
+            gc.collect()
+            adp = with_retries("adapters", adapter_phase_fresh, errors,
+                               attempts=2) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -698,6 +871,7 @@ def _main() -> int:
         "vs_baseline": round(per_dollar / baseline_per_dollar, 3),
         **{k: v for k, v in engine_stats.items() if k != "tokens_per_sec"},
         **gw,
+        **adp,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

@@ -52,6 +52,16 @@ helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
 - --dtype
 - {{ $m.dtype | quote }}
 {{- end }}
+{{- range $m.adapters }}
+- --adapter
+- {{ printf "%s=%s" .name (default .huggingfaceId .path) | quote }}
+{{- end }}
+{{- if $m.adapters }}
+- --adapter-slots
+- {{ $m.adapterSlots | default 4 | quote }}
+- --adapter-rank
+- {{ $m.adapterRank | default 16 | quote }}
+{{- end }}
 {{- range $m.engineArgs }}
 - {{ . | quote }}
 {{- end }}

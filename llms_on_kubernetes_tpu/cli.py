@@ -58,6 +58,15 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="image/frame blocks the mm prefill is compiled for "
                         "(a video counts one block per temporal patch); "
                         "requests beyond it get a 400")
+    p.add_argument("--adapter", action="append", default=None,
+                   metavar="NAME=REF",
+                   help="repeatable: serve LoRA adapter NAME from REF (HF "
+                        "repo id or local dir); requests address it as "
+                        "model=<served-name>:NAME")
+    p.add_argument("--adapter-slots", type=_positive_int, default=4,
+                   help="on-device adapter slots (LRU-recycled)")
+    p.add_argument("--adapter-rank", type=_positive_int, default=16,
+                   help="max LoRA rank the device stacks are sized for")
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -76,6 +85,10 @@ def _add_router(sub: argparse._SubParsersAction) -> None:
                    metavar="SECONDS",
                    help="active /ready probe period per replica "
                         "(default 2.0; 0 disables probing)")
+    p.add_argument("--adapters", action="append", default=None,
+                   metavar="NAME=ADAPTER[|ADAPTER...]",
+                   help="repeatable: LoRA adapters a model's replicas "
+                        "serve, addressed as model=NAME:ADAPTER")
 
 
 def _add_render(sub: argparse._SubParsersAction) -> None:
@@ -112,12 +125,14 @@ def main(argv: list[str] | None = None) -> int:
         from llms_on_kubernetes_tpu.server.router import run_router
 
         backends = {}
+        adapters = {}
         default_model, strict = args.default_model, args.strict
         probe_interval = args.probe_interval
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
             backends.update(cfg.get("backends", {}))
+            adapters.update(cfg.get("adapters", {}))
             default_model = default_model or cfg.get("default_model")
             strict = strict or bool(cfg.get("strict", False))
             if probe_interval is None and "probe_interval_s" in cfg:
@@ -127,13 +142,20 @@ def main(argv: list[str] | None = None) -> int:
             if not urls:
                 parser.error(f"--backend must be NAME=URL[|URL...], got {spec!r}")
             backends[name] = [u for u in urls.split("|") if u]
+        for spec in args.adapters or ():
+            name, _, names = spec.partition("=")
+            if not names:
+                parser.error(
+                    f"--adapters must be NAME=ADAPTER[|ADAPTER...], got {spec!r}")
+            adapters[name] = [a for a in names.split("|") if a]
         if not backends:
             parser.error("router needs --config or at least one --backend")
         if probe_interval is None:
             probe_interval = 2.0
         run_router(backends, default_model, strict,
                    host=args.host, port=args.port,
-                   probe_interval_s=probe_interval or None)
+                   probe_interval_s=probe_interval or None,
+                   adapters=adapters or None)
         return 0
 
     # serve
@@ -218,6 +240,13 @@ def main(argv: list[str] | None = None) -> int:
                      f"{n_dev} local devices")
     mesh = make_mesh(data=1, seq=sp, expert=ep, model=tp)
 
+    adapters = {}
+    for spec in args.adapter or ():
+        name, _, ref = spec.partition("=")
+        if not name or not ref:
+            parser.error(f"--adapter must be NAME=REF, got {spec!r}")
+        adapters[name] = ref
+
     engine_cfg = EngineConfig(
         model=model_cfg.name,
         dtype=args.dtype,
@@ -230,6 +259,9 @@ def main(argv: list[str] | None = None) -> int:
         prefix_caching=args.prefix_caching,
         kv_cache_dtype=args.kv_cache_dtype,
         max_images_per_request=args.max_images_per_request,
+        adapters=adapters,
+        adapter_slots=args.adapter_slots,
+        adapter_rank=args.adapter_rank,
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
     )

@@ -92,6 +92,11 @@ def _engine_args(m: ModelSpec, spec: DeploySpec) -> list[str]:
         args += ["--quantization", m.quantization]
     if m.dtype:
         args += ["--dtype", m.dtype]
+    for a in m.adapters:
+        args += ["--adapter", f"{a.name}={a.ref}"]
+    if m.adapters:
+        args += ["--adapter-slots", str(m.adapter_slots),
+                 "--adapter-rank", str(m.adapter_rank)]
     args += list(m.engine_args)
     return args
 
@@ -362,13 +367,20 @@ def _backend_urls(m: ModelSpec, spec: DeploySpec) -> list[str]:
 def router_config(spec: DeploySpec) -> dict[str, Any]:
     """The router's model→replica-set table (consumed by server/router.py
     and by the native C++ router alike)."""
-    return {
+    cfg: dict[str, Any] = {
         "backends": {m.model_name: _backend_urls(m, spec)
                      for m in spec.models},
         "default_model": spec.resolved_default,
         "strict": spec.strict_routing,
         "probe_interval_s": spec.probe_interval_s,
     }
+    adapters = {m.model_name: [a.name for a in m.adapters]
+                for m in spec.models if m.adapters}
+    if adapters:
+        # base:adapter requests resolve at the gateway; unknown adapters
+        # of a known base 404 instead of falling back to the base model
+        cfg["adapters"] = adapters
+    return cfg
 
 
 def config_hash(spec: DeploySpec) -> str:

@@ -155,6 +155,25 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKAdapterThrash",
+                        "expr": (
+                            "rate(llm_adapter_cache_evictions_total[5m])"
+                            " > 0.5"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "LoRA adapter cache thrashing",
+                            "description": (
+                                "Engine on {{ $labels.instance }} is "
+                                "evicting adapters faster than one every "
+                                "two seconds for 10m; the working set of "
+                                "adapters exceeds the device slots "
+                                "(raise adapterSlots or split tenants)."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -230,6 +249,14 @@ def grafana_dashboard() -> dict[str, Any]:
                ["rate(llm_tokens_generated_total[5m])"], 0, 32),
         _panel(10, "KV pages used / waiting requests",
                ["llm_kv_pages_used", "llm_waiting_requests"], 12, 32),
+        _panel(11, "Adapter cache: hits / misses / evictions",
+               ["rate(llm_adapter_cache_hits_total[5m])",
+                "rate(llm_adapter_cache_misses_total[5m])",
+                "rate(llm_adapter_cache_evictions_total[5m])"], 0, 40),
+        _panel(12, "Adapter load latency p95",
+               ["histogram_quantile(0.95, "
+                "rate(llm_adapter_load_seconds_bucket[5m]))"], 12, 40,
+               unit="s"),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

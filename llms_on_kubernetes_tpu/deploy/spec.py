@@ -94,6 +94,33 @@ class ShardingSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdapterSpec:
+    """One LoRA adapter a model's replicas serve (multi-tenant serving):
+    requests address it as ``model: "<modelName>:<name>"``."""
+
+    name: str
+    huggingface_id: Optional[str] = None
+    path: Optional[str] = None
+
+    @property
+    def ref(self) -> str:
+        return self.path or self.huggingface_id or ""
+
+    def validate(self, model_name: str) -> None:
+        if not _NAME_RE.match(self.name):
+            raise SpecError(
+                f"model {model_name}: adapter name {self.name!r} must be a "
+                f"DNS-1123 label (it becomes part of the model id "
+                f"'{model_name}:{self.name}')"
+            )
+        if not self.ref:
+            raise SpecError(
+                f"model {model_name}: adapter {self.name!r} needs "
+                f"huggingfaceId or path"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelSpec:
     model_name: str
     huggingface_id: Optional[str] = None
@@ -113,6 +140,11 @@ class ModelSpec:
     # ramalama model-deployments.yaml:36-37); ignored when tpu is set
     resources: Optional[dict] = None
     dtype: Optional[str] = None            # engine --dtype override
+    # multi-tenant LoRA: adapters served on this model's replicas, the
+    # device slot count (LRU-recycled) and max rank the slots are sized for
+    adapters: tuple = ()                   # tuple[AdapterSpec, ...]
+    adapter_slots: int = 4
+    adapter_rank: int = 16
 
     def validate(self) -> None:
         if not _NAME_RE.match(self.model_name):
@@ -143,6 +175,20 @@ class ModelSpec:
                 f"model {self.model_name}: replicas={self.replicas} with a "
                 f"ReadWriteOnce cache PVC deadlocks on volume attach; set "
                 f"pvcShared: true (ReadOnlyMany) or replicas: 1"
+            )
+        anames = [a.name for a in self.adapters]
+        adupes = {n for n in anames if anames.count(n) > 1}
+        if adupes:
+            raise SpecError(
+                f"model {self.model_name}: duplicate adapter name(s): "
+                f"{sorted(adupes)}"
+            )
+        for a in self.adapters:
+            a.validate(self.model_name)
+        if self.adapters and (self.adapter_slots < 1 or self.adapter_rank < 1):
+            raise SpecError(
+                f"model {self.model_name}: adapterSlots and adapterRank "
+                f"must be >= 1"
             )
 
 
@@ -212,11 +258,27 @@ def _tpu_from(d: Optional[dict]) -> Optional[TPUSpec]:
     )
 
 
+def _adapter_from(d: dict, model_name: str) -> AdapterSpec:
+    if not isinstance(d, dict):
+        raise SpecError(
+            f"model {model_name}: adapters[] entries must be mappings")
+    unknown = set(d) - {"name", "huggingfaceId", "path"}
+    if unknown:
+        raise SpecError(
+            f"model {model_name}: unknown adapter keys: {sorted(unknown)}")
+    return AdapterSpec(
+        name=str(d.get("name", "")),
+        huggingface_id=d.get("huggingfaceId"),
+        path=d.get("path"),
+    )
+
+
 def _model_from(d: dict) -> ModelSpec:
     known = {
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype",
+        "adapters", "adapterSlots", "adapterRank",
     }
     unknown = set(d) - known
     if unknown:
@@ -244,6 +306,10 @@ def _model_from(d: dict) -> ModelSpec:
         engine_args=tuple(d.get("engineArgs", ())),
         resources=d.get("resources"),
         dtype=d.get("dtype"),
+        adapters=tuple(_adapter_from(a, d.get("modelName", ""))
+                       for a in d.get("adapters", ()) or ()),
+        adapter_slots=int(d.get("adapterSlots", 4)),
+        adapter_rank=int(d.get("adapterRank", 16)),
     )
 
 

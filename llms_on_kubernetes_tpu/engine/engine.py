@@ -63,6 +63,12 @@ class QueueFullError(RuntimeError):
     The API layer maps this to HTTP 429 + Retry-After."""
 
 
+class UnknownAdapterError(LookupError):
+    """The request named a LoRA adapter this engine does not serve. The
+    API layer maps this to a structured HTTP 404 (adapter_not_found) —
+    NOT the unknown-model fallback."""
+
+
 @dataclasses.dataclass
 class SamplingParams:
     temperature: float = 1.0
@@ -172,6 +178,18 @@ class EngineConfig:
     # harvester wait. None => env LLMK_WATCHDOG_S (default 120); <= 0
     # disables.
     watchdog_stall_s: Optional[float] = None
+    # multi-tenant LoRA (engine/adapters.py + ops/lora.py): (name, ref)
+    # pairs of servable adapters. Requests pick one by name
+    # (model=base:adapter upstream); adapter_slots bounds how many live in
+    # the device stacks at once (LRU-recycled), adapter_rank is the stack
+    # rank every adapter is padded to (rank > cap rejected at load), and
+    # adapter_targets names the weights the stacks attach to. Empty
+    # adapters => no stacks exist and every executable is byte-identical
+    # to the pre-LoRA engine.
+    adapters: tuple = ()
+    adapter_slots: int = 4
+    adapter_rank: int = 16
+    adapter_targets: tuple = ("wq", "wk", "wv", "wo")
     seed: int = 0
 
     def __post_init__(self):
@@ -201,6 +219,32 @@ class EngineConfig:
             raise ValueError(
                 f"grammar_classes must be in (0, 32767], got "
                 f"{self.grammar_classes}")
+        # normalize adapters to sorted (name, ref) pairs; names must be
+        # usable inside OpenAI model strings ("base:adapter") and metric
+        # label values
+        if isinstance(self.adapters, dict):
+            self.adapters = tuple(sorted(self.adapters.items()))
+        else:
+            self.adapters = tuple((str(n), str(r)) for n, r in self.adapters)
+        seen_names: set = set()
+        for name, _ref in self.adapters:
+            if not name or ":" in name or "," in name or "=" in name \
+                    or any(c.isspace() for c in name):
+                raise ValueError(
+                    f"adapter name {name!r} is invalid (no ':', ',', '=', "
+                    f"whitespace, or empty)")
+            if name in seen_names:
+                raise ValueError(f"duplicate adapter name {name!r}")
+            seen_names.add(name)
+        if self.adapters:
+            if self.adapter_slots < 1:
+                raise ValueError(
+                    f"adapter_slots must be >= 1 when adapters are "
+                    f"configured, got {self.adapter_slots}")
+            if self.adapter_rank < 1:
+                raise ValueError(
+                    f"adapter_rank must be >= 1, got {self.adapter_rank}")
+        self.adapter_targets = tuple(self.adapter_targets)
 
     @property
     def max_model_len(self) -> int:
@@ -246,6 +290,11 @@ class Request:
     fsm_row: int = -1
     fsm_start: int = -1
     pending_fsm_state: Optional[int] = None
+    # multi-tenant LoRA: the adapter NAME this request decodes with (None
+    # = base model) and its pinned device slot in the LoRA stacks (-1
+    # until admission acquires one; released at finish/preemption)
+    adapter: Optional[str] = None
+    adapter_slot: int = -1
     finished: bool = False
     finish_reason: Optional[str] = None
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
@@ -598,10 +647,11 @@ def _pack_bias(packed: np.ndarray, row: int, base: int, params) -> None:
 
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
 # 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
-# 9 frequency(bits), 10 pos_delta (mrope), 11-13 fsm (row, set, val),
-# 14.. logit_bias ids/vals, then page_table
-_FSM_DEC = 11
-_BIAS_DEC = 14
+# 9 frequency(bits), 10 pos_delta (mrope), 11 adapter_slot (-1 = base),
+# 12-14 fsm (row, set, val), 15.. logit_bias ids/vals, then page_table
+_ADP_DEC = 11
+_FSM_DEC = 12
+_BIAS_DEC = 15
 _DEC_COLS = _BIAS_DEC + 2 * LOGIT_BIAS_SLOTS
 
 
@@ -617,6 +667,7 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
     frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
     pos_delta = packed[:, 10]
+    adapter_idx = packed[:, _ADP_DEC]
     bias = _unpack_bias(packed, _BIAS_DEC)
     page_table = packed[:, _DEC_COLS:]
 
@@ -626,7 +677,7 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
     counts = _count_decode_tokens(counts, tokens, lengths > 0)
     logits, k_pages, v_pages = forward_decode(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table,
-        pos_delta=pos_delta,
+        pos_delta=pos_delta, adapter_idx=adapter_idx,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     allowed = nxt_all = new_state = None
@@ -646,9 +697,11 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
 # 4 seed, 5 presence(bits), 6 frequency(bits), 7 slot, 8 prompt_len,
-# 9-10 fsm (row, init), 11.. logit_bias ids/vals, then page_table
-_FSM_PRE = 9
-_BIAS_PRE = 11
+# 9 adapter_slot (-1 = base), 10-11 fsm (row, init), 12.. logit_bias
+# ids/vals, then page_table
+_ADP_PRE = 9
+_FSM_PRE = 10
+_BIAS_PRE = 12
 _PRE_COLS = _BIAS_PRE + 2 * LOGIT_BIAS_SLOTS
 
 
@@ -682,6 +735,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
     frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
     slots = packed[:, 7]
     prompt_len = packed[:, 8]
+    adapter_idx = packed[:, _ADP_PRE]
     bias = _unpack_bias(packed, _BIAS_PRE)
     page_table = packed[:, _PRE_COLS:]
 
@@ -691,6 +745,7 @@ def _prefill_mm_packed_step(params, cfg, tokens, packed, img_embeds,
     logits, k_pages, v_pages = forward_prefill_mm(
         params, cfg, tokens, lengths, k_pages, v_pages, page_table,
         img_embeds, deepstack=deepstack, pos3=pos3, prompt_len=prompt_len,
+        adapter_idx=adapter_idx,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     allowed = nxt_all = new_state = None
@@ -717,6 +772,7 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     frequency = jax.lax.bitcast_convert_type(packed[:, 6], jnp.float32)
     slots = packed[:, 7]
     prompt_len = packed[:, 8]
+    adapter_idx = packed[:, _ADP_PRE]
     bias = _unpack_bias(packed, _BIAS_PRE)
     page_table = packed[:, _PRE_COLS:]
 
@@ -724,7 +780,8 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
         counts, tokens, slots, jnp.zeros_like(lengths), prompt_len, lengths,
         jnp.ones_like(lengths))
     logits, k_pages, v_pages = forward_prefill(
-        params, cfg, tokens, lengths, k_pages, v_pages, page_table
+        params, cfg, tokens, lengths, k_pages, v_pages, page_table,
+        adapter_idx=adapter_idx,
     )
     keys = _slot_keys(base_key, seeds, lengths)
     row_counts = counts[slots]
@@ -746,13 +803,15 @@ def _prefill_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
 # 9 prompt_len, 10 reset (first chunk of the request — history may be
 # nonzero when a cached prefix was adopted), 11 pos_delta (mrope: a
 # cache-hit Qwen3-VL remainder replays through this path with rope
-# positions shifted by the request's mrope delta), 12-13 fsm (row, init —
-# set only on the FINAL chunk, whose sample is the first real token),
-# 14.. logit_bias ids/vals, then page_table. Sampling position is the
-# TOTAL length (history + chunk_len) so a chunked prompt draws exactly
-# the tokens a one-shot prefill of the same prompt would.
-_FSM_CHK = 12
-_BIAS_CHK = 14
+# positions shifted by the request's mrope delta), 12 adapter_slot
+# (-1 = base), 13-14 fsm (row, init — set only on the FINAL chunk, whose
+# sample is the first real token), 15.. logit_bias ids/vals, then
+# page_table. Sampling position is the TOTAL length (history + chunk_len)
+# so a chunked prompt draws exactly the tokens a one-shot prefill of the
+# same prompt would.
+_ADP_CHK = 12
+_FSM_CHK = 13
+_BIAS_CHK = 15
 _CHK_COLS = _BIAS_CHK + 2 * LOGIT_BIAS_SLOTS
 
 
@@ -770,6 +829,7 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     prompt_len = packed[:, 9]
     reset = packed[:, 10]
     pos_delta = packed[:, 11]
+    adapter_idx = packed[:, _ADP_CHK]
     bias = _unpack_bias(packed, _BIAS_CHK)
     page_table = packed[:, _CHK_COLS:]
 
@@ -777,7 +837,7 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
         counts, tokens, slots, history, prompt_len, lengths, reset)
     logits, k_pages, v_pages = forward_chunk(
         params, cfg, tokens, history, lengths, k_pages, v_pages, page_table,
-        pos_delta=pos_delta,
+        pos_delta=pos_delta, adapter_idx=adapter_idx,
     )
     keys = _slot_keys(base_key, seeds, history + lengths)
     allowed = nxt_all = new_state = None
@@ -994,6 +1054,7 @@ class Engine:
             (B, _DEC_COLS + engine_config.pages_per_slot), np.int32)
         self._dec_rows[:, 1] = 1                               # src: host
         self._dec_rows[:, 5] = np.float32(1.0).view(np.int32)  # top_p off
+        self._dec_rows[:, _ADP_DEC] = -1                       # base model
         self._dec_rows[:, _FSM_DEC] = -1                       # no grammar
         self._dec_row_owner: list = [None] * B
         # grammar-constrained decoding: resident-grammar registry + device
@@ -1022,6 +1083,109 @@ class Engine:
 
         self._score_jit = jax.jit(forward_score, static_argnums=(1, 4))
 
+        # multi-tenant LoRA: attach zeroed per-target LoRAStacks to the
+        # params and build the slot manager. With no adapters configured
+        # the stacks are never created and every trace above stays the
+        # byte-identical pre-LoRA program.
+        self._adapters = None
+        if engine_config.adapters:
+            self._init_adapters()
+
+    # ------------------------------------------------------------------
+    # multi-tenant LoRA (engine/adapters.py, ops/lora.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def adapters(self):
+        """The AdapterManager, or None on an adapter-free engine."""
+        return self._adapters
+
+    def _init_adapters(self) -> None:
+        from llms_on_kubernetes_tpu.engine.adapters import (
+            AdapterManager, load_adapter,
+        )
+        from llms_on_kubernetes_tpu.ops.lora import lora_zeros
+
+        cfg = self.model_config
+        ec = self.config
+        if ec.multihost:
+            # follower pods replay packed steps against their own params
+            # copy and have no upload path for slot residency changes
+            raise ValueError(
+                "multi-tenant LoRA adapters are not supported with "
+                "multihost=true")
+        if cfg.num_experts and any(
+                t.startswith("w_") for t in ec.adapter_targets):
+            raise ValueError(
+                f"adapter_targets {ec.adapter_targets} include MLP "
+                f"projections, but {cfg.name!r} is MoE (per-expert LoRA "
+                f"is not supported); use attention-only targets")
+        D, F = cfg.hidden_size, cfg.intermediate_size
+        H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        # (in_shape, out_shape) in the decoder's einsum layouts
+        shapes = {
+            "wq": ((D,), (H, hd)), "wk": ((D,), (KV, hd)),
+            "wv": ((D,), (KV, hd)), "wo": ((H, hd), (D,)),
+            "w_gate": ((D,), (F,)), "w_up": ((D,), (F,)),
+            "w_down": ((F,), (D,)),
+        }
+        S, r = ec.adapter_slots, ec.adapter_rank
+        for t in ec.adapter_targets:
+            if t not in shapes:
+                raise ValueError(f"unknown adapter target {t!r} "
+                                 f"(supported: {sorted(shapes)})")
+            stack = lora_zeros(cfg.num_layers, S, *shapes[t], r)
+            if self.mesh is not None:
+                from llms_on_kubernetes_tpu.parallel.sharding import (
+                    shard_lora_stack,
+                )
+                stack = shard_lora_stack(stack, self.mesh)
+            self.params["layers"]["lora_" + t] = stack
+
+        def _load(name: str, ref: str):
+            from llms_on_kubernetes_tpu.engine.hub import ensure_adapter_dir
+
+            return load_adapter(name, ensure_adapter_dir(ref), cfg, r,
+                                targets=ec.adapter_targets)
+
+        self._adapters = AdapterManager(
+            dict(ec.adapters), S, _load, self._upload_adapter)
+
+    def _upload_adapter(self, slot: int, loaded) -> None:
+        """Copy one adapter's factors into device slot ``slot`` of every
+        target stack (zeroing targets it doesn't train). Safe while steps
+        are in flight: params is a non-donated jit argument, so dispatched
+        steps hold the previous buffers by value."""
+        from llms_on_kubernetes_tpu.ops.lora import LoRAStack
+
+        layers = self.params["layers"]
+        for t in self.config.adapter_targets:
+            stack = layers["lora_" + t]
+            fac = loaded.factors.get(t)
+            if fac is None:
+                a = stack.a.at[:, slot].set(0.0)
+                b = stack.b.at[:, slot].set(0.0)
+            else:
+                a = stack.a.at[:, slot].set(jnp.asarray(fac[0]))
+                b = stack.b.at[:, slot].set(jnp.asarray(fac[1]))
+            layers["lora_" + t] = LoRAStack(a, b, rank_axis=stack.rank_axis)
+
+    def _ensure_adapter(self, req: "Request") -> bool:
+        """Pin ``req``'s adapter into a device slot; False = every slot is
+        pinned by running requests — the caller waits, like page pressure."""
+        if req.adapter is None or req.adapter_slot >= 0:
+            return True
+        slot = self._adapters.acquire(req.adapter)
+        if slot is None:
+            return False
+        req.adapter_slot = slot
+        return True
+
+    def _release_adapter(self, req: "Request") -> None:
+        if req.adapter_slot >= 0 and self._adapters is not None:
+            self._adapters.release(req.adapter_slot)
+            req.adapter_slot = -1
+
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
@@ -1034,6 +1198,7 @@ class Engine:
         on_event=None,
         images=None,
         deadline: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> Request:
         if self.wedged:
             raise EngineStallError(
@@ -1043,6 +1208,11 @@ class Engine:
         max_len = self.config.max_model_len
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if adapter is not None and (
+                self._adapters is None or not self._adapters.known(adapter)):
+            raise UnknownAdapterError(
+                f"adapter {adapter!r} is not served by this engine "
+                f"(configured: {self._adapters.names() if self._adapters else []})")
         if images is not None:
             # normalize to a LIST of float32 arrays — [H, W, C] = an
             # image, [F, H, W, C] = a VIDEO's frames (Qwen3-VL; F frames
@@ -1125,7 +1295,7 @@ class Engine:
             prompt=list(prompt), params=params, seed=seed, images=images,
             mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
-            deadline=deadline,
+            deadline=deadline, adapter=adapter,
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:
@@ -1365,6 +1535,7 @@ class Engine:
         packed[row, 6] = np.float32(req.params.frequency_penalty).view(np.int32)
         packed[row, 7] = slot
         packed[row, 8] = len(req.prompt)  # output-token counting boundary
+        packed[row, _ADP_PRE] = req.adapter_slot
         # fresh constrained rows start at the grammar's start state;
         # resumed rows (req.output non-empty) sample a DISCARDED token
         # unconstrained and their first decode fsm_sets the replayed state
@@ -1424,6 +1595,7 @@ class Engine:
             packed[0, 9] = len(req.prompt)
             packed[0, 10] = 1 if pos == start else 0  # first chunk: reset counts
             packed[0, 11] = req.mrope_delta
+            packed[0, _ADP_CHK] = req.adapter_slot
             # only the FINAL chunk's sample is the request's first real
             # token; earlier chunks (and every chunk of a resumed
             # request) sample discarded tokens unconstrained
@@ -1749,6 +1921,8 @@ class Engine:
             if (req.params.grammar is not None
                     and not self._ensure_grammar(req)):
                 return []  # all grammar rows pinned; wait like page pressure
+            if req.adapter is not None and not self._ensure_adapter(req):
+                return []  # every adapter slot pinned; wait like pages
             resumed = bool(req.output)
             prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
             n = len(prefill_tokens)
@@ -1790,6 +1964,7 @@ class Engine:
             tokens[0, :n] = prefill_tokens
             packed = np.zeros((1, _PRE_COLS + self.allocator.pages_per_slot),
                               np.int32)
+            packed[:, _ADP_PRE] = -1
             packed[:, _FSM_PRE:_FSM_PRE + 2] = -1
             self._pack_prefill_row(packed, 0, req, n, slot)
             use_fsm = packed[0, _FSM_PRE] >= 0
@@ -1847,6 +2022,7 @@ class Engine:
             req.trace.event("finish", request=req.id, reason=reason,
                             tokens=len(req.output))
         self._g_release(req)
+        self._release_adapter(req)
         if req.slot >= 0:
             self.allocator.free(req.slot)
             self.slot_len[req.slot] = 0
@@ -1920,6 +2096,9 @@ class Engine:
         # release the grammar hold too: re-admission re-ensures residency
         # and host-replays the FSM state from the emitted tokens
         self._g_release(victim)
+        # ... and the adapter pin: re-admission re-acquires (the factors
+        # stay host-cached, so a round trip is an upload at worst)
+        self._release_adapter(victim)
         with self._lock:
             self.waiting.appendleft(victim)
 
@@ -1941,11 +2120,13 @@ class Engine:
                     tmpl[i, :] = 0
                     tmpl[i, 1] = 1
                     tmpl[i, 5] = np.float32(1.0).view(np.int32)
+                    tmpl[i, _ADP_DEC] = -1
                     tmpl[i, _FSM_DEC] = -1
                     owners[i] = None
                 continue
             fsm_row = r.fsm_row if r.fsm_row >= 0 else -1
-            if owners[i] is r and tmpl[i, _FSM_DEC] == fsm_row:
+            if (owners[i] is r and tmpl[i, _FSM_DEC] == fsm_row
+                    and tmpl[i, _ADP_DEC] == r.adapter_slot):
                 continue
             tmpl[i, :] = 0
             tmpl[i, 1] = 1
@@ -1956,6 +2137,7 @@ class Engine:
             tmpl[i, 8] = np.float32(r.params.presence_penalty).view(np.int32)
             tmpl[i, 9] = np.float32(r.params.frequency_penalty).view(np.int32)
             tmpl[i, 10] = r.mrope_delta
+            tmpl[i, _ADP_DEC] = r.adapter_slot
             tmpl[i, _FSM_DEC] = fsm_row
             _pack_bias(tmpl, i, _BIAS_DEC, r.params)
             owners[i] = r
@@ -2069,6 +2251,8 @@ class Engine:
                 if (req.params.grammar is not None
                         and not self._ensure_grammar(req)):
                     break  # all grammar rows pinned; wait
+                if req.adapter is not None and not self._ensure_adapter(req):
+                    break  # every adapter slot pinned; wait
                 resumed = bool(req.output)
                 prefill_tokens = req.prompt + (req.output[:-1] if resumed else [])
                 n = len(prefill_tokens)
@@ -2147,6 +2331,7 @@ class Engine:
         tokens = np.zeros((K, bucket), np.int32)
         packed = np.zeros((K, _PRE_COLS + pps), np.int32)
         packed[:, 3] = np.float32(1.0).view(np.int32)  # top_p disabled
+        packed[:, _ADP_PRE] = -1                       # padded rows: base
         packed[:, _FSM_PRE:_FSM_PRE + 2] = -1          # padded rows: none
         for row, (slot, req, _resumed, ptoks) in enumerate(picked):
             n = len(ptoks)
@@ -2448,9 +2633,10 @@ class Engine:
         self,
         prompt: list[int],
         params: Optional[SamplingParams] = None,
+        adapter: Optional[str] = None,
     ) -> list[int]:
         """Synchronous single-request generation (drives the scheduler)."""
-        req = self.submit(prompt, params)
+        req = self.submit(prompt, params, adapter=adapter)
         while not req.finished:
             self.step()
         return req.output

@@ -37,6 +37,14 @@ _ALLOW_PATTERNS = (
 )
 
 
+# A PEFT-format LoRA adapter is two small files; *.bin variants are
+# skipped on purpose — the loader only reads safetensors.
+_ADAPTER_ALLOW_PATTERNS = (
+    "adapter_config.json",
+    "adapter_model.safetensors",
+)
+
+
 def hub_token() -> Optional[str]:
     """Token from the mounted Secret: env var or a token file.
 
@@ -73,6 +81,34 @@ def download_snapshot(repo_id: str, cache_dir: Optional[str] = None,
         allow_patterns=list(_ALLOW_PATTERNS),
         token=token if token is not None else hub_token(),
     )
+
+
+def ensure_adapter_dir(adapter_ref: str,
+                       cache_dir: Optional[str] = None) -> str:
+    """Resolve a local PEFT LoRA adapter dir for ``adapter_ref``,
+    downloading the Hub snapshot on a miss (same PVC cache layout as the
+    base weights). An explicit directory wins; either way the dir must
+    hold ``adapter_config.json`` + ``adapter_model.safetensors`` — an
+    incomplete snapshot is a load failure, never a silent base-model
+    fallback."""
+    if os.path.isdir(adapter_ref):
+        path = adapter_ref
+    else:
+        from huggingface_hub import snapshot_download
+
+        from llms_on_kubernetes_tpu.engine.weights import hf_hub_cache
+
+        path = snapshot_download(
+            adapter_ref,
+            cache_dir=hf_hub_cache(cache_dir),
+            allow_patterns=list(_ADAPTER_ALLOW_PATTERNS),
+            token=hub_token(),
+        )
+    for fname in _ADAPTER_ALLOW_PATTERNS:
+        if not os.path.isfile(os.path.join(path, fname)):
+            raise FileNotFoundError(
+                f"adapter {adapter_ref!r}: {path} has no {fname}")
+    return path
 
 
 def ensure_model_dir(model_ref: str, cache_dir: Optional[str] = None) -> str:

@@ -35,12 +35,21 @@ from llms_on_kubernetes_tpu.ops.attention import (
     dispatch_chunk_attention, dispatch_paged_attention,
     dispatch_prefill_attention, softcap,
 )
+from llms_on_kubernetes_tpu.ops.lora import lora_qeinsum
 from llms_on_kubernetes_tpu.ops.moe import moe_block
 from llms_on_kubernetes_tpu.ops.norms import rms_norm
 from llms_on_kubernetes_tpu.ops.quant import qeinsum
 from llms_on_kubernetes_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
+
+
+def _lqe(eq: str, x: jnp.ndarray, lp: Params, name: str, idx):
+    """``qeinsum`` of layer weight ``name`` plus, when the layer carries a
+    LoRA stack for it AND a per-row adapter index is given, the batch's
+    per-slot adapter deltas (ops/lora.py). Adapter-free engines never
+    attach stacks, so every existing trace is unchanged."""
+    return lora_qeinsum(eq, x, lp[name], lp.get("lora_" + name), idx)
 
 
 def _act(cfg: ModelConfig):
@@ -136,10 +145,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[str] = None) -
 # Layer
 # ---------------------------------------------------------------------------
 
-def _qkv(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
-    q = qeinsum("btd,dhk->bthk", h, lp["wq"])
-    k = qeinsum("btd,dhk->bthk", h, lp["wk"])
-    v = qeinsum("btd,dhk->bthk", h, lp["wv"])
+def _qkv(lp: Params, cfg: ModelConfig, h: jnp.ndarray, adapter_idx=None):
+    q = _lqe("btd,dhk->bthk", h, lp, "wq", adapter_idx)
+    k = _lqe("btd,dhk->bthk", h, lp, "wk", adapter_idx)
+    v = _lqe("btd,dhk->bthk", h, lp, "wv", adapter_idx)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -150,7 +159,8 @@ def _qkv(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(lp: Params, cfg: ModelConfig, h: jnp.ndarray, token_valid: jnp.ndarray) -> jnp.ndarray:
+def _mlp(lp: Params, cfg: ModelConfig, h: jnp.ndarray, token_valid: jnp.ndarray,
+         adapter_idx=None) -> jnp.ndarray:
     act = _act(cfg)
     if cfg.is_moe:
         B, T, D = h.shape
@@ -162,9 +172,9 @@ def _mlp(lp: Params, cfg: ModelConfig, h: jnp.ndarray, token_valid: jnp.ndarray)
             valid=token_valid.reshape(B * T),
         )
         return out.reshape(B, T, D)
-    gate = act(qeinsum("btd,df->btf", h, lp["w_gate"]))
-    up = qeinsum("btd,df->btf", h, lp["w_up"])
-    return qeinsum("btf,fd->btd", gate * up, lp["w_down"])
+    gate = act(_lqe("btd,df->btf", h, lp, "w_gate", adapter_idx))
+    up = _lqe("btd,df->btf", h, lp, "w_up", adapter_idx)
+    return _lqe("btf,fd->btd", gate * up, lp, "w_down", adapter_idx)
 
 
 def _layer_step(
@@ -185,6 +195,7 @@ def _layer_step(
     mm_pos3: "jnp.ndarray | None" = None,  # [B, 3, T] qwen3vl mrope
     rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
     token_valid: "jnp.ndarray | None" = None,  # [B, T]; default: writes>=0
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     scale = (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
     # Gemma-2/3 interleaved attention: layer is global iff (i+1) % pattern == 0;
@@ -197,7 +208,7 @@ def _layer_step(
         inv_freq = jnp.where(is_global, inv_freq, inv_freq_local)
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
-    q, k, v = _qkv(lp, cfg, h)
+    q, k, v = _qkv(lp, cfg, h, adapter_idx=adapter_idx)
     if mm_pos3 is not None:
         # multimodal prompt on an mrope model (Qwen3-VL): interleaved
         # 3-axis rotary; for text-only rows all three axes are equal and
@@ -245,7 +256,7 @@ def _layer_step(
                 scale=scale, sliding_window=window,
                 attn_softcap=cfg.attn_softcap,
             )
-    out = qeinsum("bthk,hkd->btd", attn, lp["wo"])
+    out = _lqe("bthk,hkd->btd", attn, lp, "wo", adapter_idx)
     if cfg.post_norms:
         out = rms_norm(out, lp["attn_post_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     x = x + out
@@ -253,7 +264,8 @@ def _layer_step(
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     m = _mlp(lp, cfg, h,
              token_valid=(write_positions >= 0 if token_valid is None
-                          else token_valid))
+                          else token_valid),
+             adapter_idx=adapter_idx)
     if cfg.post_norms:
         m = rms_norm(m, lp["mlp_post_norm"], cfg.rms_norm_eps, style=cfg.norm_style)
     x = x + m
@@ -278,6 +290,7 @@ def _run_layers(
     mm_is_img: "jnp.ndarray | None" = None,   # [B, T] image-token mask
     rope_positions: "jnp.ndarray | None" = None,  # [B, T] mrope-shifted
     token_valid: "jnp.ndarray | None" = None,  # [B, T] MoE routing mask
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling))
     inv_freq_local = (
@@ -309,6 +322,7 @@ def _run_layers(
             xc, lp, kp, vp, layer_idx=idx, inv_freq_local=inv_freq_local,
             mm_groups=mm_groups, mm_pos3=mm_pos3,
             rope_positions=rope_positions, token_valid=token_valid,
+            adapter_idx=adapter_idx,
         )
         if deepstack is not None:
             # DeepStack (Qwen3-VL): intermediate vision features are ADDED
@@ -361,6 +375,7 @@ def forward_prefill(
     k_pages: jnp.ndarray,     # [KV, L*P, page, hd] flat pool
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, pages_per_seq]
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     """Process whole prompts; returns (last-token logits [B, V], new cache)."""
     B, T = tokens.shape
@@ -370,6 +385,7 @@ def forward_prefill(
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
         positions, write_positions, lengths, "prefill",
+        adapter_idx=adapter_idx,
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -459,6 +475,7 @@ def forward_prefill_mm(
     deepstack: "jnp.ndarray | None" = None,  # [n_taps, B, n_img*t_img, D]
     pos3: "jnp.ndarray | None" = None,       # [B, 3, T] qwen3vl mrope
     prompt_len: "jnp.ndarray | None" = None,  # [B] image-region bound
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     """Multimodal prefill: image soft tokens' embeddings are substituted at
     ``image_token_id`` positions (row-major across the prompt's images),
@@ -496,6 +513,7 @@ def forward_prefill_mm(
         cfg, params, x, k_pages, v_pages, page_table,
         positions, write_positions, lengths, "prefill", mm_groups=bidir,
         mm_pos3=pos3, deepstack=deepstack, mm_idx=idx, mm_is_img=is_img,
+        adapter_idx=adapter_idx,
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
@@ -512,6 +530,7 @@ def forward_chunk(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     pos_delta: "jnp.ndarray | None" = None,  # [B] mrope position offset
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     """Chunked prefill: process one chunk of a prompt whose earlier chunks
     are already in the paged cache. Returns the chunk's last-token logits
@@ -536,7 +555,7 @@ def forward_chunk(
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
         positions, write_positions, lengths, "chunk",
-        rope_positions=rope_positions,
+        rope_positions=rope_positions, adapter_idx=adapter_idx,
     )
     last = jnp.clip(lengths - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
@@ -552,6 +571,7 @@ def forward_decode(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     pos_delta: "jnp.ndarray | None" = None,  # [B] mrope position offset
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
 ):
     """One decode step for every active slot; returns (logits [B, V], cache).
 
@@ -568,5 +588,6 @@ def forward_decode(
     x, k_pages, v_pages = _run_layers(
         cfg, params, x, k_pages, v_pages, page_table,
         rope_positions, write_positions, lengths, "decode",
+        adapter_idx=adapter_idx,
     )
     return _logits(params, cfg, x[:, 0]), k_pages, v_pages

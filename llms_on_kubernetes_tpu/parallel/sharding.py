@@ -106,6 +106,28 @@ def shard_pool(pool, cfg: ModelConfig, mesh: Mesh):
     return jax.tree.map(put, pool)
 
 
+def shard_lora_stack(stack, mesh: Mesh):
+    """Device_put a LoRAStack onto the mesh, rank-parallel when possible.
+
+    The rank dimension is the stack's only axis guaranteed shardable
+    regardless of the base model's head/hidden divisibility (the engine
+    picks it), and it is a contraction of the delta — so each device holds
+    a rank shard of BOTH factors and ``lora_delta`` psums the partial
+    deltas (mirrors row-parallel wo/w_down). When the model axis doesn't
+    divide the rank, the stack replicates (it's tiny: 2*S*r*(in+out))."""
+    from llms_on_kubernetes_tpu.ops.lora import LoRAStack
+
+    ax = _axis(mesh, stack.rank, AXIS_MODEL)
+    a_spec = P(*([None] * (stack.a.ndim - 1)), ax)      # [..., S, *in, r]
+    b_spec = P(None, None, ax,                          # [L, S, r, *out]
+               *([None] * (stack.b.ndim - 3)))
+    return LoRAStack(
+        jax.device_put(stack.a, NamedSharding(mesh, a_spec)),
+        jax.device_put(stack.b, NamedSharding(mesh, b_spec)),
+        rank_axis=ax,
+    )
+
+
 def batch_spec() -> P:
     return P(AXIS_DATA)
 

@@ -65,6 +65,16 @@ def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
     return None
 
 
+def _adapter_from_model(model) -> Optional[str]:
+    """Multi-tenant naming: ``model="base:adapter"`` addresses a LoRA
+    adapter of the served base model. A plain model name (no colon) is the
+    base model itself — the adapter part is everything after the FIRST
+    colon (adapter names themselves cannot contain one)."""
+    if isinstance(model, str) and ":" in model:
+        return model.split(":", 1)[1]
+    return None
+
+
 class EngineLoop(threading.Thread):
     """Drives Engine.step() whenever there is work; sleeps otherwise.
 
@@ -91,7 +101,14 @@ class EngineLoop(threading.Thread):
         self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
         self._preempt_seen = 0
+        self._adapter_seen = {"hits": 0, "misses": 0, "evictions": 0}
         self._shed_total = 0
+
+    def _mlabel(self, r) -> str:
+        """Per-request model label: ``base:adapter`` for LoRA requests so
+        multi-tenant latency series separate per tenant."""
+        a = getattr(r, "adapter", None)
+        return f"{self.model_name}:{a}" if a else self.model_name
 
     def submit(self, *args, **kw) -> Request:
         req = self.engine.submit(*args, **kw)
@@ -159,6 +176,15 @@ class EngineLoop(threading.Thread):
                 if eng.preemptions > self._preempt_seen:
                     m["preemptions"].inc(eng.preemptions - self._preempt_seen)
                     self._preempt_seen = eng.preemptions
+                adp = getattr(eng, "adapters", None)
+                if adp is not None:
+                    for k, seen in self._adapter_seen.items():
+                        v = adp.stats[k]
+                        if v > seen:
+                            m["adapter_cache_" + k].inc(v - seen)
+                            self._adapter_seen[k] = v
+                    while adp.load_times:
+                        m["adapter_load"].observe(adp.load_times.pop(0))
                 m["batch_occupancy"].set(occupancy)
                 m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))
@@ -168,7 +194,7 @@ class EngineLoop(threading.Thread):
                     r = ev.request
                     if ev.finished:
                         m["requests_finished"].inc()
-                        m["e2e_latency"].labels(model=self.model_name).observe(
+                        m["e2e_latency"].labels(model=self._mlabel(r)).observe(
                             (r.finished_at or time.monotonic())
                             - r.submitted_at)
                     if ev.finished and ev.finish_reason == "timeout":
@@ -178,7 +204,7 @@ class EngineLoop(threading.Thread):
                         m["deadline_exceeded"].labels(phase=phase).inc()
                     if r.first_token_at and r.id not in self._ttft_seen:
                         self._ttft_seen.add(r.id)
-                        m["ttft"].labels(model=self.model_name).observe(
+                        m["ttft"].labels(model=self._mlabel(r)).observe(
                             r.first_token_at - r.submitted_at)
                     if ev.finished:
                         self._ttft_seen.discard(r.id)
@@ -601,14 +627,20 @@ class OpenAIServer:
         return web.json_response(snap)
 
     async def models(self, request: web.Request) -> web.Response:
+        created = int(time.time())
+        ids = [self.model_name]
+        adp = getattr(self.engine, "adapters", None)
+        if adp is not None:
+            # each served LoRA adapter is addressable as its own model id
+            ids += [f"{self.model_name}:{a}" for a in adp.names()]
         return web.json_response({
             "object": "list",
             "data": [{
-                "id": self.model_name,
+                "id": mid,
                 "object": "model",
-                "created": int(time.time()),
+                "created": created,
                 "owned_by": "llms-on-kubernetes-tpu",
-            }],
+            } for mid in ids],
         })
 
     async def version(self, request: web.Request) -> web.Response:
@@ -1084,7 +1116,10 @@ class OpenAIServer:
         success, client error, or crash — leaves a completed trace in the
         /debug/traces ring and a one-line JSON access log with its id."""
         rid = request.get("llmk_request_id") or tracing.new_request_id()
-        trace = tracing.Trace(rid, model=self.model_name)
+        adapter = _adapter_from_model(body.get("model"))
+        model_label = (f"{self.model_name}:{adapter}" if adapter
+                       else self.model_name)
+        trace = tracing.Trace(rid, model=model_label)
         trace.engine_reqs = []  # engine Requests serving this HTTP request
         status = "error"
         resp = None
@@ -1128,7 +1163,7 @@ class OpenAIServer:
         self.traces.add(trace)
         tracing.jlog(
             "request", request_id=trace.request_id, component="api",
-            model=self.model_name, status=status,
+            model=trace.model, status=status,
             http_status=getattr(resp, "status", None),
             e2e_ms=round(trace.e2e_ms() or 0.0, 3),
             tokens=sum(len(r.output) for r in trace.engine_reqs))
@@ -1138,7 +1173,7 @@ class OpenAIServer:
                            chat: bool, images=None, tools_on: bool = False,
                            tool_grammar=None) -> web.StreamResponse:
         from llms_on_kubernetes_tpu.engine.engine import (
-            EngineStallError, QueueFullError)
+            EngineStallError, QueueFullError, UnknownAdapterError)
         from llms_on_kubernetes_tpu.engine.grammar import GrammarError
 
         if self.state == "draining":
@@ -1212,6 +1247,7 @@ class OpenAIServer:
                 {"error": {"message": "best_of > n cannot be streamed"}},
                 status=400)
         stops = _parse_stops(body)
+        adapter = _adapter_from_model(body.get("model"))
         # best_of choices per prompt (prompt-major choice order, per
         # OpenAI); usage counts each UNIQUE prompt once, not n times
         loop = asyncio.get_running_loop()
@@ -1232,11 +1268,21 @@ class OpenAIServer:
                               else f"{trace.request_id}/{len(reqs)}")
                     req = self.loop_thread.submit(
                         prompt_ids, p, on_event=_event_pusher(loop, q),
-                        images=images, deadline=deadline, request_id=eng_id)
+                        images=images, deadline=deadline, request_id=eng_id,
+                        adapter=adapter)
                     req.trace = trace
                     trace.engine_reqs.append(req)
                     req._aq = q
                     reqs.append(req)
+        except UnknownAdapterError as e:
+            # 404, not a silent base-model fallback: a typo'd adapter name
+            # must never be served the base model's (different) weights
+            for r in reqs:
+                self.loop_thread.abort(r)
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error",
+                           "code": "adapter_not_found"}}, status=404)
         except EngineStallError as e:
             for r in reqs:
                 self.loop_thread.abort(r)
@@ -1391,6 +1437,12 @@ class OpenAIServer:
         return "".join(parts), finish_reason, total, entries
 
     # -- logprob response shaping --------------------------------------
+
+    def _resp_model(self, reqs) -> str:
+        """Response ``model`` field: echoes ``base:adapter`` for LoRA
+        requests (all choices of one HTTP request share the adapter)."""
+        a = getattr(reqs[0], "adapter", None) if reqs else None
+        return f"{self.model_name}:{a}" if a else self.model_name
 
     def _tok_str(self, tid: int) -> str:
         return self.tokenizer.decode([tid])
@@ -1567,7 +1619,7 @@ class OpenAIServer:
         }
         return web.json_response({
             "id": rid, "object": "chat.completion" if chat else "text_completion",
-            "created": created, "model": self.model_name,
+            "created": created, "model": self._resp_model(reqs),
             "choices": choices, "usage": usage,
         })
 
@@ -1590,6 +1642,7 @@ class OpenAIServer:
             resp.headers[REQUEST_ID_HEADER] = rid_header
         await resp.prepare(request)
         obj = "chat.completion.chunk" if chat else "text_completion"
+        resp_model = self._resp_model(reqs)
         write_lock = asyncio.Lock()
         completion_tokens = 0
 
@@ -1614,7 +1667,7 @@ class OpenAIServer:
                         entries, nlp, base_offset)
             payload = {
                 "id": rid, "object": obj, "created": created,
-                "model": self.model_name, "choices": [choice],
+                "model": resp_model, "choices": [choice],
             }
             return f"data: {json.dumps(payload)}\n\n".encode()
 
@@ -1681,7 +1734,7 @@ class OpenAIServer:
             if include_usage:
                 prompt_tokens = sum(len(p) for p in (prompts or []))
                 await resp.write(
-                    f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': self.model_name, 'choices': [], 'usage': {'prompt_tokens': prompt_tokens, 'completion_tokens': completion_tokens, 'total_tokens': prompt_tokens + completion_tokens}})}\n\n".encode())
+                    f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': resp_model, 'choices': [], 'usage': {'prompt_tokens': prompt_tokens, 'completion_tokens': completion_tokens, 'total_tokens': prompt_tokens + completion_tokens}})}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel generation so slots/pages free up now

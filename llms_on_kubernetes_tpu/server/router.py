@@ -202,6 +202,7 @@ class Router:
         backends: "dict[str, Union[str, list[str]]]",
         default_model: Optional[str] = None,
         strict: bool = False,
+        adapters: Optional[dict] = None,
         upstream_timeout: float = 300.0,
         connect_timeout: float = 5.0,
         read_timeout: float = 120.0,
@@ -226,6 +227,14 @@ class Router:
         if self.default_model not in self.backends:
             raise ValueError(f"default model {self.default_model!r} not in backends")
         self.strict = strict
+        # model -> LoRA adapter names its replicas serve; requests address
+        # them as model="base:adapter" (multi-tenant serving)
+        self.adapters: dict[str, list[str]] = {}
+        for mname, names in (adapters or {}).items():
+            if mname not in self.backends:
+                raise ValueError(
+                    f"adapters configured for unknown model {mname!r}")
+            self.adapters[mname] = sorted({str(a) for a in names})
         self.timeout = aiohttp.ClientTimeout(
             total=upstream_timeout, connect=connect_timeout,
             sock_read=read_timeout,
@@ -378,12 +387,16 @@ class Router:
     async def models(self, request: web.Request) -> web.Response:
         """Synthesized exactly like the reference gateway (no backend hop)."""
         now = int(time.time())
+        ids = []
+        for name in self.backends:
+            ids.append(name)
+            ids += [f"{name}:{a}" for a in self.adapters.get(name, ())]
         return web.json_response({
             "object": "list",
             "data": [
-                {"id": name, "object": "model", "created": now,
+                {"id": mid, "object": "model", "created": now,
                  "owned_by": "llms-on-kubernetes-tpu"}
-                for name in self.backends
+                for mid in ids
             ],
         })
 
@@ -400,21 +413,36 @@ class Router:
     def select_backend(self, body: bytes) -> tuple[str, Optional[str]]:
         """Exact-match routing on the JSON `model` field.
 
-        Returns (model_name, error); error is set only in strict mode.
+        Returns (model_name, error); error is set in strict mode and for
+        an unknown adapter of a known base (``base:adapter`` naming).
         """
-        return self._select(self._json_doc(body))
+        return self._select(self._json_doc(body))[:2]
 
-    def _select(self, doc: Optional[dict]) -> tuple[str, Optional[str]]:
+    def _select(self, doc: Optional[dict]) \
+            -> tuple[str, Optional[str], Optional[str]]:
         model = doc.get("model") if doc else None
         if isinstance(model, str) and model in self.backends:
-            return model, None
+            return model, None, None
+        if isinstance(model, str) and ":" in model:
+            # base:adapter multi-tenant naming — resolved BEFORE the
+            # unknown-model fallback so an adapter request never silently
+            # lands on the base model's (different) weights
+            base, adapter = model.split(":", 1)
+            if base in self.backends:
+                if adapter in self.adapters.get(base, ()):
+                    return base, None, None
+                # known base, unknown adapter: ALWAYS a 404 (even
+                # non-strict; the fallback counter is for unknown BASES)
+                return base, (f"adapter {adapter!r} not found for model "
+                              f"{base!r}"), "adapter_not_found"
         if model is not None:
             if self.strict:
-                return self.default_model, f"model {model!r} not found"
+                return (self.default_model, f"model {model!r} not found",
+                        "model_not_found")
             self.metrics["unknown_model_fallback"].inc()
             jlog("unknown_model_fallback", component="router",
                  model=str(model), default=self.default_model)
-        return self.default_model, None
+        return self.default_model, None, None
 
     def _deadline_from(self, request: web.Request, doc: Optional[dict],
                        now: float) -> Optional[float]:
@@ -535,12 +563,17 @@ class Router:
         t0 = trace.t0
         body = await request.read()
         doc = self._json_doc(body)
-        model, err = self._select(doc)
-        trace.model = model
+        model, err, err_code = self._select(doc)
+        req_model = doc.get("model") if doc else None
+        # the trace label keeps the adapter suffix for RESOLVED
+        # base:adapter requests (routing itself is per base model)
+        trace.model = (req_model
+                       if err is None and isinstance(req_model, str)
+                       and req_model.startswith(model + ":") else model)
         trace.add_span("receive", t0, self.clock(), bytes=len(body))
         if err:
             return web.json_response(
-                error_body(err, "invalid_request_error", "model_not_found"),
+                error_body(err, "invalid_request_error", err_code),
                 status=404, headers=self._rid_headers(rid),
             )
         deadline = self._deadline_from(request, doc, t0)
@@ -688,8 +721,9 @@ def run_router(
     host: str = "0.0.0.0",
     port: int = 8080,
     probe_interval_s: Optional[float] = 2.0,
+    adapters: Optional[dict] = None,
 ) -> None:
-    router = Router(backends, default_model, strict,
+    router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

@@ -25,6 +25,11 @@
 // ConfigMap emits for the python router (k8s/*/templates/router-config.yaml,
 // deploy/manifests.py:router_config):
 //   {"backends": {"<name>": ["http://host:port", ...], ...},
+//    "adapters": {"<name>": ["a1", ...]},  // optional; LoRA adapters per
+//                                     // model, addressed "base:adapter"
+//                                     // (unknown adapter of a known base
+//                                     // -> 404 adapter_not_found, never
+//                                     // the base-model fallback)
 //    "default_model": "<name>",       // optional; first model otherwise
 //    "strict": false,                 // optional; 404 unknown models
 //    "upstream_timeout_s": 300,       // optional; reference used 300s
@@ -97,6 +102,9 @@ struct Config {
   std::vector<std::pair<std::string, std::vector<Url>>> models;
   std::string default_model;
   bool strict = false;
+  // model -> LoRA adapter names its replicas serve; requests address them
+  // as model="base:adapter" (resolved BEFORE the unknown-model fallback)
+  std::vector<std::pair<std::string, std::vector<std::string>>> adapters;
   // active /ready probing period per replica; <= 0 disables (replicas then
   // stay selectable and only the breaker ejects them). Off by default for
   // inline --models runs (mirrors the python Router constructor); the
@@ -124,6 +132,14 @@ struct Config {
     for (const auto& kv : models)
       if (kv.first == name) return &kv.second;
     return nullptr;
+  }
+
+  bool has_adapter(const std::string& base, const std::string& name) const {
+    for (const auto& kv : adapters)
+      if (kv.first == base)
+        for (const auto& a : kv.second)
+          if (a == name) return true;
+    return false;
   }
 };
 
@@ -309,10 +325,14 @@ static void jlog_request(const Config& cfg, const std::string& rid,
 // ---------------------------------------------------------------------------
 
 // Returns the model name to route to; sets *not_found in strict mode when
-// the body names an unknown model.
+// the body names an unknown model, *adapter_not_found when it names an
+// unknown LoRA adapter of a KNOWN base ("base:adapter" naming — a 404 in
+// every mode; the fallback counter is for unknown bases only).
 static std::string select_backend(const Config& cfg, const std::string& body,
-                                  bool* not_found) {
+                                  bool* not_found,
+                                  bool* adapter_not_found = nullptr) {
   *not_found = false;
+  if (adapter_not_found) *adapter_not_found = false;
   std::string requested;
   if (!body.empty()) {
     JsonPtr parsed = JsonParser::parse(body);
@@ -322,6 +342,19 @@ static std::string select_backend(const Config& cfg, const std::string& body,
     }
   }
   if (!requested.empty() && cfg.find(requested)) return requested;
+  size_t colon = requested.find(':');
+  if (colon != std::string::npos) {
+    // base:adapter multi-tenant naming — resolved BEFORE the unknown-
+    // model fallback so an adapter request never silently lands on the
+    // base model's (different) weights
+    std::string base = requested.substr(0, colon);
+    std::string adapter = requested.substr(colon + 1);
+    if (cfg.find(base)) {
+      if (cfg.has_adapter(base, adapter)) return base;
+      if (adapter_not_found) *adapter_not_found = true;
+      return base;
+    }
+  }
   if (cfg.strict && !requested.empty()) {
     *not_found = true;
     return cfg.default_model;
@@ -360,13 +393,20 @@ static std::string models_json(const Config& cfg) {
   root->set("object", Json::of_string("list"));
   auto data = Json::make(Json::Type::Array);
   double now = static_cast<double>(time(nullptr));
-  for (const auto& kv : cfg.models) {
+  auto add = [&](const std::string& id) {
     auto m = Json::make(Json::Type::Object);
-    m->set("id", Json::of_string(kv.first));
+    m->set("id", Json::of_string(id));
     m->set("object", Json::of_string("model"));
     m->set("created", Json::of_number(now));
     m->set("owned_by", Json::of_string("llms-on-kubernetes-tpu"));
     data->arr.push_back(m);
+  };
+  for (const auto& kv : cfg.models) {
+    add(kv.first);
+    // each served LoRA adapter is addressable as base:adapter
+    for (const auto& akv : cfg.adapters)
+      if (akv.first == kv.first)
+        for (const auto& a : akv.second) add(kv.first + ":" + a);
   }
   root->set("data", data);
   return root->dump();
@@ -1468,11 +1508,17 @@ static void handle_connection(const Config& cfg, int client_fd,
       logf(cfg, "GET /metrics -> 200 (local)");
     } else {
       bool not_found = false;
-      std::string model = select_backend(cfg, req.body, &not_found);
+      bool adapter_not_found = false;
+      std::string model =
+          select_backend(cfg, req.body, &not_found, &adapter_not_found);
       std::string rid = request_id_from(req);
-      if (not_found) {
-        std::string body = error_json("model not found", "invalid_request_error",
-                                      "model_not_found");
+      if (not_found || adapter_not_found) {
+        std::string body =
+            adapter_not_found
+                ? error_json("adapter not found for this model",
+                             "invalid_request_error", "adapter_not_found")
+                : error_json("model not found", "invalid_request_error",
+                             "model_not_found");
         keep = send_all(client_fd,
                         simple_response(404, "Not Found", "application/json",
                                         body, req.keep_alive,
@@ -1543,6 +1589,18 @@ static bool load_config_json(const std::string& file, Config& cfg) {
     }
     cfg.models.emplace_back(kv.first, std::move(urls));
   }
+  // "adapters": {"model": ["a1", "a2"], ...} — LoRA adapters per model
+  if (const Json* adps = root->get("adapters"); adps && adps->is_object()) {
+    for (const auto& kv : adps->obj) {
+      if (kv.second->type != Json::Type::Array) return false;
+      std::vector<std::string> names;
+      for (const auto& item : kv.second->arr) {
+        if (!item->is_string()) return false;
+        names.push_back(item->str);
+      }
+      cfg.adapters.emplace_back(kv.first, std::move(names));
+    }
+  }
   const Json* d = root->get("default_model");
   if (!d) d = root->get("default");
   if (d && d->is_string()) cfg.default_model = d->str;
@@ -1607,6 +1665,34 @@ static bool load_models_inline(const std::string& spec, Config& cfg) {
   return !cfg.models.empty();
 }
 
+// "name=adapter[|adapter...],name2=adapter" — LoRA adapters per model
+static bool load_adapters_inline(const std::string& spec, Config& cfg) {
+  size_t start = 0;
+  while (start < spec.size()) {
+    size_t comma = spec.find(',', start);
+    std::string item = spec.substr(start, comma - start);
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) return false;
+    std::vector<std::string> names;
+    std::string rest = item.substr(eq + 1);
+    size_t p = 0;
+    while (p <= rest.size()) {
+      size_t bar = rest.find('|', p);
+      std::string one = rest.substr(p, bar == std::string::npos
+                                           ? std::string::npos
+                                           : bar - p);
+      if (!one.empty()) names.push_back(one);
+      if (bar == std::string::npos) break;
+      p = bar + 1;
+    }
+    if (names.empty()) return false;
+    cfg.adapters.emplace_back(item.substr(0, eq), std::move(names));
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return true;
+}
+
 }  // namespace llkt
 
 namespace llkt {
@@ -1636,7 +1722,7 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_shutdown_signal);
 
   Config cfg;
-  std::string config_file, models_inline;
+  std::string config_file, models_inline, adapters_inline;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* {
@@ -1652,6 +1738,10 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       models_inline = v;
+    } else if (a == "--adapters") {
+      const char* v = next();
+      if (!v) return 2;
+      adapters_inline = v;
     } else if (a == "--port") {
       const char* v = next();
       if (!v) return 2;
@@ -1699,6 +1789,7 @@ int main(int argc, char** argv) {
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
+              "[--adapters n=a1|a2,...] "
               "[--port P] [--default NAME] [--strict] [--quiet] "
               "[--upstream-timeout S] [--client-timeout S] "
               "[--connect-timeout S] [--retries N] [--retry-backoff-ms MS] "
@@ -1718,6 +1809,18 @@ int main(int argc, char** argv) {
   } else {
     fprintf(stderr, "llkt-router: need --config or --models\n");
     return 2;
+  }
+  if (!adapters_inline.empty() &&
+      !load_adapters_inline(adapters_inline, cfg)) {
+    fprintf(stderr, "llkt-router: bad --adapters spec\n");
+    return 1;
+  }
+  for (const auto& kv : cfg.adapters) {
+    if (!cfg.find(kv.first)) {
+      fprintf(stderr, "llkt-router: adapters configured for unknown model %s\n",
+              kv.first.c_str());
+      return 1;
+    }
   }
   if (cfg.default_model.empty()) cfg.default_model = cfg.models.front().first;
   if (!cfg.find(cfg.default_model)) {

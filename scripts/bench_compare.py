@@ -8,10 +8,12 @@ the latest run that produced a usable ``parsed`` payload against the
 previous such run, prints a per-metric delta table, and exits non-zero
 when any metric moved more than the threshold in the BAD direction:
 
-- latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``): higher is
-  worse;
-- throughput-ish metrics (``*tokens_per_sec*``, ``*throughput*``,
-  ``value`` — bench.py's headline tokens/s): lower is worse;
+- latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``, adapter
+  ``*evictions*``/``*load_seconds*`` churn): higher is worse;
+- throughput-ish metrics (``*tokens_per_sec*`` — including the
+  multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
+  cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s):
+  lower is worse;
 - anything else is reported but never gates (no direction known).
 
 With fewer than two comparable runs it prints a notice and exits 0 —
@@ -30,7 +32,7 @@ import pathlib
 import re
 import sys
 
-_LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit)")
+_LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit)")
 
 

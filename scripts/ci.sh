@@ -68,6 +68,20 @@ if smoke_out="$(JAX_PLATFORMS=cpu LLMK_METRICS_DUMP="$metrics_dump" \
   printf '%s\n' "$smoke_out" | tail -n 1
   echo "ci: bench smoke OK"
 
+  note "multi-tenant adapter smoke (base:adapter through the gateway)"
+  # the smoke's gateway phase fires one model=<base>:<adapter> request
+  # through the router (native llkt-router when built above) plus an
+  # unknown-adapter 404 check; gateway_adapter_ok records the verdict
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+sys.exit(0 if doc.get("gateway_adapter_ok") is True else 1)'; then
+    echo "ci: adapter smoke OK"
+  else
+    echo "ci: adapter smoke FAILED (gateway_adapter_ok not true)"
+    fails=$((fails + 1))
+  fi
+
   note "metrics lint (Prometheus exposition format on scraped /metrics)"
   if [ -s "$metrics_dump/api_metrics.txt" ] \
       && [ -s "$metrics_dump/gateway_metrics.txt" ] \

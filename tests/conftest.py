@@ -33,8 +33,9 @@ import pytest
 # the serving-path modules (real sockets, subprocess engines/routers).
 # Everything keeps working unmarked; tiers are additive selection aids.
 _UNIT_MODULES = {
-    "test_faults", "test_grammar", "test_helm_golden", "test_hub",
-    "test_manifests", "test_router", "test_tools", "test_tracing",
+    "test_adapters", "test_faults", "test_grammar", "test_helm_golden",
+    "test_hub", "test_manifests", "test_router", "test_tools",
+    "test_tracing",
 }
 _E2E_MODULES = {
     "test_bench", "test_cold_start", "test_entrypoints", "test_kind_e2e",

@@ -1,0 +1,126 @@
+"""Engine-level multi-tenant LoRA: adapters configured through
+EngineConfig, pinned at admission, applied in the batched decode step,
+LRU-recycled beyond slot capacity, and rejected with UnknownAdapterError
+when not configured."""
+
+import numpy as np
+import pytest
+
+from llms_on_kubernetes_tpu.engine.engine import (
+    Engine,
+    EngineConfig,
+    SamplingParams,
+    UnknownAdapterError,
+)
+from test_adapters import write_peft
+
+
+@pytest.fixture(scope="module")
+def adapter_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("adapters")
+    return {f"ad{i}": str(write_peft(root / f"ad{i}", rank=2, alpha=16,
+                                     seed=10 + i))
+            for i in range(3)}
+
+
+def make_engine(adapter_dirs, **kw):
+    defaults = dict(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=16, pages_per_slot=8, num_pages=4 * 8 + 1,
+        prefill_buckets=(16,), adapters=adapter_dirs,
+        adapter_slots=2, adapter_rank=4,
+    )
+    defaults.update(kw)
+    return Engine(EngineConfig(**defaults))
+
+
+PROMPT = [3, 17, 9, 42, 7]
+
+
+def greedy(eng, adapter=None, max_tokens=8):
+    return eng.generate(PROMPT, SamplingParams(temperature=0.0,
+                                               max_tokens=max_tokens),
+                        adapter=adapter)
+
+
+def test_adapter_changes_output_and_is_deterministic(adapter_dirs):
+    eng = make_engine(adapter_dirs)
+    base = greedy(eng)
+    ad0 = greedy(eng, adapter="ad0")
+    ad0_again = greedy(eng, adapter="ad0")
+    assert ad0 == ad0_again                       # pure buffer updates
+    assert base == greedy(eng)                    # base rows unaffected
+    # alpha=16 on rank-2 factors is a large delta; greedy streams diverge
+    assert ad0 != base
+    assert eng.adapters.stats["hits"] >= 1        # second ad0 run was a hit
+
+
+def test_unknown_adapter_raises_structured_error(adapter_dirs):
+    eng = make_engine(adapter_dirs)
+    with pytest.raises(UnknownAdapterError, match="not served"):
+        eng.submit(PROMPT, SamplingParams(max_tokens=4), adapter="nope")
+    assert isinstance(UnknownAdapterError("x"), LookupError)
+
+
+def test_adapter_on_adapterless_engine_raises():
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=16, pages_per_slot=8, num_pages=17, prefill_buckets=(16,)))
+    with pytest.raises(UnknownAdapterError):
+        eng.submit(PROMPT, SamplingParams(max_tokens=4), adapter="ad0")
+
+
+def test_eviction_and_reload_beyond_capacity(adapter_dirs):
+    """3 adapters through 2 slots, sequentially: the third acquire must
+    evict, and coming back to the first must reload it with identical
+    outputs (host-cache -> device upload path)."""
+    eng = make_engine(adapter_dirs)
+    outs = {n: greedy(eng, adapter=n) for n in ("ad0", "ad1", "ad2")}
+    assert eng.adapters.stats["evictions"] >= 1
+    assert len({tuple(o) for o in outs.values()}) == 3   # distinct tenants
+    # ad0 was evicted; the reload must reproduce its stream exactly
+    assert greedy(eng, adapter="ad0") == outs["ad0"]
+
+
+def test_heterogeneous_batch_matches_sequential(adapter_dirs):
+    """Concurrent requests on different adapters (one decode step applies
+    both deltas, slot-gathered) must match each adapter run alone."""
+    eng = make_engine(adapter_dirs)
+    alone = {n: greedy(eng, adapter=n) for n in ("ad0", "ad1")}
+    alone[None] = greedy(eng)
+    reqs = {n: eng.submit(PROMPT, SamplingParams(temperature=0.0,
+                                                 max_tokens=8), adapter=n)
+            for n in ("ad0", "ad1", None)}
+    while any(not r.finished for r in reqs.values()):
+        eng.step()
+    for n, r in reqs.items():
+        assert r.output == alone[n], f"adapter {n!r} diverged in batch"
+
+
+def test_slot_pins_released_after_finish(adapter_dirs):
+    eng = make_engine(adapter_dirs)
+    greedy(eng, adapter="ad0")
+    greedy(eng, adapter="ad1")
+    mgr = eng.adapters
+    assert all(refs == 0 for refs in mgr.slot_refs)
+    assert sorted(n for n in mgr.slot_name if n) == ["ad0", "ad1"]
+
+
+def test_load_latency_recorded(adapter_dirs):
+    eng = make_engine(adapter_dirs)
+    greedy(eng, adapter="ad0")
+    assert eng.adapters.load_times and all(
+        t >= 0 for t in eng.adapters.load_times)
+
+
+def test_bad_adapter_name_rejected_at_config(adapter_dirs):
+    with pytest.raises(ValueError):
+        EngineConfig(model="debug-tiny",
+                     adapters={"with:colon": "/tmp/x"})
+    with pytest.raises(ValueError):
+        EngineConfig(model="debug-tiny",
+                     adapters={"white space": "/tmp/x"})
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

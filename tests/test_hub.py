@@ -223,3 +223,33 @@ def test_serve_missing_weights_exits_nonzero(tmp_path):
     assert proc.returncode != 0
     assert "cannot obtain weights" in proc.stderr
     assert "--random-weights" in proc.stderr
+
+
+def test_ensure_adapter_dir_local_path_and_validation(tmp_path):
+    """A local PEFT dir resolves without touching the Hub, and an
+    incomplete one (missing adapter_model.safetensors) is a hard failure
+    — never a silent base-model fallback."""
+    d = tmp_path / "lora"
+    d.mkdir()
+    (d / "adapter_config.json").write_text('{"r": 4, "lora_alpha": 8}')
+    with pytest.raises(FileNotFoundError, match="adapter_model.safetensors"):
+        hub.ensure_adapter_dir(str(d))
+    (d / "adapter_model.safetensors").write_bytes(b"\x00" * 8)
+    assert hub.ensure_adapter_dir(str(d)) == str(d)
+
+
+def test_ensure_adapter_dir_downloads_on_miss(tmp_path, monkeypatch):
+    def fake_download(repo_id, cache_dir=None, allow_patterns=None,
+                      token=None):
+        assert "adapter_config.json" in allow_patterns
+        snap = tmp_path / "snap"
+        snap.mkdir(exist_ok=True)
+        (snap / "adapter_config.json").write_text("{}")
+        (snap / "adapter_model.safetensors").write_bytes(b"\x00" * 8)
+        return str(snap)
+
+    import huggingface_hub
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake_download)
+    path = hub.ensure_adapter_dir("org/some-lora",
+                                  cache_dir=str(tmp_path / "cache"))
+    assert os.path.isfile(os.path.join(path, "adapter_config.json"))

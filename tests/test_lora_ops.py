@@ -1,0 +1,242 @@
+"""Batched multi-adapter LoRA ops (ops/lora.py): the per-slot delta path
+must match merged-weights references — including on quantized bases —
+and vanish exactly when no adapter is involved.
+
+Tolerance policy follows the PR-4 quantization triage: dense-f32
+comparisons are tight (the only difference is f32 association order:
+``(x@a)@b`` vs ``x@(a@b)``); comparisons involving a quantized base
+inherit qeinsum's bf16-operand tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llms_on_kubernetes_tpu.ops.lora import (
+    LoRAStack,
+    _delta_eqs,
+    lora_delta,
+    lora_qeinsum,
+    lora_zeros,
+    merge_delta,
+)
+from llms_on_kubernetes_tpu.ops.quant import qeinsum, quantize
+
+
+def make_stack(rng, S, in_shape, out_shape, rank, layers=None, scale=0.1):
+    """A filled LoRAStack (no layer axis unless ``layers``) with distinct
+    per-slot factors."""
+    lead = () if layers is None else (layers,)
+    a = scale * rng.normal(size=lead + (S,) + tuple(in_shape) + (rank,))
+    b = scale * rng.normal(size=lead + (S, rank) + tuple(out_shape))
+    return LoRAStack(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32))
+
+
+def test_delta_eq_derivation():
+    assert _delta_eqs("btd,dhk->bthk") == ("btd,dr->btr", "btr,rhk->bthk")
+    assert _delta_eqs("btd,df->btf") == ("btd,dr->btr", "btr,rf->btf")
+    assert _delta_eqs("btf,fd->btd") == ("btf,fr->btr", "btr,rd->btd")
+    assert _delta_eqs("bthk,hkd->btd") == ("bthk,hkr->btr", "btr,rd->btd")
+
+
+def test_lora_qeinsum_none_short_circuits(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    base = qeinsum("btd,df->btf", x, w)
+    np.testing.assert_array_equal(
+        np.asarray(lora_qeinsum("btd,df->btf", x, w, None, None)),
+        np.asarray(base))
+    lora = lora_zeros(1, 2, (8,), (16,), 4)
+    np.testing.assert_array_equal(
+        np.asarray(lora_qeinsum("btd,df->btf", x, w, lora, None)),
+        np.asarray(base))
+
+
+def test_vacant_slots_and_base_rows_add_nothing(rng):
+    """Zero factors (vacant slot) and idx=-1 (base row) leave the base
+    output bit-identical up to the f32 add of an exact zero."""
+    x = jnp.asarray(rng.normal(size=(3, 2, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    lora = lora_zeros(1, 2, (8,), (16,), 4)
+    # layer axis sliced off, as _lqe does inside the layer scan
+    sliced = LoRAStack(lora.a[0], lora.b[0])
+    idx = jnp.asarray([-1, 0, 1], jnp.int32)
+    out = lora_qeinsum("btd,df->btf", x, w, sliced, idx)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(qeinsum("btd,df->btf", x, w)),
+        rtol=0, atol=0)
+
+
+def test_single_adapter_matches_merged_dense(rng):
+    """Every row on one adapter == a plain einsum against base + merged
+    delta (the merged-weights reference)."""
+    B, T, D, F, r, S = 4, 2, 8, 16, 4, 3
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, F)), jnp.float32)
+    stack = make_stack(rng, S, (D,), (F,), r)
+    s = 1
+    idx = jnp.full((B,), s, jnp.int32)
+    out = lora_qeinsum("btd,df->btf", x, w, stack, idx)
+    merged = w + merge_delta(stack.a[s], stack.b[s])
+    ref = jnp.einsum("btd,df->btf", x, merged)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_heterogeneous_batch_each_row_matches_own_merged(rng):
+    """One batched call, three different adapters + a base row: each row
+    must match the merged reference for ITS OWN slot."""
+    B, T, D, Hh, hd, r, S = 4, 1, 8, 2, 4, 3, 3
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, Hh, hd)), jnp.float32)
+    stack = make_stack(rng, S, (D,), (Hh, hd), r)
+    idx = jnp.asarray([0, 1, 2, -1], jnp.int32)
+    out = np.asarray(lora_qeinsum("btd,dhk->bthk", x, w, stack, idx))
+    for row, s in enumerate([0, 1, 2, -1]):
+        merged = w if s < 0 else w + merge_delta(stack.a[s], stack.b[s])
+        ref = jnp.einsum("btd,dhk->bthk", x[row:row + 1], merged)
+        np.testing.assert_allclose(out[row:row + 1], np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"row {row} (slot {s})")
+
+
+def test_delta_on_int8_base(rng):
+    """Additive composition with a QTensor base: output == qeinsum(base)
+    + dense delta, with qeinsum's own tolerance."""
+    B, T, D, F, r, S = 2, 2, 16, 32, 4, 2
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, F)), jnp.float32)
+    qt = quantize(w, reduce_axes=(0,))
+    stack = make_stack(rng, S, (D,), (F,), r)
+    idx = jnp.asarray([0, 1], jnp.int32)
+    out = lora_qeinsum("btd,df->btf", x, qt, stack, idx)
+    ref = np.array(qeinsum("btd,df->btf", x, qt), np.float32)
+    for row, s in enumerate([0, 1]):
+        ref[row] += np.asarray(
+            jnp.einsum("btd,df->btf", x[row:row + 1],
+                       merge_delta(stack.a[s], stack.b[s]))[0])
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_delta_on_packed4_awq_base(rng):
+    """Additive composition with a lane-packed 4-bit AWQ base
+    (GroupQTensor, packed=True) — the acceptance-criteria case: the
+    heterogeneous batched path must track base-dequantize + per-row
+    merged delta."""
+    from llms_on_kubernetes_tpu.ops.quant import GroupQTensor, pack_int4_lanes
+
+    B, T, D, F, r, S, gs = 3, 1, 16, 32, 4, 2, 8
+    G = D // gs
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    q = rng.integers(-8, 8, size=(G, gs, F)).astype(np.int8)
+    scales = (0.05 + 0.01 * rng.random((G, F))).astype(np.float32)
+    zeros = np.zeros((G, F), np.float32)
+    w = GroupQTensor(jnp.asarray(pack_int4_lanes(q)), jnp.asarray(scales),
+                     jnp.asarray(zeros), out_shape=(F,), packed=True)
+    stack = make_stack(rng, S, (D,), (F,), r)
+    idx = jnp.asarray([0, 1, -1], jnp.int32)
+    out = np.asarray(lora_qeinsum("btd,df->btf", x, w, stack, idx),
+                     np.float32)
+    deq = w.dequantize(jnp.float32)
+    for row, s in enumerate([0, 1, -1]):
+        merged = deq if s < 0 else deq + merge_delta(stack.a[s], stack.b[s])
+        ref = jnp.einsum("btd,df->btf", x[row:row + 1], merged)
+        np.testing.assert_allclose(out[row:row + 1], np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2,
+                                   err_msg=f"row {row} (slot {s})")
+
+
+def test_rank_sharded_delta_matches_replicated(rng):
+    """lora_delta under an active mesh with rank_axis set (the
+    shard_map + psum branch) must agree with the replicated scan."""
+    from llms_on_kubernetes_tpu.parallel.mesh import (
+        AXIS_MODEL, make_mesh, set_active_mesh,
+    )
+
+    B, T, D, F, r, S = 3, 1, 8, 16, 8, 2
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    stack = make_stack(rng, S, (D,), (F,), r)
+    idx = jnp.asarray([0, 1, -1], jnp.int32)
+    ref = np.asarray(lora_delta("btd,df->btf", x, stack, idx))
+    mesh = make_mesh(model=4)
+    try:
+        set_active_mesh(mesh)
+        sharded = LoRAStack(stack.a, stack.b, rank_axis=AXIS_MODEL)
+        out = np.asarray(lora_delta("btd,df->btf", x, sharded, idx))
+    finally:
+        set_active_mesh(None)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_teacher_forced_forward_parity_single_and_hetero(rng):
+    """Model-level parity, teacher-forced on the same tokens: a
+    forward_prefill with adapter_idx set must reproduce the logits of a
+    base model whose weights were merged with that adapter's delta —
+    per row, for a heterogeneous batch."""
+    import dataclasses
+
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.cache import (
+        CacheConfig, PageAllocator, init_pages,
+    )
+    from llms_on_kubernetes_tpu.models.decoder import (
+        forward_prefill, init_params,
+    )
+
+    cfg = dataclasses.replace(get_config("debug-tiny"), dtype="float32")
+    params = init_params(cfg, jax.random.key(0), dtype="float32")
+    L, D = cfg.num_layers, cfg.hidden_size
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    S, r = 2, 4
+    shapes = {"wq": ((D,), (H, hd)), "wk": ((D,), (KV, hd)),
+              "wv": ((D,), (KV, hd)), "wo": ((H, hd), (D,))}
+    stacks = {t: make_stack(rng, S, i, o, r, layers=L, scale=0.05)
+              for t, (i, o) in shapes.items()}
+
+    def run(p, adapter_idx, tokens, lengths):
+        B = tokens.shape[0]
+        cc = CacheConfig(num_layers=L, num_kv_heads=KV, head_dim=hd,
+                         num_pages=64, page_size=4, pages_per_slot=8,
+                         dtype="float32")
+        kp, vp = init_pages(cc)
+        alloc = PageAllocator(cc.num_pages, cc.page_size, B,
+                              cc.pages_per_slot)
+        for slot in range(B):
+            alloc.allocate(slot, tokens.shape[1])
+        logits, _, _ = forward_prefill(
+            p, cfg, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
+            kp, vp, jnp.asarray(alloc.page_tables)[:B],
+            adapter_idx=adapter_idx)
+        return np.asarray(logits)
+
+    tokens = np.array([[3, 17, 9, 42, 7, 0, 0, 0],
+                       [5, 11, 2, 8, 31, 0, 0, 0],
+                       [23, 4, 19, 6, 12, 0, 0, 0]], np.int32)
+    lengths = np.array([5, 5, 5], np.int32)
+
+    with_lora = dict(params)
+    with_lora["layers"] = dict(params["layers"])
+    for t, st in stacks.items():
+        with_lora["layers"]["lora_" + t] = st
+    batched = run(with_lora, jnp.asarray([0, 1, -1], jnp.int32),
+                  tokens, lengths)
+
+    for row, s in enumerate([0, 1, -1]):
+        merged = dict(params)
+        merged["layers"] = dict(params["layers"])
+        if s >= 0:
+            for t, st in stacks.items():
+                delta = jax.vmap(merge_delta)(st.a[:, s], st.b[:, s])
+                merged["layers"][t] = (
+                    params["layers"][t] + delta.astype(
+                        params["layers"][t].dtype))
+        ref = run(merged, None, tokens[row:row + 1], lengths[row:row + 1])
+        np.testing.assert_allclose(
+            batched[row:row + 1], ref, rtol=5e-3, atol=5e-3,
+            err_msg=f"row {row} (slot {s})")
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -1063,3 +1063,105 @@ def test_native_retry_rides_out_connection_resets(binary):
     finally:
         router.stop()
         lsock.close()
+
+
+def test_native_adapter_routing(binary):
+    """base:adapter naming (multi-tenant LoRA): known adapters route to
+    the base backend with the model id passed through untouched; an
+    unknown adapter of a known base 404s with adapter_not_found (never
+    the unknown-model fallback); an unknown BASE keeps the fallback
+    semantics; /v1/models lists the adapter ids."""
+    backend = start_backend("modelA")
+    router = RouterProc(binary, {"modelA": backend.server_address[1]},
+                        extra_args=("--adapters", "modelA=sql|support"))
+    try:
+        status, data = router.request("GET", "/v1/models")
+        assert status == 200
+        ids = [m["id"] for m in json.loads(data)["data"]]
+        assert ids == ["modelA", "modelA:sql", "modelA:support"]
+
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "modelA:sql"})
+        assert status == 200
+        doc = json.loads(data)
+        assert doc["served_by"] == "modelA" and doc["model"] == "modelA:sql"
+
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "modelA:nope"})
+        assert status == 404
+        assert json.loads(data)["error"]["code"] == "adapter_not_found"
+
+        # unknown base with a colon: plain unknown-model fallback
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "zz:sql"})
+        assert status == 200
+        assert json.loads(data)["served_by"] == "modelA"
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_native_adapter_unknown_404s_in_strict_too(binary):
+    backend = start_backend("modelA")
+    router = RouterProc(binary, {"modelA": backend.server_address[1]},
+                        strict=True,
+                        extra_args=("--adapters", "modelA=sql"))
+    try:
+        status, data = router.request("POST", "/v1/chat/completions",
+                                      {"model": "modelA:nope"})
+        assert status == 404
+        assert json.loads(data)["error"]["code"] == "adapter_not_found"
+        status, _ = router.request("POST", "/v1/chat/completions",
+                                   {"model": "modelA:sql"})
+        assert status == 200
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_native_adapter_config_file(binary, tmp_path):
+    """The chart's router-config.yaml "adapters" map must work on the
+    native binary (k8s/*/templates/router-config.yaml)."""
+    backend = start_backend("cfgmodel")
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {"cfgmodel":
+                     f"http://127.0.0.1:{backend.server_address[1]}"},
+        "adapters": {"cfgmodel": ["sql"]},
+        "default_model": "cfgmodel",
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    try:
+        deadline = time.monotonic() + 5
+        up = False
+        while time.monotonic() < deadline and not up:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+                conn.request("GET", "/health")
+                up = conn.getresponse().read() == b"OK"
+                conn.close()
+            except OSError:
+                time.sleep(0.02)
+        assert up
+
+        def req(body):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/v1/chat/completions",
+                         json.dumps(body).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+
+        status, data = req({"model": "cfgmodel:sql"})
+        assert status == 200 and json.loads(data)["served_by"] == "cfgmodel"
+        status, data = req({"model": "cfgmodel:zz"})
+        assert status == 404
+        assert json.loads(data)["error"]["code"] == "adapter_not_found"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()

@@ -529,3 +529,86 @@ def test_router_trace_ring_records_spans():
             await client.close()
             await b1.close()
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant LoRA adapter routing (base:adapter naming)
+# ---------------------------------------------------------------------------
+
+def run_with_adapters(fn, strict=False):
+    async def go():
+        b1 = TestClient(TestServer(make_backend("baseA")))
+        await b1.start_server()
+        router = Router({"m": str(b1.make_url("")).rstrip("/")},
+                        strict=strict, adapters={"m": ["sql", "support"]})
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await fn(client, router)
+        finally:
+            await client.close()
+            await b1.close()
+    asyncio.run(go())
+
+
+def test_adapter_naming_routes_to_base_backend():
+    async def body(client, router):
+        r = await client.post("/v1/chat/completions", json={"model": "m:sql"})
+        doc = await r.json()
+        # routed to the base model's backend, model id passed through
+        # untouched so the API server resolves the adapter
+        assert doc["served_by"] == "baseA" and doc["model"] == "m:sql"
+        assert router.metrics["unknown_model_fallback"].value == 0
+    run_with_adapters(body)
+
+
+def test_unknown_adapter_404s_even_non_strict():
+    async def body(client, router):
+        r = await client.post("/v1/chat/completions", json={"model": "m:nope"})
+        assert r.status == 404
+        err = await r.json()
+        assert err["error"]["code"] == "adapter_not_found"
+        # an unknown ADAPTER of a known base never counts as (or behaves
+        # like) an unknown-model fallback — weights would be wrong
+        assert router.metrics["unknown_model_fallback"].value == 0
+    run_with_adapters(body, strict=False)
+
+
+def test_unknown_base_with_colon_still_falls_back():
+    async def body(client, router):
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "zz:sql"})
+        assert (await r.json())["served_by"] == "baseA"
+        assert router.metrics["unknown_model_fallback"].value == 1
+    run_with_adapters(body, strict=False)
+
+
+def test_unknown_base_with_colon_404s_in_strict():
+    async def body(client, router):
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "zz:sql"})
+        assert r.status == 404
+        assert (await r.json())["error"]["code"] == "model_not_found"
+        assert router.metrics["unknown_model_fallback"].value == 0
+    run_with_adapters(body, strict=True)
+
+
+def test_models_lists_adapter_ids():
+    async def body(client, router):
+        r = await client.get("/v1/models")
+        ids = [m["id"] for m in (await r.json())["data"]]
+        assert ids == ["m", "m:sql", "m:support"]
+    run_with_adapters(body)
+
+
+def test_select_backend_keeps_two_tuple_contract():
+    router = Router({"m": "http://127.0.0.1:1"}, adapters={"m": ["sql"]})
+    assert router.select_backend(b'{"model": "m:sql"}') == ("m", None)
+    model, err = router.select_backend(b'{"model": "m:nope"}')
+    assert model == "m" and "nope" in err
+
+
+def test_adapters_for_unknown_model_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="unknown model"):
+        Router({"m": "http://127.0.0.1:1"}, adapters={"zz": ["sql"]})

@@ -504,3 +504,91 @@ def test_debug_engine_flight_recorder():
         r = await client.get("/debug/engine", params={"limit": 1})
         assert len((await r.json())["steps"]) == 1
     with_client(body)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant LoRA surface (model=base:adapter)
+# ---------------------------------------------------------------------------
+
+def make_adapter_server(tmp_path):
+    from test_adapters import write_peft
+
+    adapters = {f"ad{i}": str(write_peft(tmp_path / f"ad{i}", rank=2,
+                                         alpha=16, seed=40 + i))
+                for i in range(2)}
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=4,
+        page_size=4, num_pages=256, pages_per_slot=32,
+        prefill_buckets=(32, 64),
+        adapters=adapters, adapter_slots=2, adapter_rank=4,
+    ))
+    return OpenAIServer(eng, ByteTokenizer(), "debug-tiny")
+
+
+def test_adapter_requests_resolve_404_and_label(tmp_path):
+    async def go():
+        server = make_adapter_server(tmp_path)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            # /v1/models lists base + base:adapter ids
+            r = await client.get("/v1/models")
+            ids = [m["id"] for m in (await r.json())["data"]]
+            assert ids == ["debug-tiny", "debug-tiny:ad0", "debug-tiny:ad1"]
+
+            # base:adapter request serves and echoes the full model id
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny:ad0", "prompt": "abc",
+                "max_tokens": 4, "temperature": 0})
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["model"] == "debug-tiny:ad0"
+
+            # the adapter's output differs from the base model's
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "abc",
+                "max_tokens": 4, "temperature": 0})
+            base_doc = await r.json()
+            assert base_doc["model"] == "debug-tiny"
+            assert doc["choices"][0]["text"] != base_doc["choices"][0]["text"]
+
+            # unknown adapter: structured 404, not a base-model fallback
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny:nope", "prompt": "abc",
+                "max_tokens": 4})
+            assert r.status == 404
+            err = await r.json()
+            assert err["error"]["code"] == "adapter_not_found"
+            assert err["error"]["type"] == "invalid_request_error"
+
+            # metrics: adapter-labelled latency series + cache counters
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert 'model="debug-tiny:ad0"' in text
+            assert "llm_adapter_cache_misses_total 1.0" in text
+            assert "llm_adapter_load_seconds_count 1" in text
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_adapter_streaming_echoes_model_id(tmp_path):
+    async def go():
+        server = make_adapter_server(tmp_path)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny:ad1", "prompt": "abc",
+                "max_tokens": 4, "temperature": 0, "stream": True})
+            assert r.status == 200
+            payloads = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data:") and line != "data: [DONE]":
+                    payloads.append(json.loads(line[5:]))
+            assert payloads and all(
+                p["model"] == "debug-tiny:ad1" for p in payloads)
+        finally:
+            await client.close()
+    asyncio.run(go())
