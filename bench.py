@@ -662,6 +662,258 @@ def gateway_bench(eng, model_name: str, prompt_len: int, vocab: int,
     return out
 
 
+def request_with_retry_after(send, attempts: int = 4, backoff_s: float = 0.2,
+                             max_backoff_s: float = 5.0, sleep=time.sleep,
+                             retry_statuses=(429, 502, 503)):
+    """Run one HTTP attempt with server-directed retry pacing.
+
+    ``send()`` performs a single attempt and returns ``(status, headers,
+    data)``. On 429/503 the server's ``Retry-After`` header (the queue-
+    depth-derived estimate the API server attaches to sheds, and the
+    router to unroutable 503s) is honored EXACTLY — an immediate blind
+    retry would land back in the same full queue and double the load the
+    shed was protecting against. Responses without the header (incl. the
+    router's 502 while every replica is still waking) fall back to
+    capped exponential backoff. The final attempt's result is returned
+    as-is, even if still retryable.
+    """
+    delay = backoff_s
+    status, headers, data = send()
+    for _ in range(attempts - 1):
+        if status not in retry_statuses:
+            return status, headers, data
+        hint = None
+        for k, v in (headers or {}).items():
+            if k.lower() == "retry-after":
+                hint = v
+                break
+        wait = None
+        if hint is not None:
+            try:
+                wait = max(0.0, float(hint))
+            except (TypeError, ValueError):
+                wait = None
+        if wait is None:
+            wait = delay
+            delay = min(delay * 2, max_backoff_s)
+        sleep(wait)
+        status, headers, data = send()
+    return status, headers, data
+
+
+def spike_bench() -> dict:
+    """Spike-to-first-token against a scaled-to-zero model (ISSUE 7).
+
+    A burst of streaming requests arrives at the router while the
+    model's replica set is EMPTY (both backend ports reserved but not
+    listening — the KEDA wake-from-zero moment); two replicas then come
+    up cold under the ``slow_cold_start`` fault, and once serving, one
+    is preempted (``preempt_replica``) and must drain without dropping
+    its streams. Reports the burst-to-first-token wall time, the
+    cold-start phase split scraped from the replicas' /metrics, and the
+    dropped-stream count — which scripts/ci.sh gates at 0.
+
+    Runs on the tiny CPU config regardless of BENCH_MODEL: the scenario
+    measures the control loop (wake, retry pacing, failover, drain),
+    not the model.
+    """
+    import http.client
+    import json as _json
+    import re as _re
+    import socket
+    import threading
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu import faults
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server import metrics as server_metrics
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    model = "debug-tiny"
+    cfg = get_config(model)
+    ecfg = EngineConfig(model=model, dtype="float32", max_decode_slots=8,
+                        page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
+                        prefill_buckets=(32,))
+
+    def reserve_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    # the replica ports exist in the router's backend table from the
+    # start — that IS the scaled-to-zero state: configured, not listening
+    replica_ports = [reserve_port(), reserve_port()]
+
+    router = Router({model: [f"http://127.0.0.1:{p}" for p in replica_ports]},
+                    default_model=model, strict=False,
+                    probe_interval_s=0.2, retry_backoff_s=0.05)
+    ports: dict = {}
+    ready = threading.Event()
+    stop_holder: dict = {}
+
+    def run_router_app():
+        import asyncio
+
+        async def main_async():
+            stop = asyncio.Event()
+            stop_holder["stop"] = stop
+            stop_holder["loop"] = asyncio.get_running_loop()
+            runner = web.AppRunner(router.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            ports["router"] = runner.addresses[0][1]
+            ready.set()
+            await stop.wait()
+            await runner.cleanup()
+
+        asyncio.new_event_loop().run_until_complete(main_async())
+
+    rt = threading.Thread(target=run_router_app, daemon=True)
+    rt.start()
+    if not ready.wait(timeout=60):
+        raise RuntimeError("spike bench: router failed to start")
+    rport = ports["router"]
+
+    n_clients = 6
+    gen_tokens = 24
+    body = _json.dumps({
+        "model": model, "prompt": [1, 2, 3, 4, 5, 6, 7, 8],
+        "max_tokens": gen_tokens, "temperature": 0.0, "stream": True,
+    })
+    results: list = [None] * n_clients
+    first_byte_at: list = [None] * n_clients
+
+    def client(i):
+        def send():
+            conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+            try:
+                conn.request("POST", "/v1/completions", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    data = resp.read()
+                    headers = dict(resp.getheaders())
+                    conn.close()
+                    return resp.status, headers, data
+                first = resp.read(1)
+                if first_byte_at[i] is None:
+                    first_byte_at[i] = time.monotonic()
+                data = first + resp.read()
+                conn.close()
+                return 200, {}, data
+            except OSError:
+                # mid-stream transport failure = a dropped stream; do
+                # NOT blind-retry it into a false success
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return -1, {}, b""
+
+        results[i] = request_with_retry_after(send, attempts=60,
+                                              backoff_s=0.1,
+                                              max_backoff_s=1.0)
+
+    # --- the spike: clients first, replicas second -----------------------
+    faults.reset_claims()
+    prev_fault = os.environ.get("LLMK_FAULT")
+    os.environ["LLMK_FAULT"] = "slow_cold_start:0.8;preempt_replica:0.5"
+    t_burst = time.monotonic()
+    clients = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for c in clients:
+        c.start()
+    time.sleep(0.3)  # the burst is already 503ing against zero replicas
+
+    servers, runners = [], []
+    sready = threading.Event()
+
+    def run_replicas():
+        import asyncio
+
+        async def main_async():
+            stop = asyncio.Event()
+            stop_holder["rstop"] = stop
+            stop_holder["rloop"] = asyncio.get_running_loop()
+            for p in replica_ports:
+                # per-replica zero point: each "ready" observation spans
+                # only ITS engine build + faulted startup, not the
+                # earlier replica's (in-process replicas start serially)
+                server_metrics.cold_start.reset()
+                srv = OpenAIServer(build_engine(ecfg, cfg), ByteTokenizer(),
+                                   model)
+                servers.append(srv)
+                runner = web.AppRunner(srv.make_app())
+                await runner.setup()  # slow_cold_start delays in here
+                site = web.TCPSite(runner, "127.0.0.1", p)
+                await site.start()
+                runners.append(runner)
+            sready.set()
+            await stop.wait()
+            for r in runners:
+                await r.cleanup()
+
+        asyncio.new_event_loop().run_until_complete(main_async())
+
+    st = threading.Thread(target=run_replicas, daemon=True)
+    st.start()
+    sready.wait(timeout=120)
+    for c in clients:
+        c.join(timeout=300)
+
+    # cold-start phase split, scraped like Prometheus would
+    phase_re = _re.compile(
+        rb'llm_cold_start_seconds_sum\{phase="([a-z]+)"\} ([0-9.e+-]+)')
+    phases: dict = {}
+    for p in replica_ports:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read()
+            conn.close()
+        except OSError:
+            continue
+        for name, val in phase_re.findall(text):
+            k = name.decode()
+            phases[k] = round(phases.get(k, 0.0) + float(val), 3)
+
+    # count BEFORE cleanup — shutdown also parks servers in "draining"
+    preempted = sum(1 for s in servers if s.state == "draining")
+
+    if prev_fault is None:
+        os.environ.pop("LLMK_FAULT", None)
+    else:
+        os.environ["LLMK_FAULT"] = prev_fault
+    faults.reset_claims()
+    for key in ("rstop", "stop"):
+        if key in stop_holder:
+            stop_holder[key.replace("stop", "loop") if key == "stop"
+                        else "rloop"].call_soon_threadsafe(
+                stop_holder[key].set)
+    rt.join(timeout=30)
+    st.join(timeout=30)
+
+    dropped = sum(
+        1 for r in results
+        if r is None or r[0] != 200 or b"data:" not in (r[2] or b""))
+    firsts = [t for t in first_byte_at if t is not None]
+    return {
+        "spike_first_token_s": (round(min(firsts) - t_burst, 3)
+                                if firsts else None),
+        "spike_completed_streams": n_clients - dropped,
+        "dropped_streams": dropped,
+        "spike_cold_start_s": phases,
+        "spike_preempted_replicas": preempted,
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -861,6 +1113,13 @@ def _main() -> int:
             adp = with_retries("adapters", adapter_phase_fresh, errors,
                                attempts=2) or {}
 
+    # --- phase 4: spike-to-first-token (scale-from-zero + preemption) ---
+    # Always tiny-CPU-sized; it measures the control loop, so it runs in
+    # smoke/CI (where ci.sh gates dropped_streams == 0) or on demand.
+    spike = {}
+    if smoke or os.environ.get("BENCH_SPIKE"):
+        spike = with_retries("spike", spike_bench, errors, attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -872,6 +1131,7 @@ def _main() -> int:
         **{k: v for k, v in engine_stats.items() if k != "tokens_per_sec"},
         **gw,
         **adp,
+        **spike,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

@@ -67,6 +67,51 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="on-device adapter slots (LRU-recycled)")
     p.add_argument("--adapter-rank", type=_positive_int, default=16,
                    help="max LoRA rank the device stacks are sized for")
+    p.add_argument("--no-warmup", dest="warmup", action="store_false",
+                   default=True,
+                   help="skip the pre-serving warmup generation (first "
+                        "requests then pay the prefill/decode compiles)")
+
+
+def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a durable directory.
+
+    Cold-start attack: the warmup compiles (20-40 s per executable on a
+    real TPU) are the dominant cold-start phase; cached on the weight
+    PVC they are paid once per (program, jaxlib, topology), not once per
+    pod. Resolution order: ``LLMK_COMPILE_CACHE_DIR`` env (empty string
+    DISABLES the cache), explicit ``cache_dir`` arg, else ``xla_cache/``
+    next to the HF hub cache — which in the charts lives on the same
+    PVC as the weights. Returns the directory used, or None if disabled.
+    Must run before the first compilation; call it early in serve.
+    """
+    import jax
+
+    raw = os.environ.get("LLMK_COMPILE_CACHE_DIR")
+    if raw is not None:
+        cache_dir = raw.strip() or None
+    elif cache_dir is None:
+        from llms_on_kubernetes_tpu.engine.weights import hf_hub_cache
+
+        # hf_hub_cache() is <cache-root>/hub; keep XLA artifacts beside
+        # it, not inside it (hub tooling owns that layout)
+        cache_dir = os.path.join(
+            os.path.dirname(hf_hub_cache().rstrip(os.sep)), "xla_cache")
+    if not cache_dir:
+        return None
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache small programs too: the CPU-side tests (and debug-tiny
+    # configs) compile in well under the default 1 s / 4 KiB floors, and
+    # a warm restart must hit for them as well. Knob names vary across
+    # jax versions — absence just means that floor doesn't exist there.
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):
+            pass
+    return cache_dir
 
 
 def _add_router(sub: argparse._SubParsersAction) -> None:
@@ -168,10 +213,18 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from llms_on_kubernetes_tpu.parallel.distributed import maybe_initialize
+    from llms_on_kubernetes_tpu.server.metrics import cold_start
 
-    multi_host = maybe_initialize()  # join the pod group BEFORE backend init
+    with cold_start.phase("mesh"):
+        multi_host = maybe_initialize()  # join pod group BEFORE backend init
 
     import jax
+
+    # before any compilation: warm restarts reuse cached executables
+    cache_dir = configure_compilation_cache()
+    if cache_dir:
+        print(f"[serve] persistent compile cache: {cache_dir}",
+              file=sys.stderr)
 
     from llms_on_kubernetes_tpu.configs import from_hf_config, get_config
     from llms_on_kubernetes_tpu.engine.engine import Engine, EngineConfig
@@ -228,6 +281,14 @@ def main(argv: list[str] | None = None) -> int:
     if model_cfg is None:
         raise SystemExit(f"cannot resolve model {args.model!r}")
 
+    # cold-start attack: open/mmap the checkpoint shards (pure host I/O)
+    # in the background WHILE the device mesh is built below
+    weights_preload = None
+    if model_dir is not None and not args.random_weights:
+        from llms_on_kubernetes_tpu.engine.weights import WeightsPreload
+
+        weights_preload = WeightsPreload(model_dir)
+
     n_dev = len(jax.devices())
     ep = args.expert_parallel_size
     sp = args.sequence_parallel_size
@@ -238,7 +299,8 @@ def main(argv: list[str] | None = None) -> int:
     if tp < 1 or ep * sp * tp > n_dev:
         parser.error(f"--tp {tp} x --ep {ep} x --sp {sp} exceeds the "
                      f"{n_dev} local devices")
-    mesh = make_mesh(data=1, seq=sp, expert=ep, model=tp)
+    with cold_start.phase("mesh"):
+        mesh = make_mesh(data=1, seq=sp, expert=ep, model=tp)
 
     adapters = {}
     for spec in args.adapter or ():
@@ -269,16 +331,20 @@ def main(argv: list[str] | None = None) -> int:
     if gguf_file is not None and not args.random_weights:
         from llms_on_kubernetes_tpu.engine.gguf import load_gguf_params
 
-        _, gguf_params = load_gguf_params(
-            gguf_file, cfg=model_cfg, dtype=args.dtype,
-            quantization=args.quantization, mesh=mesh,
-        )  # closes the mmap; the parsed metadata dict stays usable
+        with cold_start.phase("load"):
+            _, gguf_params = load_gguf_params(
+                gguf_file, cfg=model_cfg, dtype=args.dtype,
+                quantization=args.quantization, mesh=mesh,
+            )  # closes the mmap; the parsed metadata dict stays usable
     elif gguf_file is not None:
         gguf_file.close()
-    engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
-                    params=gguf_params,
-                    model_dir=None if (args.random_weights or gguf_params is not None)
-                    else model_dir)
+    with cold_start.phase("load"):
+        engine = Engine(engine_cfg, model_config=model_cfg, mesh=mesh,
+                        params=gguf_params,
+                        model_dir=None if (args.random_weights
+                                           or gguf_params is not None)
+                        else model_dir,
+                        weights_preload=weights_preload)
     if gguf_file is not None:
         # prefer HF tokenizer files beside the .gguf; else the tokenizer
         # embedded in the GGUF metadata itself (a bare .gguf is the
@@ -310,6 +376,20 @@ def main(argv: list[str] | None = None) -> int:
             follower_loop(engine)
             return 0
     try:
+        if args.warmup:
+            # build the prefill + decode executables BEFORE taking
+            # traffic (or fetch them from the persistent cache): the
+            # first real request must not pay a 20-40 s compile. Timed
+            # as the "compile" cold-start phase.
+            from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+            with cold_start.phase("compile"):
+                w = engine.submit(
+                    [1, 2, 3, 4],
+                    SamplingParams(temperature=0.0, max_tokens=2))
+                while not w.finished:
+                    engine.step()
+            print("[serve] warmup complete", file=sys.stderr)
         run_server(engine, tokenizer, served, host=args.host, port=args.port)
     finally:
         engine.stop_followers()  # release follower pods' mirror loops

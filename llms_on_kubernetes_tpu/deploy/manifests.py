@@ -304,6 +304,16 @@ def render_model_service(m: ModelSpec, spec: DeploySpec) -> Manifest:
     }
 
 
+def _peak_replicas(m: ModelSpec) -> int:
+    """Most replicas this model can ever run: the static count, or the
+    autoscaler's ceiling when one is configured. Routing topology (the
+    headless -replicas Service) keys off this, not the instantaneous
+    count — an HPA scaling 1 -> 4 must not change the backend URL."""
+    if m.autoscaling is not None:
+        return max(m.replicas, m.autoscaling.max_replicas)
+    return m.replicas
+
+
 def render_model_replica_service(m: ModelSpec,
                                  spec: DeploySpec) -> Optional[Manifest]:
     """Headless Service over a replicated single-host model's pods.
@@ -313,7 +323,7 @@ def render_model_replica_service(m: ModelSpec,
     land on a different replica than the one that just refused (a ClusterIP
     Service would be a single conntrack-balanced VIP hiding the replicas).
     """
-    if m.replicas <= 1 or (m.tpu is not None and m.tpu.multi_host):
+    if _peak_replicas(m) <= 1 or (m.tpu is not None and m.tpu.multi_host):
         return None
     name = f"model-{m.model_name}"
     return {
@@ -324,6 +334,106 @@ def render_model_replica_service(m: ModelSpec,
             "clusterIP": "None",
             "selector": {"app": name},
             "ports": [{"port": ENGINE_PORT, "name": "http"}],
+        },
+    }
+
+
+# Scale-down damping shared by the HPA behavior block and the KEDA
+# cooldownPeriod: one replica per minute after a 5-minute quiet window,
+# so a burst's tail never mass-SIGTERMs replicas mid-stream (each removal
+# still runs the full drain lifecycle: preStop sleep + graceful drain).
+SCALE_DOWN_STABILIZATION_S = 300
+
+
+def _ttft_miss_milli(a) -> int:
+    """TTFT miss-ratio threshold in thousandths (k8s quantity millis):
+    a 0.95 attainment floor = scale out beyond 50m missed."""
+    return int(round((1.0 - a.ttft_ok_ratio_floor) * 1000))
+
+
+def render_model_autoscaler(m: ModelSpec,
+                            spec: DeploySpec) -> Optional[Manifest]:
+    """One autoscaler per model with an ``autoscaling:`` block.
+
+    minReplicas >= 1: an ``autoscaling/v2`` HPA. ``llm_queue_depth`` is a
+    per-pod series (prometheus-adapter exposes it on the custom-metrics
+    API from the pods' own /metrics scrape); TTFT attainment rides along
+    as an Object metric on the api-gateway Service — the router emits
+    ``llm_slo_ttft_miss_ratio`` over its sliding SLO window, so the
+    target is the MISS ratio (HPA scales up when a Value metric exceeds
+    its target; the ok-ratio would have the inverted sign).
+
+    minReplicas == 0: a KEDA ScaledObject (the HPA cannot reach zero).
+    Its prometheus triggers query the series Prometheus scrapes from the
+    router's ``/metrics/cluster``; the queue trigger adds the router-side
+    arrival rate (``llm_router_requests_total``) so a fully scaled-to-
+    zero model — whose engines emit no queue depth at all — still wakes
+    on incoming demand.
+    """
+    a = m.autoscaling
+    if a is None:
+        return None
+    name = f"model-{m.model_name}"
+    if a.min_replicas >= 1:
+        return {
+            "apiVersion": "autoscaling/v2",
+            "kind": "HorizontalPodAutoscaler",
+            "metadata": _meta(name, spec, "autoscaler"),
+            "spec": {
+                "scaleTargetRef": {"apiVersion": "apps/v1",
+                                   "kind": "Deployment", "name": name},
+                "minReplicas": a.min_replicas,
+                "maxReplicas": a.max_replicas,
+                "metrics": [
+                    {"type": "Pods", "pods": {
+                        "metric": {"name": "llm_queue_depth"},
+                        "target": {"type": "AverageValue",
+                                   "averageValue":
+                                       str(a.queue_depth_target)}}},
+                    {"type": "Object", "object": {
+                        "metric": {"name": "llm_slo_ttft_miss_ratio"},
+                        "describedObject": {"apiVersion": "v1",
+                                            "kind": "Service",
+                                            "name": "api-gateway"},
+                        "target": {"type": "Value",
+                                   "value": f"{_ttft_miss_milli(a)}m"}}},
+                ],
+                "behavior": {"scaleDown": {
+                    "stabilizationWindowSeconds": SCALE_DOWN_STABILIZATION_S,
+                    "policies": [{"type": "Pods", "value": 1,
+                                  "periodSeconds": 60}],
+                }},
+            },
+        }
+    queue_query = (
+        f'sum(llm_queue_depth{{model="{m.model_name}"}}) + '
+        f'sum(rate(llm_router_requests_total{{model="{m.model_name}"}}[1m]))'
+    )
+    return {
+        "apiVersion": "keda.sh/v1alpha1",
+        "kind": "ScaledObject",
+        "metadata": _meta(name, spec, "autoscaler"),
+        "spec": {
+            "scaleTargetRef": {"name": name},
+            "minReplicaCount": a.min_replicas,
+            "maxReplicaCount": a.max_replicas,
+            "cooldownPeriod": SCALE_DOWN_STABILIZATION_S,
+            "triggers": [
+                {"type": "prometheus", "metadata": {
+                    "serverAddress": spec.prometheus_url,
+                    "metricName": "llm_queue_depth",
+                    "query": queue_query,
+                    "threshold": str(a.queue_depth_target)}},
+                # percent integer, not a float ratio: the Helm template
+                # must render the identical string without float math
+                {"type": "prometheus", "metadata": {
+                    "serverAddress": spec.prometheus_url,
+                    "metricName": "llm_slo_ttft_miss_ratio",
+                    "query": "100 * max(llm_slo_ttft_miss_ratio)",
+                    "threshold":
+                        str(int(round((1.0 - a.ttft_ok_ratio_floor)
+                                      * 100)))}},
+            ],
         },
     }
 
@@ -352,7 +462,7 @@ def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
 
 def _backend_urls(m: ModelSpec, spec: DeploySpec) -> list[str]:
     """Replica-set URLs for one model (always a list, even for one)."""
-    if m.replicas > 1 and not (m.tpu is not None and m.tpu.multi_host):
+    if _peak_replicas(m) > 1 and not (m.tpu is not None and m.tpu.multi_host):
         # replicated single-host model: route via the headless -replicas
         # Service, whose DNS answers with the READY pod IPs (Deployment
         # pods have no stable per-pod names to enumerate). Explicit
@@ -603,6 +713,9 @@ def render_manifests(spec: DeploySpec) -> list[Manifest]:
         pvc = render_model_pvc(m, spec)
         if pvc:
             out.append(pvc)
+        hpa = render_model_autoscaler(m, spec)
+        if hpa:
+            out.append(hpa)
     out += render_router(spec)
     out += render_istio(spec)
     out += render_webui(spec)

@@ -174,6 +174,49 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKColdStartSlow",
+                        # phase="ready" is process start -> taking
+                        # traffic; with the persistent compile cache a
+                        # warm restart should be far under this
+                        "expr": (
+                            "histogram_quantile(0.95, rate("
+                            'llm_cold_start_seconds_bucket'
+                            '{phase="ready"}[30m])) > 180'
+                        ),
+                        "for": "5m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "replicas starting too slowly "
+                                       "to absorb spikes",
+                            "description": (
+                                "p95 cold start (start to ready) is "
+                                "{{ $value }}s over the last 30m; "
+                                "scale-out arrives too late to help a "
+                                "spike. Check the persistent compile "
+                                "cache (LLMK_COMPILE_CACHE_DIR on the "
+                                "weight PVC) and weight-load times."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKQueueSaturated",
+                        # 2x the default autoscaling queueDepthTarget (8)
+                        "expr": "llm_queue_depth > 16",
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "admission queue saturated",
+                            "description": (
+                                "Model {{ $labels.model }} on "
+                                "{{ $labels.instance }} has held more "
+                                "than twice the autoscaling target of "
+                                "queued requests for 10m; the "
+                                "autoscaler is at its ceiling, not "
+                                "reacting, or scale-out is too slow."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -257,6 +300,13 @@ def grafana_dashboard() -> dict[str, Any]:
                ["histogram_quantile(0.95, "
                 "rate(llm_adapter_load_seconds_bucket[5m]))"], 12, 40,
                unit="s"),
+        _panel(13, "Cold start by phase (p95)",
+               ["histogram_quantile(0.95, sum by (le, phase) "
+                "(rate(llm_cold_start_seconds_bucket[30m])))"], 0, 48,
+               unit="s"),
+        _panel(14, "Queue depth per model (autoscaling signal)",
+               ["llm_queue_depth",
+                "rate(llm_router_requests_total[1m])"], 12, 48),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

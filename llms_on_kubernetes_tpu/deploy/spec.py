@@ -17,6 +17,11 @@ from typing import Optional
 
 _NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,51}[a-z0-9])?$")
 
+# Prometheus the KEDA ScaledObject triggers query (scrapes the router's
+# /metrics/cluster); the kube-prometheus-stack default in-cluster address.
+DEFAULT_PROMETHEUS_URL = \
+    "http://prometheus-server.monitoring.svc.cluster.local:9090"
+
 # chips per host for each accelerator type: a request larger than this
 # renders a multi-host slice (LeaderWorkerSet-style pod group).
 CHIPS_PER_HOST = {"v5e": 8, "v5p": 4, "v6e": 8}
@@ -94,6 +99,45 @@ class ShardingSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscalingSpec:
+    """Per-model closed-loop autoscaling knobs (``autoscaling:`` block).
+
+    ``min_replicas >= 1`` renders an ``autoscaling/v2`` HPA scaling on
+    ``llm_queue_depth`` (served per-pod by prometheus-adapter) plus the
+    router's TTFT-SLO attainment (``llm_slo_ttft_miss_ratio`` as an
+    Object metric on the api-gateway Service). ``min_replicas == 0``
+    renders a KEDA ScaledObject instead — the HPA cannot scale to zero —
+    whose prometheus triggers query the same series Prometheus scrapes
+    from the router's ``/metrics/cluster``.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # waiting requests per replica before adding one (llm_queue_depth)
+    queue_depth_target: int = 8
+    # scale out when the TTFT-SLO ok ratio drops below this attainment
+    ttft_ok_ratio_floor: float = 0.95
+
+    def validate(self, model_name: str) -> None:
+        if self.min_replicas < 0:
+            raise SpecError(
+                f"model {model_name}: autoscaling.minReplicas must be >= 0")
+        if self.max_replicas < max(1, self.min_replicas):
+            raise SpecError(
+                f"model {model_name}: autoscaling.maxReplicas="
+                f"{self.max_replicas} must be >= max(1, minReplicas="
+                f"{self.min_replicas})")
+        if self.queue_depth_target < 1:
+            raise SpecError(
+                f"model {model_name}: autoscaling.queueDepthTarget must "
+                f"be >= 1")
+        if not (0.0 < self.ttft_ok_ratio_floor <= 1.0):
+            raise SpecError(
+                f"model {model_name}: autoscaling.ttftOkRatioFloor must be "
+                f"in (0, 1], got {self.ttft_ok_ratio_floor}")
+
+
+@dataclasses.dataclass(frozen=True)
 class AdapterSpec:
     """One LoRA adapter a model's replicas serve (multi-tenant serving):
     requests address it as ``model: "<modelName>:<name>"``."""
@@ -145,6 +189,8 @@ class ModelSpec:
     adapters: tuple = ()                   # tuple[AdapterSpec, ...]
     adapter_slots: int = 4
     adapter_rank: int = 16
+    # closed-loop replica autoscaling; None = static replica count
+    autoscaling: Optional[AutoscalingSpec] = None
 
     def validate(self) -> None:
         if not _NAME_RE.match(self.model_name):
@@ -155,8 +201,12 @@ class ModelSpec:
             raise SpecError(
                 f"model {self.model_name}: need huggingfaceId or modelPath"
             )
-        if self.replicas < 1:
-            raise SpecError(f"model {self.model_name}: replicas must be >= 1")
+        scale_to_zero = (self.autoscaling is not None
+                         and self.autoscaling.min_replicas == 0)
+        if self.replicas < (0 if scale_to_zero else 1):
+            raise SpecError(
+                f"model {self.model_name}: replicas must be >= 1 "
+                f"(0 only with autoscaling.minReplicas: 0 — scale-to-zero)")
         if self.quantization not in (None, "int8", "fp8", "awq"):
             raise SpecError(
                 f"model {self.model_name}: unknown quantization "
@@ -170,9 +220,23 @@ class ModelSpec:
                 )
             self.tpu.resolved_topology()
             self.sharding.resolve(self.tpu.chips)
-        if self.replicas > 1 and not self.pvc_shared and self.huggingface_id:
+        # peak replica count: what the autoscaler may scale up to, not
+        # just the static spec — an HPA-driven second replica hits the
+        # same RWO volume-attach deadlock as a static replicas: 2
+        peak = self.replicas
+        if self.autoscaling is not None:
+            self.autoscaling.validate(self.model_name)
+            peak = max(peak, self.autoscaling.max_replicas)
+            if self.tpu is not None and self.tpu.multi_host:
+                raise SpecError(
+                    f"model {self.model_name}: autoscaling targets the "
+                    f"replica count, but a multi-host slice's StatefulSet "
+                    f"replicas are the pod GROUP size ({self.tpu.hosts} "
+                    f"hosts); autoscaling multi-host models is unsupported"
+                )
+        if peak > 1 and not self.pvc_shared and self.huggingface_id:
             raise SpecError(
-                f"model {self.model_name}: replicas={self.replicas} with a "
+                f"model {self.model_name}: up to {peak} replicas with a "
                 f"ReadWriteOnce cache PVC deadlocks on volume attach; set "
                 f"pvcShared: true (ReadOnlyMany) or replicas: 1"
             )
@@ -209,6 +273,8 @@ class DeploySpec:
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
     host_model_path: Optional[str] = None  # local path mount (CPU profile)
+    # Prometheus address the KEDA ScaledObject triggers query
+    prometheus_url: str = DEFAULT_PROMETHEUS_URL
 
     def validate(self) -> None:
         if not self.models:
@@ -258,6 +324,27 @@ def _tpu_from(d: Optional[dict]) -> Optional[TPUSpec]:
     )
 
 
+def _autoscaling_from(d: Optional[dict], model_name: str) \
+        -> Optional[AutoscalingSpec]:
+    if d is None:
+        return None
+    if not isinstance(d, dict):
+        raise SpecError(
+            f"model {model_name}: autoscaling must be a mapping")
+    unknown = set(d) - {"minReplicas", "maxReplicas", "queueDepthTarget",
+                        "ttftOkRatioFloor"}
+    if unknown:
+        raise SpecError(
+            f"model {model_name}: unknown autoscaling keys: "
+            f"{sorted(unknown)}")
+    return AutoscalingSpec(
+        min_replicas=int(d.get("minReplicas", 1)),
+        max_replicas=int(d.get("maxReplicas", 4)),
+        queue_depth_target=int(d.get("queueDepthTarget", 8)),
+        ttft_ok_ratio_floor=float(d.get("ttftOkRatioFloor", 0.95)),
+    )
+
+
 def _adapter_from(d: dict, model_name: str) -> AdapterSpec:
     if not isinstance(d, dict):
         raise SpecError(
@@ -278,7 +365,7 @@ def _model_from(d: dict) -> ModelSpec:
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype",
-        "adapters", "adapterSlots", "adapterRank",
+        "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
     unknown = set(d) - known
     if unknown:
@@ -310,6 +397,8 @@ def _model_from(d: dict) -> ModelSpec:
                        for a in d.get("adapters", ()) or ()),
         adapter_slots=int(d.get("adapterSlots", 4)),
         adapter_rank=int(d.get("adapterRank", 16)),
+        autoscaling=_autoscaling_from(d.get("autoscaling"),
+                                      d.get("modelName", "")),
     )
 
 
@@ -353,6 +442,8 @@ def load_spec(source: "str | dict") -> DeploySpec:
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),
         host_model_path=data.get("hostModelPath"),
+        prometheus_url=str(data.get("prometheusUrl")
+                           or DEFAULT_PROMETHEUS_URL),
     )
     spec.validate()
     return spec

@@ -888,6 +888,7 @@ class Engine:
         params: Optional[Params] = None,
         mesh=None,
         model_dir: Optional[str] = None,
+        weights_preload=None,
     ):
         from llms_on_kubernetes_tpu.ops.quant import SUPPORTED_QUANTIZATIONS
 
@@ -930,6 +931,7 @@ class Engine:
             self.params = load_hf_params(
                 cfg, model_dir, mesh=mesh, dtype=engine_config.dtype,
                 quantization=engine_config.quantization,
+                preload=weights_preload,
             )
         else:  # random weights (tests / benchmarks)
             self.params = init_params(cfg, jax.random.key(engine_config.seed),

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import pathlib
+import threading
 from typing import Any, Callable, Optional
 
 import jax
@@ -304,12 +305,49 @@ def hf_layer_maps(cfg: ModelConfig, fetch: _Fetch, i: int,
     return out
 
 
+class WeightsPreload:
+    """Overlap safetensors open/mmap with other startup work.
+
+    Cold-start attack: ``_open_safetensors`` walks the checkpoint dir,
+    parses every shard header and (native stload path) mmaps the tensor
+    data — pure host I/O with no JAX dependency, so it can run in a
+    background thread WHILE the distributed runtime initializes and the
+    device mesh is built. Start one before mesh init; pass it to
+    :func:`load_hf_params` (via ``Engine(weights_preload=...)``) and the
+    load phase begins with the loaders already open instead of paying
+    the walk+header+mmap cost serially.
+    """
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        self._loaders: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="weights-preload")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._loaders = _open_safetensors(self.model_dir)
+        except BaseException as e:  # re-raised on the consumer thread
+            self._error = e
+
+    def loaders(self) -> dict:
+        """Join the preload and return its loaders (or raise its error)."""
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        assert self._loaders is not None
+        return self._loaders
+
+
 def load_hf_params(
     cfg: ModelConfig,
     model_dir: str,
     mesh=None,
     dtype: Optional[str] = None,
     quantization: Optional[str] = None,
+    preload: Optional[WeightsPreload] = None,
 ) -> Params:
     """Load a HF checkpoint directory into (optionally mesh-sharded) params.
 
@@ -342,7 +380,10 @@ def load_hf_params(
                 f"at {model_dir} is {found or 'full-precision'}"
             )
     dt = jnp.dtype(dtype or cfg.dtype)
-    loaders = _open_safetensors(model_dir)
+    if preload is not None and preload.model_dir == model_dir:
+        loaders = preload.loaders()
+    else:
+        loaders = _open_safetensors(model_dir)
     fetch = _Fetch(loaders, quant=ckpt_quant)
 
     # Pre-quantized checkpoints always serve int8 (their weights are

@@ -32,6 +32,17 @@ Known fault names (each documented at its injection site):
   seconds (default 1.0) while the engine keeps serving. A cluster-level
   fault (replica joining/leaving endpoints repeatedly) for exercising
   router health-probe ejection/re-admission against a live server.
+- ``slow_cold_start[:SECONDS]`` — server startup holds the replica in
+  ``loading`` (readiness 503) for SECONDS (default 2.0) before serving:
+  a compile-cache-miss cold start in miniature, so spike/scale-out tests
+  see a realistically slow replica join.
+- ``preempt_replica[:DELAY]`` — DELAY seconds (default 1.0) after a
+  server starts serving, it receives a simulated spot-TPU preemption
+  notice and begins the graceful drain (readiness 503, in-flight streams
+  finish, no new admissions). One-shot per process via :func:`claim`:
+  with several in-process replicas sharing the env (tests, bench),
+  exactly ONE is preempted — the point is proving the survivors absorb
+  its traffic with zero dropped streams.
 
 Routers do not read ``LLMK_FAULT``; their faults (connection resets,
 stalled responses) are injected by the fake upstream backends in the test
@@ -41,6 +52,7 @@ fixtures, which is both more deterministic and closer to the real failure.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 ENV_VAR = "LLMK_FAULT"
@@ -98,3 +110,29 @@ def inject_delay(name: str, default_s: float) -> None:
     s = get_float(name, default_s)
     if s is not None and s > 0:
         time.sleep(s)
+
+
+# one-shot faults: first in-process claimer wins (see preempt_replica)
+_claimed: set[str] = set()
+_claim_lock = threading.Lock()
+
+
+def claim(name: str) -> bool:
+    """True exactly once per process for an active fault ``name``.
+
+    Lets N in-process replicas share one ``LLMK_FAULT`` env while only
+    the first to reach the hook acts on it — a single-victim fault.
+    """
+    if not is_active(name):
+        return False
+    with _claim_lock:
+        if name in _claimed:
+            return False
+        _claimed.add(name)
+        return True
+
+
+def reset_claims() -> None:
+    """Forget one-shot claims (test isolation between cases)."""
+    with _claim_lock:
+        _claimed.clear()

@@ -305,6 +305,15 @@ def slo_gauges(registry, tracker: SLOTracker) -> dict:
             "Fraction of recent requests whose TTFT met the objective "
             "(sliding window; 1.0 with no traffic)", registry,
             lambda: tracker.snapshot()["ttft_ok_ratio"]),
+        # the complement, as its own series: HPA Object metrics and KEDA
+        # thresholds scale UP when a value EXCEEDS its target, so the
+        # autoscaling loop needs the miss ratio, not the ok ratio
+        # (deploy/manifests.py render_model_autoscaler)
+        "ttft_miss_ratio": CallbackGauge(
+            "llm_slo_ttft_miss_ratio",
+            "Fraction of recent requests whose TTFT missed the objective "
+            "(1 - llm_slo_ttft_ok_ratio; the scale-out signal)", registry,
+            lambda: round(1.0 - tracker.snapshot()["ttft_ok_ratio"], 6)),
         "availability": CallbackGauge(
             "llm_slo_availability",
             "Fraction of recent requests that did not fail 5xx/transport "

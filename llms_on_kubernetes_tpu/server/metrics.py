@@ -291,6 +291,22 @@ def engine_metrics(registry: Registry) -> dict:
             "llm_kv_pages_used", "KV pages allocated", registry),
         "waiting": Gauge(
             "llm_waiting_requests", "Requests queued for admission", registry),
+        # same value as llm_waiting_requests but model-labeled: the
+        # autoscaling signal (HPA Pods metric / KEDA prometheus trigger
+        # per model) — deploy/manifests.py render_model_autoscaler
+        "queue_depth": Gauge(
+            "llm_queue_depth",
+            "Requests queued for admission, per served model "
+            "(the replica-autoscaling signal)",
+            registry, label_names=("model",)),
+        "cold_start": Histogram(
+            "llm_cold_start_seconds",
+            "Startup phase durations: compile=warmup executable builds, "
+            "load=checkpoint load + engine init, mesh=distributed init + "
+            "device mesh, ready=process start to serving",
+            (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 30.0, 60.0, 120.0, 180.0,
+             300.0, 600.0),
+            registry, label_names=("phase",)),
         "prefix_hit_tokens": Gauge(
             "llm_prefix_cache_hit_tokens_total",
             "Prompt tokens served from the prefix cache", registry),
@@ -326,6 +342,60 @@ def engine_metrics(registry: Registry) -> dict:
     }
 
 
+class ColdStartRecorder:
+    """Collects startup-phase durations BEFORE a metrics registry exists.
+
+    The cold-start phases (mesh init, checkpoint load, warmup compile)
+    happen in ``cli.py serve`` long before ``OpenAIServer`` builds its
+    registry, so the timings park here and are drained into the
+    ``llm_cold_start_seconds{phase=...}`` histogram when the server
+    constructs. A module-level singleton (``cold_start``) because process
+    startup is inherently a singleton; tests reset it via ``reset()``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._phases: list[tuple[str, float]] = []
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.monotonic()
+            self._phases = []
+
+    def record(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self._phases.append((phase, float(seconds)))
+
+    def phase(self, name: str):
+        """Context manager timing one startup phase."""
+        recorder = self
+
+        class _Phase:
+            def __enter__(self):
+                self._t = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                recorder.record(name, time.monotonic() - self._t)
+                return False
+
+        return _Phase()
+
+    def elapsed(self) -> float:
+        """Seconds since process start (or the last reset)."""
+        with self._lock:
+            return time.monotonic() - self._t0
+
+    def drain(self) -> list[tuple[str, float]]:
+        with self._lock:
+            phases, self._phases = self._phases, []
+            return phases
+
+
+cold_start = ColdStartRecorder()
+
+
 def router_metrics(registry: Registry) -> dict:
     """Gateway-side metric set (replica routing + failover visibility)."""
     return {
@@ -333,6 +403,12 @@ def router_metrics(registry: Registry) -> dict:
             "llm_replica_healthy",
             "Active /ready probe verdict per replica (1=routable)",
             registry, label_names=("model", "replica")),
+        "requests_total": Counter(
+            "llm_router_requests_total",
+            "Requests the router accepted, by resolved model — the "
+            "demand signal that wakes a scaled-to-zero model (its "
+            "engines emit no llm_queue_depth while no replica runs)",
+            registry, label_names=("model",)),
         "failover": Counter(
             "llm_failover_total",
             "Requests retried on a different replica after a "

@@ -36,7 +36,7 @@ from llms_on_kubernetes_tpu.engine.engine import Engine, Request, SamplingParams
 from llms_on_kubernetes_tpu.engine.tokenizer import TokenizerLike
 from llms_on_kubernetes_tpu.server import tracing
 from llms_on_kubernetes_tpu.server.metrics import (
-    Registry, build_info_metrics, engine_metrics,
+    Registry, build_info_metrics, cold_start, engine_metrics,
 )
 from llms_on_kubernetes_tpu.server.profiling import ProfileManager
 from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
@@ -188,6 +188,8 @@ class EngineLoop(threading.Thread):
                 m["batch_occupancy"].set(occupancy)
                 m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))
+                m["queue_depth"].labels(model=self.model_name).set(
+                    len(eng.waiting))
                 m["prefix_hit_tokens"].set(eng.allocator.hit_tokens_total)
                 for ev in events:
                     m["tokens_generated"].inc(len(ev.new_tokens))
@@ -358,6 +360,12 @@ class OpenAIServer:
         self.model_name = model_name
         self.registry = registry or Registry()
         self.metrics = engine_metrics(self.registry)
+        # startup phases timed before this registry existed (mesh init,
+        # checkpoint load, warmup compiles in cli.py) land in the process
+        # -wide ColdStartRecorder; flush them into the histogram now so
+        # the first /metrics scrape already carries the full cold start
+        for phase, seconds in cold_start.drain():
+            self.metrics["cold_start"].labels(phase=phase).observe(seconds)
         try:
             import jax
             backend = jax.default_backend()
@@ -450,9 +458,48 @@ class OpenAIServer:
         return app
 
     async def _start_loop(self, app) -> None:
+        from llms_on_kubernetes_tpu import faults
+        # injected fault: startup stalls (compile-cache miss in
+        # miniature) — the replica stays "loading"/503 so routers and
+        # autoscalers see a realistically slow join
+        delay = faults.get_float("slow_cold_start", 2.0)
+        if delay is not None and delay > 0:
+            await asyncio.sleep(delay)
         if not self.loop_thread.is_alive():
             self.loop_thread.start()
         self._state = "serving"
+        # "ready" = process start -> taking traffic; sub-phases
+        # (mesh/load/compile) were recorded by cli.py where they ran
+        self.metrics["cold_start"].labels(phase="ready").observe(
+            cold_start.elapsed())
+        # injected fault: a spot-TPU preemption notice lands DELAY
+        # seconds from now. One-shot (faults.claim) so a multi-replica
+        # process loses exactly one replica; its in-flight streams must
+        # finish or fail over, never drop.
+        notice = faults.get_float("preempt_replica", 1.0)
+        if notice is not None and faults.claim("preempt_replica"):
+            t = threading.Timer(
+                max(notice, 0.0), self.begin_drain,
+                kwargs={"reason": "preempt_replica fault"})
+            t.daemon = True
+            t.start()
+
+    def begin_drain(self, reason: str = "scale-in") -> None:
+        """Enter the graceful drain from OUTSIDE the event loop.
+
+        The SIGTERM path (aiohttp cleanup -> ``_stop_loop``) and this
+        method converge on the same lifecycle: readiness goes 503 so
+        routers eject the replica, admissions are refused, and the
+        engine loop keeps stepping until in-flight work completes
+        (bounded by ``EngineLoop.drain_timeout_s``). Used by the
+        ``preempt_replica`` fault and scale-in hooks; idempotent."""
+        if self._state == "draining":
+            return
+        self._state = "draining"
+        self.metrics["engine_state"].set(self.STATE_CODES["draining"])
+        # stop() only sets events — safe from any thread; the engine
+        # loop drains in its own thread while streams keep flowing
+        self.loop_thread.stop()
 
     async def _stop_loop(self, app) -> None:
         self._state = "draining"

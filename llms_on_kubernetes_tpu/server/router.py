@@ -576,6 +576,10 @@ class Router:
                 error_body(err, "invalid_request_error", err_code),
                 status=404, headers=self._rid_headers(rid),
             )
+        # demand signal, counted BEFORE replica selection can fail: a
+        # scaled-to-zero model has no healthy replica, and this series'
+        # rate is exactly what wakes it (KEDA trigger in manifests.py)
+        self.metrics["requests_total"].labels(model=model).inc()
         deadline = self._deadline_from(request, doc, t0)
         if deadline is not None and self.clock() >= deadline:
             return self._deadline_response(rid)

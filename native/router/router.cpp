@@ -167,6 +167,17 @@ static std::atomic<long> g_deadline_rejected_total{0};
 // satellite), never silently dropped from it
 static std::atomic<long> g_cluster_scrape_errors_total{0};
 
+// per-model accepted-request counter (llm_router_requests_total) — the
+// demand signal autoscalers watch so a scaled-to-zero model still shows
+// traffic even when no engine replica is up to report queue depth
+static std::mutex g_requests_by_model_mu;
+static std::map<std::string, long> g_requests_by_model;
+
+static void count_model_request(const std::string& model) {
+  std::lock_guard<std::mutex> lock(g_requests_by_model_mu);
+  ++g_requests_by_model[model];
+}
+
 // build identity: must match the python package __version__ so
 // llm_build_info{version=...} agrees across the serving path
 static const char kLlmkVersion[] = "0.1.0";
@@ -1457,6 +1468,11 @@ static void handle_connection(const Config& cfg, int client_fd,
            "TTFT met the objective (sliding window; 1.0 with no traffic)\n"
         << "# TYPE llm_slo_ttft_ok_ratio gauge\n"
         << "llm_slo_ttft_ok_ratio " << slo.ttft_ok_ratio << "\n"
+        << "# HELP llm_slo_ttft_miss_ratio Fraction of recent requests "
+           "whose TTFT missed the objective (1 - llm_slo_ttft_ok_ratio; "
+           "the scale-out signal)\n"
+        << "# TYPE llm_slo_ttft_miss_ratio gauge\n"
+        << "llm_slo_ttft_miss_ratio " << (1.0 - slo.ttft_ok_ratio) << "\n"
         << "# HELP llm_slo_availability Fraction of recent requests that "
            "did not fail 5xx/transport (sliding window; 1.0 with no "
            "traffic)\n"
@@ -1486,8 +1502,18 @@ static void handle_connection(const Config& cfg, int client_fd,
            "the gateway with an already-expired deadline\n"
         << "# TYPE llm_router_deadline_rejected_total counter\n"
         << "llm_router_deadline_rejected_total "
-        << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n"
-        << "# HELP llm_replica_healthy Active /ready probe verdict per "
+        << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n";
+      {
+        std::lock_guard<std::mutex> lock(g_requests_by_model_mu);
+        m << "# HELP llm_router_requests_total Requests the router "
+             "accepted, by resolved model (demand signal that wakes a "
+             "scaled-to-zero model)\n"
+          << "# TYPE llm_router_requests_total counter\n";
+        for (const auto& kv : g_requests_by_model)
+          m << "llm_router_requests_total{model=\"" << prom_escape(kv.first)
+            << "\"} " << kv.second << "\n";
+      }
+      m << "# HELP llm_replica_healthy Active /ready probe verdict per "
            "replica (1=routable)\n"
         << "# TYPE llm_replica_healthy gauge\n";
       for (const auto& kv : cfg.models)
@@ -1528,6 +1554,7 @@ static void handle_connection(const Config& cfg, int client_fd,
         g_slo.observe(404, -1.0);
         jlog_request(cfg, rid, model, "", 404, 0.0, 0.0, 0.0);
       } else {
+        count_model_request(model);
         keep = proxy_request(cfg, req, client_fd, client_ip, model, rid);
       }
     }

@@ -32,8 +32,10 @@ import pathlib
 import re
 import sys
 
-_LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds)")
-_HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit)")
+_LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
+                           r"|cold_start|dropped_streams|spike_first_token)")
+_HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
+                            r"|completed_streams)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

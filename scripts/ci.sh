@@ -82,6 +82,20 @@ sys.exit(0 if doc.get("gateway_adapter_ok") is True else 1)'; then
     fails=$((fails + 1))
   fi
 
+  note "spike smoke (scale-from-zero wake + preemption drain, 0 drops)"
+  # the smoke's spike phase bursts streaming clients at a router with
+  # zero live replicas, brings two up cold, preempts one mid-serve;
+  # every stream must complete or fail over — dropped_streams is a hard 0
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+sys.exit(0 if doc.get("dropped_streams") == 0 else 1)'; then
+    echo "ci: spike smoke OK (dropped_streams == 0)"
+  else
+    echo "ci: spike smoke FAILED (dropped_streams != 0)"
+    fails=$((fails + 1))
+  fi
+
   note "metrics lint (Prometheus exposition format on scraped /metrics)"
   if [ -s "$metrics_dump/api_metrics.txt" ] \
       && [ -s "$metrics_dump/gateway_metrics.txt" ] \
@@ -105,6 +119,17 @@ if "$PY" "$REPO/scripts/bench_compare.py"; then
   echo "ci: bench compare OK"
 else
   echo "ci: bench compare flagged regressions (advisory only)"
+fi
+
+note "manifest goldens (autoscaler HPA/ScaledObject + helm/python parity)"
+# explicit gate on the rendered-manifest contract: the Python renderer's
+# golden dicts plus (when a helm binary exists) Go-template parity
+if "$PY" -m pytest "$REPO/tests/test_manifests.py" \
+    "$REPO/tests/test_helm_golden.py" -q -p no:cacheprovider; then
+  echo "ci: manifest goldens OK"
+else
+  echo "ci: manifest goldens FAILED"
+  fails=$((fails + 1))
 fi
 
 note "monitoring artifacts (alert rules + dashboard + chart sync)"

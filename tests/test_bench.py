@@ -107,6 +107,69 @@ class TestWithRetries:
         assert slept == [1.0, 2.0]  # attempts-1 sleeps, linear backoff
 
 
+class TestRetryAfter:
+    """ISSUE 7: the bench HTTP client honors the server's Retry-After
+    hint on 429/503 instead of blind immediate retry."""
+
+    @staticmethod
+    def _scripted(responses):
+        it = iter(responses)
+
+        def send():
+            return next(it)
+        return send
+
+    def test_server_hint_honored_exactly(self):
+        slept = []
+        send = self._scripted([
+            (429, {"Retry-After": "7"}, b"full"),
+            (503, {"retry-after": "2.5"}, b"draining"),  # case-insensitive
+            (200, {}, b"ok"),
+        ])
+        status, _, data = bench.request_with_retry_after(
+            send, attempts=4, backoff_s=0.2, sleep=slept.append)
+        assert (status, data) == (200, b"ok")
+        assert slept == [7.0, 2.5]  # the hints, not the backoff schedule
+
+    def test_missing_header_falls_back_to_capped_backoff(self):
+        slept = []
+        send = self._scripted([(503, {}, b"")] * 5)
+        status, _, _ = bench.request_with_retry_after(
+            send, attempts=5, backoff_s=1.0, max_backoff_s=4.0,
+            sleep=slept.append)
+        assert status == 503            # last attempt returned as-is
+        assert slept == [1.0, 2.0, 4.0, 4.0]  # exponential, capped
+
+    def test_malformed_hint_falls_back_to_backoff(self):
+        slept = []
+        send = self._scripted([
+            (429, {"Retry-After": "soon"}, b""),
+            (200, {}, b"ok"),
+        ])
+        status, _, _ = bench.request_with_retry_after(
+            send, attempts=2, backoff_s=0.3, sleep=slept.append)
+        assert status == 200
+        assert slept == [0.3]
+
+    def test_negative_hint_clamped_to_zero(self):
+        slept = []
+        send = self._scripted([(503, {"Retry-After": "-3"}, b""),
+                               (200, {}, b"ok")])
+        bench.request_with_retry_after(send, attempts=2, sleep=slept.append)
+        assert slept == [0.0]
+
+    def test_success_and_hard_errors_return_immediately(self):
+        slept = []
+        send = self._scripted([(200, {"Retry-After": "9"}, b"ok")])
+        status, _, _ = bench.request_with_retry_after(
+            send, attempts=5, sleep=slept.append)
+        assert status == 200 and slept == []
+        send = self._scripted([(404, {}, b"nope")])
+        status, _, _ = bench.request_with_retry_after(
+            send, attempts=5, sleep=slept.append)
+        assert status == 404 and slept == []  # 4xx bugs are not retried
+
+
 class TestPartialEmission:
     def test_cpu_bench_end_to_end_emits_json(self, tmp_path):
         """The tiny-model CPU bench must print a parseable JSON line with
@@ -146,6 +209,12 @@ class TestPartialEmission:
         data = json.loads(line)
         assert data["smoke"] is True
         assert data["value"] > 0
+        # ISSUE 7: the spike scenario rides the smoke pass — scale-from-
+        # zero wake + one preempted replica, with zero dropped streams
+        assert data["dropped_streams"] == 0
+        assert data["spike_completed_streams"] > 0
+        assert data["spike_preempted_replicas"] == 1
+        assert data["spike_cold_start_s"].get("ready", 0) > 0
         repo = pathlib.Path(bench.__file__).resolve().parent
         binary = repo / "native" / "router" / "llkt-router"
         if binary.exists():

@@ -638,6 +638,124 @@ def test_drain_lifecycle_completes_inflight_stream():
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 7: cold-start + preemption faults
+# ---------------------------------------------------------------------------
+
+def test_fault_claim_is_one_shot_per_process(monkeypatch):
+    """claim() is the single-victim gate: N in-process replicas share one
+    LLMK_FAULT env, exactly the first claimer acts on it."""
+    monkeypatch.setenv("LLMK_FAULT", "preempt_replica:0.1")
+    faults.reset_claims()
+    try:
+        assert faults.claim("preempt_replica") is True
+        assert faults.claim("preempt_replica") is False   # second replica
+        # inactive faults never claim, and do not consume the slot
+        assert faults.claim("slow_cold_start") is False
+        faults.reset_claims()
+        assert faults.claim("preempt_replica") is True    # test isolation
+    finally:
+        faults.reset_claims()
+
+
+@pytest.mark.e2e
+def test_slow_cold_start_delays_readiness(monkeypatch):
+    """LLMK_FAULT=slow_cold_start:S holds startup for S seconds — the
+    compile-cache-miss cold start in miniature. Once serving, the
+    cold-start histogram carries the phase="ready" observation that the
+    spike bench and the LLMKColdStartSlow alert read."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server import metrics as server_metrics
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    monkeypatch.setenv("LLMK_FAULT", "slow_cold_start:0.5")
+    faults.reset_claims()
+    server_metrics.cold_start.reset()
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        t0 = time.monotonic()
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()        # on_startup holds for the fault
+        startup_s = time.monotonic() - t0
+        try:
+            assert startup_s >= 0.5, startup_s
+            assert (await client.get("/ready")).status == 200
+            text = await (await client.get("/metrics")).text()
+            assert 'llm_cold_start_seconds_count{phase="ready"} 1' in text
+            # the observed ready time includes the injected delay
+            for line in text.splitlines():
+                if line.startswith('llm_cold_start_seconds_sum{phase="ready"}'):
+                    assert float(line.split()[-1]) >= 0.5
+                    break
+            else:
+                pytest.fail("no cold_start sum sample")
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+@pytest.mark.e2e
+def test_preempt_replica_drains_single_victim_without_drops(monkeypatch):
+    """The scale-in/preemption contract end-to-end: with TWO in-process
+    replicas sharing LLMK_FAULT=preempt_replica, exactly one receives the
+    simulated preemption notice, flips to draining (readiness 503 so the
+    router/endpoints eject it), REFUSES new work, and still runs its
+    in-flight stream to completion — zero dropped streams. The survivor
+    keeps serving."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    monkeypatch.setenv("LLMK_FAULT", "preempt_replica:0.2")
+    faults.reset_claims()
+    srv_a = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+    srv_b = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        ca = TestClient(TestServer(srv_a.make_app()))
+        cb = TestClient(TestServer(srv_b.make_app()))
+        await ca.start_server()
+        # the in-flight stream on the victim BEFORE the notice fires
+        resp = await ca.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "hello", "max_tokens": 8,
+            "stream": True})
+        assert resp.status == 200
+        first = b""
+        while b"data:" not in first:
+            first = await resp.content.readline()
+        await cb.start_server()
+        try:
+            # only the first replica to start claims the fault
+            deadline = time.monotonic() + 10
+            while srv_a.state != "draining" and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert srv_a.state == "draining"
+            assert srv_b.state == "serving"     # survivor untouched
+
+            r = await ca.get("/ready")          # endpoints eject the victim
+            assert r.status == 503
+            assert (await r.json())["state"] == "draining"
+            r = await ca.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "new", "max_tokens": 4})
+            assert r.status == 503              # no new work on the victim
+            assert (await r.json())["error"]["code"] == "shutting_down"
+
+            # the in-flight stream survives the preemption drain
+            text = (first + await resp.content.read()).decode()
+            assert '"finish_reason": "length"' in text
+            assert "data: [DONE]" in text
+
+            # the survivor absorbs the traffic
+            r = await cb.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "failover", "max_tokens": 4})
+            assert r.status == 200
+        finally:
+            faults.reset_claims()
+            await ca.close()
+            await cb.close()
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
 # hardened entry points under a hung backend (subprocess, like production)
 # ---------------------------------------------------------------------------
 

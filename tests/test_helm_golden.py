@@ -112,6 +112,23 @@ def test_router_and_gateway_match(chart):
 
 
 @pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_autoscalers_match_field_level(chart):
+    """ISSUE 7: the HPA/ScaledObject specs must be identical between helm
+    and the Python renderer — the threshold integer math (ttftOkRatioFloor
+    to millis/percent) is duplicated across Go templates and Python, so
+    spec-level equality is the drift detector."""
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+    as_keys = [k for k in py
+               if k[0] in ("HorizontalPodAutoscaler", "ScaledObject")]
+    assert as_keys, "no autoscalers rendered — values.yaml lost autoscaling:"
+    for key in as_keys:
+        assert key in helm, f"helm did not render {key}"
+        assert helm[key]["spec"] == py[key]["spec"], key
+        assert helm[key]["apiVersion"] == py[key]["apiVersion"], key
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
 def test_monitoring_configmaps_match(chart):
     """ISSUE 5: the alert-rules and dashboard ConfigMaps must exist in
     both renders and carry parse-equal payloads (helm mounts the files/

@@ -252,6 +252,136 @@ models:
         DeploySpec(models=spec.models, default_model="nope").validate()
 
 
+AUTOSCALE_YAML = """
+namespace: tpu-models
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    pvcShared: true
+    tpu: {accelerator: v5e, chips: 8}
+    autoscaling: {minReplicas: 1, maxReplicas: 4, queueDepthTarget: 8,
+                  ttftOkRatioFloor: 0.95}
+  - modelName: mistral-7b
+    huggingfaceId: mistralai/Mistral-7B-Instruct-v0.2
+    pvcShared: true
+    replicas: 0
+    tpu: {accelerator: v5e, chips: 8}
+    autoscaling: {minReplicas: 0, maxReplicas: 2, queueDepthTarget: 4}
+"""
+
+
+def test_autoscaling_hpa_golden():
+    """ISSUE 7: minReplicas >= 1 renders an autoscaling/v2 HPA on
+    llm_queue_depth (Pods) + TTFT-SLO attainment (Object on the gateway
+    Service), with the slow-scale-down behavior that keeps a burst's
+    replicas warm for the next one."""
+    ms = render_manifests(load_spec(AUTOSCALE_YAML))
+    hpa = by_name(ms, "HorizontalPodAutoscaler", "model-llama-3-8b")
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    assert hpa["spec"]["scaleTargetRef"] == {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "name": "model-llama-3-8b"}
+    assert hpa["spec"]["minReplicas"] == 1
+    assert hpa["spec"]["maxReplicas"] == 4
+    assert hpa["spec"]["metrics"] == [
+        {"type": "Pods", "pods": {
+            "metric": {"name": "llm_queue_depth"},
+            "target": {"type": "AverageValue", "averageValue": "8"}}},
+        {"type": "Object", "object": {
+            "metric": {"name": "llm_slo_ttft_miss_ratio"},
+            "describedObject": {"apiVersion": "v1", "kind": "Service",
+                                "name": "api-gateway"},
+            # 1 - 0.95 floor, as integer millis (no float-format drift
+            # between the Python renderer and the Helm template)
+            "target": {"type": "Value", "value": "50m"}}},
+    ]
+    assert hpa["spec"]["behavior"] == {"scaleDown": {
+        "stabilizationWindowSeconds": 300,
+        "policies": [{"type": "Pods", "value": 1, "periodSeconds": 60}]}}
+    # no ScaledObject for the HPA-managed model
+    assert not [m for m in kinds(ms, "ScaledObject")
+                if m["metadata"]["name"] == "model-llama-3-8b"]
+
+
+def test_autoscaling_scaledobject_golden():
+    """minReplicas: 0 renders a KEDA ScaledObject instead: Prometheus
+    queue-depth trigger with a router arrival-rate term (the wake-from-
+    zero signal — at zero replicas there are no pods to report queue
+    depth) plus the TTFT trigger as an integer percent."""
+    ms = render_manifests(load_spec(AUTOSCALE_YAML))
+    so = by_name(ms, "ScaledObject", "model-mistral-7b")
+    assert so["apiVersion"] == "keda.sh/v1alpha1"
+    assert so["spec"]["scaleTargetRef"] == {"name": "model-mistral-7b"}
+    assert so["spec"]["minReplicaCount"] == 0
+    assert so["spec"]["maxReplicaCount"] == 2
+    assert so["spec"]["cooldownPeriod"] == 300
+    prom = "http://prometheus-server.monitoring.svc.cluster.local:9090"
+    assert so["spec"]["triggers"] == [
+        {"type": "prometheus", "metadata": {
+            "serverAddress": prom,
+            "metricName": "llm_queue_depth",
+            "query": 'sum(llm_queue_depth{model="mistral-7b"}) + '
+                     'sum(rate(llm_router_requests_total{model="mistral-7b"}'
+                     '[1m]))',
+            "threshold": "4"}},
+        {"type": "prometheus", "metadata": {
+            "serverAddress": prom,
+            "metricName": "llm_slo_ttft_miss_ratio",
+            "query": "100 * max(llm_slo_ttft_miss_ratio)",
+            "threshold": "5"}},
+    ]
+    # the scaled-to-zero Deployment starts at replicas: 0
+    dep = by_name(ms, "Deployment", "model-mistral-7b")
+    assert dep["spec"]["replicas"] == 0
+    # no HPA for the KEDA-managed model (they would fight over the
+    # replica count)
+    assert not [m for m in kinds(ms, "HorizontalPodAutoscaler")
+                if m["metadata"]["name"] == "model-mistral-7b"]
+
+
+def test_autoscaling_peak_drives_replica_routing():
+    """Routing topology keys off the PEAK replica count (autoscaling
+    maxReplicas), not the instantaneous one: a model at replicas: 1 that
+    can scale to 4 still needs the headless -replicas Service and the
+    router must route through it, or scaled-out pods get no traffic."""
+    ms = render_manifests(load_spec(AUTOSCALE_YAML))
+    for name in ("model-llama-3-8b", "model-mistral-7b"):
+        headless = by_name(ms, "Service", f"{name}-replicas")
+        assert headless["spec"]["clusterIP"] == "None"
+    cfg = json.loads(by_name(ms, "ConfigMap", "api-gateway-config")
+                     ["data"]["router.json"])
+    assert cfg["backends"]["llama-3-8b"] == [
+        "http://model-llama-3-8b-replicas.tpu-models.svc.cluster.local:8080"]
+
+
+def test_autoscaling_validation():
+    base = "modelName: a, huggingfaceId: x, pvcShared: true"
+    with pytest.raises(SpecError, match="maxReplicas"):
+        load_spec("models: [{%s, autoscaling: {minReplicas: 3, "
+                  "maxReplicas: 2}}]" % base)
+    with pytest.raises(SpecError, match="unknown autoscaling keys"):
+        load_spec("models: [{%s, autoscaling: {replicas: 2}}]" % base)
+    # replicas: 0 is only meaningful under scale-to-zero autoscaling
+    with pytest.raises(SpecError, match="scale-to-zero"):
+        load_spec("models: [{%s, replicas: 0}]" % base)
+    # autoscaling a multi-host pod group is unsupported (replicas are the
+    # GROUP size, not a capacity dial)
+    with pytest.raises(SpecError, match="multi-host"):
+        load_spec("""
+models:
+  - modelName: big
+    huggingfaceId: x
+    pvcShared: true
+    tpu: {accelerator: v5p, chips: 16}
+    autoscaling: {minReplicas: 1, maxReplicas: 2}
+""")
+    # peak replicas (maxReplicas), not current, drives the RWO deadlock
+    # check: replicas: 1 but scalable to 2 still needs pvcShared
+    with pytest.raises(SpecError, match="deadlock"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, "
+                  "autoscaling: {minReplicas: 1, maxReplicas: 2}}]")
+
+
 def test_sharding_resolution():
     assert ShardingSpec().resolve(8) == ShardingSpec(tp=8, ep=1, data=1)
     assert ShardingSpec(ep=8).resolve(16) == ShardingSpec(tp=2, ep=8, data=1)

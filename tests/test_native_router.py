@@ -974,10 +974,24 @@ def test_native_metrics_build_info_and_slo_series(stack):
     assert _metric_value(text, "llm_slo_ttft_ok_ratio") == 1.0
     assert _metric_value(text, "llm_slo_availability") == 1.0
     assert _metric_value(text, "llm_slo_error_budget_burn_rate") == 0.0
+    # ISSUE 7: the HPA scale-out signal (1 - ok_ratio, vacuous 0 here)
+    assert _metric_value(text, "llm_slo_ttft_miss_ratio") == 0.0
     for family in ("llm_build_info", "llm_slo_availability",
-                   "llm_cluster_scrape_errors_total"):
+                   "llm_cluster_scrape_errors_total",
+                   "llm_slo_ttft_miss_ratio", "llm_router_requests_total"):
         assert f"# HELP {family} " in text, family
         assert f"# TYPE {family} " in text, family
+
+
+def test_native_per_model_request_counter(stack):
+    """ISSUE 7: every accepted request bumps
+    llm_router_requests_total{model=} — the KEDA wake-from-zero demand
+    signal must count requests even when replica selection later fails."""
+    status, _ = stack.request("POST", "/v1/completions",
+                              {"model": "modelA", "prompt": "x"})
+    assert status == 200
+    text = _metrics(stack)
+    assert 'llm_router_requests_total{model="modelA"} ' in text
 
 
 def test_native_slo_tracker_observes_outcomes(binary):

@@ -381,6 +381,11 @@ llm_waiting_requests 2
 llm_ttft_seconds_bucket{model="m",le="+Inf"} 3
 llm_ttft_seconds_sum{model="m"} 0.5
 llm_ttft_seconds_count{model="m"} 3
+# HELP llm_cold_start_seconds Startup phases until first-ready
+# TYPE llm_cold_start_seconds histogram
+llm_cold_start_seconds_bucket{phase="ready",le="+Inf"} 1
+llm_cold_start_seconds_sum{phase="ready"} 12.5
+llm_cold_start_seconds_count{phase="ready"} 1
 """
 
 EXPO_B = EXPO_A.replace("llm_requests_total 3", "llm_requests_total 4") \
@@ -410,6 +415,11 @@ def test_cluster_metrics_sums_counters_and_labels_gauges():
             # histogram series summed too
             assert ('llm_ttft_seconds_count{model="m"} 6.0' in text
                     or 'llm_ttft_seconds_count{model="m"} 6' in text)
+            # ISSUE 7: cold-start phases survive the merge — the fleet
+            # view of wake-from-zero latency (LLMKColdStartSlow reads it)
+            assert ('llm_cold_start_seconds_count{phase="ready"} 2.0' in text
+                    or 'llm_cold_start_seconds_count{phase="ready"} 2' in text)
+            assert 'llm_cold_start_seconds_sum{phase="ready"} 25.0' in text
             # gauges per-replica labeled, value preserved per source
             assert f'llm_waiting_requests{{replica="{u1}"}} 2.0' in text
             assert f'llm_waiting_requests{{replica="{u2}"}} 7.0' in text
