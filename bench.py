@@ -265,6 +265,14 @@ def measure_engine(eng, cfg, prompt_len, gen_len, rng) -> dict:
     admits = sorted(p.admitted_at - p.submitted_at
                     for p in pool if p.admitted_at)
     tok_s = decode_tokens / decode_time if decode_time > 0 else 0.0
+    # fused-decode amortization, per ROW (a batch-wide tokens/dispatch
+    # ratio would sit below 1 even unfused): each steps_obs entry is how
+    # many token-steps a dispatch advanced its rows, so 1/mean is device
+    # launches per generated token per slot — exactly 1.0 on the
+    # single-step path, ~1/K fused (ramp-in and early-exited windows
+    # keep it a bit above the ideal)
+    steps = list(getattr(eng, "steps_obs", ()) or ())
+    dpt = round(len(steps) / sum(steps), 4) if sum(steps) else None
     return {
         "tokens_per_sec": round(tok_s, 1),
         "p50_ttft_ms": round(1000.0 * ttfts[len(ttfts) // 2], 1),
@@ -272,6 +280,7 @@ def measure_engine(eng, cfg, prompt_len, gen_len, rng) -> dict:
                          if admits else None),
         "aggregate_tokens_per_sec": round(
             sum(len(r.output) for r in reqs) / wall, 1),
+        "dispatches_per_token": dpt,
     }
 
 
@@ -1136,6 +1145,7 @@ def _main() -> int:
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,
         "async_depth": ecfg.async_depth,
+        "decode_steps": ecfg.decode_steps,
         "platform": platform,
         "on_tpu": on_tpu,
     }

@@ -48,6 +48,10 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
                    help="store KV quantized (halved decode HBM traffic, "
                         "2x token capacity; ~1/127 per-element error)")
+    p.add_argument("--decode-steps", type=int, default=None,
+                   help="decode tokens sampled per fused device dispatch "
+                        "(default: $LLMK_DECODE_STEPS or 4; forced to 1 "
+                        "on multihost)")
     def _positive_int(v: str) -> int:
         n = int(v)
         if n < 1:
@@ -320,6 +324,7 @@ def main(argv: list[str] | None = None) -> int:
         quantization=args.quantization,
         prefix_caching=args.prefix_caching,
         kv_cache_dtype=args.kv_cache_dtype,
+        decode_steps=args.decode_steps,
         max_images_per_request=args.max_images_per_request,
         adapters=adapters,
         adapter_slots=args.adapter_slots,

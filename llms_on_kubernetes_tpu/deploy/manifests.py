@@ -137,6 +137,12 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
         **_probes(),
         **_lifecycle(),
     }
+    if m.decode_steps is not None:
+        # env (not an engine arg) so the fused-decode window stays out
+        # of the argv contract the golden tests pin; the engine clamps
+        # to 1 on multihost regardless of what the spec asks for
+        c["env"].append({"name": "LLMK_DECODE_STEPS",
+                         "value": str(m.decode_steps)})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on

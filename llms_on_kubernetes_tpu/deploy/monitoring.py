@@ -307,6 +307,10 @@ def grafana_dashboard() -> dict[str, Any]:
         _panel(14, "Queue depth per model (autoscaling signal)",
                ["llm_queue_depth",
                 "rate(llm_router_requests_total[1m])"], 12, 48),
+        _panel(15, "Decode fusion: steps/dispatch p50 / early-exit rate",
+               ["histogram_quantile(0.5, "
+                "rate(llm_decode_steps_per_dispatch_bucket[5m]))",
+                "rate(llm_decode_early_exit_total[5m])"], 0, 56),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -184,6 +184,11 @@ class ModelSpec:
     # ramalama model-deployments.yaml:36-37); ignored when tpu is set
     resources: Optional[dict] = None
     dtype: Optional[str] = None            # engine --dtype override
+    # fused decode window: tokens sampled per device dispatch
+    # (LLMK_DECODE_STEPS); None = engine default. Multihost replicas
+    # clamp to 1 at engine start until the broadcast protocol carries
+    # the window, so the spec accepts it everywhere.
+    decode_steps: Optional[int] = None
     # multi-tenant LoRA: adapters served on this model's replicas, the
     # device slot count (LRU-recycled) and max rank the slots are sized for
     adapters: tuple = ()                   # tuple[AdapterSpec, ...]
@@ -207,6 +212,11 @@ class ModelSpec:
             raise SpecError(
                 f"model {self.model_name}: replicas must be >= 1 "
                 f"(0 only with autoscaling.minReplicas: 0 — scale-to-zero)")
+        if self.decode_steps is not None and self.decode_steps < 1:
+            raise SpecError(
+                f"model {self.model_name}: decodeSteps must be >= 1, "
+                f"got {self.decode_steps}"
+            )
         if self.quantization not in (None, "int8", "fp8", "awq"):
             raise SpecError(
                 f"model {self.model_name}: unknown quantization "
@@ -364,7 +374,7 @@ def _model_from(d: dict) -> ModelSpec:
     known = {
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
-        "engineArgs", "resources", "dtype",
+        "engineArgs", "resources", "dtype", "decodeSteps",
         "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
     unknown = set(d) - known
@@ -393,6 +403,8 @@ def _model_from(d: dict) -> ModelSpec:
         engine_args=tuple(d.get("engineArgs", ())),
         resources=d.get("resources"),
         dtype=d.get("dtype"),
+        decode_steps=(int(d["decodeSteps"]) if "decodeSteps" in d
+                      else None),
         adapters=tuple(_adapter_from(a, d.get("modelName", ""))
                        for a in d.get("adapters", ()) or ()),
         adapter_slots=int(d.get("adapterSlots", 4)),

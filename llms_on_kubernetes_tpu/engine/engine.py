@@ -190,6 +190,18 @@ class EngineConfig:
     adapter_slots: int = 4
     adapter_rank: int = 16
     adapter_targets: tuple = ("wq", "wk", "wv", "wo")
+    # fused multi-step decode: one jitted dispatch runs this many decode
+    # steps via lax.scan — sampling, penalties, stop-token detection, the
+    # grammar FSM advance, and per-row early-exit masks all stay on device
+    # (_decode_multi_packed_step); the harvester drains up to K packed
+    # tokens per dispatch. Amortizes the per-dispatch host round trip
+    # (ROADMAP item 5) and is the substrate the verify-k-tokens
+    # speculative path lands on. None => env LLMK_DECODE_STEPS (default
+    # 4). Forced to 1 under multihost until the broadcast protocol
+    # carries the window. Streams are bit-identical to decode_steps=1
+    # (same PRNG positions, same penalty-count evolution) — pinned by
+    # tests/test_decode_multistep.py.
+    decode_steps: Optional[int] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -201,6 +213,15 @@ class EngineConfig:
 
         if self.kv_write is None:
             self.kv_write = default_kv_write_strategy()
+        if self.decode_steps is None:
+            self.decode_steps = int(os.environ.get("LLMK_DECODE_STEPS", "4"))
+        if self.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {self.decode_steps}")
+        if self.multihost and self.decode_steps > 1:
+            # followers mirror single-step MSG_DECODE programs; the packed
+            # broadcast does not carry the window yet
+            self.decode_steps = 1
         if self.watchdog_stall_s is None:
             self.watchdog_stall_s = float(
                 os.environ.get("LLMK_WATCHDOG_S", "120"))
@@ -326,11 +347,17 @@ class StepEvent:
 
 @dataclasses.dataclass
 class InflightStep:
-    """A launched-but-unharvested decode step (async scheduling)."""
-    pack: Any                              # device [B, 2+2K] packed result
+    """A launched-but-unharvested decode dispatch (async scheduling).
+    With fused multi-step decode one dispatch carries a WINDOW of up to
+    decode_steps tokens per slot; ``planned`` records how many tokens
+    each slot's row was budgeted for (None => legacy single-step, 1
+    per active slot)."""
+    pack: Any                              # device packed result:
+    #                                        [B, W] (K=1) or [K, B, W]
     toks: Any                              # device [B] sampled tokens (merge)
     active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
     seq: int = -1                          # harvester sequence number
+    planned: Optional[dict] = None         # slot -> tokens planned this window
 
 
 class _Harvester(threading.Thread):
@@ -645,13 +672,24 @@ def _pack_bias(packed: np.ndarray, row: int, base: int, params) -> None:
         packed[row, base + LOGIT_BIAS_SLOTS + j] = np.float32(bv).view(np.int32)
 
 
+# on-device stop-token detection (fused multi-step decode): each row
+# carries its request's stop_token_ids so the window's early-exit mask can
+# kill the row the moment one is sampled; -1 pads unused slots. More than
+# STOP_SLOTS stop ids are rejected at submit() — the column budget is a
+# hard bound, like LOGIT_BIAS_SLOTS.
+STOP_SLOTS = 8
+
 # packed decode columns: 0 lengths, 1 src, 2 vals, 3 top_k, 4 temps(bits),
 # 5 top_p(bits), 6 seed, 7 prefill_row, 8 presence(bits),
 # 9 frequency(bits), 10 pos_delta (mrope), 11 adapter_slot (-1 = base),
-# 12-14 fsm (row, set, val), 15.. logit_bias ids/vals, then page_table
+# 12-14 fsm (row, set, val), 15 window budget (planned alive iterations —
+# multi-step decode only, 0/ignored for K=1), 16.. stop-token ids
+# (STOP_SLOTS, -1 padded), then logit_bias ids/vals, then page_table
 _ADP_DEC = 11
 _FSM_DEC = 12
-_BIAS_DEC = 15
+_BUD_DEC = 15
+_STOP_DEC = 16
+_BIAS_DEC = _STOP_DEC + STOP_SLOTS
 _DEC_COLS = _BIAS_DEC + 2 * LOGIT_BIAS_SLOTS
 
 
@@ -693,6 +731,84 @@ def _decode_packed_step(params, cfg, packed, last_toks, prefill_toks,
         new_state = jnp.where(constrained & (lengths > 0),
                               _fsm_next(nxt_all, res.tokens), base)
     return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
+
+
+def _decode_multi_packed_step(params, cfg, K, packed, last_toks,
+                              prefill_toks, k_pages, v_pages, counts,
+                              base_key, fsm=None):
+    """Fused multi-step decode: ONE dispatch runs K sampling steps via
+    lax.scan, returning the K packed host rows stacked [K, B, W].
+
+    Parity with K chained _decode_packed_step calls is exact: iteration j
+    samples at sequence position lengths0 + j (same PRNG fold_in), counts
+    its input token before sampling (same penalty evolution), and feeds
+    its sampled token straight into iteration j+1's merge. Per-row
+    early-exit: a row whose sampled token hits one of its stop ids — or
+    whose planned budget (_BUD_DEC) runs out — is MASKED (lengths 0) for
+    the remainder of the window, not recomputed: its KV writes divert to
+    the trash page (cache.write_tokens pos<0), its counts stop
+    accumulating, and its input token freezes so the host-side replay
+    stays deterministic. The host (_emit) remains authoritative for
+    finishes — the device mask can only under-run, never over-run, the
+    stream. Grammar rows ride the loop: the FSM state is scan carry,
+    masked+advanced per iteration exactly as the single-step path."""
+    lengths0 = packed[:, 0]
+    src, vals = packed[:, 1], packed[:, 2]
+    top_ks = packed[:, 3]
+    temps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+    seeds = packed[:, 6]
+    prefill_row = packed[:, 7]
+    presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
+    pos_delta = packed[:, 10]
+    adapter_idx = packed[:, _ADP_DEC]
+    budget = packed[:, _BUD_DEC]
+    stop_ids = packed[:, _STOP_DEC:_STOP_DEC + STOP_SLOTS]
+    bias = _unpack_bias(packed, _BIAS_DEC)
+    page_table = packed[:, _DEC_COLS:]
+
+    toks0 = _merge_tokens(last_toks, src, vals, prefill_toks, prefill_row)
+    if fsm is not None:
+        g_rows = packed[:, _FSM_DEC]
+        state0 = jnp.where(packed[:, _FSM_DEC + 1] == 1,
+                           packed[:, _FSM_DEC + 2], fsm[0])
+    else:
+        state0 = jnp.zeros_like(lengths0)
+    alive0 = (lengths0 > 0) & (budget > 0)
+
+    def body(carry, j):
+        cur, alive, state, k_pages, v_pages, counts = carry
+        lengths = jnp.where(alive, lengths0 + j, 0)
+        # the input token is always a previously-sampled OUTPUT token:
+        # count it before sampling so this iteration's draw sees it
+        counts = _count_decode_tokens(counts, cur, lengths > 0)
+        logits, k_pages, v_pages = forward_decode(
+            params, cfg, cur, lengths, k_pages, v_pages, page_table,
+            pos_delta=pos_delta, adapter_idx=adapter_idx,
+        )
+        keys = _slot_keys(base_key, seeds, lengths)
+        allowed = nxt_all = constrained = None
+        if fsm is not None:
+            allowed, nxt_all, constrained = _fsm_apply(fsm, g_rows, state)
+        res = sample(logits, keys, temps, top_ks, top_ps,
+                     penalties=(presence, frequency, counts), bias=bias,
+                     allowed=allowed)
+        new_toks = jnp.where(alive, res.tokens, cur)
+        if fsm is not None:
+            state = jnp.where(constrained & alive,
+                              _fsm_next(nxt_all, res.tokens), state)
+        stopped = ((stop_ids >= 0)
+                   & (stop_ids == res.tokens[:, None])).any(axis=1)
+        alive = alive & ~stopped & (j + 1 < budget)
+        return (new_toks, alive, state, k_pages, v_pages, counts), \
+            res.host_pack()
+
+    carry0 = (toks0, alive0, state0, k_pages, v_pages, counts)
+    (toks, _alive, state, k_pages, v_pages, counts), packs = jax.lax.scan(
+        body, carry0, jnp.arange(K, dtype=jnp.int32))
+    new_state = state if fsm is not None else None
+    return packs, toks, k_pages, v_pages, counts, new_state
 
 
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
@@ -984,6 +1100,14 @@ class Engine:
         self._seed_rng = np.random.default_rng(engine_config.seed)
         self._lock = threading.Lock()
         self.preemptions = 0  # total KV-pressure preemptions (metrics)
+        # fused multi-step decode accounting (metrics + bench):
+        self.decode_dispatches = 0   # decode device dispatches
+        self.decode_tokens = 0       # tokens committed to streams by decode
+        self.early_exit_steps = 0    # planned row-steps wasted mid-window
+        # per-dispatch consumed window depth; drained by the serving
+        # loop into the llm_decode_steps_per_dispatch histogram
+        self.steps_obs: "collections.deque[int]" = collections.deque(
+            maxlen=4096)
         # seconds the ENGINE thread spent blocked on device reads (sync
         # path); async-path device waits land on the harvester thread's
         # own counter — device_wait_s() sums both for step attribution
@@ -994,6 +1118,10 @@ class Engine:
         )
         self._decode_packed = jax.jit(
             _decode_packed_step, static_argnums=(1,), donate_argnums=(5, 6, 7)
+        )
+        self._decode_multi = jax.jit(
+            _decode_multi_packed_step, static_argnums=(1, 2),
+            donate_argnums=(6, 7, 8)
         )
         self._chunk_packed = jax.jit(
             _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
@@ -1058,6 +1186,7 @@ class Engine:
         self._dec_rows[:, 5] = np.float32(1.0).view(np.int32)  # top_p off
         self._dec_rows[:, _ADP_DEC] = -1                       # base model
         self._dec_rows[:, _FSM_DEC] = -1                       # no grammar
+        self._dec_rows[:, _STOP_DEC:_STOP_DEC + STOP_SLOTS] = -1  # no stops
         self._dec_row_owner: list = [None] * B
         # grammar-constrained decoding: resident-grammar registry + device
         # tables, created lazily on the first constrained admission
@@ -1249,6 +1378,12 @@ class Engine:
             raise ValueError(
                 f"logit_bias supports at most {LOGIT_BIAS_SLOTS} entries, "
                 f"got {len(params.logit_bias)}")
+        if len(params.stop_token_ids) > STOP_SLOTS:
+            # the fused decode window's on-device early-exit mask carries
+            # stop ids in STOP_SLOTS packed columns — a hard bound
+            raise ValueError(
+                f"stop_token_ids supports at most {STOP_SLOTS} entries, "
+                f"got {len(params.stop_token_ids)}")
         seen_bias: set[int] = set()
         for tid, _bv in params.logit_bias:
             if not 0 <= tid < self.model_config.vocab_size:
@@ -2124,6 +2259,7 @@ class Engine:
                     tmpl[i, 5] = np.float32(1.0).view(np.int32)
                     tmpl[i, _ADP_DEC] = -1
                     tmpl[i, _FSM_DEC] = -1
+                    tmpl[i, _STOP_DEC:_STOP_DEC + STOP_SLOTS] = -1
                     owners[i] = None
                 continue
             fsm_row = r.fsm_row if r.fsm_row >= 0 else -1
@@ -2141,6 +2277,9 @@ class Engine:
             tmpl[i, 10] = r.mrope_delta
             tmpl[i, _ADP_DEC] = r.adapter_slot
             tmpl[i, _FSM_DEC] = fsm_row
+            tmpl[i, _STOP_DEC:_STOP_DEC + STOP_SLOTS] = -1
+            for j, sid in enumerate(r.params.stop_token_ids):
+                tmpl[i, _STOP_DEC + j] = sid
             _pack_bias(tmpl, i, _BIAS_DEC, r.params)
             owners[i] = r
         packed = tmpl.copy()
@@ -2170,6 +2309,9 @@ class Engine:
 
         from llms_on_kubernetes_tpu.engine.multihost import MSG_DECODE
 
+        self.decode_dispatches += 1
+        self.decode_tokens += len(active)
+        self.steps_obs.append(1)
         packed = self._dec_template(active)
         for i, r in active:
             packed[i, 0] = self.slot_len[i] + 1
@@ -2225,6 +2367,19 @@ class Engine:
             for j, r in s.active:
                 if self.slots[j] is r:
                     counts[j] = counts.get(j, 0) + 1
+        return counts
+
+    def _inflight_tokens(self) -> dict:
+        """Per-slot in-flight TOKEN counts — like _inflight_counts, but a
+        fused multi-step dispatch contributes its planned window size, not
+        1. This is what the multi-step launch sizes page allocations and
+        window budgets from."""
+        counts: dict[int, int] = {}
+        for s in self._inflight:
+            for j, r in s.active:
+                if self.slots[j] is r:
+                    p = 1 if s.planned is None else s.planned.get(j, 0)
+                    counts[j] = counts.get(j, 0) + p
         return counts
 
     def _admit_async(self, events: list[StepEvent]):
@@ -2381,6 +2536,9 @@ class Engine:
         values (slots with no step in flight), and this step's prefill
         (just-admitted slots). Returns "launched", "paced" (deliberately
         deferred — the device queue is deep enough), or "idle"."""
+        if self.config.decode_steps > 1:
+            return self._launch_decode_multi(self.config.decode_steps,
+                                             admitted, events)
         B = self.config.max_decode_slots
         max_len = self.config.max_model_len
 
@@ -2468,7 +2626,125 @@ class Engine:
         if new_state is not None:
             self._fsm_state = new_state
         seq = next(self._seq_counter)
-        step = InflightStep(pack, toks, active, seq)
+        step = InflightStep(pack, toks, active, seq,
+                            planned={i: 1 for i, _r in active})
+        self._inflight.append(step)
+        self._harvester.push(seq, pack)
+        now = time.monotonic()
+        self._busy_until = max(now, self._busy_until) + self._est_step
+        return "launched"
+
+    def _launch_decode_multi(self, K: int, admitted,
+                             events: list[StepEvent]) -> str:
+        """Multi-step variant of _launch_decode_async: one dispatch runs a
+        window of up to K decode steps per slot (_decode_multi_packed_step).
+
+        Per slot the launch PLANS p <= K tokens — clipped by the request's
+        remaining max_tokens budget (minus tokens already in flight and a
+        not-yet-harvested first token) and by max_model_len — allocates
+        pages for the whole window up front, and ships p as the row's
+        on-device budget. Rows with p == 0 ride along masked (lengths 0).
+        The harvest consumes up to p tokens per row; host-side _emit stays
+        authoritative for finishes, so a row that stops mid-window simply
+        wastes its tail (early-exit accounting)."""
+        B = self.config.max_decode_slots
+        max_len = self.config.max_model_len
+
+        pace = self.config.pace_target_steps
+        if pace > 0 and admitted is None and self._inflight:
+            if self._busy_until - time.monotonic() > pace * self._est_step:
+                return "paced"
+
+        # plan windows + grow page tables; drain in-flight work, then
+        # preempt, on exhaustion (same recovery ladder as the K=1 path).
+        # Token-level inflight counts: a planned-but-unharvested window
+        # already owns its positions. Stale plan entries survive drains —
+        # slot_len + inflight is invariant under harvest (tokens move from
+        # in-flight to slot_len one-for-one), and so is the max_tokens
+        # budget (output grows by exactly the harvested tokens).
+        infl = self._inflight_tokens()
+        first_pending = {id(r) for r, _k, _row in self._pending_first}
+        plan: dict[int, int] = {}
+        i = 0
+        while i < B:
+            r = self.slots[i]
+            if r is None:
+                i += 1
+                continue
+            prior = infl.get(i, 0)
+            base0 = int(self.slot_len[i]) + prior + 1
+            # a first token still in the priority-read queue will be
+            # emitted before any of this window's tokens — budget for it
+            extra = 1 if id(r) in first_pending else 0
+            budget = r.params.max_tokens - len(r.output) - prior - extra
+            p = max(0, min(K, budget, max_len - base0 + 1))
+            if p == 0:
+                plan[i] = 0
+                i += 1
+                continue
+            try:
+                self.allocator.allocate(i, base0 + p - 1)
+                plan[i] = p
+                i += 1
+            except MemoryError:
+                if self._inflight or self._pending_first:
+                    events += self._harvest(drain=True)
+                    infl = self._inflight_tokens()
+                    first_pending = {id(r) for r, _k, _row
+                                     in self._pending_first}
+                    continue
+                self._preempt_youngest()
+                infl = self._inflight_tokens()
+
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return "idle"
+        if all(plan.get(i, 0) == 0 for i, _r in active):
+            # every row's budget is consumed by in-flight work — a
+            # dispatch would be all-masked. The pipeline is non-empty in
+            # this state (empty pipeline => budget >= 1), so harvesting
+            # makes progress.
+            return "paced"
+
+        packed = self._dec_template(active)
+        for i, r in active:
+            p = plan.get(i, 0)
+            packed[i, 0] = 0 if p <= 0 else \
+                int(self.slot_len[i]) + infl.get(i, 0) + 1
+            packed[i, _BUD_DEC] = p
+            if r.fsm_row >= 0 and r.pending_fsm_state is not None:
+                packed[i, _FSM_DEC + 1] = 1      # resume: force state
+                packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                r.pending_fsm_state = None
+            if admitted is not None and i in admitted["slots"]:
+                resumed, host_val, row = admitted["slots"][i]
+                if resumed:              # resumed: host-known pending token
+                    packed[i, 1], packed[i, 2] = 1, host_val
+                else:                    # fresh: token sampled by the prefill
+                    packed[i, 1], packed[i, 7] = 2, row
+            elif infl.get(i, 0) > 0:
+                packed[i, 1] = 0         # newest in-flight step's output
+            else:
+                packed[i, 1], packed[i, 2] = 1, r.pending_token
+
+        last_toks = self._inflight[-1].toks if self._inflight else self._zeros_B
+        prefill_toks = admitted["toks"] if admitted is not None else self._zeros_1
+
+        # multihost always clamps decode_steps to 1 in EngineConfig, so
+        # this path never needs a broadcast message
+        use_fsm = self._fsm_any_active()
+        (pack, toks, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._decode_multi(
+            self.params, self.model_config, K, jnp.asarray(packed),
+            last_toks, prefill_toks, self.k_pages, self.v_pages,
+            self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
+        )
+        if new_state is not None:
+            self._fsm_state = new_state
+        seq = next(self._seq_counter)
+        step = InflightStep(pack, toks, active, seq,
+                            planned={i: plan.get(i, 0) for i, _r in active})
         self._inflight.append(step)
         self._harvester.push(seq, pack)
         now = time.monotonic()
@@ -2600,18 +2876,41 @@ class Engine:
             if self._head_blocking_first() is not None:
                 break  # the step's request still awaits its first token
             step = self._inflight.popleft()
-            host = HostSample(np.asarray(self._harvester.get(step.seq)))
+            arr = np.asarray(self._harvester.get(step.seq))
+            if arr.ndim == 2:    # single-step pack [B, W] => window of 1
+                arr = arr[None]
+            hosts = [HostSample(arr[k]) for k in range(arr.shape[0])]
             processed = step.seq
             n_steps += 1
+            consumed_total = wasted = max_consumed = 0
             for slot, req in step.active:
-                # skip slots whose request finished/aborted/was preempted
-                # after this step launched — their sampled token is garbage
-                if req.finished or req.slot != slot:
+                p = 1 if step.planned is None else step.planned.get(slot, 0)
+                if p <= 0:
                     continue
-                self.slot_len[slot] += 1
-                tok = int(host.tokens[slot])
-                req.pending_token = tok
-                events += self._emit(req, tok, _lp_entry(host, slot))
+                # skip slots whose request finished/aborted/was preempted
+                # after this step launched — their sampled tokens are
+                # garbage (and the whole window is wasted speculation)
+                if req.finished or req.slot != slot:
+                    wasted += p
+                    continue
+                consumed = 0
+                for k in range(p):
+                    self.slot_len[slot] += 1
+                    tok = int(hosts[k].tokens[slot])
+                    req.pending_token = tok
+                    events += self._emit(req, tok, _lp_entry(hosts[k], slot))
+                    consumed += 1
+                    if req.finished:
+                        # the device masked this row right here too
+                        # (stop id / budget); its tail is wasted window
+                        break
+                consumed_total += consumed
+                wasted += p - consumed
+                max_consumed = max(max_consumed, consumed)
+            self.decode_dispatches += 1
+            self.decode_tokens += consumed_total
+            self.early_exit_steps += wasted
+            self.steps_obs.append(max_consumed)
         if processed >= 0:
             self._harvester.discard_upto(processed)
         return n_steps

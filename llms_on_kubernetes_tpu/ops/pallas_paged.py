@@ -545,6 +545,130 @@ def pallas_paged_attention_write(
     return out.reshape(B, n_q, d), k_pages, v_pages
 
 
+def _paged_kernel_write_window(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    base_ref,         # SMEM [B] first token's 0-based pool position
+    width_ref,        # SMEM [B] tokens to write (0 => idle row)
+    k_hbm,            # ANY  [n_kv, P, page, d] (aliased with k_out)
+    v_hbm,            # ANY  [n_kv, P, page, d]
+    k_new_ref,        # VMEM [1, W, n_kv, d] — window of new K rows
+    v_new_ref,        # VMEM [1, W, n_kv, d]
+    k_out,            # ANY  (alias of k_hbm)
+    v_out,            # ANY  (alias of v_hbm)
+    kblk,             # VMEM [n_kv, 8, d] write-block scratch
+    vblk,             # VMEM [n_kv, 8, d]
+    wsem,             # DMA semaphores [2]
+    *,
+    window: int,
+    page_size: int,
+):
+    """In-place append of a K-token WINDOW per slot (multi-step decode).
+
+    Same 8-sublane-tile READ-MODIFY-WRITE as _paged_kernel_write, applied
+    token-by-token through the window: fetch the aligned 8-row block the
+    token lands in, splice the row, DMA the block back, and WAIT before
+    the next token — consecutive window tokens often share a block, so
+    the RMW chain must be ordered. Tokens past the row's ``width`` (early
+    exit: the row stopped mid-window) are skipped, leaving the pool
+    byte-identical to a per-step write sequence that stopped there."""
+    b = pl.program_id(0)
+    base = base_ref[b]
+    width = width_ref[b]
+
+    # every fetch AND write-back goes through the OUTPUT alias: token t+1
+    # often lands in the same 8-row block as token t, and fetching from
+    # the input ref would re-read pre-window bytes — losing token t's
+    # splice (a lost update the interpret mode catches deterministically)
+    for t in range(window):
+        @pl.when(t < width)
+        def _rmw(t=t):
+            pos = base + t
+            w_pid = page_table_ref[b, pos // page_size]
+            off8 = pl.multiple_of((pos % page_size) // 8 * 8, 8)
+            pltpu.make_async_copy(
+                k_out.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).start()
+            pltpu.make_async_copy(
+                v_out.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).start()
+            pltpu.make_async_copy(
+                k_out.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).wait()
+            pltpu.make_async_copy(
+                v_out.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).wait()
+            row = jax.lax.broadcasted_iota(
+                jnp.int32, (1, 8, 1), 1) == (pos % page_size) - off8
+            k_row = k_new_ref[0, t]                      # [n_kv, d]
+            v_row = v_new_ref[0, t]
+            kblk[...] = jnp.where(row, k_row[:, None, :], kblk[...])
+            vblk[...] = jnp.where(row, v_row[:, None, :], vblk[...])
+            pltpu.make_async_copy(
+                kblk, k_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).start()
+            pltpu.make_async_copy(
+                vblk, v_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).start()
+            pltpu.make_async_copy(
+                kblk, k_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).wait()
+            pltpu.make_async_copy(
+                vblk, v_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).wait()
+
+
+def pallas_paged_write_window(
+    k_pages: jnp.ndarray,      # [n_kv, P, page, d] (head-major pool; donated)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    base: jnp.ndarray,         # [B] int32 0-based position of token 0
+    widths: jnp.ndarray,       # [B] int32 tokens to write (<= window)
+    k_new: jnp.ndarray,        # [B, W, n_kv, d] window of new K rows
+    v_new: jnp.ndarray,        # [B, W, n_kv, d]
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused in-place append of up to W tokens per slot in ONE kernel
+    launch (see _paged_kernel_write_window). The multi-step decode
+    window's verify-k speculative path lands on this entry point: a
+    draft-and-verify step commits 0..W accepted tokens per slot, and
+    ``widths`` is exactly the per-slot acceptance count. Returns
+    (k_pages, v_pages) updated in place via input/output aliasing."""
+    n_kv, P, page_size, d = k_pages.shape
+    B, W = k_new.shape[:2]
+
+    kernel = functools.partial(
+        _paged_kernel_write_window,
+        window=W, page_size=page_size,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, W, n_kv, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, W, n_kv, d), lambda b, *_: (b, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, 8, d), k_pages.dtype),
+            pltpu.VMEM((n_kv, 8, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # inputs count scalar-prefetch args first: pt=0, base=1, widths=2,
+        # k_pages=3, v_pages=4, k_new=5, v_new=6; outputs: k=0, v=1
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), base.astype(jnp.int32),
+      widths.astype(jnp.int32), k_pages, v_pages,
+      k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
 )

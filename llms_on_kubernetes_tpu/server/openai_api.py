@@ -101,6 +101,7 @@ class EngineLoop(threading.Thread):
         self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
         self._preempt_seen = 0
+        self._early_exit_seen = 0
         self._adapter_seen = {"hits": 0, "misses": 0, "evictions": 0}
         self._shed_total = 0
 
@@ -176,6 +177,16 @@ class EngineLoop(threading.Thread):
                 if eng.preemptions > self._preempt_seen:
                     m["preemptions"].inc(eng.preemptions - self._preempt_seen)
                     self._preempt_seen = eng.preemptions
+                steps_obs = getattr(eng, "steps_obs", None)
+                if steps_obs is not None:
+                    while steps_obs:
+                        m["decode_steps_per_dispatch"].observe(
+                            steps_obs.popleft())
+                early_exit = getattr(eng, "early_exit_steps", 0)
+                if early_exit > self._early_exit_seen:
+                    m["decode_early_exit"].inc(
+                        early_exit - self._early_exit_seen)
+                    self._early_exit_seen = early_exit
                 adp = getattr(eng, "adapters", None)
                 if adp is not None:
                     for k, seen in self._adapter_seen.items():

@@ -33,9 +33,11 @@ import re
 import sys
 
 _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
-                           r"|cold_start|dropped_streams|spike_first_token)")
+                           r"|cold_start|dropped_streams|spike_first_token"
+                           r"|dispatches_per_token|host_share)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
-                            r"|completed_streams)")
+                            r"|completed_streams|tokens_per_dispatch"
+                            r"|steps_per_dispatch)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

@@ -96,6 +96,22 @@ sys.exit(0 if doc.get("dropped_streams") == 0 else 1)'; then
     fails=$((fails + 1))
   fi
 
+  note "fused decode smoke (K>1 window actually amortizes dispatches)"
+  # the smoke engine runs the fused multi-step decode path (decode_steps
+  # defaults to 4); dispatches_per_token is per slot, so anything >= 1
+  # means every token paid its own device launch — the fusion is off
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+dpt = doc.get("dispatches_per_token")
+sys.exit(0 if (doc.get("decode_steps") or 1) > 1
+         and dpt is not None and dpt < 1 else 1)'; then
+    echo "ci: fused decode smoke OK (dispatches_per_token < 1)"
+  else
+    echo "ci: fused decode smoke FAILED (dispatches_per_token >= 1)"
+    fails=$((fails + 1))
+  fi
+
   note "metrics lint (Prometheus exposition format on scraped /metrics)"
   if [ -s "$metrics_dump/api_metrics.txt" ] \
       && [ -s "$metrics_dump/gateway_metrics.txt" ] \

@@ -38,7 +38,8 @@ import numpy as np
 def steady_packed(eng, lengths_val: int) -> np.ndarray:
     """A full-batch decode packed array at a fixed context length."""
     from llms_on_kubernetes_tpu.engine.engine import (
-        _BIAS_DEC, _DEC_COLS, _FSM_DEC,
+        _BIAS_DEC, _BUD_DEC, _DEC_COLS, _FSM_DEC, _STOP_DEC,
+        LOGIT_BIAS_SLOTS, STOP_SLOTS,
     )
 
     B = eng.config.max_decode_slots
@@ -49,7 +50,9 @@ def steady_packed(eng, lengths_val: int) -> np.ndarray:
     packed[:, 4] = np.float32(0.0).view(np.int32)   # greedy
     packed[:, 5] = np.float32(1.0).view(np.int32)
     packed[:, _FSM_DEC] = -1
-    packed[:, _BIAS_DEC:_BIAS_DEC + 32] = -1
+    packed[:, _BUD_DEC] = 1_000_000                 # never early-exit
+    packed[:, _STOP_DEC:_STOP_DEC + STOP_SLOTS] = -1
+    packed[:, _BIAS_DEC:_BIAS_DEC + LOGIT_BIAS_SLOTS] = -1
     packed[:, _DEC_COLS:] = eng.allocator.page_tables
     return packed
 
@@ -87,23 +90,32 @@ def main():
         if all(r is not None for r in eng.slots):
             break
     eng._drain_async()
-    # grow allocations to cover the probed context length
+    # grow allocations to cover the probed context length + fused window
+    K = int(eng.config.decode_steps or 1)
     for i in range(B):
-        eng.allocator.allocate(i, args.ctx + 2)
+        eng.allocator.allocate(i, args.ctx + K + 2)
 
     packed_np = steady_packed(eng, args.ctx)
     packed = jnp.asarray(packed_np)
     toks = jnp.asarray(np.full((B,), 17, np.int32))
 
-    def chain(n):
-        """Dispatch n chained steps; returns (enqueue wall, sync wall)."""
+    def chain(n, k=1):
+        """Dispatch n decode launches (each a fused k-step window when
+        k > 1); returns (enqueue wall, sync wall)."""
         nonlocal toks
         t0 = time.monotonic()
         for _ in range(n):
-            (_pack, toks, eng.k_pages, eng.v_pages, eng.token_counts,
-             _state) = eng._decode_packed(
-                eng.params, cfg, packed, toks, eng._zeros_1, eng.k_pages,
-                eng.v_pages, eng.token_counts, eng._key, None)
+            if k == 1:
+                (_pack, toks, eng.k_pages, eng.v_pages, eng.token_counts,
+                 _state) = eng._decode_packed(
+                    eng.params, cfg, packed, toks, eng._zeros_1, eng.k_pages,
+                    eng.v_pages, eng.token_counts, eng._key, None)
+            else:
+                (_packs, toks, eng.k_pages, eng.v_pages, eng.token_counts,
+                 _state) = eng._decode_multi(
+                    eng.params, cfg, k, packed, toks, eng._zeros_1,
+                    eng.k_pages, eng.v_pages, eng.token_counts, eng._key,
+                    None)
         t1 = time.monotonic()
         np.asarray(toks)  # ONE synchronizing read
         return t1 - t0, time.monotonic() - t1
@@ -123,6 +135,26 @@ def main():
     enq, har = chain(args.steps)
     dispatch_ms = 1000 * enq / args.steps
     harvest_ms = 1000 * har
+
+    # --- fused K-step window: per-DISPATCH cost + host-share vs K=1 ---
+    # host time per dispatch (enqueue + sync read + packed-array build)
+    # is roughly constant in K, so fusing K steps into one launch shrinks
+    # the host share of each generated token by ~K. Both paths are
+    # measured in THIS run so the PROFILE line carries its own baseline.
+    kernel_k_ms = per_step * 1000
+    dispatch_k_ms, harvest_k_ms = dispatch_ms, harvest_ms
+    if K > 1:
+        n_k = max(4, args.steps // K)
+        chain(2, K)  # warm the fused executable
+        wall_k = sum(chain(n_k, K))
+        probe_k = sum(chain(1, K))
+        kernel_k_ms = 1000 * max(wall_k - probe_k, 1e-9) / max(n_k - 1, 1)
+        enq_k, har_k = chain(n_k, K)
+        dispatch_k_ms = 1000 * enq_k / n_k
+        harvest_k_ms = 1000 * har_k
+        print(f"fused window (K={K}): {kernel_k_ms:.2f} ms/dispatch = "
+              f"{kernel_k_ms / K:.2f} ms/token-step "
+              f"({1000 * per_step:.2f} ms unfused)", flush=True)
 
     # host packed-array build: the template-cached _dec_template path plus
     # the per-step dynamic columns (what the engine loop pays per step)
@@ -150,18 +182,38 @@ def main():
     else:
         collective_ms = report_trace(args.trace, n_steps=10)
 
+    # host share of a dispatch: the host-BLOCKING work per launch — the
+    # synchronizing harvest read + the packed-array build. Enqueue is
+    # excluded: it overlaps the device in the async pipeline (and on CPU
+    # its wall time is just execution backpressure). These costs are
+    # ~constant in K, so fusing K steps divides the per-token host share
+    # by ~K. Both paths are measured in THIS run so the PROFILE line
+    # carries its own K=1 baseline.
+    host_k1 = harvest_ms + host_pack_ms
+    host_share_k1 = host_k1 / max(1000 * per_step + host_k1, 1e-9)
+    host_k = harvest_k_ms + host_pack_ms
+    host_share = host_k / max(kernel_k_ms + host_k, 1e-9)
+
     breakdown = {
-        "kernel_ms": round(1000 * per_step, 4),
-        "dispatch_ms": round(dispatch_ms, 4),
+        # per-DISPATCH costs of the fused path (== per-step when K=1)
+        "kernel_ms": round(kernel_k_ms, 4),
+        "dispatch_ms": round(dispatch_k_ms, 4),
         "collective_ms": round(collective_ms, 4),
-        "harvest_ms": round(harvest_ms, 4),
+        "harvest_ms": round(harvest_k_ms, 4),
         "host_pack_ms": round(host_pack_ms, 4),
+        "decode_steps": K,
+        "tokens_per_dispatch": K,
+        "dispatches_per_token": round(1.0 / K, 4),
+        "host_share": round(host_share, 4),
+        "host_share_k1": round(host_share_k1, 4),
+        "kernel_k1_ms": round(1000 * per_step, 4),
         "batch": B,
         "ctx": args.ctx,
     }
-    print("-- decode-step breakdown (ms/step) --", flush=True)
+    print(f"-- decode breakdown (ms/DISPATCH; K={K} token-steps fused) --",
+          flush=True)
     print(f"  kernel      {breakdown['kernel_ms']:8.3f}  "
-          "(chained device window)", flush=True)
+          "(fused device window)", flush=True)
     print(f"  dispatch    {breakdown['dispatch_ms']:8.3f}  "
           "(host enqueue; overlaps the device on TPU)", flush=True)
     print(f"  collective  {breakdown['collective_ms']:8.3f}  "
@@ -170,6 +222,8 @@ def main():
           "(synchronizing read / tunnel RTT)", flush=True)
     print(f"  host-pack   {breakdown['host_pack_ms']:8.3f}  "
           "(packed-array build; template-cached)", flush=True)
+    print(f"  host share  {breakdown['host_share']:8.3f}  "
+          f"(K=1 baseline {breakdown['host_share_k1']:.3f})", flush=True)
     print("PROFILE:" + json.dumps(breakdown), flush=True)
 
     # --- engine-loop comparison ---------------------------------------
@@ -180,6 +234,7 @@ def main():
     reqs = [eng.submit(list(rng.integers(1, 100, prompt_len)),
                        SamplingParams(temperature=0.0, max_tokens=gen_len))
             for _ in range(B - 1)]
+    disp0, tok0 = eng.decode_dispatches, eng.decode_tokens
     t0 = time.monotonic()
     total = 0
     window_start = window_tokens = None
@@ -198,6 +253,11 @@ def main():
         print(f"engine-loop steady decode: {tps:.0f} tok/s "
               f"({1000 * (B - 1) / tps:.2f} ms/step at B={B - 1})",
               flush=True)
+    disp = eng.decode_dispatches - disp0
+    toks_n = eng.decode_tokens - tok0
+    if toks_n:
+        print(f"engine-loop dispatches/token: {disp / toks_n:.3f} "
+              f"({disp} dispatches, {toks_n} tokens, K={K})", flush=True)
     print(f"total wall {time.monotonic() - t0:.1f}s", flush=True)
 
 

@@ -490,6 +490,56 @@ def test_inflight_deadline_aborts_with_timeout_reason(monkeypatch):
 
 
 @pytest.mark.e2e
+def test_midwindow_abort_discards_tokens_and_preserves_kv(monkeypatch):
+    """ISSUE 8 bugfix: a deadline abort while a fused K-step decode
+    window is in flight must discard the unharvested tail — no tokens
+    appended past the abort point — WITHOUT corrupting the paged-KV
+    accounting: every page comes back reclaimable, and a fresh request
+    on the recycled slot decodes exactly like on a fresh engine (stale
+    window writes past the abort point are never read)."""
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+
+    monkeypatch.setenv("LLMK_FAULT", "slow_step:0.05")
+    eng = _mk_engine(decode_steps=4)
+    alloc = eng.allocator
+    reclaimable0 = alloc.num_free_pages + alloc.num_evictable_pages
+    victim = eng.submit([1, 2, 3],
+                        SamplingParams(temperature=0.0, max_tokens=4096))
+    mate = eng.submit([4, 5, 6, 7],
+                      SamplingParams(temperature=0.0, max_tokens=8))
+    hard = time.monotonic() + 120
+    while victim.admitted_at is None or not victim.output:
+        assert time.monotonic() < hard, "victim never started decoding"
+        eng.step()
+    victim.deadline = time.monotonic()  # expires with windows in flight
+    while not (victim.finished and mate.finished):
+        assert time.monotonic() < hard, "abort or drain never happened"
+        eng.step()
+    monkeypatch.delenv("LLMK_FAULT")
+    assert victim.finish_reason == "timeout"
+    n_at_abort = len(victim.output)
+    eng.step()
+    eng._drain_async()
+    assert len(victim.output) == n_at_abort  # tail really discarded
+    assert (alloc.num_free_pages + alloc.num_evictable_pages
+            == reclaimable0), "pages leaked by the mid-window abort"
+    # recycled slot parity: same prompt, fresh engine
+    replay = eng.submit([9, 10, 11],
+                        SamplingParams(temperature=0.0, max_tokens=8))
+    while not replay.finished:
+        assert time.monotonic() < hard
+        eng.step()
+    fresh_eng = _mk_engine(decode_steps=4)
+    fresh = fresh_eng.submit([9, 10, 11],
+                             SamplingParams(temperature=0.0, max_tokens=8))
+    while not fresh.finished:
+        assert time.monotonic() < hard
+        fresh_eng.step()
+    assert replay.output == fresh.output
+    assert replay.finish_reason == fresh.finish_reason
+
+
+@pytest.mark.e2e
 def test_api_rejects_expired_deadline_504():
     from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
     from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer

@@ -250,6 +250,35 @@ models:
     with pytest.raises(SpecError, match="defaultModel"):
         spec = load_spec(BASE_YAML)
         DeploySpec(models=spec.models, default_model="nope").validate()
+    with pytest.raises(SpecError, match="decodeSteps"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, decodeSteps: 0}]")
+
+
+def test_decode_steps_threads_to_engine_env():
+    """ISSUE 8: decodeSteps rides as LLMK_DECODE_STEPS env (not an engine
+    arg, keeping the argv contract stable); absent by default."""
+    spec = load_spec("""
+namespace: tpu-models
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    decodeSteps: 8
+    tpu: {accelerator: v5e, chips: 8}
+  - modelName: mistral-7b
+    huggingfaceId: mistralai/Mistral-7B-Instruct-v0.2
+    tpu: {accelerator: v5e, chips: 8}
+""")
+    assert spec.models[0].decode_steps == 8
+    assert spec.models[1].decode_steps is None
+    ms = render_manifests(spec)
+    env = {e["name"]: e.get("value") for e in
+           by_name(ms, "Deployment", "model-llama-3-8b")
+           ["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["LLMK_DECODE_STEPS"] == "8"
+    env2 = {e["name"] for e in
+            by_name(ms, "Deployment", "model-mistral-7b")
+            ["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "LLMK_DECODE_STEPS" not in env2
 
 
 AUTOSCALE_YAML = """

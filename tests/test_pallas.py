@@ -256,3 +256,45 @@ def test_paged_decode_fused_write_matches_reference(rng, window, softcap):
                                   np.asarray(kp_ref.data)[:, 1:])
     np.testing.assert_array_equal(np.asarray(vp2)[:, 1:],
                                   np.asarray(vp_ref.data)[:, 1:])
+
+
+def test_paged_write_window_matches_reference(rng):
+    """Windowed fused append (multi-step decode substrate): ONE kernel
+    launch writes up to W tokens per slot; per-row ``widths`` model
+    early exit (a row that stopped mid-window commits only its prefix)
+    and idle rows. Written rows must carry the window's bytes exactly;
+    every other pool byte must be UNTOUCHED (unlike write_tokens'
+    chunked path, which backfills later pages with clamped-gather
+    filler, this kernel read-modify-writes 8-row blocks) — covering
+    windows that start mid-page, at a page boundary, at position 0, and
+    windows crossing into a fresh page."""
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_write_window,
+    )
+
+    n_kv, d, page, pps, W = 2, 8, 8, 4, 4
+    base_np = np.asarray([7, 8, 0, 15, 3], np.int32)
+    widths_np = np.asarray([4, 3, 4, 2, 0], np.int32)
+    B = len(base_np)
+    k_pages, v_pages, table = _paged_setup(rng, B, n_kv, d, page, pps,
+                                           base_np + W)
+    k_new = jnp.asarray(rng.normal(size=(B, W, n_kv, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, W, n_kv, d)), jnp.float32)
+
+    # numpy reference: splice each written token's row into a copy of the
+    # original pool; everything else must round-trip bit-identically
+    table_np = np.asarray(table)
+    kp_ref = np.asarray(k_pages).copy()
+    vp_ref = np.asarray(v_pages).copy()
+    for b in range(B):
+        for t in range(int(widths_np[b])):
+            pos = int(base_np[b]) + t
+            pid = table_np[b, pos // page]
+            kp_ref[:, pid, pos % page] = np.asarray(k_new)[b, t]
+            vp_ref[:, pid, pos % page] = np.asarray(v_new)[b, t]
+
+    kp2, vp2 = pallas_paged_write_window(
+        k_pages, v_pages, table, jnp.asarray(base_np),
+        jnp.asarray(widths_np), k_new, v_new, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kp2), kp_ref)
+    np.testing.assert_array_equal(np.asarray(vp2), vp_ref)
