@@ -923,6 +923,187 @@ def spike_bench() -> dict:
     }
 
 
+def resume_bench() -> dict:
+    """Zero-drop mid-stream failover (ISSUE 9): streaming clients run
+    against two live replicas while the ``kill_mid_stream`` fault severs
+    one stream per wave on whichever replica it landed; the router's
+    journal must splice a resumed continuation from the survivor so the
+    client never notices. Reports ``resume_client_visible_drops`` (ci.sh
+    gates this at 0), ``resumed_streams`` (ci.sh gates >= 1) and the
+    client-observed resume gap (largest inter-chunk stall of the killed
+    wave) p50/p95.
+
+    Runs on the tiny CPU config regardless of BENCH_MODEL: the scenario
+    measures the journal/splice control loop, not the model.
+    """
+    import http.client
+    import json as _json
+    import re as _re
+    import threading
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu import faults
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    model = "debug-tiny"
+    cfg = get_config(model)
+    ecfg = EngineConfig(model=model, dtype="float32", max_decode_slots=8,
+                        page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
+                        prefill_buckets=(32,))
+
+    # two identically-seeded replicas: greedy continuations are identical,
+    # which is exactly what makes a journal resume client-invisible
+    ports: dict = {}
+    ready = threading.Event()
+    stop_holder: dict = {}
+    servers: list = []
+
+    def run_stack():
+        import asyncio
+
+        async def main_async():
+            stop = asyncio.Event()
+            stop_holder["stop"] = stop
+            stop_holder["loop"] = asyncio.get_running_loop()
+            runners = []
+            replica_urls = []
+            for _ in range(2):
+                srv = OpenAIServer(build_engine(ecfg, cfg), ByteTokenizer(),
+                                   model)
+                servers.append(srv)
+                runner = web.AppRunner(srv.make_app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                runners.append(runner)
+                replica_urls.append(
+                    f"http://127.0.0.1:{runner.addresses[0][1]}")
+            router = Router({model: replica_urls}, default_model=model,
+                            strict=False, probe_interval_s=0.2,
+                            retry_backoff_s=0.05)
+            r_runner = web.AppRunner(router.make_app())
+            await r_runner.setup()
+            r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
+            await r_site.start()
+            runners.append(r_runner)
+            ports["router"] = r_runner.addresses[0][1]
+            ready.set()
+            await stop.wait()
+            for r in runners:
+                await r.cleanup()
+
+        asyncio.new_event_loop().run_until_complete(main_async())
+
+    rt = threading.Thread(target=run_stack, daemon=True)
+    rt.start()
+    if not ready.wait(timeout=120):
+        raise RuntimeError("resume bench: stack failed to start")
+    rport = ports["router"]
+
+    def scrape_resume_counts() -> tuple[float, float]:
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=10)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        vals = {}
+        for m in _re.finditer(
+                r'llm_stream_resume_total\{outcome="(\w+)"\} ([0-9.e+-]+)',
+                text):
+            vals[m.group(1)] = float(m.group(2))
+        return vals.get("ok", 0.0), vals.get("gave_up", 0.0)
+
+    n_clients = 4
+    gen_tokens = 24
+    waves = 3
+    body = _json.dumps({
+        "model": model, "prompt": [1, 2, 3, 4, 5, 6, 7, 8],
+        "max_tokens": gen_tokens, "temperature": 0.0, "stream": True,
+    })
+
+    def client(i, results, gaps):
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+        try:
+            conn.request("POST", "/v1/completions", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                results[i] = (resp.status, resp.read())
+                return
+            chunks, stamps = [], []
+            while True:
+                piece = resp.read1(65536)
+                if not piece:
+                    break
+                chunks.append(piece)
+                stamps.append(time.monotonic())
+            results[i] = (200, b"".join(chunks))
+            gaps[i] = max((b - a for a, b in zip(stamps, stamps[1:])),
+                          default=0.0)
+        except OSError:
+            results[i] = (-1, b"")  # transport drop = client-visible
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    prev_fault = os.environ.get("LLMK_FAULT")
+    drops = 0
+    completed = 0
+    wave_gaps_ms: list = []
+    ok0, _gave0 = scrape_resume_counts()
+    try:
+        for _ in range(waves):
+            faults.reset_claims()
+            os.environ["LLMK_FAULT"] = "kill_mid_stream:6"
+            results: list = [None] * n_clients
+            gaps: list = [0.0] * n_clients
+            threads = [threading.Thread(target=client,
+                                        args=(i, results, gaps), daemon=True)
+                       for i in range(n_clients)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=300)
+            for r in results:
+                if (r is None or r[0] != 200
+                        or b"data: [DONE]" not in (r[1] or b"")):
+                    drops += 1
+                else:
+                    completed += 1
+            # the killed stream's resume stall dominates every other
+            # inter-chunk gap in its wave
+            wave_gaps_ms.append(round(1000 * max(gaps), 1))
+    finally:
+        if prev_fault is None:
+            os.environ.pop("LLMK_FAULT", None)
+        else:
+            os.environ["LLMK_FAULT"] = prev_fault
+        faults.reset_claims()
+    ok1, gave1 = scrape_resume_counts()
+
+    if "stop" in stop_holder:
+        stop_holder["loop"].call_soon_threadsafe(stop_holder["stop"].set)
+    rt.join(timeout=30)
+
+    wave_gaps_ms.sort()
+    return {
+        "resume_client_visible_drops": drops,
+        "resume_completed_streams": completed,
+        "resumed_streams": int(ok1 - ok0),
+        "resume_gave_up_streams": int(gave1),
+        "resume_gap_ms_p50": wave_gaps_ms[len(wave_gaps_ms) // 2],
+        "resume_gap_ms_p95": wave_gaps_ms[
+            min(len(wave_gaps_ms) - 1,
+                int(len(wave_gaps_ms) * 0.95))],
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1129,6 +1310,14 @@ def _main() -> int:
     if smoke or os.environ.get("BENCH_SPIKE"):
         spike = with_retries("spike", spike_bench, errors, attempts=1) or {}
 
+    # --- phase 5: zero-drop mid-stream failover (kill + journal resume) -
+    # Tiny-CPU-sized like the spike; ci.sh gates resume_client_visible_
+    # drops == 0 and resumed_streams >= 1 on the smoke run.
+    resume = {}
+    if smoke or os.environ.get("BENCH_RESUME"):
+        resume = with_retries("resume", resume_bench, errors,
+                              attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -1141,6 +1330,7 @@ def _main() -> int:
         **gw,
         **adp,
         **spike,
+        **resume,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

@@ -177,6 +177,9 @@ def main(argv: list[str] | None = None) -> int:
         adapters = {}
         default_model, strict = args.default_model, args.strict
         probe_interval = args.probe_interval
+        # None = let Router fall back to the LLMK_STREAM_RESUME /
+        # LLMK_RESUME_ATTEMPTS / LLMK_HEDGE_MS env knobs
+        stream_resume = resume_attempts = hedge_ms = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -186,6 +189,12 @@ def main(argv: list[str] | None = None) -> int:
             strict = strict or bool(cfg.get("strict", False))
             if probe_interval is None and "probe_interval_s" in cfg:
                 probe_interval = float(cfg["probe_interval_s"])
+            if "stream_resume" in cfg:
+                stream_resume = bool(cfg["stream_resume"])
+            if "resume_attempts" in cfg:
+                resume_attempts = int(cfg["resume_attempts"])
+            if "hedge_ms" in cfg:
+                hedge_ms = float(cfg["hedge_ms"])
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -204,7 +213,9 @@ def main(argv: list[str] | None = None) -> int:
         run_router(backends, default_model, strict,
                    host=args.host, port=args.port,
                    probe_interval_s=probe_interval or None,
-                   adapters=adapters or None)
+                   adapters=adapters or None,
+                   stream_resume=stream_resume,
+                   resume_attempts=resume_attempts, hedge_ms=hedge_ms)
         return 0
 
     # serve

@@ -489,6 +489,11 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         "default_model": spec.resolved_default,
         "strict": spec.strict_routing,
         "probe_interval_s": spec.probe_interval_s,
+        # zero-drop streams: journal resume + hedging knobs (ISSUE 9),
+        # parsed by both router implementations
+        "stream_resume": spec.stream_resume,
+        "resume_attempts": spec.resume_attempts,
+        "hedge_ms": spec.hedge_ms,
     }
     adapters = {m.model_name: [a.name for a in m.adapters]
                 for m in spec.models if m.adapters}

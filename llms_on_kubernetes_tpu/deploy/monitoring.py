@@ -217,6 +217,31 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKStreamLoss",
+                        # any truncation at all is a client that watched
+                        # its generation die — the journal/resume path
+                        # exists precisely so this stays at zero
+                        "expr": (
+                            "increase(llm_stream_truncated_total[10m]) > 0"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "page"},
+                        "annotations": {
+                            "summary": "client-visible stream truncations",
+                            "description": (
+                                "Streams for model {{ $labels.model }} "
+                                "were truncated mid-generation for 10m "
+                                "(upstream died and no resume was "
+                                "possible). Check replica churn and "
+                                "llm_stream_resume_total{outcome="
+                                "\"gave_up\"} — exhausted resume "
+                                "attempts, expired deadlines, or "
+                                "non-resumable streams (multi-choice/"
+                                "logprobs) are the usual causes."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -311,6 +336,10 @@ def grafana_dashboard() -> dict[str, Any]:
                ["histogram_quantile(0.5, "
                 "rate(llm_decode_steps_per_dispatch_bucket[5m]))",
                 "rate(llm_decode_early_exit_total[5m])"], 0, 56),
+        _panel(16, "Stream resilience: resumes / hedges / truncations",
+               ["rate(llm_stream_resume_total[5m])",
+                "rate(llm_hedged_requests_total[5m])",
+                "rate(llm_stream_truncated_total[5m])"], 12, 56),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

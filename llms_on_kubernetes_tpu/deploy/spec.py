@@ -279,6 +279,13 @@ class DeploySpec:
     native_router: bool = True             # C++ router image vs python
     # router-side active /ready probe period per replica; 0 disables
     probe_interval_s: float = 2.0
+    # zero-drop streams (ISSUE 9): mid-stream journal resume on upstream
+    # death (on by default), capped re-issues per stream, and hedged
+    # first-byte requests (0 = off). Rendered into router.json — both the
+    # native C++ router and the python router parse the same keys.
+    stream_resume: bool = True
+    resume_attempts: int = 2
+    hedge_ms: float = 0.0
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -300,6 +307,13 @@ class DeploySpec:
                 f"defaultModel {self.default_model!r} is not in models[] "
                 f"({names})"
             )
+        if self.resume_attempts < 0:
+            raise SpecError(
+                f"router.resumeAttempts must be >= 0, got "
+                f"{self.resume_attempts}")
+        if self.hedge_ms < 0:
+            raise SpecError(
+                f"router.hedgeMs must be >= 0, got {self.hedge_ms}")
 
     @property
     def resolved_default(self) -> str:
@@ -450,6 +464,11 @@ def load_spec(source: "str | dict") -> DeploySpec:
         native_router=bool((data.get("router") or {}).get("native", True)),
         probe_interval_s=float(
             (data.get("router") or {}).get("probeIntervalS", 2.0)),
+        stream_resume=bool(
+            (data.get("router") or {}).get("streamResume", True)),
+        resume_attempts=int(
+            (data.get("router") or {}).get("resumeAttempts", 2)),
+        hedge_ms=float((data.get("router") or {}).get("hedgeMs", 0.0)),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -96,6 +96,17 @@ class SamplingParams:
     # engine masks every sampled token to the grammar's allowed set and
     # advances the FSM on device (engine/grammar.py).
     grammar: Optional[Any] = None
+    # resume-after-failure: output tokens ALREADY generated for this
+    # request by a previous (now dead) replica. The engine seeds
+    # req.output with them, so admission takes the resumed re-prefill
+    # path (prompt + prefix, chunked prefill + prefix cache) and decoding
+    # continues at sequence position len(prompt) + len(prefix) — the
+    # position-keyed sampling chain then draws exactly the tokens the
+    # uninterrupted stream would have drawn (bit-identical for a fixed
+    # seed, trivially for greedy). Prefix tokens count toward max_tokens
+    # and toward the presence/frequency penalty counts, exactly as if
+    # this engine had generated them itself.
+    prefix_tokens: tuple[int, ...] = ()
 
 
 @dataclasses.dataclass
@@ -1412,6 +1423,24 @@ class Engine:
             params = dataclasses.replace(
                 params, max_tokens=max(1, max_len - len(prompt))
             )
+        prefix = list(params.prefix_tokens or ())
+        if prefix:
+            vocab = self.model_config.vocab_size
+            for t in prefix:
+                if not isinstance(t, int) or not 0 <= t < vocab:
+                    raise ValueError(
+                        f"prefix_tokens contains id {t!r} outside the "
+                        f"vocabulary (size {vocab})")
+            if len(prompt) + len(prefix) + 1 > max_len:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + prefix_tokens ({len(prefix)}) "
+                    f"cannot fit max_model_len={max_len} with room to "
+                    f"generate")
+            if len(prefix) >= params.max_tokens:
+                raise ValueError(
+                    f"prefix_tokens ({len(prefix)}) already meets "
+                    f"max_tokens ({params.max_tokens}); nothing left to "
+                    f"generate")
         # mask to int32 range: the seed rides in int32 device arrays, and
         # an unchecked 64-bit client seed would OverflowError inside step()
         seed = (params.seed if params.seed is not None
@@ -1433,6 +1462,11 @@ class Engine:
             mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
             deadline=deadline, adapter=adapter,
+            # a non-empty output at submit makes admission take the
+            # resumed re-prefill path (prompt + output), continuing the
+            # stream exactly where the prefix left off; logprob data for
+            # prefix tokens was generated elsewhere and is unrecoverable
+            output=prefix, output_logprobs=[None] * len(prefix),
             on_event=on_event,  # attached BEFORE queueing: no missed events
         )
         with self._lock:

@@ -36,6 +36,13 @@ Known fault names (each documented at its injection site):
   ``loading`` (readiness 503) for SECONDS (default 2.0) before serving:
   a compile-cache-miss cold start in miniature, so spike/scale-out tests
   see a realistically slow replica join.
+- ``kill_mid_stream[:N_TOKENS]`` — the first in-process stream to deliver
+  N_TOKENS (default 8) tokens severs its client socket abruptly (TCP
+  RST), simulating a replica dying mid-generation. One-shot per process
+  via :func:`claim` (like ``preempt_replica``): with several in-process
+  replicas behind one router, exactly ONE stream is killed — the point is
+  proving the router's journal resume splices the continuation from a
+  surviving replica with zero client-visible drops.
 - ``preempt_replica[:DELAY]`` — DELAY seconds (default 1.0) after a
   server starts serving, it receives a simulated spot-TPU preemption
   notice and begins the graceful drain (readiness 503, in-flight streams

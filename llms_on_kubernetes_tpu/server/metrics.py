@@ -437,4 +437,23 @@ def router_metrics(registry: Registry) -> dict:
             "llm_cluster_scrape_errors_total",
             "Replica /metrics scrapes that failed during /metrics/cluster "
             "aggregation (unreachable replica, bad exposition)", registry),
+        "stream_resume": Counter(
+            "llm_stream_resume_total",
+            "Journaled SSE streams whose upstream died mid-relay, by "
+            "outcome: ok=continuation spliced from another replica "
+            "(invisible to the client), gave_up=resume disabled, "
+            "exhausted, or impossible (stream truncated)",
+            registry, label_names=("outcome",)),
+        "hedged": Counter(
+            "llm_hedged_requests_total",
+            "Streams whose first byte outran LLMK_HEDGE_MS so a secondary "
+            "was raced on another replica, by which attempt won "
+            "(primary_won / hedge_won)",
+            registry, label_names=("outcome",)),
+        "stream_truncated": Counter(
+            "llm_stream_truncated_total",
+            "Streams that died mid-relay and could not be resumed: the "
+            "client got a final SSE error event "
+            "(finish_reason=upstream_lost) and a closed stream",
+            registry, label_names=("model",)),
     }

@@ -40,7 +40,15 @@ from llms_on_kubernetes_tpu.server.metrics import (
 )
 from llms_on_kubernetes_tpu.server.profiling import ProfileManager
 from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
-from llms_on_kubernetes_tpu.server.router import DEADLINE_HEADER
+# Stream-resume protocol headers (canonical definitions and the
+# comment-after-data splice invariant are documented at server/router.py):
+# the router re-issues a died-mid-stream request with the token ids it
+# already relayed; the engine continues decoding from that exact position,
+# and this layer journals token ids / suppresses the replayed prefix.
+from llms_on_kubernetes_tpu.server.router import (
+    DEADLINE_HEADER, JOURNAL_HEADER, RESUME_CREATED_HEADER,
+    RESUME_STREAM_ID_HEADER, RESUME_TOKENS_HEADER,
+)
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
 
@@ -63,6 +71,19 @@ def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
     if isinstance(t, (int, float)) and not isinstance(t, bool) and t > 0:
         return time.monotonic() + float(t)
     return None
+
+
+def _keepalive_interval_s() -> float:
+    """SSE keepalive comment period: ``LLMK_SSE_KEEPALIVE_S`` seconds
+    (default 15; <= 0 disables). Read per-stream so tests can monkeypatch
+    the env without restarting the server."""
+    import os
+
+    raw = os.environ.get("LLMK_SSE_KEEPALIVE_S", "")
+    try:
+        return float(raw) if raw else 15.0
+    except ValueError:
+        return 15.0
 
 
 def _adapter_from_model(model) -> Optional[str]:
@@ -1304,6 +1325,26 @@ class OpenAIServer:
             return web.json_response(
                 {"error": {"message": "best_of > n cannot be streamed"}},
                 status=400)
+        raw_resume = request.headers.get(RESUME_TOKENS_HEADER)
+        if raw_resume is not None:
+            # internal resume replay (router splice): continue a stream a
+            # dead replica started. Only single-choice streams are
+            # journaled/resumable; the replay is idempotent — the same
+            # prefix + seed deterministically yields the same continuation.
+            if not body.get("stream") or n != 1 or best_of != 1 \
+                    or len(prompts) != 1:
+                return web.json_response(
+                    {"error": {"message": "stream resume requires a "
+                               "single-choice streaming request"}}, status=400)
+            try:
+                prefix = tuple(int(t) for t in raw_resume.split(",")
+                               if t.strip())
+            except ValueError:
+                return web.json_response(
+                    {"error": {"message": f"malformed {RESUME_TOKENS_HEADER} "
+                               "header"}}, status=400)
+            if prefix:
+                params = dataclasses.replace(params, prefix_tokens=prefix)
         stops = _parse_stops(body)
         adapter = _adapter_from_model(body.get("model"))
         # best_of choices per prompt (prompt-major choice order, per
@@ -1367,6 +1408,15 @@ class OpenAIServer:
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
+        if raw_resume is not None:
+            # the spliced continuation must be indistinguishable from the
+            # original stream: reuse its SSE id and created stamp
+            sid = request.headers.get(RESUME_STREAM_ID_HEADER, "")
+            if sid and len(sid) <= 128 and sid.isprintable():
+                rid = sid
+            raw_created = request.headers.get(RESUME_CREATED_HEADER, "")
+            if raw_created.isdigit():
+                created = int(raw_created)
         if body.get("stream"):
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage"))
@@ -1403,7 +1453,8 @@ class OpenAIServer:
 
     async def _drain(self, req, stops):
         """Async generator over one request's events: yields
-        ``(text_delta, done, finish_reason, tokens_so_far, lp_entries)``.
+        ``(text_delta, done, finish_reason, tokens_so_far, lp_entries,
+        raw_tokens)``.
 
         Single source of truth for stop-token filtering, incremental
         detokenization, stop-sequence matching, and early abort — consumed
@@ -1411,7 +1462,9 @@ class OpenAIServer:
         counts event tokens deterministically (``req.output`` may still be
         growing on the engine thread after an abort). ``lp_entries`` pairs
         each VISIBLE token id with its recorded (logprob, top_ids,
-        top_logprobs) tuple.
+        top_logprobs) tuple. ``raw_tokens`` is the event's UNFILTERED token
+        id list (stop tokens included) — what the router's resume journal
+        must record.
         """
         detok = IncrementalDetokenizer(self.tokenizer)
         stopper = StopChecker(stops)
@@ -1420,6 +1473,26 @@ class OpenAIServer:
         total = 0
         pending: list = []   # entries whose text the stopper still holds back
         released_chars = 0   # emitted chars covered by released entries
+        prefix = list(req.params.prefix_tokens or ())
+        if prefix:
+            # Resume replay: the prefix tokens' text was already delivered
+            # to the client by the replica that died. Warm the detokenizer
+            # and stop checker with them so continuation deltas splice
+            # byte-exactly after what the client has: cumulative emitted
+            # chars are a pure function of the cumulative token ids, so
+            # ``stopper.emitted`` lands exactly where the dead replica's
+            # stream left off (regardless of how it chunked its writes).
+            warm_text, warm_hit = stopper.push(
+                detok.push(prefix, final=False), final=False)
+            del warm_text
+            total = len(prefix)
+            released_chars = stopper.emitted
+            if warm_hit:
+                # the prefix itself completes a stop sequence — the
+                # original stream was ending anyway; finish cleanly
+                self.loop_thread.abort(req)
+                yield "", True, "stop", total, [], []
+                return
         while True:
             toks, done, reason = await _next_event(req)
             start = total
@@ -1439,9 +1512,9 @@ class OpenAIServer:
                     final=done)
                 if hit:
                     self.loop_thread.abort(req)
-                    yield text, True, "stop", total, []
+                    yield text, True, "stop", total, [], toks
                     return
-                yield text, done, reason, total, []
+                yield text, done, reason, total, [], toks
                 if done:
                     return
                 continue
@@ -1477,9 +1550,9 @@ class OpenAIServer:
                 released.append(pending.pop(0))
             if hit:
                 self.loop_thread.abort(req)
-                yield text, True, "stop", total, released
+                yield text, True, "stop", total, released, toks
                 return
-            yield text, done, reason, total, released
+            yield text, done, reason, total, released, toks
             if done:
                 return
 
@@ -1487,7 +1560,8 @@ class OpenAIServer:
         parts: list[str] = []
         finish_reason, total = None, 0
         entries: list = []
-        async for text, done, reason, total, evs in self._drain(req, stops):
+        async for text, done, reason, total, evs, _toks in self._drain(
+                req, stops):
             parts.append(text)
             entries += evs
             if done:
@@ -1685,6 +1759,8 @@ class OpenAIServer:
                                nlp: int = 0, include_usage: bool = False,
                                prompts=None,
                                tools_on: bool = False) -> web.StreamResponse:
+        from llms_on_kubernetes_tpu import faults
+
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -1703,6 +1779,16 @@ class OpenAIServer:
         resp_model = self._resp_model(reqs)
         write_lock = asyncio.Lock()
         completion_tokens = 0
+        # router-internal stream-resume protocol (headers documented at the
+        # module constants): journal comments only when the router asked,
+        # and only single-choice streams are journaled — the router marks
+        # anything else non-resumable
+        journal_on = (JOURNAL_HEADER in request.headers) and len(reqs) == 1
+        resumed = RESUME_TOKENS_HEADER in request.headers
+        # LLMK_FAULT=kill_mid_stream[:N]: one-shot (claim) — the first
+        # in-process stream to deliver N tokens severs its client socket
+        # abruptly, simulating a replica death mid-generation
+        kill_after = faults.get_float("kill_mid_stream", 8.0)
 
         def chunk(index: int, delta_text: Optional[str], reason: Optional[str],
                   role: bool = False, entries=None, base_offset: int = 0,
@@ -1733,7 +1819,9 @@ class OpenAIServer:
             """Relay one request's tokens as SSE chunks (choices interleave
             across requests; the write lock keeps individual events intact)."""
             nonlocal completion_tokens
-            if chat:
+            if chat and not resumed:
+                # a resumed splice continues an existing client stream;
+                # the role delta was already delivered by the original
                 async with write_lock:
                     await resp.write(chunk(index, None, None, role=True))
             tool_parser = None
@@ -1745,7 +1833,8 @@ class OpenAIServer:
             total = 0
             tok_chars = 0  # cumulative offsets across the WHOLE stream
             signalled = False  # any chunk written for this choice yet
-            async for text, done, reason, total, entries in self._drain(req, stops):
+            async for text, done, reason, total, entries, raw_toks in \
+                    self._drain(req, stops):
                 tool_deltas = None
                 if tool_parser is not None:
                     # tool-call blocks are cut out of the content stream;
@@ -1785,8 +1874,40 @@ class OpenAIServer:
                                 and reason == "stop"):
                             reason = "tool_calls"
                         await resp.write(chunk(index, None, reason))
+                    if journal_on and raw_toks:
+                        # AFTER the event's data writes — the splice
+                        # invariant (see JOURNAL_HEADER): a journaled
+                        # token implies its emitted text was delivered
+                        await resp.write(
+                            (": llmk-tok "
+                             + ",".join(str(t) for t in raw_toks)
+                             + "\n\n").encode())
+                if (kill_after is not None and total >= kill_after
+                        and faults.claim("kill_mid_stream")):
+                    # simulated replica death mid-generation: sever the
+                    # socket abruptly (RST) so the router sees a broken
+                    # stream and exercises its journal resume/truncation
+                    for r in reqs:
+                        self.loop_thread.abort(r, "kill_mid_stream")
+                    if request.transport is not None:
+                        request.transport.abort()
+                    return
             completion_tokens += total
 
+        keepalive_task = None
+        keep_s = _keepalive_interval_s()
+        if keep_s > 0:
+            async def _keepalive() -> None:
+                # SSE comment heartbeat: long prefills/queue waits produce
+                # no data chunks, and idle-timeout LBs reap quiet streams;
+                # clients and the router ignore/relay comments transparently
+                while True:
+                    await asyncio.sleep(keep_s)
+                    async with write_lock:
+                        await resp.write(b": ping\n\n")
+
+            keepalive_task = asyncio.get_running_loop().create_task(
+                _keepalive())
         try:
             await asyncio.gather(*(pump(i, r) for i, r in enumerate(reqs)))
             if include_usage:
@@ -1799,6 +1920,9 @@ class OpenAIServer:
             for r in reqs:
                 self.loop_thread.abort(r, "disconnect")
             raise
+        finally:
+            if keepalive_task is not None:
+                keepalive_task.cancel()
         await resp.write_eof()
         return resp
 

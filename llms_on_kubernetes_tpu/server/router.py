@@ -77,6 +77,22 @@ from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER, jlog
 
 DEADLINE_HEADER = "X-LLMK-Deadline-Ms"
 
+# Stream-resume protocol (router <-> API server, internal). The router adds
+# JOURNAL_HEADER to streaming completion requests; the API then follows each
+# SSE event's data with a ``: llmk-tok <ids>`` comment naming the event's raw
+# token ids, which the router journals and strips. When the upstream dies
+# mid-stream, the router re-issues the request to another replica with
+# RESUME_TOKENS_HEADER carrying the journaled ids (plus the original SSE
+# stream id/created stamp) and splices the continuation into the client's
+# stream. Comment-AFTER-data ordering is the correctness invariant: a
+# journaled token implies all its emitted text was already relayed, so the
+# continuation can never skip text the client is missing — at worst it
+# replays a little, which the router drops (the echo).
+JOURNAL_HEADER = "X-LLMK-Journal"
+RESUME_TOKENS_HEADER = "X-LLMK-Resume-Tokens"
+RESUME_STREAM_ID_HEADER = "X-LLMK-Resume-Stream-Id"
+RESUME_CREATED_HEADER = "X-LLMK-Resume-Created"
+
 HOP_BY_HOP = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
     "te", "trailers", "transfer-encoding", "upgrade", "host",
@@ -94,11 +110,175 @@ RETRYABLE_ERRORS = (
 )
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def error_body(message: str, type_: str, code: str = "") -> dict:
     body = {"error": {"message": message, "type": type_}}
     if code:
         body["error"]["code"] = code
     return body
+
+
+class _StreamJournal:
+    """Per-stream resume journal for a relayed SSE completion stream.
+
+    Records the token ids the client has effectively received (from the
+    API's ``: llmk-tok`` comments, which are stripped before forwarding)
+    and the count of content chars actually forwarded. On an upstream
+    death the journal is everything needed to splice a continuation:
+
+    - ``tokens``        -> ``X-LLMK-Resume-Tokens`` for the re-issue;
+    - ``chars - chars_at_mark`` -> the replayed echo to drop: chars the
+      client received for tokens the journal missed (possible because the
+      tok comment follows its data). The resumed replica regenerates
+      those tokens deterministically and re-emits their text, which
+      ``feed`` trims from the continuation (``echo_skip``).
+
+    Bounded: past ``max_tokens`` journaled ids the stream is marked
+    non-resumable (a resume needs the COMPLETE prefix, so a dropping ring
+    would be useless — overflow just flips the stream back to the
+    truncation path). Text itself is never buffered, only counted.
+    """
+
+    _TOK = b": llmk-tok"
+
+    def __init__(self, max_tokens: int = 4096):
+        self.max_tokens = max_tokens
+        self.tokens: list[int] = []
+        self.chars = 0           # content chars forwarded to the client
+        self.chars_at_mark = 0   # self.chars when the last tok comment landed
+        self.saw_data = False    # any data: chunk forwarded yet
+        self.done = False        # "data: [DONE]" forwarded: stream complete
+        self.finished = False    # a choice carried a finish_reason
+        self.overflow = False
+        self.not_resumable: Optional[str] = None
+        self.stream_id: Optional[str] = None
+        self.created: Optional[int] = None
+        self.echo_skip = 0       # replayed-echo chars still to drop
+        self._buf = b""
+
+    def feed(self, data: bytes) -> bytes:
+        """Digest upstream bytes; return what to forward downstream.
+
+        Complete lines only — a trailing partial line is held until its
+        newline arrives, so journal state never runs behind forwarded
+        text and a spliced continuation never lands mid-line.
+        """
+        self._buf += data
+        out = bytearray()
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                break
+            line = self._buf[:nl + 1]
+            self._buf = self._buf[nl + 1:]
+            kept = self._line(line)
+            if kept is not None:
+                out += kept
+        return bytes(out)
+
+    def _line(self, line: bytes) -> Optional[bytes]:
+        s = line.strip()
+        if s.startswith(self._TOK):
+            try:
+                ids = [int(x) for x in s[len(self._TOK):].split(b",")
+                       if x.strip()]
+            except ValueError:
+                ids = []
+            self.tokens += ids
+            if len(self.tokens) > self.max_tokens:
+                self.overflow = True
+            self.chars_at_mark = self.chars
+            return None  # internal comment: never reaches the client
+        if not s.startswith(b"data:"):
+            return line  # keepalives, blank lines, "event:" fields, ...
+        payload = s[5:].strip()
+        if payload == b"[DONE]":
+            self.done = True
+            return line
+        try:
+            doc = json.loads(payload)
+            if not isinstance(doc, dict):
+                raise ValueError("non-object data chunk")
+        except (ValueError, UnicodeDecodeError):
+            self.not_resumable = "unparseable data chunk"
+            self.saw_data = True
+            return line
+        return self._data(doc, line)
+
+    def _data(self, doc: dict, line: bytes) -> Optional[bytes]:
+        self.saw_data = True
+        if self.stream_id is None and isinstance(doc.get("id"), str):
+            self.stream_id = doc["id"]
+            if isinstance(doc.get("created"), int):
+                self.created = doc["created"]
+        content: Optional[str] = None
+        content_key = None
+        choices = doc.get("choices")
+        for ch in choices if isinstance(choices, list) else []:
+            if not isinstance(ch, dict):
+                continue
+            if ch.get("index", 0) != 0:
+                self.not_resumable = "multi-choice stream"
+            if ch.get("finish_reason"):
+                self.finished = True
+            if ch.get("logprobs"):
+                # prefix logprob data is unrecoverable on another replica
+                self.not_resumable = "logprobs stream"
+            delta = ch.get("delta")
+            if isinstance(delta, dict):
+                if delta.get("tool_calls"):
+                    self.not_resumable = "tool-call stream"
+                c = delta.get("content")
+                key = ("delta", "content")
+            else:
+                c = ch.get("text")
+                key = ("text",)
+            if isinstance(c, str) and ch.get("index", 0) == 0:
+                content, content_key = c, (ch, key)
+        if content:
+            if self.echo_skip > 0:
+                # a resumed upstream deterministically regenerated tokens
+                # the client already has text for: trim the duplicate
+                drop = min(self.echo_skip, len(content))
+                self.echo_skip -= drop
+                content = content[drop:]
+                ch, key = content_key
+                if len(key) == 2:
+                    ch[key[0]][key[1]] = content
+                else:
+                    ch[key[0]] = content
+                line = b"data: " + json.dumps(doc).encode() + b"\n"
+            self.chars += len(content)
+        return line
+
+    def flush(self) -> bytes:
+        """Held-back trailing bytes (a stream that ended without a final
+        newline); forward them verbatim once the upstream EOFs cleanly."""
+        tail, self._buf = self._buf, b""
+        return tail
+
+    def resumable(self) -> tuple[bool, str]:
+        """May this stream be spliced onto another replica right now?"""
+        if self.done:
+            return False, "stream already complete"
+        if self.overflow:
+            return False, f"journal overflow (> {self.max_tokens} tokens)"
+        if self.not_resumable:
+            return False, self.not_resumable
+        return True, ""
 
 
 class CircuitBreaker:
@@ -213,6 +393,10 @@ class Router:
         probe_interval_s: Optional[float] = None,
         probe_timeout_s: float = 2.0,
         probe_path: str = "/ready",
+        stream_resume: Optional[bool] = None,
+        resume_attempts: Optional[int] = None,
+        hedge_ms: Optional[float] = None,
+        journal_max_tokens: int = 4096,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -241,6 +425,22 @@ class Router:
         )
         self.retry_attempts = max(1, retry_attempts)
         self.retry_backoff_s = retry_backoff_s
+        # mid-stream failover (journal + splice): LLMK_STREAM_RESUME
+        # (default on), capped at LLMK_RESUME_ATTEMPTS re-issues per
+        # stream; hedged first-byte requests via LLMK_HEDGE_MS (default
+        # off). Constructor args override the env for embedded/test use.
+        if stream_resume is None:
+            stream_resume = os.environ.get(
+                "LLMK_STREAM_RESUME", "1").strip().lower() not in (
+                    "0", "false", "off", "no", "")
+        self.stream_resume = bool(stream_resume)
+        if resume_attempts is None:
+            resume_attempts = _env_int("LLMK_RESUME_ATTEMPTS", 2)
+        self.resume_attempts = max(0, resume_attempts)
+        if hedge_ms is None:
+            hedge_ms = _env_float("LLMK_HEDGE_MS", 0.0)
+        self.hedge_ms = max(0.0, hedge_ms)
+        self.journal_max_tokens = max(1, journal_max_tokens)
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.probe_path = probe_path
@@ -585,12 +785,18 @@ class Router:
             return self._deadline_response(rid)
 
         # the inbound deadline header is consumed here; a decremented copy
-        # is re-added per attempt below (never the client's raw value)
+        # is re-added per attempt below (never the client's raw value).
+        # The stream-resume protocol headers are router-internal — a
+        # client-supplied copy must never reach an upstream.
         headers = {
             k: v for k, v in request.headers.items()
             if k.lower() not in HOP_BY_HOP
             and k.lower() not in (DEADLINE_HEADER.lower(),
-                                  REQUEST_ID_HEADER.lower())
+                                  REQUEST_ID_HEADER.lower(),
+                                  JOURNAL_HEADER.lower(),
+                                  RESUME_TOKENS_HEADER.lower(),
+                                  RESUME_STREAM_ID_HEADER.lower(),
+                                  RESUME_CREATED_HEADER.lower())
         }
         headers[REQUEST_ID_HEADER] = rid
         peername = request.transport.get_extra_info("peername") if request.transport else None
@@ -599,6 +805,19 @@ class Router:
         prior = request.headers.get("X-Forwarded-For")
         headers["X-Forwarded-For"] = f"{prior}, {client_ip}" if prior else client_ip
         headers["X-Forwarded-Proto"] = request.scheme
+
+        # streaming completions get the journal/splice relay: the journal
+        # is kept even with resume disabled (the truncation error event
+        # and counter need it); the upstream only emits tok comments when
+        # asked, so the header rides only when resume is on
+        journal: Optional[_StreamJournal] = None
+        if (request.method == "POST" and doc is not None
+                and doc.get("stream") is True
+                and request.match_info["path"].rstrip("/").endswith(
+                    "completions")):
+            journal = _StreamJournal(self.journal_max_tokens)
+            if self.stream_resume:
+                headers[JOURNAL_HEADER] = "1"
 
         # --- connect/request phase: bounded retries with backoff+jitter.
         # Only failures BEFORE a response head are retried (the buffered
@@ -672,7 +891,13 @@ class Router:
                 status=502, headers=self._rid_headers(rid),
             )
 
-        # --- relay phase: stream the response; never retried.
+        if journal is not None:
+            return await self._relay_stream(
+                request, trace, rid, model, headers, body, deadline,
+                upstream, active, tried, t0, journal)
+
+        # --- relay phase (non-journaled): stream the response; never
+        # retried (the upstream may have executed the request).
         resp: Optional[web.StreamResponse] = None
         t_head = self.clock()
         t_first: Optional[float] = None
@@ -717,6 +942,346 @@ class Router:
         finally:
             active.inflight -= 1
 
+    # ------------------------------------------------------------------
+    # journaled SSE relay: mid-stream failover splice + hedged requests
+
+    _RELAY_ERRORS = (aiohttp.ClientError, TimeoutError, OSError)
+
+    async def _relay_stream(self, request: web.Request,
+                            trace: "tracing.Trace", rid: str, model: str,
+                            headers: dict, body: bytes,
+                            deadline: Optional[float],
+                            upstream: aiohttp.ClientResponse,
+                            active: Replica, tried: set, t0: float,
+                            journal: _StreamJournal) -> web.StreamResponse:
+        """Relay a streaming completion with the resume journal engaged.
+
+        One iteration of the outer loop per upstream segment: the original
+        stream, then — on a mid-stream death — each continuation spliced
+        from another replica. The client sees a single uninterrupted SSE
+        stream; when no continuation is possible the stream ends with an
+        explicit error event instead of a silent EOF.
+        """
+        resp: Optional[web.StreamResponse] = None
+        sse = False
+        t_head = self.clock()
+        t_first: Optional[float] = None
+        relayed = 0
+        resumes = 0  # re-issues consumed, capped by resume_attempts
+        first: Optional[bytes] = None
+        try:
+            if self.hedge_ms > 0:
+                try:
+                    upstream, active, first = await self._hedge_race(
+                        request, model, headers, body, deadline, upstream,
+                        active, tried, trace, rid)
+                except self._RELAY_ERRORS as e:
+                    # every attempt died before a first byte; the hedge
+                    # race already released its replicas, and nothing is
+                    # on the wire yet so a plain 502 is still possible
+                    active = None
+                    trace.event("relay_error", error=str(e), bytes=0)
+                    return web.json_response(
+                        error_body(f"upstream error: {e}", "bad_gateway",
+                                   "upstream_error"),
+                        status=502, headers=self._rid_headers(rid))
+            while True:  # one iteration per upstream segment
+                if resp is None:
+                    sse = upstream.headers.get(
+                        "Content-Type", "").lower().startswith(
+                            "text/event-stream")
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_BY_HOP:
+                            resp.headers[k] = v
+                    resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+                    await resp.prepare(request)
+                lost: Optional[BaseException] = None
+                ait = upstream.content.iter_any().__aiter__()
+                while True:
+                    if first is not None:
+                        chunk, first = first, None
+                        if not chunk:
+                            continue
+                    else:
+                        try:
+                            chunk = await ait.__anext__()
+                        except StopAsyncIteration:
+                            break
+                        except self._RELAY_ERRORS as e:
+                            lost = e
+                            break
+                    if t_first is None:
+                        t_first = self.clock()
+                        trace.add_span("first_byte", t_head, t_first)
+                        request["llmk_ttft_ms"] = (t_first - t0) * 1000.0
+                    relayed += len(chunk)
+                    out = journal.feed(chunk) if sse else chunk
+                    if out:
+                        # client-side write failures propagate (client
+                        # gone) — only UPSTREAM errors trigger a resume
+                        await resp.write(out)
+                if lost is None:
+                    upstream.close()
+                    break  # clean upstream EOF: relay complete
+                # --- upstream died mid-stream
+                active.breaker.record_failure()
+                active.inflight -= 1
+                tried.add(active.url)
+                dead = active.url
+                active = None
+                upstream.close()
+                trace.event("relay_error", error=str(lost), bytes=relayed,
+                            replica=dead)
+                if not resp.prepared:
+                    return web.json_response(
+                        error_body(f"upstream error: {lost}", "bad_gateway",
+                                   "upstream_error"),
+                        status=502, headers=self._rid_headers(rid))
+                if not sse:
+                    # a non-SSE upstream body (error JSON relayed verbatim):
+                    # the pre-resume close-on-death contract
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
+                if journal.finished or journal.done:
+                    # the stream was semantically complete — at most the
+                    # [DONE] terminator was lost; finish it ourselves
+                    try:
+                        if not journal.done:
+                            await resp.write(b"data: [DONE]\n\n")
+                        await resp.write_eof()
+                    except (ConnectionResetError, OSError):
+                        pass
+                    return resp
+                nxt = await self._resume_upstream(
+                    request, model, headers, body, deadline, tried, journal,
+                    rid, resumes)
+                if nxt is None:
+                    return await self._truncate_stream(resp, model, trace)
+                upstream, active, used = nxt
+                resumes += used
+                self.metrics["stream_resume"].labels(outcome="ok").inc()
+                journal.echo_skip = journal.chars - journal.chars_at_mark
+                jlog("stream_resume", request_id=rid, component="router",
+                     model=model, replica=active.url,
+                     prefix_tokens=len(journal.tokens),
+                     echo_skip=journal.echo_skip)
+                trace.event("stream_resume", replica=active.url,
+                            tokens=len(journal.tokens))
+            tail = journal.flush() if sse else b""
+            if tail:
+                await resp.write(tail)
+            await resp.write_eof()
+            trace.add_span("stream", t_first if t_first is not None
+                           else t_head, self.clock(), bytes=relayed,
+                           upstream_status=upstream.status, resumes=resumes)
+            return resp
+        finally:
+            if active is not None:
+                active.inflight -= 1
+
+    async def _resume_upstream(self, request: web.Request, model: str,
+                               headers: dict, body: bytes,
+                               deadline: Optional[float], tried: set,
+                               journal: _StreamJournal, rid: str,
+                               resumes: int):
+        """Re-issue a died stream to another replica with the journaled
+        prefix. Returns (upstream, replica, attempts_used) on a spliceable
+        200 SSE response, or None to give up (disabled, exhausted,
+        non-resumable stream, no replica, or deadline spent)."""
+        if not self.stream_resume:
+            ok, why = False, "resume disabled"
+        elif resumes >= self.resume_attempts:
+            ok, why = False, f"attempts exhausted ({self.resume_attempts})"
+        else:
+            ok, why = journal.resumable()
+        if not ok:
+            jlog("stream_resume_giveup", request_id=rid, component="router",
+                 model=model, reason=why)
+            return None
+        h = dict(headers)
+        if journal.saw_data or journal.tokens:
+            # the client has seen part of the stream: replay idempotently
+            # with the journaled prefix (possibly empty — e.g. only the
+            # role delta was delivered) and the original stream identity
+            h[RESUME_TOKENS_HEADER] = ",".join(map(str, journal.tokens))
+            if journal.stream_id:
+                h[RESUME_STREAM_ID_HEADER] = journal.stream_id
+            if journal.created is not None:
+                h[RESUME_CREATED_HEADER] = str(journal.created)
+        # else: nothing reached the client yet — a clean re-issue
+        used = 0
+        budget = self.resume_attempts - resumes
+        while used < budget:
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    jlog("stream_resume_giveup", request_id=rid,
+                         component="router", model=model, reason="deadline")
+                    return None
+                h[DEADLINE_HEADER] = str(int(remaining * 1000))
+            replica = self._pick(model, tried)
+            if replica is None:
+                jlog("stream_resume_giveup", request_id=rid,
+                     component="router", model=model,
+                     reason="no healthy replica")
+                return None
+            used += 1
+            url = f"{replica.url}/{request.match_info['path']}"
+            if request.query_string:
+                url += f"?{request.query_string}"
+            replica.inflight += 1
+            try:
+                up = await self._session.request(
+                    request.method, url, data=body or None, headers=h)
+            except self._RELAY_ERRORS:
+                replica.inflight -= 1
+                replica.breaker.record_failure()
+                tried.add(replica.url)
+                continue
+            ctype = up.headers.get("Content-Type", "").lower()
+            if up.status != 200 or not ctype.startswith("text/event-stream"):
+                # the replica answered but refused the splice (draining
+                # 503, resume rejected 400): not a transport failure
+                replica.inflight -= 1
+                up.close()
+                tried.add(replica.url)
+                continue
+            replica.breaker.record_success()
+            return up, replica, used
+        jlog("stream_resume_giveup", request_id=rid, component="router",
+             model=model, reason=f"attempts exhausted ({self.resume_attempts})")
+        return None
+
+    async def _truncate_stream(self, resp: web.StreamResponse, model: str,
+                               trace: "tracing.Trace") -> web.StreamResponse:
+        """No continuation possible: end the stream with an explicit SSE
+        error event (finish_reason=upstream_lost) instead of the silent
+        EOF clients used to get, and count the loss."""
+        self.metrics["stream_truncated"].labels(model=model).inc()
+        if self.stream_resume:
+            self.metrics["stream_resume"].labels(outcome="gave_up").inc()
+        trace.event("stream_truncated", model=model)
+        payload = {
+            "error": {"message": "upstream connection lost mid-stream and "
+                      "the stream could not be resumed",
+                      "type": "upstream_error", "code": "upstream_lost"},
+            "choices": [{"index": 0, "delta": {},
+                         "finish_reason": "upstream_lost"}],
+        }
+        try:
+            await resp.write(b"event: error\ndata: "
+                             + json.dumps(payload).encode() + b"\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, OSError):
+            pass
+        return resp
+
+    async def _hedge_race(self, request: web.Request, model: str,
+                          headers: dict, body: bytes,
+                          deadline: Optional[float],
+                          upstream: aiohttp.ClientResponse, active: Replica,
+                          tried: set, trace: "tracing.Trace", rid: str):
+        """Tail-TTFT hedging (LLMK_HEDGE_MS): wait for the primary's first
+        body byte; when it is late, race a secondary on a different
+        replica and keep whichever streams first. The loser is cancelled
+        and its connection closed (the replica aborts the duplicate on
+        disconnect), so at most one stream ever reaches the client.
+        Returns (upstream, replica, first_chunk) for the winner; raises
+        the last transport error if every attempt dies before a first
+        byte (both replicas already released)."""
+
+        async def first_of(up: aiohttp.ClientResponse):
+            try:
+                chunk = await up.content.iter_any().__aiter__().__anext__()
+            except StopAsyncIteration:
+                chunk = b""
+            return up, chunk
+
+        prim = asyncio.ensure_future(first_of(upstream))
+        done, _ = await asyncio.wait({prim}, timeout=self.hedge_ms / 1000.0)
+        if done:
+            try:
+                _, chunk = prim.result()
+            except self._RELAY_ERRORS:
+                active.breaker.record_failure()
+                active.inflight -= 1
+                tried.add(active.url)
+                raise
+            return upstream, active, chunk
+        hedge_rep = self._pick(model, tried | {active.url})
+        if hedge_rep is None:
+            # nowhere to hedge to: keep waiting on the primary
+            try:
+                _, chunk = await prim
+            except self._RELAY_ERRORS:
+                active.breaker.record_failure()
+                active.inflight -= 1
+                tried.add(active.url)
+                raise
+            return upstream, active, chunk
+        h = dict(headers)
+        if deadline is not None:
+            remaining = deadline - self.clock()
+            h[DEADLINE_HEADER] = str(max(1, int(remaining * 1000)))
+        url = f"{hedge_rep.url}/{request.match_info['path']}"
+        if request.query_string:
+            url += f"?{request.query_string}"
+        jlog("hedge_launch", request_id=rid, component="router", model=model,
+             primary=active.url, hedge=hedge_rep.url)
+        trace.event("hedge_launch", primary=active.url, hedge=hedge_rep.url)
+        hedge_rep.inflight += 1
+
+        async def hedge_of():
+            up2 = await self._session.request(
+                request.method, url, data=body or None, headers=h)
+            try:
+                return await first_of(up2)
+            except asyncio.CancelledError:
+                up2.close()
+                raise
+
+        sec = asyncio.ensure_future(hedge_of())
+        live = {prim: active, sec: hedge_rep}
+        pending = {prim, sec}
+        last_err: Optional[BaseException] = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            # deterministic preference: the primary when both land together
+            for fut in (f for f in (prim, sec) if f in done):
+                rep = live[fut]
+                if fut.exception() is not None:
+                    last_err = fut.exception()
+                    rep.breaker.record_failure()
+                    rep.inflight -= 1
+                    tried.add(rep.url)
+                    continue
+                up, chunk = fut.result()
+                loser = sec if fut is prim else prim
+                if loser in pending:
+                    loser.cancel()
+                    try:
+                        await loser
+                    except (asyncio.CancelledError, *self._RELAY_ERRORS):
+                        pass
+                    else:
+                        lup, _ = loser.result()
+                        lup.close()
+                    lrep = live[loser]
+                    lrep.inflight -= 1
+                    if loser is prim:
+                        upstream.close()
+                rep.breaker.record_success()
+                outcome = "primary_won" if fut is prim else "hedge_won"
+                self.metrics["hedged"].labels(outcome=outcome).inc()
+                if fut is not prim:
+                    trace.event("hedge_won", replica=rep.url)
+                return up, rep, chunk
+        assert last_err is not None
+        raise last_err
+
 
 def run_router(
     backends: "dict[str, Union[str, list[str]]]",
@@ -726,8 +1291,13 @@ def run_router(
     port: int = 8080,
     probe_interval_s: Optional[float] = 2.0,
     adapters: Optional[dict] = None,
+    stream_resume: Optional[bool] = None,
+    resume_attempts: Optional[int] = None,
+    hedge_ms: Optional[float] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
-                    probe_interval_s=probe_interval_s)
+                    probe_interval_s=probe_interval_s,
+                    stream_resume=stream_resume,
+                    resume_attempts=resume_attempts, hedge_ms=hedge_ms)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

@@ -83,6 +83,7 @@
 #include <map>
 #include <mutex>
 #include <optional>
+#include <poll.h>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -125,6 +126,15 @@ struct Config {
   int retry_backoff_ms = 200;
   int breaker_threshold = 5;
   double breaker_open_s = 10.0;
+  // zero-drop streams (mirrors the python Router): journal in-flight SSE
+  // completion streams and splice a continuation from another replica
+  // when the upstream dies mid-stream. Defaults come from the same env
+  // vars the python router reads (LLMK_STREAM_RESUME, LLMK_RESUME_ATTEMPTS,
+  // LLMK_HEDGE_MS); config-file keys override.
+  bool stream_resume = true;
+  int resume_attempts = 2;
+  double hedge_ms = 0.0;          // 0 = hedged requests off
+  size_t journal_max_tokens = 4096;
   int port = 8080;
   bool quiet = false;
 
@@ -176,6 +186,22 @@ static std::map<std::string, long> g_requests_by_model;
 static void count_model_request(const std::string& model) {
   std::lock_guard<std::mutex> lock(g_requests_by_model_mu);
   ++g_requests_by_model[model];
+}
+
+// zero-drop stream counters (mirror server/metrics.py router_metrics()):
+// llm_stream_resume_total{outcome=ok|gave_up},
+// llm_hedged_requests_total{outcome=primary_won|hedge_won},
+// llm_stream_truncated_total{model=...}
+static std::atomic<long> g_stream_resume_ok_total{0};
+static std::atomic<long> g_stream_resume_gave_up_total{0};
+static std::atomic<long> g_hedged_primary_won_total{0};
+static std::atomic<long> g_hedged_hedge_won_total{0};
+static std::mutex g_stream_truncated_mu;
+static std::map<std::string, long> g_stream_truncated_by_model;
+
+static void count_stream_truncated(const std::string& model) {
+  std::lock_guard<std::mutex> lock(g_stream_truncated_mu);
+  ++g_stream_truncated_by_model[model];
 }
 
 // build identity: must match the python package __version__ so
@@ -1059,6 +1085,324 @@ static bool relay_body(SockReader& up, int client_fd, const ResponseHead& head,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Stream journal + splice (mirrors server/router.py::_StreamJournal and the
+// _relay_stream/_resume_upstream/_truncate_stream/_hedge_race quartet)
+// ---------------------------------------------------------------------------
+
+// Internal router<->API resume protocol headers. The router asks the API to
+// journal (kJournalHeader); the API follows each SSE data event with a
+// ": llmk-tok <ids>" comment naming the token ids whose text has been
+// DELIVERED. On a mid-stream upstream death the journaled ids are re-issued
+// to another replica (kResumeTokensHeader, plus the original stream
+// identity) and the continuation spliced into the same client stream.
+// Comment-AFTER-data ordering is the correctness invariant: a journaled
+// token implies its text was already relayed, so a splice can never skip
+// text — at worst it replays a little, which the journal trims (echo_skip).
+static const char kJournalHeader[] = "X-LLMK-Journal";
+static const char kResumeTokensHeader[] = "X-LLMK-Resume-Tokens";
+static const char kResumeStreamIdHeader[] = "X-LLMK-Resume-Stream-Id";
+static const char kResumeCreatedHeader[] = "X-LLMK-Resume-Created";
+
+static std::string strip_copy(const std::string& s) {
+  size_t b = s.find_first_not_of(" \t\r\n");
+  if (b == std::string::npos) return "";
+  size_t e = s.find_last_not_of(" \t\r\n");
+  return s.substr(b, e - b + 1);
+}
+
+static Json* get_mut(Json* j, const std::string& key) {
+  if (!j || j->type != Json::Type::Object) return nullptr;
+  for (auto& kv : j->obj)
+    if (kv.first == key) return kv.second.get();
+  return nullptr;
+}
+
+// Per-stream resume journal. Counts are BYTES of content forwarded (the
+// python journal counts codepoints; each router is internally consistent —
+// echo_skip is computed and consumed in the same units, and a byte cut
+// always lands on a boundary the client already received, because the
+// resumed replica regenerates the identical byte stream). Text is never
+// buffered, only counted; past max_tokens the stream flips non-resumable
+// (a resume needs the COMPLETE prefix, so a dropping ring would be useless).
+struct StreamJournal {
+  size_t max_tokens = 4096;
+  std::vector<long> tokens;      // journaled (delivered) token ids
+  size_t chars = 0;              // content bytes forwarded to the client
+  size_t chars_at_mark = 0;      // chars when the last tok comment landed
+  bool saw_data = false;         // any data: chunk forwarded yet
+  bool done = false;             // "data: [DONE]" forwarded
+  bool finished = false;         // a choice carried a finish_reason
+  bool overflow = false;
+  std::string not_resumable;     // non-empty: reason this can't resume
+  std::string stream_id;         // upstream completion id (reused on resume)
+  long long created = -1;
+  size_t echo_skip = 0;          // replayed-echo bytes still to drop
+  std::string buf;               // partial trailing line held between feeds
+
+  // Digest upstream bytes; returns what to forward downstream. Complete
+  // lines only — a trailing partial line is held until its newline
+  // arrives, so journal state never runs behind forwarded text.
+  std::string feed(const char* data, size_t n) {
+    buf.append(data, n);
+    std::string out;
+    size_t pos = 0;
+    while (true) {
+      size_t nl = buf.find('\n', pos);
+      if (nl == std::string::npos) break;
+      line(buf.substr(pos, nl - pos + 1), &out);
+      pos = nl + 1;
+    }
+    buf.erase(0, pos);
+    return out;
+  }
+
+  // held-back tail (a stream that ended without a final newline);
+  // forwarded verbatim once the upstream EOFs cleanly
+  std::string flush() {
+    std::string tail;
+    tail.swap(buf);
+    return tail;
+  }
+
+  bool resumable(std::string* why) const {
+    if (done) {
+      *why = "stream already complete";
+      return false;
+    }
+    if (overflow) {
+      *why = "journal overflow (> " + std::to_string(max_tokens) + " tokens)";
+      return false;
+    }
+    if (!not_resumable.empty()) {
+      *why = not_resumable;
+      return false;
+    }
+    why->clear();
+    return true;
+  }
+
+ private:
+  void line(const std::string& ln, std::string* out) {
+    static const char kTok[] = ": llmk-tok";
+    std::string s = strip_copy(ln);
+    if (s.compare(0, sizeof kTok - 1, kTok) == 0) {
+      std::vector<long> ids;
+      bool bad = false;
+      std::string rest = s.substr(sizeof kTok - 1);
+      size_t p = 0;
+      while (p <= rest.size()) {
+        size_t comma = rest.find(',', p);
+        std::string part = strip_copy(
+            rest.substr(p, comma == std::string::npos ? std::string::npos
+                                                      : comma - p));
+        if (!part.empty()) {
+          try {
+            size_t used = 0;
+            long v = std::stol(part, &used);
+            if (used != part.size()) throw std::invalid_argument(part);
+            ids.push_back(v);
+          } catch (...) {
+            bad = true;
+            break;
+          }
+        }
+        if (comma == std::string::npos) break;
+        p = comma + 1;
+      }
+      if (!bad) tokens.insert(tokens.end(), ids.begin(), ids.end());
+      if (tokens.size() > max_tokens) overflow = true;
+      chars_at_mark = chars;
+      return;  // internal comment: never reaches the client
+    }
+    if (s.compare(0, 5, "data:") != 0) {
+      out->append(ln);  // keepalives, blank lines, "event:" fields, ...
+      return;
+    }
+    std::string payload = strip_copy(s.substr(5));
+    if (payload == "[DONE]") {
+      done = true;
+      out->append(ln);
+      return;
+    }
+    data_line(payload, ln, out);
+  }
+
+  void data_line(const std::string& payload, const std::string& ln,
+                 std::string* out) {
+    saw_data = true;
+    JsonPtr doc = JsonParser::parse(payload);
+    if (!doc || !doc->is_object()) {
+      not_resumable = "unparseable data chunk";
+      out->append(ln);
+      return;
+    }
+    if (stream_id.empty()) {
+      const Json* idj = doc->get("id");
+      if (idj && idj->is_string()) {
+        stream_id = idj->str;
+        const Json* cj = doc->get("created");
+        if (cj && cj->type == Json::Type::Number)
+          created = static_cast<long long>(cj->number);
+      }
+    }
+    Json* content_node = nullptr;
+    Json* choices = get_mut(doc.get(), "choices");
+    if (choices && choices->type == Json::Type::Array) {
+      for (auto& chp : choices->arr) {
+        Json* ch = chp.get();
+        if (!ch || !ch->is_object()) continue;
+        const Json* idx = ch->get("index");
+        long index = idx && idx->type == Json::Type::Number
+                         ? static_cast<long>(idx->number)
+                         : 0;
+        if (index != 0) not_resumable = "multi-choice stream";
+        const Json* fr = ch->get("finish_reason");
+        if (fr && fr->type != Json::Type::Null &&
+            !(fr->is_string() && fr->str.empty()))
+          finished = true;
+        const Json* lp = ch->get("logprobs");
+        if (lp && lp->type != Json::Type::Null &&
+            !(lp->is_object() && lp->obj.empty()))
+          // prefix logprob data is unrecoverable on another replica
+          not_resumable = "logprobs stream";
+        Json* delta = get_mut(ch, "delta");
+        Json* c = nullptr;
+        if (delta && delta->is_object()) {
+          const Json* tc = delta->get("tool_calls");
+          if (tc && tc->type == Json::Type::Array && !tc->arr.empty())
+            not_resumable = "tool-call stream";
+          c = get_mut(delta, "content");
+        } else {
+          c = get_mut(ch, "text");
+        }
+        if (c && c->is_string() && index == 0) content_node = c;
+      }
+    }
+    std::string fwd = ln;
+    if (content_node && !content_node->str.empty()) {
+      if (echo_skip > 0) {
+        // a resumed upstream deterministically regenerated tokens the
+        // client already has text for: trim the duplicate
+        size_t drop = std::min(echo_skip, content_node->str.size());
+        echo_skip -= drop;
+        content_node->str.erase(0, drop);
+        fwd = "data: " + doc->dump() + "\n";
+      }
+      chars += content_node->str.size();
+    }
+    out->append(fwd);
+  }
+};
+
+// Normalizes the upstream body framing (chunked / Content-Length / EOF) to
+// a plain byte feed. Unlike relay_body — which forwards the upstream's own
+// framing verbatim — a journaled stream is assembled from MULTIPLE upstream
+// segments and must carry the router's own chunked framing end to end, so
+// the upstream framing has to be parsed away here.
+struct StreamBodyReader {
+  enum class Mode { Chunked, Length, Eof };
+  SockReader& r;
+  Mode mode = Mode::Eof;
+  unsigned long left = 0;
+  bool complete = false;  // body ended per its framing (chunked/CL only)
+
+  StreamBodyReader(SockReader& reader, const ResponseHead& head) : r(reader) {
+    const std::string* te = head.headers.get("transfer-encoding");
+    if (te && lower(*te).find("chunked") != std::string::npos) {
+      mode = Mode::Chunked;
+    } else if (const std::string* cl = head.headers.get("content-length")) {
+      mode = Mode::Length;
+      try {
+        left = std::stoul(*cl);
+      } catch (...) {
+        left = 0;
+      }
+    }
+  }
+
+  // >0: bytes read into buf; 0: end (per framing, or EOF in Eof mode —
+  // the caller disambiguates clean completion via journal state);
+  // -1: transport error
+  ssize_t next(char* buf, size_t cap) {
+    if (complete) return 0;
+    if (mode == Mode::Length) {
+      if (left == 0) {
+        complete = true;
+        return 0;
+      }
+      ssize_t n = r.read_some(buf, std::min<size_t>(left, cap));
+      if (n <= 0) return -1;
+      left -= static_cast<unsigned long>(n);
+      if (left == 0) complete = true;
+      return n;
+    }
+    if (mode == Mode::Eof) {
+      ssize_t n = r.read_some(buf, cap);
+      if (n < 0) return -1;
+      return n;
+    }
+    // chunked
+    std::string ln;
+    while (left == 0) {
+      if (!r.read_line(ln)) return -1;
+      unsigned long sz = 0;
+      try {
+        sz = std::stoul(ln.substr(0, ln.find(';')), nullptr, 16);
+      } catch (...) {
+        return -1;
+      }
+      if (sz == 0) {
+        while (true) {  // trailers, then the blank terminator line
+          if (!r.read_line(ln)) return -1;
+          if (ln.empty()) {
+            complete = true;
+            return 0;
+          }
+        }
+      }
+      left = sz;
+    }
+    ssize_t n = r.read_some(buf, std::min<size_t>(left, cap));
+    if (n <= 0) return -1;
+    left -= static_cast<unsigned long>(n);
+    if (left == 0) {
+      if (!r.read_line(ln)) return -1;  // chunk-terminating CRLF
+    }
+    return n;
+  }
+};
+
+// one chunk of the router's own chunked framing toward the client
+static bool write_client_chunk(int fd, const std::string& data) {
+  if (data.empty()) return true;
+  char hdr[32];
+  int m = snprintf(hdr, sizeof hdr, "%zx\r\n", data.size());
+  return send_all(fd, hdr, static_cast<size_t>(m)) && send_all(fd, data) &&
+         send_all(fd, "\r\n", 2);
+}
+
+// the explicit end-of-stream error event (same payload shape the python
+// router emits) that replaces the silent-EOF truncation clients used to get
+static std::string sse_truncation_event() {
+  auto root = Json::make(Json::Type::Object);
+  auto err = Json::make(Json::Type::Object);
+  err->set("message",
+           Json::of_string("upstream connection lost mid-stream and the "
+                           "stream could not be resumed"));
+  err->set("type", Json::of_string("upstream_error"));
+  err->set("code", Json::of_string("upstream_lost"));
+  root->set("error", err);
+  auto choices = Json::make(Json::Type::Array);
+  auto ch = Json::make(Json::Type::Object);
+  ch->set("index", Json::of_number(0));
+  ch->set("delta", Json::make(Json::Type::Object));
+  ch->set("finish_reason", Json::of_string("upstream_lost"));
+  choices->arr.push_back(ch);
+  root->set("choices", choices);
+  return "event: error\ndata: " + root->dump() + "\n\n";
+}
+
 // Proxies one request; returns true iff the client connection can be
 // reused for another request.
 static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
@@ -1108,9 +1452,30 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   };
   if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
 
+  // streaming completions get the journal/splice relay: the journal is
+  // kept even with resume disabled (the truncation error event and
+  // counter need it); the upstream only emits tok comments when asked,
+  // so the journal header rides only when resume is on
+  bool journal_mode = false;
+  if (req.method == "POST" && !req.body.empty()) {
+    std::string path = req.target.substr(0, req.target.find('?'));
+    while (!path.empty() && path.back() == '/') path.pop_back();
+    static const char kSuffix[] = "completions";
+    if (path.size() >= sizeof kSuffix - 1 &&
+        path.compare(path.size() - (sizeof kSuffix - 1), sizeof kSuffix - 1,
+                     kSuffix) == 0) {
+      JsonPtr parsed = JsonParser::parse(req.body);
+      if (parsed && parsed->is_object()) {
+        const Json* st = parsed->get("stream");
+        journal_mode = st && st->type == Json::Type::Bool && st->boolean;
+      }
+    }
+  }
+
   // upstream request head, rebuilt per attempt so the forwarded deadline
-  // reflects time already burned on failed replicas
-  auto build_head = [&](const Url& target) {
+  // reflects time already burned on failed replicas; `extra` carries the
+  // resume-protocol headers of a mid-stream re-issue
+  auto build_head = [&](const Url& target, const std::string& extra) {
     std::string path =
         target.path == "/" ? req.target : target.path + req.target;
     std::ostringstream out;
@@ -1123,6 +1488,11 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (n == "x-forwarded-for") continue;  // re-added with client appended
       if (n == "x-llmk-deadline-ms") continue;  // re-added decremented
       if (n == "x-llmk-request-id") continue;  // re-added canonicalized
+      // internal resume protocol: never client-settable (a forged prefix
+      // would be an output-injection hole)
+      if (n == "x-llmk-journal" || n == "x-llmk-resume-tokens" ||
+          n == "x-llmk-resume-stream-id" || n == "x-llmk-resume-created")
+        continue;
       out << kv.first << ": " << kv.second << "\r\n";
     }
     out << kRequestIdHeader << ": " << rid << "\r\n";
@@ -1136,6 +1506,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       out << "X-LLMK-Deadline-Ms: "
           << static_cast<long>(rem > 0 ? rem : 0) << "\r\n";
     }
+    if (journal_mode && cfg.stream_resume)
+      out << kJournalHeader << ": 1\r\n";
+    out << extra;
     out << "Content-Length: " << req.body.size() << "\r\n";
     out << "Connection: keep-alive\r\n\r\n";
     return out.str();
@@ -1190,7 +1563,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     attempted = true;
     health = &g_health.get(target->host, target->port);
     health->inflight.fetch_add(1, std::memory_order_relaxed);
-    const std::string head_bytes = build_head(*target);
+    const std::string head_bytes = build_head(*target, std::string());
     bool pooled = false;
     up_fd = g_upstream_pool.acquire(target->host, target->port);
     if (up_fd >= 0) {
@@ -1295,10 +1668,6 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     return req.keep_alive;
   }
 
-  // forward response head; keep the upstream's framing headers
-  // (Transfer-Encoding/Content-Length) so the relayed body matches
-  bool has_framing = head.headers.get("content-length") ||
-                     head.headers.get("transfer-encoding");
   // connect_ms: arrival -> upstream socket established (incl. failover
   // attempts); head_ms: arrival -> response head received (the upstream's
   // processing time for non-streaming responses)
@@ -1308,6 +1677,323 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
           : std::chrono::duration<double, std::milli>(connected_at - t0)
                 .count();
   double head_ms = ms_since(t0);
+
+  // --- zero-drop streaming: a 200 SSE answer to a streaming completion
+  // request is relayed through the journal/splice path — the client sees
+  // a single uninterrupted stream across upstream deaths (resumed on a
+  // sibling replica with the journaled prefix), and a stream that cannot
+  // be resumed ends with an explicit error event, never a silent EOF.
+  const std::string* up_ct = head.headers.get("content-type");
+  if (journal_mode && head.status == 200 && up_ct &&
+      lower(*up_ct).compare(0, 17, "text/event-stream") == 0) {
+    StreamJournal journal;
+    journal.max_tokens = cfg.journal_max_tokens;
+    int resumes = 0;  // re-issues consumed, capped by cfg.resume_attempts
+    size_t relayed = 0;
+    std::chrono::steady_clock::time_point first_at{};
+    char buf[16 * 1024];
+
+    // re-issue helper shared by resume and hedge: connect to `nt`, send
+    // the rebuilt head (+ resume headers when `extra` carries them) and
+    // the buffered body, read the response head into *nh. The SockReader
+    // lands in `slot` so body bytes that arrived with the head survive.
+    // Returns the connected fd, or -1 (slot untouched or reset).
+    auto issue_to = [&](const Url& nt, const std::string& extra,
+                        std::optional<SockReader>& slot,
+                        ResponseHead* nh) -> int {
+      int fd = g_upstream_pool.acquire(nt.host, nt.port);
+      if (fd < 0)
+        fd = connect_to(nt.host, nt.port, cfg.upstream_timeout_s,
+                        cfg.connect_timeout_s);
+      if (fd < 0) return -1;
+      if (!send_all(fd, build_head(nt, extra)) ||
+          (!req.body.empty() && !send_all(fd, req.body))) {
+        ::close(fd);
+        return -1;
+      }
+      slot.emplace(fd);
+      if (!read_response_head(*slot, *nh)) {
+        ::close(fd);
+        slot.reset();
+        return -1;
+      }
+      return fd;
+    };
+
+    // hedged requests (LLMK_HEDGE_MS): when the primary shows no body
+    // byte within the budget, race a secondary on a different replica
+    // and keep whichever streams first. The loser is closed — the API
+    // aborts generation on disconnect — so at most one stream ever
+    // reaches the client. Slow is not failed: the loser takes no
+    // breaker hit and stays out of `tried`.
+    if (cfg.hedge_ms > 0 && !up->has_buffered()) {
+      struct pollfd pfd {up_fd, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, static_cast<int>(cfg.hedge_ms));
+      if (pr == 0) {
+        std::vector<const Url*> skip = tried;
+        skip.push_back(target);
+        const Url* hr = pick_replica(cfg, replicas, skip);
+        if (hr) {
+          ReplicaHealth* hh = &g_health.get(hr->host, hr->port);
+          hh->inflight.fetch_add(1, std::memory_order_relaxed);
+          logf(cfg, "hedge %s: %s:%d late, racing %s:%d", model.c_str(),
+               target->host.c_str(), target->port, hr->host.c_str(),
+               hr->port);
+          std::optional<SockReader> up2;
+          ResponseHead head2;
+          int fd2 = issue_to(*hr, std::string(), up2, &head2);
+          if (fd2 < 0 || head2.status != 200) {
+            // secondary never reached the race: fall back to the primary.
+            // Only a transport failure feeds the breaker — a non-200
+            // answer means the replica is alive but refused.
+            if (fd2 >= 0)
+              ::close(fd2);
+            else
+              g_breakers.get(hr->host, hr->port)
+                  .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+            hh->inflight.fetch_sub(1, std::memory_order_relaxed);
+            tried.push_back(hr);
+            g_hedged_primary_won_total.fetch_add(1,
+                                                 std::memory_order_relaxed);
+          } else {
+            struct pollfd pair[2] = {{up_fd, POLLIN, 0}, {fd2, POLLIN, 0}};
+            int pw = up2->has_buffered()
+                         ? 0
+                         : ::poll(pair, 2, cfg.upstream_timeout_s * 1000);
+            bool sec_first =
+                up2->has_buffered() ||
+                (pw > 0 && !(pair[0].revents & POLLIN) &&
+                 (pair[1].revents & POLLIN));
+            if (sec_first) {
+              // secondary wins: swap it in as the active upstream
+              ::close(up_fd);
+              health->inflight.fetch_sub(1, std::memory_order_relaxed);
+              target = hr;
+              health = hh;
+              up = std::move(up2);
+              up_fd = fd2;
+              head = head2;
+              g_breakers.get(hr->host, hr->port).record_success();
+              g_hedged_hedge_won_total.fetch_add(1,
+                                                 std::memory_order_relaxed);
+              logf(cfg, "hedge won %s: %s:%d", model.c_str(),
+                   hr->host.c_str(), hr->port);
+            } else {
+              // deterministic primary preference when both land together
+              ::close(fd2);
+              hh->inflight.fetch_sub(1, std::memory_order_relaxed);
+              g_hedged_primary_won_total.fetch_add(
+                  1, std::memory_order_relaxed);
+            }
+          }
+        }
+      }
+    }
+
+    // the client response head is the ROUTER's: the body is re-framed
+    // (upstream framing is parsed away so segments from several replicas
+    // splice into one chunked stream)
+    {
+      std::ostringstream rh;
+      rh << head.status_line << "\r\n";
+      for (const auto& kv : head.headers.items) {
+        std::string n = lower(kv.first);
+        if (n == "connection" || n == "keep-alive" ||
+            n == "transfer-encoding" || n == "content-length")
+          continue;
+        rh << kv.first << ": " << kv.second << "\r\n";
+      }
+      if (!head.headers.get("x-llmk-request-id")) rh << rid_header;
+      rh << "Transfer-Encoding: chunked\r\n";
+      rh << "Connection: " << (req.keep_alive ? "keep-alive" : "close")
+         << "\r\n\r\n";
+      if (!send_all(client_fd, rh.str())) {
+        ::close(up_fd);
+        health->inflight.fetch_sub(1, std::memory_order_relaxed);
+        return false;
+      }
+    }
+
+    bool client_ok = true;
+    bool complete = false;
+    std::optional<StreamBodyReader> body_r;
+    body_r.emplace(*up, head);
+    while (true) {  // one iteration per body read; resumes splice inline
+      ssize_t n = body_r->next(buf, sizeof buf);
+      if (n > 0) {
+        if (first_at == std::chrono::steady_clock::time_point{})
+          first_at = std::chrono::steady_clock::now();
+        relayed += static_cast<size_t>(n);
+        std::string fwd = journal.feed(buf, static_cast<size_t>(n));
+        if (!fwd.empty() && !write_client_chunk(client_fd, fwd)) {
+          client_ok = false;  // client gone — never a reason to resume
+          break;
+        }
+        continue;
+      }
+      if (n == 0 && (body_r->complete ||
+                     (body_r->mode == StreamBodyReader::Mode::Eof &&
+                      journal.done))) {
+        complete = true;  // clean end per framing (or EOF after [DONE])
+        break;
+      }
+      // --- upstream died mid-stream
+      g_breakers.get(target->host, target->port)
+          .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+      health->inflight.fetch_sub(1, std::memory_order_relaxed);
+      health = nullptr;
+      ::close(up_fd);
+      up_fd = -1;
+      tried.push_back(target);
+      logf(cfg, "stream lost %s: %s:%d after %zu bytes", model.c_str(),
+           target->host.c_str(), target->port, relayed);
+      if (journal.finished || journal.done) {
+        // semantically complete — at most the [DONE] terminator was
+        // lost; finish the stream ourselves
+        if (!journal.done)
+          client_ok = write_client_chunk(client_fd, "data: [DONE]\n\n");
+        complete = true;
+        break;
+      }
+      // try to splice a continuation from another replica
+      std::string why;
+      if (!cfg.stream_resume) {
+        why = "resume disabled";
+      } else if (resumes >= cfg.resume_attempts) {
+        why = "attempts exhausted";
+      } else {
+        journal.resumable(&why);
+      }
+      const Url* nt = nullptr;
+      std::optional<SockReader> up2;
+      ResponseHead head2;
+      int fd2 = -1;
+      if (why.empty()) {
+        std::string extra;
+        if (journal.saw_data || !journal.tokens.empty()) {
+          // the client has seen part of the stream: replay idempotently
+          // with the journaled prefix (possibly empty) and the original
+          // stream identity
+          std::string ids;
+          for (size_t i = 0; i < journal.tokens.size(); ++i) {
+            if (i) ids += ",";
+            ids += std::to_string(journal.tokens[i]);
+          }
+          extra += std::string(kResumeTokensHeader) + ": " + ids + "\r\n";
+          if (!journal.stream_id.empty())
+            extra += std::string(kResumeStreamIdHeader) + ": " +
+                     journal.stream_id + "\r\n";
+          if (journal.created >= 0)
+            extra += std::string(kResumeCreatedHeader) + ": " +
+                     std::to_string(journal.created) + "\r\n";
+        }  // else: nothing reached the client yet — a clean re-issue
+        int budget = cfg.resume_attempts - resumes;
+        for (int used = 0; used < budget && fd2 < 0;) {
+          if (budget_ms >= 0 && remaining_ms() <= 0) {
+            why = "deadline";
+            break;
+          }
+          nt = pick_replica(cfg, replicas, tried);
+          if (!nt) {
+            why = "no healthy replica";
+            break;
+          }
+          ++used;
+          ++resumes;
+          ReplicaHealth* nh = &g_health.get(nt->host, nt->port);
+          nh->inflight.fetch_add(1, std::memory_order_relaxed);
+          int fd = issue_to(*nt, extra, up2, &head2);
+          if (fd < 0) {
+            nh->inflight.fetch_sub(1, std::memory_order_relaxed);
+            g_breakers.get(nt->host, nt->port)
+                .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+            tried.push_back(nt);
+            continue;
+          }
+          const std::string* ct2 = head2.headers.get("content-type");
+          if (head2.status != 200 || !ct2 ||
+              lower(*ct2).compare(0, 17, "text/event-stream") != 0) {
+            // the replica answered but refused the splice (draining 503,
+            // resume rejected 400): not a transport failure
+            nh->inflight.fetch_sub(1, std::memory_order_relaxed);
+            ::close(fd);
+            up2.reset();
+            tried.push_back(nt);
+            continue;
+          }
+          g_breakers.get(nt->host, nt->port).record_success();
+          fd2 = fd;
+          health = nh;
+        }
+        if (fd2 < 0 && why.empty()) why = "attempts exhausted";
+      }
+      if (fd2 < 0) {
+        // no continuation possible: explicit error event, counted loss
+        count_stream_truncated(model);
+        if (cfg.stream_resume)
+          g_stream_resume_gave_up_total.fetch_add(1,
+                                                  std::memory_order_relaxed);
+        logf(cfg, "stream truncated %s: %s", model.c_str(), why.c_str());
+        client_ok =
+            write_client_chunk(client_fd, sse_truncation_event()) &&
+            client_ok;
+        complete = true;
+        break;
+      }
+      g_stream_resume_ok_total.fetch_add(1, std::memory_order_relaxed);
+      journal.echo_skip = journal.chars - journal.chars_at_mark;
+      logf(cfg, "stream resume %s -> %s:%d (prefix %zu tokens, echo %zu)",
+           model.c_str(), nt->host.c_str(), nt->port, journal.tokens.size(),
+           journal.echo_skip);
+      target = nt;
+      up = std::move(up2);
+      up_fd = fd2;
+      head = head2;
+      body_r.emplace(*up, head);
+    }
+    if (complete && client_ok) {
+      std::string tail = journal.flush();
+      if (!tail.empty()) client_ok = write_client_chunk(client_fd, tail);
+    }
+    // terminal chunk ends the router's own framing (so the client can
+    // tell a finished stream from a dropped connection even at the
+    // transport layer)
+    if (complete && client_ok)
+      client_ok = send_all(client_fd, "0\r\n\r\n", 5);
+    double ttfb_ms =
+        first_at == std::chrono::steady_clock::time_point{}
+            ? head_ms
+            : std::chrono::duration<double, std::milli>(first_at - t0)
+                  .count();
+    g_slo.observe(head.status,
+                  first_at == std::chrono::steady_clock::time_point{}
+                      ? -1.0
+                      : ttfb_ms);
+    jlog_request(cfg, rid, model,
+                 target->host + ":" + std::to_string(target->port),
+                 head.status, connect_ms, ttfb_ms, ms_since(t0));
+    if (up_fd >= 0) {
+      // the live upstream's framing was consumed exactly; pool on clean
+      // completion like the normal path
+      const std::string* up_conn = head.headers.get("connection");
+      bool up_keep =
+          head.status_line.compare(0, 8, "HTTP/1.1") == 0 &&
+          (!up_conn ||
+           lower(*up_conn).find("close") == std::string::npos);
+      if (complete && body_r->complete && up_keep && !up->has_buffered())
+        g_upstream_pool.release(target->host, target->port, up_fd);
+      else
+        ::close(up_fd);
+    }
+    if (health)
+      health->inflight.fetch_sub(1, std::memory_order_relaxed);
+    return req.keep_alive && client_ok && complete;
+  }
+
+  // forward response head; keep the upstream's framing headers
+  // (Transfer-Encoding/Content-Length) so the relayed body matches
+  bool has_framing = head.headers.get("content-length") ||
+                     head.headers.get("transfer-encoding");
   std::ostringstream rh;
   rh << head.status_line << "\r\n";
   for (const auto& kv : head.headers.items) {
@@ -1502,7 +2188,32 @@ static void handle_connection(const Config& cfg, int client_fd,
            "the gateway with an already-expired deadline\n"
         << "# TYPE llm_router_deadline_rejected_total counter\n"
         << "llm_router_deadline_rejected_total "
-        << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n";
+        << g_deadline_rejected_total.load(std::memory_order_relaxed) << "\n"
+        << "# HELP llm_stream_resume_total Mid-stream upstream deaths "
+           "handled by the resume journal, by outcome (ok=spliced onto "
+           "another replica, gave_up=truncated)\n"
+        << "# TYPE llm_stream_resume_total counter\n"
+        << "llm_stream_resume_total{outcome=\"ok\"} "
+        << g_stream_resume_ok_total.load(std::memory_order_relaxed) << "\n"
+        << "llm_stream_resume_total{outcome=\"gave_up\"} "
+        << g_stream_resume_gave_up_total.load(std::memory_order_relaxed)
+        << "\n"
+        << "# HELP llm_hedged_requests_total Hedged streaming requests by "
+           "outcome (which attempt produced the stream the client got)\n"
+        << "# TYPE llm_hedged_requests_total counter\n"
+        << "llm_hedged_requests_total{outcome=\"primary_won\"} "
+        << g_hedged_primary_won_total.load(std::memory_order_relaxed) << "\n"
+        << "llm_hedged_requests_total{outcome=\"hedge_won\"} "
+        << g_hedged_hedge_won_total.load(std::memory_order_relaxed) << "\n";
+      {
+        std::lock_guard<std::mutex> lock(g_stream_truncated_mu);
+        m << "# HELP llm_stream_truncated_total Client-visible stream "
+             "truncations (upstream lost mid-stream, no resume possible)\n"
+          << "# TYPE llm_stream_truncated_total counter\n";
+        for (const auto& kv : g_stream_truncated_by_model)
+          m << "llm_stream_truncated_total{model=\"" << prom_escape(kv.first)
+            << "\"} " << kv.second << "\n";
+      }
       {
         std::lock_guard<std::mutex> lock(g_requests_by_model_mu);
         m << "# HELP llm_router_requests_total Requests the router "
@@ -1657,6 +2368,15 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("probe_interval_s");
       t && t->type == Json::Type::Number)
     cfg.probe_interval_s = t->number;
+  if (const Json* t = root->get("stream_resume");
+      t && t->type == Json::Type::Bool)
+    cfg.stream_resume = t->boolean;
+  if (const Json* t = root->get("resume_attempts");
+      t && t->type == Json::Type::Number)
+    cfg.resume_attempts = std::max(0, static_cast<int>(t->number));
+  if (const Json* t = root->get("hedge_ms");
+      t && t->type == Json::Type::Number)
+    cfg.hedge_ms = std::max(0.0, t->number);
   return true;
 }
 
@@ -1749,6 +2469,17 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_shutdown_signal);
 
   Config cfg;
+  // stream-resume knobs share the python router's env vars; config-file
+  // keys and CLI flags override (read first so they can)
+  if (const char* sr = getenv("LLMK_STREAM_RESUME"); sr && *sr) {
+    std::string v = lower(strip_copy(sr));
+    cfg.stream_resume =
+        !(v.empty() || v == "0" || v == "false" || v == "off" || v == "no");
+  }
+  cfg.resume_attempts = std::max(
+      0, static_cast<int>(env_double("LLMK_RESUME_ATTEMPTS",
+                                     cfg.resume_attempts)));
+  cfg.hedge_ms = std::max(0.0, env_double("LLMK_HEDGE_MS", cfg.hedge_ms));
   std::string config_file, models_inline, adapters_inline;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -1813,6 +2544,16 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       cfg.probe_interval_s = atof(v);
+    } else if (a == "--no-stream-resume") {
+      cfg.stream_resume = false;
+    } else if (a == "--resume-attempts") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.resume_attempts = std::max(0, atoi(v));
+    } else if (a == "--hedge-ms") {
+      const char* v = next();
+      if (!v) return 2;
+      cfg.hedge_ms = std::max(0.0, atof(v));
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
@@ -1821,7 +2562,8 @@ int main(int argc, char** argv) {
               "[--upstream-timeout S] [--client-timeout S] "
               "[--connect-timeout S] [--retries N] [--retry-backoff-ms MS] "
               "[--breaker-threshold N] [--breaker-open S] "
-              "[--probe-interval S]\n");
+              "[--probe-interval S] [--no-stream-resume] "
+              "[--resume-attempts N] [--hedge-ms MS]\n");
       return 2;
     }
   }

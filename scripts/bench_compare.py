@@ -9,10 +9,12 @@ previous such run, prints a per-metric delta table, and exits non-zero
 when any metric moved more than the threshold in the BAD direction:
 
 - latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``, adapter
-  ``*evictions*``/``*load_seconds*`` churn): higher is worse;
+  ``*evictions*``/``*load_seconds*`` churn, mid-stream failover
+  ``resume_gap_ms_*`` stalls and ``*visible_drops``): higher is worse;
 - throughput-ish metrics (``*tokens_per_sec*`` — including the
   multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
-  cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s):
+  cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s —
+  and ``resumed_streams``, proof the failover drill actually spliced):
   lower is worse;
 - anything else is reported but never gates (no direction known).
 
@@ -34,10 +36,11 @@ import sys
 
 _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|cold_start|dropped_streams|spike_first_token"
-                           r"|dispatches_per_token|host_share)")
+                           r"|dispatches_per_token|host_share|resume_gap"
+                           r"|visible_drops|gave_up)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
-                            r"|steps_per_dispatch)")
+                            r"|steps_per_dispatch|resumed_streams)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

@@ -96,6 +96,23 @@ sys.exit(0 if doc.get("dropped_streams") == 0 else 1)'; then
     fails=$((fails + 1))
   fi
 
+  note "resume smoke (kill mid-stream under load, zero client-visible drops)"
+  # the smoke's resume phase kills one stream per wave on a live replica
+  # (kill_mid_stream fault); the router journal must splice every one —
+  # drops are a hard 0 AND at least one resume must actually have fired
+  # (a run where the fault never landed would pass the 0-drop check
+  # without proving anything)
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+sys.exit(0 if doc.get("resume_client_visible_drops") == 0
+         and (doc.get("resumed_streams") or 0) >= 1 else 1)'; then
+    echo "ci: resume smoke OK (0 drops, >=1 resumed stream)"
+  else
+    echo "ci: resume smoke FAILED (drops != 0 or no stream resumed)"
+    fails=$((fails + 1))
+  fi
+
   note "fused decode smoke (K>1 window actually amortizes dispatches)"
   # the smoke engine runs the fused multi-step decode path (decode_steps
   # defaults to 4); dispatches_per_token is per slot, so anything >= 1

@@ -112,6 +112,30 @@ def test_router_and_gateway_match(chart):
 
 
 @pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
+def test_stream_resilience_knobs_match_field_level(chart):
+    """ISSUE 9: the zero-drop stream knobs (streamResume / resumeAttempts
+    / hedgeMs) must land in router.json identically from both renderers —
+    and with the shipped values they must carry the documented defaults
+    (resume on, 2 attempts, hedging off). The Go template uses hasKey
+    rather than `default`, so an explicit false/0 override must survive;
+    field-level equality here is the drift detector for that logic."""
+    import json
+
+    helm = _by_key(_helm_docs(chart))
+    py = _by_key(_python_docs(chart))
+    key = ("ConfigMap", "api-gateway-config")
+    hcfg = json.loads(helm[key]["data"]["router.json"])
+    pcfg = json.loads(py[key]["data"]["router.json"])
+    for field in ("stream_resume", "resume_attempts", "hedge_ms"):
+        assert field in hcfg, f"helm router.json lost {field}"
+        assert field in pcfg, f"python router.json lost {field}"
+        assert hcfg[field] == pcfg[field], (field, hcfg[field], pcfg[field])
+    assert pcfg["stream_resume"] is True
+    assert pcfg["resume_attempts"] == 2
+    assert pcfg["hedge_ms"] == 0
+
+
+@pytest.mark.parametrize("chart", ["tpu-models", "local-models"])
 def test_autoscalers_match_field_level(chart):
     """ISSUE 7: the HPA/ScaledObject specs must be identical between helm
     and the Python renderer — the threshold integer math (ttftOkRatioFloor

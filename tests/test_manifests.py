@@ -448,6 +448,48 @@ def test_router_config_matches_python_router():
     assert err is not None  # strict
 
 
+def test_router_config_stream_resilience_knobs():
+    """ISSUE 9: router.streamResume/resumeAttempts/hedgeMs flow into
+    router.json (defaults: resume on, 2 attempts, hedging off) and the
+    python Router honors them over the env knobs. Falsy overrides must
+    survive — the historical Helm `default`-swallows-false bug is exactly
+    what the hasKey template + this test guard against."""
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    cfg = router_config(load_spec(BASE_YAML))
+    assert cfg["stream_resume"] is True
+    assert cfg["resume_attempts"] == 2
+    assert cfg["hedge_ms"] == 0.0
+
+    tuned = BASE_YAML.replace(
+        "router:",
+        "router:\n  streamResume: false\n  resumeAttempts: 0\n"
+        "  hedgeMs: 75.5")
+    cfg2 = router_config(load_spec(tuned))
+    assert cfg2["stream_resume"] is False
+    assert cfg2["resume_attempts"] == 0
+    assert cfg2["hedge_ms"] == 75.5
+    # knob changes roll the router pods via the config-hash annotation
+    assert config_hash(load_spec(tuned)) != config_hash(load_spec(BASE_YAML))
+
+    r = Router(cfg2["backends"], cfg2["default_model"], cfg2["strict"],
+               stream_resume=cfg2["stream_resume"],
+               resume_attempts=cfg2["resume_attempts"],
+               hedge_ms=cfg2["hedge_ms"])
+    assert r.stream_resume is False
+    assert r.resume_attempts == 0
+    assert r.hedge_ms == 75.5
+
+    import pytest as _pytest
+
+    from llms_on_kubernetes_tpu.deploy.spec import SpecError
+    with _pytest.raises(SpecError):
+        load_spec(BASE_YAML.replace("router:", "router:\n  hedgeMs: -1"))
+    with _pytest.raises(SpecError):
+        load_spec(BASE_YAML.replace("router:",
+                                    "router:\n  resumeAttempts: -2"))
+
+
 def test_monitoring_configmaps_rendered():
     """ISSUE 5: render_manifests ships the alert-rules and Grafana
     dashboard ConfigMaps; payloads are well-formed and land in the

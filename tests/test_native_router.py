@@ -1179,3 +1179,381 @@ def test_native_adapter_config_file(binary, tmp_path):
         proc.terminate()
         proc.wait(timeout=5)
         backend.shutdown()
+
+# ---------------------------------------------------------------------------
+# Zero-drop streams: journal + splice, hedging, truncation (PR 9)
+# ---------------------------------------------------------------------------
+
+RESUME_TOKENS = list(range(101, 109))  # 8 tokens
+
+
+def _tok_text(i: int) -> str:
+    return f"t{i} "
+
+
+RESUME_FULL_TEXT = "".join(_tok_text(i) for i in RESUME_TOKENS)
+
+
+def _resume_backend(name: str, fail: dict, arrivals=None):
+    """SSE completion backend speaking the router<->API resume protocol:
+    emits one content delta per token; with X-LLMK-Journal set, follows
+    each data event with a ``: llmk-tok <id>`` comment; honors
+    X-LLMK-Resume-Tokens by continuing after the prefix under the
+    original stream id (deterministic regeneration). `fail` is SHARED
+    across replicas: {"after": N, "mode": "before_comment"|"after_comment",
+    "done": False} kills the connection once, after N tokens, on
+    whichever replica the stream landed. `arrivals`, when given, is a
+    shared list; arrival order indexes `delays` for hedge tests."""
+
+    class ResumeBackend(FakeBackend):
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                body = {}
+            journaled = self.headers.get("X-LLMK-Journal") is not None
+            resume_raw = self.headers.get("X-LLMK-Resume-Tokens")
+            prefix = []
+            if resume_raw is not None and resume_raw.strip():
+                prefix = [int(x) for x in resume_raw.split(",") if x.strip()]
+            sid = (self.headers.get("X-LLMK-Resume-Stream-Id")
+                   or f"cmpl-{self.name}")
+            delay = 0
+            if arrivals is not None:
+                arrivals.append(self.name)
+                delay = (self.delays or [0])[
+                    min(len(arrivals) - 1, len(self.delays) - 1)]
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if delay:
+                    # stall the FIRST BODY BYTE (the head is already out):
+                    # this is what LLMK_HEDGE_MS races against
+                    time.sleep(delay)
+
+                def chunk(data: bytes):
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                    self.wfile.flush()
+
+                chunk(b": ping\n\n")  # keepalive comment: relayed verbatim
+                for pos in range(len(prefix), len(RESUME_TOKENS)):
+                    tok = RESUME_TOKENS[pos]
+                    ev = {"id": sid, "object": "chat.completion.chunk",
+                          "created": 1, "model": body.get("model", "m"),
+                          "choices": [{"index": 0,
+                                       "delta": {"content": _tok_text(tok)},
+                                       "finish_reason": None}]}
+                    chunk(f"data: {json.dumps(ev)}\n\n".encode())
+                    if (fail and not fail.get("done")
+                            and fail["mode"] == "before_comment"
+                            and pos + 1 >= fail["after"]):
+                        fail["done"] = True
+                        self._die()
+                        return
+                    if journaled:
+                        chunk(f": llmk-tok {tok}\n\n".encode())
+                    if (fail and not fail.get("done")
+                            and fail["mode"] == "after_comment"
+                            and pos + 1 >= fail["after"]):
+                        fail["done"] = True
+                        self._die()
+                        return
+                fin = {"id": sid, "object": "chat.completion.chunk",
+                       "created": 1, "model": body.get("model", "m"),
+                       "choices": [{"index": 0, "delta": {},
+                                    "finish_reason": "stop"}]}
+                chunk(f"data: {json.dumps(fin)}\n\n".encode())
+                chunk(b"data: [DONE]\n\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True  # hedge loser: router hung up
+
+        def _die(self):
+            # abrupt mid-chunked-stream FIN (no terminal chunk): incomplete
+            # framing is a transport death to the router, and unlike an RST
+            # a FIN never discards bytes already queued to the peer
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    return type(f"ResumeBackend_{name}", (ResumeBackend,),
+                {"name": name, "delays": None})
+
+
+def _start_resume_backend(name, fail, arrivals=None, delays=None):
+    handler = _resume_backend(name, fail, arrivals)
+    if delays is not None:
+        handler.delays = delays
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _stream_completion(port, timeout=15, model="m"):
+    """POST a streaming completion through the router; returns the decoded
+    SSE body (http.client de-chunks, so a terminal chunk must exist)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/chat/completions",
+                 body=json.dumps({"model": model, "stream": True}).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data.decode()
+
+
+def _sse_content(sse: str) -> str:
+    out = []
+    for line in sse.splitlines():
+        line = line.strip()
+        if not line.startswith("data:") or line == "data: [DONE]":
+            continue
+        doc = json.loads(line[5:].strip())
+        for ch in doc.get("choices", []):
+            c = (ch.get("delta") or {}).get("content")
+            if c:
+                out.append(c)
+    return "".join(out)
+
+
+def _assert_clean_stream(sse: str):
+    assert _sse_content(sse) == RESUME_FULL_TEXT
+    assert ": llmk-tok" not in sse      # journal comments never leak
+    assert ": ping" in sse              # other SSE comments relay verbatim
+    assert sse.count('"finish_reason": "stop"') == 1
+    assert sse.rstrip().endswith("data: [DONE]")
+    ids = {json.loads(l[5:].strip())["id"] for l in sse.splitlines()
+           if l.strip().startswith("data:") and l.strip() != "data: [DONE]"}
+    assert len(ids) == 1, ids           # one stream identity across the splice
+
+
+@pytest.mark.parametrize("mode", ["after_comment", "before_comment"])
+def test_native_mid_stream_death_resumes_on_other_replica(binary, mode):
+    """An upstream killed mid-stream (after/before its journal comment) is
+    invisible to the client: the router splices a continuation from the
+    sibling replica — before_comment also exercises the echo trim (text
+    delivered past the last journaled token is regenerated and dropped)."""
+    fail = {"after": 3, "mode": mode, "done": False}
+    s1 = _start_resume_backend("r1", fail)
+    s2 = _start_resume_backend("r2", fail)
+    router = RouterProc(
+        binary,
+        {"m": f"http://127.0.0.1:{s1.server_address[1]}"
+              f"|http://127.0.0.1:{s2.server_address[1]}"},
+        extra_args=("--breaker-threshold", "100"))
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        _assert_clean_stream(sse)
+        text = _metrics(router)
+        assert _metric_value(text,
+                             'llm_stream_resume_total{outcome="ok"}') == 1
+        assert _metric_value(
+            text, 'llm_stream_resume_total{outcome="gave_up"}') == 0
+        assert "llm_stream_truncated_total{" not in text
+    finally:
+        router.stop()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_native_death_after_finish_completes_without_resume(binary):
+    """A death after finish_reason was relayed (only [DONE] lost) is
+    completed by the router itself — no resume, no truncation."""
+    fail = {"after": 99, "mode": "after_finish", "done": False}
+
+    class FinishKiller(_resume_backend("fk", fail)):
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: bytes):
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                self.wfile.flush()
+
+            for tok in RESUME_TOKENS:
+                ev = {"id": "cmpl-fk", "created": 1,
+                      "choices": [{"index": 0,
+                                   "delta": {"content": _tok_text(tok)},
+                                   "finish_reason": None}]}
+                chunk(f"data: {json.dumps(ev)}\n\n".encode())
+                if self.headers.get("X-LLMK-Journal") is not None:
+                    chunk(f": llmk-tok {tok}\n\n".encode())
+            fin = {"id": "cmpl-fk", "created": 1,
+                   "choices": [{"index": 0, "delta": {},
+                                "finish_reason": "stop"}]}
+            chunk(f"data: {json.dumps(fin)}\n\n".encode())
+            self._die()  # [DONE] and the terminal chunk never arrive
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FinishKiller)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    router = RouterProc(binary, {"m": srv.server_address[1]},
+                        extra_args=("--breaker-threshold", "100"))
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        assert _sse_content(sse) == RESUME_FULL_TEXT
+        assert sse.rstrip().endswith("data: [DONE]")  # router-written
+        text = _metrics(router)
+        assert _metric_value(text,
+                             'llm_stream_resume_total{outcome="ok"}') == 0
+        assert "llm_stream_truncated_total{" not in text
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_resume_disabled_truncates_with_error_event(binary):
+    """--no-stream-resume: a mid-stream death ends the client stream with
+    an explicit SSE error event (finish_reason=upstream_lost) and bumps
+    llm_stream_truncated_total — never a silent EOF."""
+    fail = {"after": 3, "mode": "before_comment", "done": False}
+    srv = _start_resume_backend("solo", fail)
+    router = RouterProc(binary, {"m": srv.server_address[1]},
+                        extra_args=("--no-stream-resume",
+                                    "--breaker-threshold", "100"))
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        assert "event: error" in sse
+        assert '"finish_reason":"upstream_lost"' in sse.replace(" ", "")
+        assert '"code":"upstream_lost"' in sse.replace(" ", "")
+        text = _metrics(router)
+        assert _metric_value(text,
+                             'llm_stream_truncated_total{model="m"}') == 1
+        # resume disabled: the gave_up outcome is not counted
+        assert _metric_value(
+            text, 'llm_stream_resume_total{outcome="gave_up"}') == 0
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_resume_gave_up_when_attempts_exhausted(binary):
+    """--resume-attempts 0 with resume on: the death is journaled but no
+    re-issue is allowed — counted as gave_up AND truncated."""
+    fail = {"after": 3, "mode": "after_comment", "done": False}
+    srv = _start_resume_backend("solo", fail)
+    router = RouterProc(binary, {"m": srv.server_address[1]},
+                        extra_args=("--resume-attempts", "0",
+                                    "--breaker-threshold", "100"))
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        assert "event: error" in sse
+        text = _metrics(router)
+        assert _metric_value(
+            text, 'llm_stream_resume_total{outcome="gave_up"}') == 1
+        assert _metric_value(text,
+                             'llm_stream_truncated_total{model="m"}') == 1
+        assert _metric_value(text,
+                             'llm_stream_resume_total{outcome="ok"}') == 0
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_hedge_secondary_wins_when_primary_stalls(binary):
+    """LLMK-style hedging (--hedge-ms): the FIRST stream request to arrive
+    anywhere sleeps 2s before its first byte; the hedge launched after
+    50ms lands on the other replica (arrival #2, instant) and wins. The
+    client sees one complete stream; the loser is cancelled."""
+    arrivals = []
+    s1 = _start_resume_backend("h1", None, arrivals, delays=[2.0, 0, 0])
+    s2 = _start_resume_backend("h2", None, arrivals, delays=[2.0, 0, 0])
+    router = RouterProc(
+        binary,
+        {"m": f"http://127.0.0.1:{s1.server_address[1]}"
+              f"|http://127.0.0.1:{s2.server_address[1]}"},
+        extra_args=("--hedge-ms", "50", "--breaker-threshold", "100"))
+    try:
+        t0 = time.monotonic()
+        status, sse = _stream_completion(router.port)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert _sse_content(sse) == RESUME_FULL_TEXT
+        assert elapsed < 1.8, f"hedge should beat the 2s stall ({elapsed:.2f}s)"
+        assert len(arrivals) == 2 and arrivals[0] != arrivals[1]
+        text = _metrics(router)
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="hedge_won"}') == 1
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="primary_won"}') == 0
+    finally:
+        router.stop()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_native_hedge_primary_wins_when_faster(binary):
+    """Primary first byte at 0.3s (past the 50ms hedge trigger but well
+    ahead of the 2s secondary): the hedge launches, the primary wins, the
+    secondary is discarded — at most one stream reaches the client."""
+    arrivals = []
+    s1 = _start_resume_backend("h1", None, arrivals, delays=[0.3, 2.0, 0])
+    s2 = _start_resume_backend("h2", None, arrivals, delays=[0.3, 2.0, 0])
+    router = RouterProc(
+        binary,
+        {"m": f"http://127.0.0.1:{s1.server_address[1]}"
+              f"|http://127.0.0.1:{s2.server_address[1]}"},
+        extra_args=("--hedge-ms", "50", "--breaker-threshold", "100"))
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        assert _sse_content(sse) == RESUME_FULL_TEXT
+        assert len(arrivals) == 2
+        text = _metrics(router)
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="primary_won"}') == 1
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="hedge_won"}') == 0
+    finally:
+        router.stop()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_native_hedge_off_by_default(binary):
+    """Without --hedge-ms a slow first byte launches nothing."""
+    arrivals = []
+    srv = _start_resume_backend("h1", None, arrivals, delays=[0.3, 0])
+    router = RouterProc(binary, {"m": srv.server_address[1]})
+    try:
+        status, sse = _stream_completion(router.port)
+        assert status == 200
+        assert _sse_content(sse) == RESUME_FULL_TEXT
+        assert len(arrivals) == 1
+        text = _metrics(router)
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="primary_won"}') == 0
+        assert _metric_value(
+            text, 'llm_hedged_requests_total{outcome="hedge_won"}') == 0
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_native_stream_metrics_families_exposed(stack):
+    """The zero-drop stream counter families carry HELP/TYPE and zero
+    values from boot (dashboards and metrics_lint see them pre-traffic)."""
+    text = _metrics(stack)
+    for family in ("llm_stream_resume_total", "llm_hedged_requests_total",
+                   "llm_stream_truncated_total"):
+        assert f"# HELP {family} " in text, family
+        assert f"# TYPE {family} " in text, family
+    assert 'llm_stream_resume_total{outcome="ok"} 0' in text
+    assert 'llm_hedged_requests_total{outcome="hedge_won"} 0' in text

@@ -23,7 +23,9 @@ from pathlib import Path
 import pytest
 
 from conftest import free_port
-from test_native_router import start_backend
+from test_native_router import (RESUME_FULL_TEXT, _sse_content,
+                                _start_resume_backend, _stream_completion,
+                                start_backend)
 
 REPO = Path(__file__).resolve().parent.parent
 ROUTER_DIR = REPO / "native" / "router"
@@ -251,6 +253,105 @@ def _drive(binary: Path):
         assert "ERROR: " not in (fo_err or ""), fo_err[-3000:]
         assert "runtime error:" not in (fo_err or ""), fo_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (fo_err or ""), fo_err[-3000:]
+
+        # kill-mid-stream + resume splice under the sanitizer: the journal
+        # parser, re-framing relay and resume re-issue allocate per-line
+        # buffers and share breaker/health state across the death — with
+        # several concurrent streams this is the hottest new TSan surface
+        fail = {"after": 3, "mode": "before_comment", "done": False}
+        rb1 = _start_resume_backend("san-r1", fail)
+        rb2 = _start_resume_backend("san-r2", fail)
+        rs_port = free_port()
+        rs = subprocess.Popen(
+            [str(binary), "--models",
+             f"sanmodel=http://127.0.0.1:{rb1.server_address[1]}"
+             f"|http://127.0.0.1:{rb2.server_address[1]}",
+             "--port", str(rs_port), "--quiet",
+             "--breaker-threshold", "100"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", rs_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                for status, sse in pool.map(
+                        lambda _: _stream_completion(rs_port), range(4)):
+                    assert status == 200
+                    assert _sse_content(sse) == RESUME_FULL_TEXT
+            assert fail["done"], "the one-shot mid-stream kill never fired"
+        finally:
+            rs.terminate()
+            try:
+                _, rs_err = rs.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                rs.kill()
+                _, rs_err = rs.communicate()
+            rb1.shutdown()
+            rb2.shutdown()
+        assert "ERROR: " not in (rs_err or ""), rs_err[-3000:]
+        assert "runtime error:" not in (rs_err or ""), rs_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (rs_err or ""), rs_err[-3000:]
+
+        # truncation (resume disabled) and hedged-request paths: the SSE
+        # error-event builder and the poll()-based first-byte race each
+        # manage a second upstream socket lifetime worth sanitizing
+        fail2 = {"after": 3, "mode": "after_comment", "done": False}
+        tb = _start_resume_backend("san-t", fail2)
+        arrivals = []
+        hb1 = _start_resume_backend("san-h1", None, arrivals,
+                                    delays=[2.0, 0, 0])
+        hb2 = _start_resume_backend("san-h2", None, arrivals,
+                                    delays=[2.0, 0, 0])
+        th_port = free_port()
+        th = subprocess.Popen(
+            [str(binary), "--models",
+             f"truncmodel=http://127.0.0.1:{tb.server_address[1]}",
+             f"hedgemodel=http://127.0.0.1:{hb1.server_address[1]}"
+             f"|http://127.0.0.1:{hb2.server_address[1]}",
+             "--port", str(th_port), "--quiet", "--no-stream-resume",
+             "--hedge-ms", "50", "--breaker-threshold", "100"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", th_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            status, sse = _stream_completion(th_port, model="truncmodel")
+            assert status == 200
+            assert "event: error" in sse, sse[-500:]
+            status, sse = _stream_completion(th_port, model="hedgemodel")
+            assert status == 200
+            assert _sse_content(sse) == RESUME_FULL_TEXT
+            assert len(arrivals) == 2, arrivals
+            time.sleep(0.3)   # let the hedge loser thread unwind its socket
+        finally:
+            th.terminate()
+            try:
+                _, th_err = th.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                th.kill()
+                _, th_err = th.communicate()
+            tb.shutdown()
+            hb1.shutdown()
+            hb2.shutdown()
+        assert "ERROR: " not in (th_err or ""), th_err[-3000:]
+        assert "runtime error:" not in (th_err or ""), th_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (th_err or ""), th_err[-3000:]
 
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")
