@@ -180,6 +180,7 @@ def main(argv: list[str] | None = None) -> int:
         # None = let Router fall back to the LLMK_STREAM_RESUME /
         # LLMK_RESUME_ATTEMPTS / LLMK_HEDGE_MS env knobs
         stream_resume = resume_attempts = hedge_ms = None
+        qos = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -195,6 +196,8 @@ def main(argv: list[str] | None = None) -> int:
                 resume_attempts = int(cfg["resume_attempts"])
             if "hedge_ms" in cfg:
                 hedge_ms = float(cfg["hedge_ms"])
+            if "qos" in cfg:
+                qos = cfg["qos"]  # per-tenant QoS block, passed verbatim
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -215,7 +218,8 @@ def main(argv: list[str] | None = None) -> int:
                    probe_interval_s=probe_interval or None,
                    adapters=adapters or None,
                    stream_resume=stream_resume,
-                   resume_attempts=resume_attempts, hedge_ms=hedge_ms)
+                   resume_attempts=resume_attempts, hedge_ms=hedge_ms,
+                   qos=qos)
         return 0
 
     # serve
@@ -324,6 +328,27 @@ def main(argv: list[str] | None = None) -> int:
             parser.error(f"--adapter must be NAME=REF, got {spec!r}")
         adapters[name] = ref
 
+    # LLMK_QOS: engine-side fair-queue config as JSON, e.g.
+    # {"weights": {"alice": 4}, "priorities": {"bulk": "batch"},
+    #  "default_priority": "normal", "starvation_s": 5} — env (not a flag)
+    # so the chart can feed one ConfigMap value to every model pod
+    qos_kw = {}
+    raw_qos = os.environ.get("LLMK_QOS", "").strip()
+    if raw_qos:
+        import json
+
+        try:
+            q = json.loads(raw_qos)
+        except ValueError as e:
+            raise SystemExit(f"[serve] LLMK_QOS is not valid JSON: {e}")
+        qos_kw = dict(
+            qos_weights=q.get("weights", ()),
+            qos_priorities=q.get("priorities", ()),
+            qos_default_weight=float(q.get("default_weight", 1.0)),
+            qos_default_priority=str(q.get("default_priority", "normal")),
+            qos_starvation_s=float(q.get("starvation_s", 5.0)),
+        )
+
     engine_cfg = EngineConfig(
         model=model_cfg.name,
         dtype=args.dtype,
@@ -342,6 +367,7 @@ def main(argv: list[str] | None = None) -> int:
         adapter_rank=args.adapter_rank,
         # only the coordinator schedules; its engine broadcasts step inputs
         multihost=multi_host,
+        **qos_kw,
     )
     gguf_params = None
     if gguf_file is not None and not args.random_weights:

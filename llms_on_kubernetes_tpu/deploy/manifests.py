@@ -501,6 +501,10 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         # base:adapter requests resolve at the gateway; unknown adapters
         # of a known base 404 instead of falling back to the base model
         cfg["adapters"] = adapters
+    if spec.qos is not None:
+        # per-tenant QoS (ISSUE 10): fair shares, rate limits, brownout —
+        # identical wire keys for both router implementations
+        cfg["qos"] = spec.qos.to_wire()
     return cfg
 
 

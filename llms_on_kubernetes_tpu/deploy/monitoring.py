@@ -242,6 +242,35 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKTenantStarvation",
+                        # the fair scheduler's whole contract: no tenant
+                        # waits unboundedly while peers are served. p99
+                        # admission wait far beyond the starvation-aging
+                        # threshold (qos_starvation_s, default 5s) means
+                        # weights/priorities are misconfigured or the
+                        # fleet is undersized for the admitted mix
+                        "expr": (
+                            "histogram_quantile(0.99, sum by (le, tenant)"
+                            " (rate("
+                            "llm_tenant_queue_wait_seconds_bucket[5m])))"
+                            " > 30"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "tenant starving in the "
+                                       "admission queue",
+                            "description": (
+                                "Tenant {{ $labels.tenant }} has a p99 "
+                                "queue wait above 30s for 10m while the "
+                                "engine keeps admitting; its fair-share "
+                                "weight is too small for its load, or "
+                                "higher-priority traffic plus brownout "
+                                "shedding is not relieving pressure."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -340,6 +369,18 @@ def grafana_dashboard() -> dict[str, Any]:
                ["rate(llm_stream_resume_total[5m])",
                 "rate(llm_hedged_requests_total[5m])",
                 "rate(llm_stream_truncated_total[5m])"], 12, 56),
+        _panel(17, "QoS: shed by priority (gateway + engine)",
+               ["sum by (priority) "
+                "(rate(llm_tenant_router_shed_total[5m]))",
+                "sum by (priority) (rate(llm_tenant_shed_total[5m]))",
+                "sum by (priority) "
+                "(rate(llm_tenant_degraded_total[5m]))"], 0, 64),
+        _panel(18, "QoS: per-tenant queue wait p95 / admissions",
+               ["histogram_quantile(0.95, sum by (le, tenant) "
+                "(rate(llm_tenant_queue_wait_seconds_bucket[5m])))",
+                "sum by (tenant) "
+                "(rate(llm_tenant_admitted_total[5m]))"], 12, 64,
+               unit="s"),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

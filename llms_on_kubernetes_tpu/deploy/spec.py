@@ -137,6 +137,98 @@ class AutoscalingSpec:
                 f"in (0, 1], got {self.ttft_ok_ratio_floor}")
 
 
+_QOS_PRIORITIES = ("interactive", "normal", "batch")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQoSSpec:
+    """One tenant's QoS contract (an entry under ``qos.tenants``, or the
+    ``qos.default`` applied to unlisted tenants). Keys are the router.json
+    wire names — the block is passed to both routers verbatim."""
+
+    weight: float = 1.0            # DRR share inside its priority class
+    priority: Optional[str] = None  # interactive | normal | batch
+    rps: float = 0.0               # requests/s bucket; 0 = unlimited
+    burst: float = 0.0             # rps bucket capacity; 0 = derived
+    tokens_per_min: float = 0.0    # generated-token budget; 0 = unlimited
+
+    def validate(self, label: str) -> None:
+        if self.priority is not None and self.priority not in _QOS_PRIORITIES:
+            raise SpecError(
+                f"qos {label}: priority must be one of {_QOS_PRIORITIES}, "
+                f"got {self.priority!r}")
+        if self.weight <= 0:
+            raise SpecError(f"qos {label}: weight must be > 0")
+        for k in ("rps", "burst", "tokens_per_min"):
+            if getattr(self, k) < 0:
+                raise SpecError(f"qos {label}: {k} must be >= 0")
+
+    def to_wire(self) -> dict:
+        out: dict = {}
+        if self.weight != 1.0:
+            out["weight"] = self.weight
+        if self.priority is not None:
+            out["priority"] = self.priority
+        for k in ("rps", "burst", "tokens_per_min"):
+            v = getattr(self, k)
+            if v:
+                out[k] = v
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSSpec:
+    """The gateway-level QoS block (``qos:``): per-tenant weighted fair
+    shares + token-bucket rate limits, and the adaptive brownout ladder.
+    Rendered verbatim into router.json — the python and native routers
+    parse identical keys (tests/data/qos_vectors.json is the semantics
+    contract between them)."""
+
+    tenants: tuple[tuple[str, TenantQoSSpec], ...] = ()
+    default: Optional[TenantQoSSpec] = None
+    # brownout signals: 0 disables a signal entirely
+    has_brownout: bool = False
+    queue_depth_hi: float = 0.0
+    burn_rate_hi: float = 0.0
+    clamp_max_tokens: int = 64
+    # the values.yaml block as given; to_wire() emits it verbatim so the
+    # Python renderer and the Go template (`toJson .Values.qos`) produce
+    # byte-identical router.json qos blocks (field-level parity tests)
+    raw: Optional[dict] = None
+
+    def validate(self) -> None:
+        for name, t in self.tenants:
+            if not name:
+                raise SpecError("qos.tenants: tenant names must be "
+                                "non-empty strings")
+            t.validate(f"tenant {name!r}")
+        if self.default is not None:
+            self.default.validate("default")
+        if self.queue_depth_hi < 0 or self.burn_rate_hi < 0:
+            raise SpecError("qos.brownout thresholds must be >= 0")
+        if self.clamp_max_tokens < 1:
+            raise SpecError("qos.brownout.clamp_max_tokens must be >= 1")
+
+    def to_wire(self) -> dict:
+        if self.raw is not None:
+            return self.raw  # callers serialize, never mutate
+        out: dict = {}
+        if self.tenants:
+            out["tenants"] = {n: t.to_wire() for n, t in self.tenants}
+        if self.default is not None:
+            out["default"] = self.default.to_wire()
+        if self.has_brownout:
+            b: dict = {}
+            if self.queue_depth_hi:
+                b["queue_depth_hi"] = self.queue_depth_hi
+            if self.burn_rate_hi:
+                b["burn_rate_hi"] = self.burn_rate_hi
+            if self.clamp_max_tokens != 64:
+                b["clamp_max_tokens"] = self.clamp_max_tokens
+            out["brownout"] = b
+        return out
+
+
 @dataclasses.dataclass(frozen=True)
 class AdapterSpec:
     """One LoRA adapter a model's replicas serve (multi-tenant serving):
@@ -286,6 +378,8 @@ class DeploySpec:
     stream_resume: bool = True
     resume_attempts: int = 2
     hedge_ms: float = 0.0
+    # per-tenant QoS at the gateway (ISSUE 10); None = QoS disabled
+    qos: Optional[QoSSpec] = None
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -314,6 +408,8 @@ class DeploySpec:
         if self.hedge_ms < 0:
             raise SpecError(
                 f"router.hedgeMs must be >= 0, got {self.hedge_ms}")
+        if self.qos is not None:
+            self.qos.validate()
 
     @property
     def resolved_default(self) -> str:
@@ -366,6 +462,58 @@ def _autoscaling_from(d: Optional[dict], model_name: str) \
         max_replicas=int(d.get("maxReplicas", 4)),
         queue_depth_target=int(d.get("queueDepthTarget", 8)),
         ttft_ok_ratio_floor=float(d.get("ttftOkRatioFloor", 0.95)),
+    )
+
+
+def _tenant_qos_from(d, label: str) -> TenantQoSSpec:
+    if not isinstance(d, dict):
+        raise SpecError(f"qos {label}: must be a mapping")
+    unknown = set(d) - {"weight", "priority", "rps", "burst",
+                        "tokens_per_min"}
+    if unknown:
+        raise SpecError(f"qos {label}: unknown keys: {sorted(unknown)}")
+    return TenantQoSSpec(
+        weight=float(d.get("weight", 1.0)),
+        priority=d.get("priority"),
+        rps=float(d.get("rps", 0.0)),
+        burst=float(d.get("burst", 0.0)),
+        tokens_per_min=float(d.get("tokens_per_min", 0.0)),
+    )
+
+
+def _qos_from(d: Optional[dict]) -> Optional[QoSSpec]:
+    if not d:
+        # absent OR empty block = disabled (matches both routers'
+        # truthiness: empty tenants/default/brownout do not enable QoS)
+        return None
+    if not isinstance(d, dict):
+        raise SpecError("qos must be a mapping")
+    unknown = set(d) - {"tenants", "default", "brownout"}
+    if unknown:
+        raise SpecError(f"unknown qos keys: {sorted(unknown)}")
+    tenants_raw = d.get("tenants") or {}
+    if not isinstance(tenants_raw, dict):
+        raise SpecError("qos.tenants must be a mapping of tenant -> entry")
+    brownout = d.get("brownout")
+    if brownout is not None and not isinstance(brownout, dict):
+        raise SpecError("qos.brownout must be a mapping")
+    if brownout:
+        unknown = set(brownout) - {"queue_depth_hi", "burn_rate_hi",
+                                   "clamp_max_tokens"}
+        if unknown:
+            raise SpecError(f"unknown qos.brownout keys: {sorted(unknown)}")
+    b = brownout or {}
+    return QoSSpec(
+        tenants=tuple(sorted(
+            (str(n), _tenant_qos_from(t, f"tenant {n!r}"))
+            for n, t in tenants_raw.items())),
+        default=(_tenant_qos_from(d["default"], "default")
+                 if d.get("default") else None),
+        has_brownout=bool(brownout),
+        queue_depth_hi=float(b.get("queue_depth_hi", 0.0)),
+        burn_rate_hi=float(b.get("burn_rate_hi", 0.0)),
+        clamp_max_tokens=int(b.get("clamp_max_tokens", 64)),
+        raw=d,
     )
 
 
@@ -469,6 +617,7 @@ def load_spec(source: "str | dict") -> DeploySpec:
         resume_attempts=int(
             (data.get("router") or {}).get("resumeAttempts", 2)),
         hedge_ms=float((data.get("router") or {}).get("hedgeMs", 0.0)),
+        qos=_qos_from(data.get("qos")),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -37,6 +37,9 @@ import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
 from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
+from llms_on_kubernetes_tpu.engine.qos import (
+    TenantFairQueue, normalize_priority, priority_rank,
+)
 from llms_on_kubernetes_tpu.engine.sampling import (
     MAX_CANDIDATES, HostSample, sample,
 )
@@ -213,6 +216,20 @@ class EngineConfig:
     # (same PRNG positions, same penalty-count evolution) — pinned by
     # tests/test_decode_multistep.py.
     decode_steps: Optional[int] = None
+    # per-tenant QoS (engine/qos.py): admission runs deficit-weighted fair
+    # queuing across tenants inside strict priority classes. qos_weights /
+    # qos_priorities are (tenant, value) pairs (dicts normalize); unlisted
+    # tenants get qos_default_weight / qos_default_priority. Starvation
+    # aging: a lower-class head waiting > qos_starvation_s is served ahead
+    # of higher classes (<= 0 disables). With no tenants configured and no
+    # tenant-tagged submissions the queue degenerates to FIFO — every
+    # request lands in the one default bucket — so single-tenant serving
+    # (and K=1/K=4 decode parity) is byte-identical to the old deque.
+    qos_weights: tuple = ()
+    qos_priorities: tuple = ()
+    qos_default_weight: float = 1.0
+    qos_default_priority: str = "normal"
+    qos_starvation_s: float = 5.0
     seed: int = 0
 
     def __post_init__(self):
@@ -277,6 +294,35 @@ class EngineConfig:
                 raise ValueError(
                     f"adapter_rank must be >= 1, got {self.adapter_rank}")
         self.adapter_targets = tuple(self.adapter_targets)
+        # normalize the QoS maps to sorted (tenant, value) pairs, same
+        # convention as adapters (hashable config, deterministic order)
+        from llms_on_kubernetes_tpu.engine.qos import MIN_WEIGHT, PRIORITIES
+        if isinstance(self.qos_weights, dict):
+            self.qos_weights = tuple(sorted(self.qos_weights.items()))
+        self.qos_weights = tuple(
+            (str(t), float(w)) for t, w in self.qos_weights)
+        for tenant, w in self.qos_weights:
+            if w < MIN_WEIGHT:
+                raise ValueError(
+                    f"qos weight for tenant {tenant!r} must be >= "
+                    f"{MIN_WEIGHT}, got {w}")
+        if isinstance(self.qos_priorities, dict):
+            self.qos_priorities = tuple(sorted(self.qos_priorities.items()))
+        self.qos_priorities = tuple(
+            (str(t), str(p)) for t, p in self.qos_priorities)
+        for tenant, p in self.qos_priorities:
+            if p not in PRIORITIES:
+                raise ValueError(
+                    f"qos priority for tenant {tenant!r} must be one of "
+                    f"{PRIORITIES}, got {p!r}")
+        if self.qos_default_priority not in PRIORITIES:
+            raise ValueError(
+                f"qos_default_priority must be one of {PRIORITIES}, got "
+                f"{self.qos_default_priority!r}")
+        if self.qos_default_weight < MIN_WEIGHT:
+            raise ValueError(
+                f"qos_default_weight must be >= {MIN_WEIGHT}, got "
+                f"{self.qos_default_weight}")
 
     @property
     def max_model_len(self) -> int:
@@ -327,6 +373,12 @@ class Request:
     # until admission acquires one; released at finish/preemption)
     adapter: Optional[str] = None
     adapter_slot: int = -1
+    # per-tenant QoS: the fair-queue bucket this request bills to ("" =
+    # the shared default bucket) and its resolved priority class — both
+    # fixed at submit; the queue keys on them and preemption prefers
+    # lower-priority victims
+    tenant: str = ""
+    priority: str = "normal"
     finished: bool = False
     finish_reason: Optional[str] = None
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
@@ -1105,7 +1157,20 @@ class Engine:
         )
         self.slots: list[Optional[Request]] = [None] * B
         self.slot_len = np.zeros((B,), np.int64)  # tokens whose KV is cached
-        self.waiting: "collections.deque[Request]" = collections.deque()
+        # per-tenant fair admission (engine/qos.py): priority classes +
+        # deficit round-robin keyed by Request.tenant; deque-compatible
+        # for every scheduler call site (peek/popleft/appendleft/...)
+        self.waiting: TenantFairQueue = TenantFairQueue(
+            weights=dict(engine_config.qos_weights),
+            default_weight=engine_config.qos_default_weight,
+            starvation_s=engine_config.qos_starvation_s,
+        )
+        # per-tenant admission accounting, drained by the serving loop
+        # into llm_tenant_* series: total admitted per (tenant, priority)
+        # and (tenant, queue-wait seconds, priority) observations
+        self.tenant_admitted: "collections.Counter" = collections.Counter()
+        self.tenant_wait_obs: "collections.deque" = collections.deque(
+            maxlen=4096)
         self._key = jax.random.key(engine_config.seed)
         self._id_counter = iter(range(2 ** 62))
         self._seed_rng = np.random.default_rng(engine_config.seed)
@@ -1341,6 +1406,8 @@ class Engine:
         images=None,
         deadline: Optional[float] = None,
         adapter: Optional[str] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Request:
         if self.wedged:
             raise EngineStallError(
@@ -1456,12 +1523,21 @@ class Engine:
                 list(prompt), self.model_config.image_token_id,
                 self.model_config.vision.mm_tokens_per_image,
                 grids=self._mm_grids(images))
+        # QoS identity: the fair-queue bucket ("" = shared default) and the
+        # priority class, resolved submit arg > per-tenant config > default
+        tenant = str(tenant) if tenant else ""
+        if priority is None:
+            priority = dict(self.config.qos_priorities).get(
+                tenant, self.config.qos_default_priority)
+        priority = normalize_priority(
+            priority, self.config.qos_default_priority)
         req = Request(
             id=request_id or f"req-{next(self._id_counter)}",
             prompt=list(prompt), params=params, seed=seed, images=images,
             mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
             deadline=deadline, adapter=adapter,
+            tenant=tenant, priority=priority,
             # a non-empty output at submit makes admission take the
             # resumed re-prefill path (prompt + output), continuing the
             # stream exactly where the prefix left off; logprob data for
@@ -2114,6 +2190,7 @@ class Engine:
         self.allocator.allocate(slot, n + 1)
         if hit:
             self.allocator.commit_adopt(slot, hit)
+        self._note_admission(req)
         self.slots[slot] = req
         req.slot = slot
         if resumed and req.fsm_row >= 0:
@@ -2247,13 +2324,29 @@ class Engine:
         self._pending_first = []
         return events
 
+    def _note_admission(self, req: Request) -> None:
+        """Per-tenant admission accounting, recorded where a request takes
+        its slot. Only FIRST admissions count (admitted_at is still None;
+        a preemption round trip is not new tenant throughput) — the
+        serving loop drains these into the llm_tenant_* series."""
+        if req.admitted_at is not None:
+            return
+        self.tenant_admitted[(req.tenant, req.priority)] += 1
+        self.tenant_wait_obs.append(
+            (req.tenant, time.monotonic() - req.submitted_at, req.priority))
+
     def _preempt_youngest(self) -> None:
-        """Free the most recently admitted request's pages; requeue it to
-        re-prefill (prompt + generated so far) when memory frees up."""
+        """Free a victim's pages; requeue it to re-prefill (prompt +
+        generated so far) when memory frees up. Victim selection is
+        priority-aware: the lowest class sheds first (batch before normal
+        before interactive), youngest submission breaking ties — KV
+        pressure lands on the traffic the operator marked preemptible
+        before it ever touches interactive streams."""
         victims = [r for r in self.slots if r is not None]
         if not victims:
             raise MemoryError("KV pool exhausted with no preemptable request")
-        victim = max(victims, key=lambda r: r.submitted_at)
+        victim = max(victims,
+                     key=lambda r: (priority_rank(r.priority), r.submitted_at))
         self.preemptions += 1
         if victim.trace is not None:
             victim.trace.event("preempted", request=victim.id,
@@ -2464,6 +2557,7 @@ class Engine:
                     self.allocator.allocate(slot, n + 1)
                     if hit:
                         self.allocator.commit_adopt(slot, hit)
+                    self._note_admission(req)
                     self.slots[slot] = req
                     req.slot = slot
                     if resumed and req.fsm_row >= 0:
@@ -2477,6 +2571,7 @@ class Engine:
                     break  # wait for pages to free up
                 self.waiting.popleft()
                 self.allocator.allocate(slot, n + 1)
+                self._note_admission(req)
                 self.slots[slot] = req
                 req.slot = slot
                 if resumed and req.fsm_row >= 0:

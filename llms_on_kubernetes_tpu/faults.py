@@ -50,8 +50,16 @@ Known fault names (each documented at its injection site):
   with several in-process replicas sharing the env (tests, bench),
   exactly ONE is preempted — the point is proving the survivors absorb
   its traffic with zero dropped streams.
+- ``overload_spike[:LEVEL]`` — the Python router's QoS gate treats the
+  gateway as already at brownout level LEVEL (default 2, clamped 0..3)
+  regardless of the real queue-depth/burn-rate signals, so the
+  shed-lowest-priority-first ladder is testable without generating real
+  overload. See ``server/qos.py`` for the level -> action table.
 
-Routers do not read ``LLMK_FAULT``; their faults (connection resets,
+Routers do not read ``LLMK_FAULT``, with one documented exception:
+``overload_spike`` above, a brownout-ladder hook for the Python router
+only (the native router's overload behavior is exercised through real
+config-driven thresholds). All other router faults (connection resets,
 stalled responses) are injected by the fake upstream backends in the test
 fixtures, which is both more deterministic and closer to the real failure.
 """

@@ -351,6 +351,26 @@ def engine_metrics(registry: Registry) -> dict:
             "accounting; a high rate vs llm_tokens_generated_total means "
             "decode_steps is oversized for typical generations)",
             registry),
+        "tenant_admitted": Counter(
+            "llm_tenant_admitted_total",
+            "Requests admitted into a decode slot, by fair-queue tenant "
+            "and priority class (first admissions only; a preemption "
+            "round trip is not new throughput)",
+            registry, label_names=("tenant", "priority")),
+        "tenant_queue_wait": Histogram(
+            "llm_tenant_queue_wait_seconds",
+            "Submit-to-admission wait per fair-queue tenant — the "
+            "fairness signal (one tenant's p99 diverging from the rest "
+            "means its weight/priority is starving it)",
+            (0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0),
+            registry, label_names=("tenant",)),
+        "tenant_shed": Counter(
+            "llm_tenant_shed_total",
+            "Requests refused with 429 by tenant, priority, and reason "
+            "(overloaded = queue-depth backpressure / brownout, "
+            "rate_limited = the tenant's own token-bucket limits)",
+            registry, label_names=("tenant", "priority", "reason")),
     }
 
 
@@ -456,4 +476,26 @@ def router_metrics(registry: Registry) -> dict:
             "client got a final SSE error event "
             "(finish_reason=upstream_lost) and a closed stream",
             registry, label_names=("model",)),
+        "tenant_requests": Counter(
+            "llm_tenant_requests_total",
+            "Proxied requests by QoS tenant and resolved priority class "
+            "(counted at the gateway before rate-limit/brownout checks)",
+            registry, label_names=("tenant", "priority")),
+        "tenant_router_shed": Counter(
+            "llm_tenant_router_shed_total",
+            "Requests the gateway refused with 429, by tenant, priority, "
+            "and reason (rate_limited = the tenant's token buckets, "
+            "overloaded = the adaptive brownout ladder)",
+            registry, label_names=("tenant", "priority", "reason")),
+        "tenant_tokens": Counter(
+            "llm_tenant_tokens_total",
+            "Generated-token budget charged per tenant at admission "
+            "(max_tokens or the default charge — what the "
+            "tokens-per-minute bucket meters)",
+            registry, label_names=("tenant",)),
+        "tenant_degraded": Counter(
+            "llm_tenant_degraded_total",
+            "Requests admitted in degraded mode under brownout (clamped "
+            "max_tokens, hedging disabled), by tenant and priority",
+            registry, label_names=("tenant", "priority")),
     }

@@ -23,6 +23,7 @@ aiohttp event loop never blocks on device work.
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import json
 import threading
@@ -39,6 +40,9 @@ from llms_on_kubernetes_tpu.server.metrics import (
     Registry, build_info_metrics, cold_start, engine_metrics,
 )
 from llms_on_kubernetes_tpu.server.profiling import ProfileManager
+from llms_on_kubernetes_tpu.server.qos import (
+    PRIORITIES, PRIORITY_HEADER, retry_after_s, tenant_of,
+)
 from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
 # Stream-resume protocol headers (canonical definitions and the
 # comment-after-data splice invariant are documented at server/router.py):
@@ -124,6 +128,8 @@ class EngineLoop(threading.Thread):
         self._preempt_seen = 0
         self._early_exit_seen = 0
         self._adapter_seen = {"hits": 0, "misses": 0, "evictions": 0}
+        self._tenant_admitted_seen: "collections.Counter" = (
+            collections.Counter())
         self._shed_total = 0
 
     def _mlabel(self, r) -> str:
@@ -203,6 +209,20 @@ class EngineLoop(threading.Thread):
                     while steps_obs:
                         m["decode_steps_per_dispatch"].observe(
                             steps_obs.popleft())
+                admitted = getattr(eng, "tenant_admitted", None)
+                if admitted is not None:
+                    for key, v in list(admitted.items()):
+                        seen = self._tenant_admitted_seen[key]
+                        if v > seen:
+                            m["tenant_admitted"].labels(
+                                tenant=key[0], priority=key[1]).inc(v - seen)
+                            self._tenant_admitted_seen[key] = v
+                twobs = getattr(eng, "tenant_wait_obs", None)
+                if twobs is not None:
+                    while twobs:
+                        tenant, wait, _prio = twobs.popleft()
+                        m["tenant_queue_wait"].labels(
+                            tenant=tenant).observe(wait)
                 early_exit = getattr(eng, "early_exit_steps", 0)
                 if early_exit > self._early_exit_seen:
                     m["decode_early_exit"].inc(
@@ -1347,6 +1367,16 @@ class OpenAIServer:
                 params = dataclasses.replace(params, prefix_tokens=prefix)
         stops = _parse_stops(body)
         adapter = _adapter_from_model(body.get("model"))
+        # per-tenant QoS identity (mirrors the router's resolution): the
+        # body's `user` else the requested model string. The priority
+        # header is the router's RESOLVED value (it strips the client's);
+        # direct clients may set it too — invalid values fall through to
+        # the engine's per-tenant config/default.
+        tenant = tenant_of(body, self.model_name)
+        raw_prio = request.headers.get(PRIORITY_HEADER)
+        priority = (raw_prio.strip().lower()
+                    if raw_prio is not None
+                    and raw_prio.strip().lower() in PRIORITIES else None)
         # best_of choices per prompt (prompt-major choice order, per
         # OpenAI); usage counts each UNIQUE prompt once, not n times
         loop = asyncio.get_running_loop()
@@ -1368,7 +1398,7 @@ class OpenAIServer:
                     req = self.loop_thread.submit(
                         prompt_ids, p, on_event=_event_pusher(loop, q),
                         images=images, deadline=deadline, request_id=eng_id,
-                        adapter=adapter)
+                        adapter=adapter, tenant=tenant, priority=priority)
                     req.trace = trace
                     trace.engine_reqs.append(req)
                     req._aq = q
@@ -1395,12 +1425,22 @@ class OpenAIServer:
             # Retry-After from the actual backlog — queue depth times the
             # observed step time — so a saturated replica says "come back
             # when the queue has drained" instead of inviting a thundering
-            # herd at 1 s intervals
+            # herd at 1 s intervals. Shares the rate limiter's clamp
+            # (server/qos.py retry_after_s) but carries a DISTINCT error
+            # code: overloaded = the server's capacity, rate_limited = the
+            # tenant's own contract — clients back off differently.
             est = len(self.engine.waiting) * max(self.engine._est_step, 1e-3)
-            retry_after = max(1, min(60, int(est + 0.999)))
+            prio_label = priority or dict(
+                self.engine.config.qos_priorities).get(
+                    tenant, self.engine.config.qos_default_priority)
+            self.metrics["tenant_shed"].labels(
+                tenant=tenant, priority=prio_label,
+                reason="overloaded").inc()
             return web.json_response(
-                {"error": {"message": str(e), "type": "rate_limit_exceeded"}},
-                status=429, headers={"Retry-After": str(retry_after)})
+                {"error": {"message": str(e), "type": "rate_limit_exceeded",
+                           "code": "overloaded"}},
+                status=429,
+                headers={"Retry-After": str(retry_after_s(est))})
         except ValueError as e:
             for r in reqs:
                 self.loop_thread.abort(r)

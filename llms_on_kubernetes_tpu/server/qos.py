@@ -1,0 +1,369 @@
+"""Edge QoS: per-tenant rate limits, priority resolution, brownout ladder.
+
+This module is the **executable spec** for the gateway-side QoS semantics
+(ISSUE 10). The Python router imports it directly; the native router
+(native/router/router.cpp) re-implements the same functions in C++ and the
+two are held byte-compatible by the shared test vectors in
+``tests/data/qos_vectors.json`` (driven against this module by
+``tests/test_qos.py`` and against the C++ implementation by the router's
+``--qos-selftest`` mode).
+
+Semantics, in check order (both routers, identical):
+
+1. **Tenant identity**: the request body's ``user`` field (non-empty
+   string), else the requested ``model`` string verbatim (including the
+   ``base:adapter`` multi-tenant form), else the resolved default model.
+2. **Priority**: a valid ``X-LLMK-Priority`` header (interactive / normal
+   / batch, case-insensitive) wins; else the tenant's configured
+   priority; else the configured default ("normal"). The router strips
+   the client's header and forwards the RESOLVED value upstream, so the
+   engine's fair queue and the edge always agree.
+3. **Rate limits** (per tenant, token buckets): a requests-per-second
+   bucket and a generated-tokens-per-minute bucket charged with the
+   request's ``max_tokens`` (default charge 16 when unset). Over limit ->
+   429 with ``code=rate_limited`` and a Retry-After computed from the
+   bucket's actual refill deficit.
+4. **Brownout** (adaptive overload shedding): the brownout level is the
+   max of the queue-depth signal (total gateway in-flight vs
+   ``queue_depth_hi`` / 2x / 4x) and the SLO burn-rate signal
+   (``burn_rate_hi`` / 2x / 4x). Level 1 sheds batch; level 2 also
+   degrades normal (clamp ``max_tokens``, disable hedging); level 3 sheds
+   batch+normal and degrades interactive. Sheds are 429 with
+   ``code=overloaded`` and Retry-After ``min(60, 2**level)``.
+
+Both 429 paths (and the API server's queue-full 429) share one
+Retry-After clamp: ``max(1, min(60, ceil(seconds)))``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+from llms_on_kubernetes_tpu.engine.qos import (  # re-exported: one spelling
+    PRIORITIES, normalize_priority, priority_rank,
+)
+
+__all__ = [
+    "PRIORITIES", "PRIORITY_HEADER", "QoSConfig", "QoSGate", "TenantBuckets",
+    "TokenBucket", "brownout_action", "brownout_level",
+    "brownout_retry_after", "default_token_charge", "normalize_priority",
+    "priority_rank", "resolve_priority", "retry_after_s", "tenant_of",
+]
+
+PRIORITY_HEADER = "X-LLMK-Priority"
+
+# tokens-per-minute charge for a request that names no max_tokens: the
+# serving default is open-ended, but the bucket must charge something
+# deterministic (matching C++: qos_default_token_charge)
+DEFAULT_TOKEN_CHARGE = 16
+
+
+def default_token_charge(doc: Optional[dict]) -> int:
+    """The generated-tokens charge for one request: its ``max_tokens``
+    when that is a positive number, else DEFAULT_TOKEN_CHARGE."""
+    mt = (doc or {}).get("max_tokens")
+    if isinstance(mt, (int, float)) and not isinstance(mt, bool) and mt > 0:
+        return int(mt)
+    return DEFAULT_TOKEN_CHARGE
+
+
+def retry_after_s(seconds: float) -> int:
+    """The one shared Retry-After computation: whole seconds, never below
+    1 (clients would hot-loop) and never above 60 (a parked client should
+    re-probe within the SLO window). Used by the rate limiter, the
+    brownout shedder, and the API server's queue-full 429."""
+    return max(1, min(60, int(math.ceil(seconds))))
+
+
+def tenant_of(doc: Optional[dict], resolved_model: str) -> str:
+    """Tenant identity for fair queuing / rate limiting: body ``user``
+    (the OpenAI per-end-user field), else the REQUESTED model string
+    (so base:adapter tenants separate), else the resolved model."""
+    if doc:
+        user = doc.get("user")
+        if isinstance(user, str) and user:
+            return user
+        model = doc.get("model")
+        if isinstance(model, str) and model:
+            return model
+    return resolved_model
+
+
+def resolve_priority(header_value: Optional[str],
+                     tenant_priority: Optional[str],
+                     default_priority: str = "normal") -> str:
+    """Header (when valid) > tenant config > default. An INVALID header
+    falls through to the config — a typo must not silently grant or deny
+    priority."""
+    if header_value is not None:
+        p = header_value.strip().lower()
+        if p in PRIORITIES:
+            return p
+    if tenant_priority is not None:
+        p = str(tenant_priority).strip().lower()
+        if p in PRIORITIES:
+            return p
+    return normalize_priority(default_priority)
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+
+def _signal_level(value: float, hi: float) -> int:
+    """0..3 from one overload signal against its threshold: below hi = 0,
+    then one level per doubling (hi / 2*hi / 4*hi). hi <= 0 disables."""
+    if hi <= 0 or value < hi:
+        return 0
+    if value < 2 * hi:
+        return 1
+    if value < 4 * hi:
+        return 2
+    return 3
+
+
+def brownout_level(queue_depth: float, burn_rate: float,
+                   queue_depth_hi: float, burn_rate_hi: float) -> int:
+    """Overall brownout level: the worse of the two signals."""
+    return max(_signal_level(queue_depth, queue_depth_hi),
+               _signal_level(burn_rate, burn_rate_hi))
+
+
+def brownout_action(level: int, priority: str) -> str:
+    """"pass" | "degrade" | "shed" for one request at one level.
+
+    The ladder sheds lowest-priority first and degrades (clamped
+    max_tokens, no hedging) one class above the shed line before ever
+    touching interactive traffic:
+
+    =====  ============  =========  ========
+    level  interactive   normal     batch
+    =====  ============  =========  ========
+    0      pass          pass       pass
+    1      pass          pass       shed
+    2      pass          degrade    shed
+    3      degrade       shed       shed
+    =====  ============  =========  ========
+    """
+    rank = priority_rank(priority)
+    if level <= 0:
+        return "pass"
+    if level == 1:
+        return "shed" if rank == 2 else "pass"
+    if level == 2:
+        return ("shed" if rank == 2 else
+                "degrade" if rank == 1 else "pass")
+    return "degrade" if rank == 0 else "shed"
+
+
+def brownout_retry_after(level: int) -> int:
+    """Retry-After for a brownout shed: exponential in the level so
+    deeper overload parks clients longer (2/4/8 s), shared clamp."""
+    return retry_after_s(float(2 ** max(1, level)))
+
+
+# ---------------------------------------------------------------------------
+# token buckets
+# ---------------------------------------------------------------------------
+
+
+class TokenBucket:
+    """Classic token bucket with an injectable clock.
+
+    ``rate`` units refill per second up to ``burst``; ``take(n)`` returns
+    (allowed, retry_after_seconds). rate <= 0 means unlimited (always
+    allowed). The arithmetic is plain IEEE doubles in both
+    implementations, so the shared vectors exercise it with exactly
+    representable rates/times.
+    """
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.level = self.burst
+        self.clock = clock
+        self._last = clock()
+
+    def take(self, n: float = 1.0) -> tuple[bool, float]:
+        if self.rate <= 0:
+            return True, 0.0
+        now = self.clock()
+        self.level = min(self.burst, self.level + (now - self._last) * self.rate)
+        self._last = now
+        if self.level >= n:
+            self.level -= n
+            return True, 0.0
+        return False, (n - self.level) / self.rate
+
+
+class TenantBuckets:
+    """One tenant's pair of buckets: requests/s + generated-tokens/min."""
+
+    def __init__(self, rps: float, burst: float, tokens_per_min: float,
+                 clock=time.monotonic):
+        self.rps = TokenBucket(
+            rps, burst if burst > 0 else max(1.0, math.ceil(rps)), clock)
+        # the token budget refills continuously at tokens_per_min / 60 per
+        # second; capacity = one minute's allowance
+        self.tokens = TokenBucket(
+            tokens_per_min / 60.0 if tokens_per_min > 0 else 0.0,
+            tokens_per_min, clock)
+
+    def admit(self, token_charge: int) -> tuple[bool, str, float]:
+        """(allowed, which_bucket, retry_after_seconds). The request
+        bucket is charged first; the token bucket is only charged when
+        the request bucket admitted (a rate-limited request must not
+        also drain the token budget)."""
+        ok, wait = self.rps.take(1.0)
+        if not ok:
+            return False, "requests", wait
+        ok, wait = self.tokens.take(float(token_charge))
+        if not ok:
+            # refund the request-bucket charge: the request was never
+            # forwarded, so it must not count against rps either
+            self.rps.level = min(self.rps.burst, self.rps.level + 1.0)
+            return False, "tokens", wait
+        return True, "", 0.0
+
+
+# ---------------------------------------------------------------------------
+# config + gate
+# ---------------------------------------------------------------------------
+
+
+class QoSConfig:
+    """Parsed ``qos`` config block (the router.json shape; see
+    deploy/spec.py QoSSpec.to_router_config for the canonical renderer):
+
+    {
+      "tenants": {name: {"weight": f, "priority": s, "rps": f,
+                         "burst": f, "tokens_per_min": f}},
+      "default": {"weight": f, "priority": s, "rps": f, "burst": f,
+                  "tokens_per_min": f},
+      "brownout": {"queue_depth_hi": f, "burn_rate_hi": f,
+                   "clamp_max_tokens": i}
+    }
+
+    Every key is optional; a missing/empty block disables that feature
+    (no limits, no brownout). Unknown tenants use the ``default`` entry.
+    """
+
+    def __init__(self, raw: Optional[dict]):
+        raw = raw or {}
+        self.tenants: dict[str, dict] = {}
+        for name, entry in (raw.get("tenants") or {}).items():
+            if isinstance(entry, dict):
+                self.tenants[str(name)] = self._entry(entry)
+        self.default = self._entry(raw.get("default") or {})
+        brown = raw.get("brownout") or {}
+        self.queue_depth_hi = self._num(brown.get("queue_depth_hi"), 0.0)
+        self.burn_rate_hi = self._num(brown.get("burn_rate_hi"), 0.0)
+        self.clamp_max_tokens = int(
+            self._num(brown.get("clamp_max_tokens"), 64.0))
+        self.enabled = bool(
+            self.tenants or raw.get("default") or raw.get("brownout"))
+
+    @staticmethod
+    def _num(v, default: float) -> float:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        return default
+
+    @classmethod
+    def _entry(cls, e: dict) -> dict:
+        prio = e.get("priority")
+        return {
+            "weight": cls._num(e.get("weight"), 1.0),
+            "priority": (str(prio).strip().lower()
+                         if isinstance(prio, str)
+                         and str(prio).strip().lower() in PRIORITIES
+                         else None),
+            "rps": cls._num(e.get("rps"), 0.0),
+            "burst": cls._num(e.get("burst"), 0.0),
+            "tokens_per_min": cls._num(e.get("tokens_per_min"), 0.0),
+        }
+
+    def entry(self, tenant: str) -> dict:
+        return self.tenants.get(tenant, self.default)
+
+
+class Verdict:
+    """One admission decision."""
+
+    __slots__ = ("action", "reason", "retry_after", "message",
+                 "clamp_max_tokens")
+
+    def __init__(self, action: str = "pass", reason: str = "",
+                 retry_after: int = 0, message: str = "",
+                 clamp_max_tokens: Optional[int] = None):
+        self.action = action            # "pass" | "degrade" | "shed"
+        self.reason = reason            # "" | "rate_limited" | "overloaded"
+        self.retry_after = retry_after
+        self.message = message
+        self.clamp_max_tokens = clamp_max_tokens
+
+
+class QoSGate:
+    """The per-process QoS state: tenant buckets + brownout evaluation.
+
+    ``check`` is synchronous and lock-free under the aiohttp single event
+    loop; the native router guards the equivalent map with a mutex.
+    """
+
+    def __init__(self, config: Optional[dict], clock=time.monotonic):
+        self.config = QoSConfig(config)
+        self.clock = clock
+        self._buckets: dict[str, TenantBuckets] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    def resolve(self, doc: Optional[dict], resolved_model: str,
+                header_value: Optional[str]) -> tuple[str, str]:
+        """(tenant, priority) for one request."""
+        tenant = tenant_of(doc, resolved_model)
+        entry = self.config.entry(tenant)
+        priority = resolve_priority(
+            header_value, entry["priority"],
+            self.config.default["priority"] or "normal")
+        return tenant, priority
+
+    def check(self, tenant: str, priority: str, token_charge: int,
+              queue_depth: float, burn_rate: float,
+              forced_level: int = 0) -> Verdict:
+        """Rate limit first (the per-tenant contract holds even when the
+        gateway is idle), then the brownout ladder. ``forced_level``
+        floors the brownout level (the overload_spike fault hook)."""
+        entry = self.config.entry(tenant)
+        if entry["rps"] > 0 or entry["tokens_per_min"] > 0:
+            buckets = self._buckets.get(tenant)
+            if buckets is None:
+                buckets = self._buckets[tenant] = TenantBuckets(
+                    entry["rps"], entry["burst"], entry["tokens_per_min"],
+                    self.clock)
+            ok, which, wait = buckets.admit(token_charge)
+            if not ok:
+                noun = ("request rate" if which == "requests"
+                        else "generated-token rate")
+                return Verdict(
+                    "shed", "rate_limited", retry_after_s(wait),
+                    f"tenant {tenant!r} exceeded its {noun} limit")
+        level = max(
+            brownout_level(queue_depth, burn_rate,
+                           self.config.queue_depth_hi,
+                           self.config.burn_rate_hi),
+            max(0, min(3, int(forced_level))))
+        action = brownout_action(level, priority)
+        if action == "shed":
+            return Verdict(
+                "shed", "overloaded", brownout_retry_after(level),
+                f"gateway overloaded (brownout level {level}); "
+                f"{priority} traffic is being shed")
+        if action == "degrade":
+            return Verdict("degrade",
+                           clamp_max_tokens=self.config.clamp_max_tokens)
+        return Verdict("pass")

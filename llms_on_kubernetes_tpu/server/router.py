@@ -66,12 +66,16 @@ from typing import Optional, Union
 import aiohttp
 from aiohttp import web
 
+from llms_on_kubernetes_tpu import faults
 from llms_on_kubernetes_tpu.server import tracing
 from llms_on_kubernetes_tpu.server.cluster_metrics import (
     SLOTracker, merge_expositions, slo_gauges,
 )
 from llms_on_kubernetes_tpu.server.metrics import (
     Registry, build_info_metrics, router_metrics,
+)
+from llms_on_kubernetes_tpu.server.qos import (
+    PRIORITY_HEADER, QoSGate, default_token_charge,
 )
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER, jlog
 
@@ -397,6 +401,7 @@ class Router:
         resume_attempts: Optional[int] = None,
         hedge_ms: Optional[float] = None,
         journal_max_tokens: int = 4096,
+        qos: Optional[dict] = None,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -452,6 +457,10 @@ class Router:
         # it at scrape time); objectives from LLMK_SLO_* env vars
         self.slo = SLOTracker()
         slo_gauges(self.registry, self.slo)
+        # per-tenant QoS: rate limits + priority resolution + brownout
+        # (server/qos.py is the executable spec; the native router
+        # mirrors it). An empty/missing config leaves the gate dormant.
+        self.qos_gate = QoSGate(qos, clock=clock)
         self.scrape_timeout_s = 5.0
         self.traces = tracing.TraceStore(
             int(os.environ.get("LLMK_TRACE_RING", "256")))
@@ -780,6 +789,55 @@ class Router:
         # scaled-to-zero model has no healthy replica, and this series'
         # rate is exactly what wakes it (KEDA trigger in manifests.py)
         self.metrics["requests_total"].labels(model=model).inc()
+
+        # --- edge QoS gate: per-tenant rate limits, then the brownout
+        # ladder (shed lowest-priority first, degrade before shedding the
+        # class above). The resolved priority is forwarded upstream in
+        # place of whatever the client sent, so the engine's fair queue
+        # and the edge always agree on the request's class.
+        tenant, priority = self.qos_gate.resolve(
+            doc, model, request.headers.get(PRIORITY_HEADER))
+        hedge_ok = True
+        if self.qos_gate.enabled:
+            self.metrics["tenant_requests"].labels(
+                tenant=tenant, priority=priority).inc()
+            depth = sum(r.inflight for reps in self.replicas.values()
+                        for r in reps)
+            burn = self.slo.snapshot()["error_budget_burn_rate"]
+            forced = 0
+            if faults.is_active("overload_spike"):
+                # brownout-ladder fault hook (Python router only; see
+                # faults.py): pretend the gateway is at this level
+                forced = int(faults.get_float("overload_spike", 2.0) or 0)
+            charge = default_token_charge(doc)
+            verdict = self.qos_gate.check(
+                tenant, priority, charge, float(depth), float(burn), forced)
+            if verdict.action == "shed":
+                self.metrics["tenant_router_shed"].labels(
+                    tenant=tenant, priority=priority,
+                    reason=verdict.reason).inc()
+                return web.json_response(
+                    error_body(verdict.message, "rate_limit_exceeded",
+                               verdict.reason),
+                    status=429, headers=self._rid_headers(
+                        rid, {"Retry-After": str(verdict.retry_after)}))
+            if verdict.action == "degrade":
+                self.metrics["tenant_degraded"].labels(
+                    tenant=tenant, priority=priority).inc()
+                hedge_ok = False  # no speculative duplicates under brownout
+                clamp = verdict.clamp_max_tokens or 0
+                if doc is not None and clamp > 0:
+                    mt = doc.get("max_tokens")
+                    unset = not (isinstance(mt, (int, float))
+                                 and not isinstance(mt, bool) and mt > 0)
+                    if unset or mt > clamp:
+                        doc = dict(doc)
+                        doc["max_tokens"] = clamp
+                        body = json.dumps(doc).encode()
+                        charge = min(charge, clamp)
+            self.metrics["tenant_tokens"].labels(tenant=tenant).inc(charge)
+        request["llmk_hedge_ok"] = hedge_ok
+
         deadline = self._deadline_from(request, doc, t0)
         if deadline is not None and self.clock() >= deadline:
             return self._deadline_response(rid)
@@ -793,12 +851,16 @@ class Router:
             if k.lower() not in HOP_BY_HOP
             and k.lower() not in (DEADLINE_HEADER.lower(),
                                   REQUEST_ID_HEADER.lower(),
+                                  PRIORITY_HEADER.lower(),
                                   JOURNAL_HEADER.lower(),
                                   RESUME_TOKENS_HEADER.lower(),
                                   RESUME_STREAM_ID_HEADER.lower(),
                                   RESUME_CREATED_HEADER.lower())
         }
         headers[REQUEST_ID_HEADER] = rid
+        # RESOLVED priority, never the client's raw header (an invalid or
+        # unauthorized value must not leak past the gateway)
+        headers[PRIORITY_HEADER] = priority
         peername = request.transport.get_extra_info("peername") if request.transport else None
         client_ip = peername[0] if peername else ""
         headers["X-Real-IP"] = client_ip
@@ -970,7 +1032,7 @@ class Router:
         resumes = 0  # re-issues consumed, capped by resume_attempts
         first: Optional[bytes] = None
         try:
-            if self.hedge_ms > 0:
+            if self.hedge_ms > 0 and request.get("llmk_hedge_ok", True):
                 try:
                     upstream, active, first = await self._hedge_race(
                         request, model, headers, body, deadline, upstream,
@@ -1294,10 +1356,12 @@ def run_router(
     stream_resume: Optional[bool] = None,
     resume_attempts: Optional[int] = None,
     hedge_ms: Optional[float] = None,
+    qos: Optional[dict] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s,
                     stream_resume=stream_resume,
-                    resume_attempts=resume_attempts, hedge_ms=hedge_ms)
+                    resume_attempts=resume_attempts, hedge_ms=hedge_ms,
+                    qos=qos)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

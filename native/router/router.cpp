@@ -71,6 +71,7 @@
 #include <atomic>
 #include <cerrno>
 #include <chrono>
+#include <cmath>
 #include <cstdarg>
 #include <csignal>
 #include <cstdio>
@@ -87,6 +88,7 @@
 #include <sstream>
 #include <string>
 #include <thread>
+#include <tuple>
 #include <utility>
 #include <vector>
 
@@ -94,6 +96,34 @@
 #include "json.hpp"
 
 namespace llkt {
+
+// ---------------------------------------------------------------------------
+// Per-tenant QoS config (mirrors server/qos.py QoSConfig — the executable
+// spec; the two are held byte-compatible by tests/data/qos_vectors.json,
+// driven here via --qos-selftest)
+// ---------------------------------------------------------------------------
+
+struct QosEntry {
+  double weight = 1.0;           // engine-side fair-share weight (informational here)
+  std::string priority;          // "" = unset (falls through to the default chain)
+  double rps = 0.0;              // <= 0 = unlimited
+  double burst = 0.0;            // <= 0 = derived from rps
+  double tokens_per_min = 0.0;   // <= 0 = unlimited
+};
+
+struct QosConfig {
+  bool enabled = false;
+  std::map<std::string, QosEntry> tenants;
+  QosEntry default_entry;        // applied to tenants not listed above
+  double queue_depth_hi = 0.0;   // <= 0 disables the queue-depth signal
+  double burn_rate_hi = 0.0;     // <= 0 disables the burn-rate signal
+  int clamp_max_tokens = 64;     // degrade action's max_tokens ceiling
+
+  const QosEntry& entry(const std::string& tenant) const {
+    auto it = tenants.find(tenant);
+    return it == tenants.end() ? default_entry : it->second;
+  }
+};
 
 struct Config {
   // insertion-ordered: first model is the default (like the reference's
@@ -135,6 +165,9 @@ struct Config {
   int resume_attempts = 2;
   double hedge_ms = 0.0;          // 0 = hedged requests off
   size_t journal_max_tokens = 4096;
+  // per-tenant QoS: rate limits + priority + adaptive brownout ("qos"
+  // config block; absent = gate dormant)
+  QosConfig qos;
   int port = 8080;
   bool quiet = false;
 
@@ -316,6 +349,252 @@ static std::string prom_escape(const std::string& s) {
   }
   return out;
 }
+
+// ---------------------------------------------------------------------------
+// QoS semantics (mirrors server/qos.py function by function — that module
+// is the executable spec; every constant and message here must match it)
+// ---------------------------------------------------------------------------
+
+static const char kPriorityHeader[] = "X-LLMK-Priority";
+static const int kQosDefaultTokenCharge = 16;
+
+static std::string strip_copy(const std::string& s);  // defined below
+
+static int qos_priority_rank(const std::string& p) {
+  if (p == "interactive") return 0;
+  if (p == "batch") return 2;
+  return 1;  // normal + anything unknown
+}
+
+static bool qos_valid_priority(const std::string& p) {
+  return p == "interactive" || p == "normal" || p == "batch";
+}
+
+// the one shared Retry-After clamp: whole seconds in [1, 60]
+static int qos_retry_after_s(double seconds) {
+  double c = std::ceil(seconds);
+  if (c < 1.0) return 1;
+  if (c > 60.0) return 60;
+  return static_cast<int>(c);
+}
+
+// tenant identity: body "user" (non-empty string) > REQUESTED model string
+// (so base:adapter tenants separate) > resolved model
+static std::string qos_tenant_of(const Json* doc,
+                                 const std::string& resolved_model) {
+  if (doc && doc->is_object()) {
+    const Json* u = doc->get("user");
+    if (u && u->is_string() && !u->str.empty()) return u->str;
+    const Json* m = doc->get("model");
+    if (m && m->is_string() && !m->str.empty()) return m->str;
+  }
+  return resolved_model;
+}
+
+// header (when valid) > tenant config > default; an INVALID header falls
+// through — a typo must not silently grant or deny priority
+static std::string qos_resolve_priority(const std::string* header,
+                                        const std::string& tenant_priority,
+                                        const std::string& default_priority) {
+  if (header) {
+    std::string p = lower(strip_copy(*header));
+    if (qos_valid_priority(p)) return p;
+  }
+  if (!tenant_priority.empty()) {
+    std::string p = lower(strip_copy(tenant_priority));
+    if (qos_valid_priority(p)) return p;
+  }
+  std::string d = lower(strip_copy(default_priority));
+  return qos_valid_priority(d) ? d : "normal";
+}
+
+// generated-tokens charge: the body's max_tokens when positive, else 16
+static int qos_token_charge(const Json* doc) {
+  if (doc && doc->is_object()) {
+    const Json* mt = doc->get("max_tokens");
+    if (mt && mt->type == Json::Type::Number && mt->number > 0)
+      return static_cast<int>(mt->number);
+  }
+  return kQosDefaultTokenCharge;
+}
+
+// 0..3 from one overload signal: below hi = 0, one level per doubling
+static int qos_signal_level(double value, double hi) {
+  if (hi <= 0 || value < hi) return 0;
+  if (value < 2 * hi) return 1;
+  if (value < 4 * hi) return 2;
+  return 3;
+}
+
+static int qos_brownout_level(double queue_depth, double burn_rate,
+                              double queue_depth_hi, double burn_rate_hi) {
+  return std::max(qos_signal_level(queue_depth, queue_depth_hi),
+                  qos_signal_level(burn_rate, burn_rate_hi));
+}
+
+// "pass" | "degrade" | "shed"; sheds lowest-priority first, degrades one
+// class above the shed line (see server/qos.py brownout_action's table)
+static const char* qos_brownout_action(int level, const std::string& priority) {
+  int rank = qos_priority_rank(priority);
+  if (level <= 0) return "pass";
+  if (level == 1) return rank == 2 ? "shed" : "pass";
+  if (level == 2)
+    return rank == 2 ? "shed" : rank == 1 ? "degrade" : "pass";
+  return rank == 0 ? "degrade" : "shed";
+}
+
+// exponential in the level (2/4/8 s) through the shared clamp
+static int qos_brownout_retry_after(int level) {
+  return qos_retry_after_s(static_cast<double>(1 << std::max(1, level)));
+}
+
+// classic token bucket over an explicit clock (seconds as a double): the
+// live gate feeds it steady-clock time, --qos-selftest feeds it the
+// vector's scripted times, and the python TokenBucket does the identical
+// IEEE-double arithmetic
+struct QosBucket {
+  double rate = 0.0;
+  double burst = 1.0;
+  double level = 1.0;
+  double last = 0.0;
+
+  void init(double r, double b, double now) {
+    rate = r;
+    burst = std::max(1.0, b);
+    level = burst;
+    last = now;
+  }
+
+  // on refusal *wait gets the refill deficit in seconds
+  bool take(double n, double now, double* wait) {
+    *wait = 0.0;
+    if (rate <= 0) return true;
+    level = std::min(burst, level + (now - last) * rate);
+    last = now;
+    if (level >= n) {
+      level -= n;
+      return true;
+    }
+    *wait = (n - level) / rate;
+    return false;
+  }
+};
+
+// one tenant's pair: requests/s + generated-tokens/min
+struct QosTenantBuckets {
+  QosBucket rps, tokens;
+
+  void init(const QosEntry& e, double now) {
+    rps.init(e.rps,
+             e.burst > 0 ? e.burst : std::max(1.0, std::ceil(e.rps)), now);
+    tokens.init(e.tokens_per_min > 0 ? e.tokens_per_min / 60.0 : 0.0,
+                e.tokens_per_min, now);
+  }
+
+  // request bucket charged first; a token-limited request refunds its
+  // request charge (it was never forwarded, so it must not count)
+  bool admit(int charge, double now, const char** which, double* wait) {
+    *which = "";
+    if (!rps.take(1.0, now, wait)) {
+      *which = "requests";
+      return false;
+    }
+    if (!tokens.take(static_cast<double>(charge), now, wait)) {
+      rps.level = std::min(rps.burst, rps.level + 1.0);
+      *which = "tokens";
+      return false;
+    }
+    return true;
+  }
+};
+
+struct QosVerdict {
+  std::string action = "pass";  // pass | degrade | shed
+  std::string reason;           // "" | rate_limited | overloaded
+  int retry_after = 0;
+  std::string message;
+  int clamp_max_tokens = 0;     // 0 = no clamp
+};
+
+// one admission decision: rate limit first (the per-tenant contract holds
+// even when the gateway is idle), then the brownout ladder. forced_level
+// floors the brownout level (clamped 0..3). Pure over (buckets, now) so
+// the selftest can drive it with scripted time.
+static QosVerdict qos_check(const QosConfig& qc,
+                            std::map<std::string, QosTenantBuckets>& buckets,
+                            const std::string& tenant,
+                            const std::string& priority, int charge,
+                            double queue_depth, double burn_rate,
+                            int forced_level, double now) {
+  QosVerdict v;
+  const QosEntry& e = qc.entry(tenant);
+  if (e.rps > 0 || e.tokens_per_min > 0) {
+    auto it = buckets.find(tenant);
+    if (it == buckets.end()) {
+      it = buckets.emplace(tenant, QosTenantBuckets{}).first;
+      it->second.init(e, now);
+    }
+    const char* which = "";
+    double wait = 0.0;
+    if (!it->second.admit(charge, now, &which, &wait)) {
+      v.action = "shed";
+      v.reason = "rate_limited";
+      v.retry_after = qos_retry_after_s(wait);
+      v.message = "tenant '" + tenant + "' exceeded its " +
+                  std::string(std::string(which) == "requests"
+                                  ? "request rate"
+                                  : "generated-token rate") +
+                  " limit";
+      return v;
+    }
+  }
+  int level = std::max(
+      qos_brownout_level(queue_depth, burn_rate, qc.queue_depth_hi,
+                         qc.burn_rate_hi),
+      std::max(0, std::min(3, forced_level)));
+  std::string action = qos_brownout_action(level, priority);
+  if (action == "shed") {
+    v.action = "shed";
+    v.reason = "overloaded";
+    v.retry_after = qos_brownout_retry_after(level);
+    v.message = "gateway overloaded (brownout level " +
+                std::to_string(level) + "); " + priority +
+                " traffic is being shed";
+    return v;
+  }
+  if (action == "degrade") {
+    v.action = "degrade";
+    v.clamp_max_tokens = qc.clamp_max_tokens;
+  }
+  return v;
+}
+
+// live gate state: one bucket map for the process, mutex-guarded (the
+// python gate is lock-free under the aiohttp event loop instead)
+static std::mutex g_qos_mu;
+static std::map<std::string, QosTenantBuckets> g_qos_buckets;
+
+static QosVerdict qos_gate_check(const Config& cfg, const std::string& tenant,
+                                 const std::string& priority, int charge,
+                                 double queue_depth, double burn_rate,
+                                 int forced_level) {
+  double now = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - g_start_steady).count();
+  std::lock_guard<std::mutex> lock(g_qos_mu);
+  return qos_check(cfg.qos, g_qos_buckets, tenant, priority, charge,
+                   queue_depth, burn_rate, forced_level, now);
+}
+
+// per-tenant counters (mirror server/metrics.py router_metrics():
+// llm_tenant_requests_total{tenant,priority},
+// llm_tenant_router_shed_total{tenant,priority,reason},
+// llm_tenant_tokens_total{tenant}, llm_tenant_degraded_total{tenant,priority})
+static std::mutex g_tenant_metrics_mu;
+static std::map<std::pair<std::string, std::string>, long> g_tenant_requests;
+static std::map<std::tuple<std::string, std::string, std::string>, long>
+    g_tenant_shed;
+static std::map<std::string, long> g_tenant_tokens;
+static std::map<std::pair<std::string, std::string>, long> g_tenant_degraded;
 
 // ---------------------------------------------------------------------------
 // Request IDs + structured access log (mirrors server/tracing.py)
@@ -1407,7 +1686,9 @@ static std::string sse_truncation_event() {
 // reused for another request.
 static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                           const std::string& client_ip, const std::string& model,
-                          const std::string& rid) {
+                          const std::string& rid,
+                          const std::string& priority = "normal",
+                          bool hedge_ok = true) {
   const std::vector<Url>& replicas = *cfg.find(model);
   const auto t0 = std::chrono::steady_clock::now();
   const std::string rid_header =
@@ -1488,6 +1769,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (n == "x-forwarded-for") continue;  // re-added with client appended
       if (n == "x-llmk-deadline-ms") continue;  // re-added decremented
       if (n == "x-llmk-request-id") continue;  // re-added canonicalized
+      // re-added RESOLVED, never the client's raw value (an invalid or
+      // unauthorized priority must not leak past the gateway)
+      if (n == "x-llmk-priority") continue;
       // internal resume protocol: never client-settable (a forged prefix
       // would be an output-injection hole)
       if (n == "x-llmk-journal" || n == "x-llmk-resume-tokens" ||
@@ -1496,6 +1780,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       out << kv.first << ": " << kv.second << "\r\n";
     }
     out << kRequestIdHeader << ": " << rid << "\r\n";
+    out << kPriorityHeader << ": " << priority << "\r\n";
     out << "X-Real-IP: " << client_ip << "\r\n";
     const std::string* fwd = req.headers.get("x-forwarded-for");
     out << "X-Forwarded-For: " << (fwd ? *fwd + ", " + client_ip : client_ip)
@@ -1726,7 +2011,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     // aborts generation on disconnect — so at most one stream ever
     // reaches the client. Slow is not failed: the loser takes no
     // breaker hit and stays out of `tried`.
-    if (cfg.hedge_ms > 0 && !up->has_buffered()) {
+    if (cfg.hedge_ms > 0 && hedge_ok && !up->has_buffered()) {
       struct pollfd pfd {up_fd, POLLIN, 0};
       int pr = ::poll(&pfd, 1, static_cast<int>(cfg.hedge_ms));
       if (pr == 0) {
@@ -2215,6 +2500,39 @@ static void handle_connection(const Config& cfg, int client_fd,
             << "\"} " << kv.second << "\n";
       }
       {
+        std::lock_guard<std::mutex> lock(g_tenant_metrics_mu);
+        m << "# HELP llm_tenant_requests_total Requests by resolved tenant "
+             "and priority class (QoS gate)\n"
+          << "# TYPE llm_tenant_requests_total counter\n";
+        for (const auto& kv : g_tenant_requests)
+          m << "llm_tenant_requests_total{tenant=\""
+            << prom_escape(kv.first.first) << "\",priority=\""
+            << prom_escape(kv.first.second) << "\"} " << kv.second << "\n";
+        m << "# HELP llm_tenant_router_shed_total Requests shed at the "
+             "gateway by tenant, priority and reason "
+             "(rate_limited|overloaded)\n"
+          << "# TYPE llm_tenant_router_shed_total counter\n";
+        for (const auto& kv : g_tenant_shed)
+          m << "llm_tenant_router_shed_total{tenant=\""
+            << prom_escape(std::get<0>(kv.first)) << "\",priority=\""
+            << prom_escape(std::get<1>(kv.first)) << "\",reason=\""
+            << prom_escape(std::get<2>(kv.first)) << "\"} " << kv.second
+            << "\n";
+        m << "# HELP llm_tenant_tokens_total Generated-token charge "
+             "admitted through the QoS gate, by tenant\n"
+          << "# TYPE llm_tenant_tokens_total counter\n";
+        for (const auto& kv : g_tenant_tokens)
+          m << "llm_tenant_tokens_total{tenant=\"" << prom_escape(kv.first)
+            << "\"} " << kv.second << "\n";
+        m << "# HELP llm_tenant_degraded_total Requests degraded under "
+             "brownout (clamped max_tokens, hedging disabled)\n"
+          << "# TYPE llm_tenant_degraded_total counter\n";
+        for (const auto& kv : g_tenant_degraded)
+          m << "llm_tenant_degraded_total{tenant=\""
+            << prom_escape(kv.first.first) << "\",priority=\""
+            << prom_escape(kv.first.second) << "\"} " << kv.second << "\n";
+      }
+      {
         std::lock_guard<std::mutex> lock(g_requests_by_model_mu);
         m << "# HELP llm_router_requests_total Requests the router "
              "accepted, by resolved model (demand signal that wakes a "
@@ -2266,7 +2584,83 @@ static void handle_connection(const Config& cfg, int client_fd,
         jlog_request(cfg, rid, model, "", 404, 0.0, 0.0, 0.0);
       } else {
         count_model_request(model);
-        keep = proxy_request(cfg, req, client_fd, client_ip, model, rid);
+        // --- edge QoS: tenant + priority are resolved for EVERY request
+        // (the resolved priority is forwarded upstream either way); the
+        // rate-limit/brownout gate only engages when configured. Check
+        // order matches the python router: select -> 404 -> count ->
+        // rate limit -> brownout -> deadline -> replica pick.
+        JsonPtr qdoc =
+            req.body.empty() ? nullptr : JsonParser::parse(req.body);
+        const Json* doc =
+            (qdoc && qdoc->is_object()) ? qdoc.get() : nullptr;
+        std::string tenant = qos_tenant_of(doc, model);
+        const QosEntry& qe = cfg.qos.entry(tenant);
+        std::string priority = qos_resolve_priority(
+            req.headers.get("x-llmk-priority"), qe.priority,
+            cfg.qos.default_entry.priority);
+        bool hedge_ok = true;
+        bool qos_shed = false;
+        if (cfg.qos.enabled) {
+          {
+            std::lock_guard<std::mutex> lock(g_tenant_metrics_mu);
+            ++g_tenant_requests[{tenant, priority}];
+          }
+          // overload signals: total gateway in-flight across every
+          // replica of every model, and the SLO error-budget burn rate
+          double depth = 0.0;
+          for (const auto& mkv : cfg.models)
+            for (const Url& u : mkv.second)
+              depth += g_health.get(u.host, u.port)
+                           .inflight.load(std::memory_order_relaxed);
+          double burn = g_slo.snapshot().burn_rate;
+          int charge = qos_token_charge(doc);
+          QosVerdict v = qos_gate_check(cfg, tenant, priority, charge,
+                                        depth, burn, 0);
+          if (v.action == "shed") {
+            {
+              std::lock_guard<std::mutex> lock(g_tenant_metrics_mu);
+              ++g_tenant_shed[{tenant, priority, v.reason}];
+            }
+            std::string body =
+                error_json(v.message, "rate_limit_exceeded", v.reason);
+            keep = send_all(
+                       client_fd,
+                       simple_response(
+                           429, "Too Many Requests", "application/json",
+                           body, req.keep_alive,
+                           std::string(kRequestIdHeader) + ": " + rid +
+                               "\r\nRetry-After: " +
+                               std::to_string(v.retry_after) + "\r\n")) &&
+                   req.keep_alive;
+            g_slo.observe(429, -1.0);
+            jlog_request(cfg, rid, model, "", 429, 0.0, 0.0, 0.0);
+            qos_shed = true;
+          } else if (v.action == "degrade") {
+            {
+              std::lock_guard<std::mutex> lock(g_tenant_metrics_mu);
+              ++g_tenant_degraded[{tenant, priority}];
+            }
+            hedge_ok = false;  // no speculative duplicates under brownout
+            if (doc && v.clamp_max_tokens > 0) {
+              const Json* mt = doc->get("max_tokens");
+              bool unset = !(mt && mt->type == Json::Type::Number &&
+                             mt->number > 0);
+              if (unset || mt->number > v.clamp_max_tokens) {
+                qdoc->set("max_tokens",
+                          Json::of_number(v.clamp_max_tokens));
+                req.body = qdoc->dump();
+                charge = std::min(charge, v.clamp_max_tokens);
+              }
+            }
+          }
+          if (!qos_shed) {
+            std::lock_guard<std::mutex> lock(g_tenant_metrics_mu);
+            g_tenant_tokens[tenant] += charge;
+          }
+        }
+        if (!qos_shed)
+          keep = proxy_request(cfg, req, client_fd, client_ip, model, rid,
+                               priority, hedge_ok);
       }
     }
     if (!keep) break;
@@ -2277,6 +2671,172 @@ static void handle_connection(const Config& cfg, int client_fd,
 // ---------------------------------------------------------------------------
 // Config loading
 // ---------------------------------------------------------------------------
+
+// "qos" block parser, shared by load_config_json and --qos-selftest (the
+// selftest builds per-vector configs from the same JSON shape the Helm
+// charts and deploy/manifests.py render)
+static void parse_qos_entry(const Json* e, QosEntry& out) {
+  if (!e || !e->is_object()) return;
+  if (const Json* v = e->get("weight"); v && v->type == Json::Type::Number)
+    out.weight = v->number;
+  if (const Json* v = e->get("priority"); v && v->is_string())
+    out.priority = v->str;
+  if (const Json* v = e->get("rps"); v && v->type == Json::Type::Number)
+    out.rps = v->number;
+  if (const Json* v = e->get("burst"); v && v->type == Json::Type::Number)
+    out.burst = v->number;
+  if (const Json* v = e->get("tokens_per_min");
+      v && v->type == Json::Type::Number)
+    out.tokens_per_min = v->number;
+}
+
+static void parse_qos_config(const Json* q, QosConfig& out) {
+  if (!q || !q->is_object()) return;
+  const Json* tenants = q->get("tenants");
+  if (tenants && tenants->is_object())
+    for (const auto& kv : tenants->obj) {
+      QosEntry e;
+      parse_qos_entry(kv.second.get(), e);
+      out.tenants[kv.first] = e;
+    }
+  const Json* d = q->get("default");
+  parse_qos_entry(d, out.default_entry);
+  const Json* b = q->get("brownout");
+  if (b && b->is_object()) {
+    if (const Json* v = b->get("queue_depth_hi");
+        v && v->type == Json::Type::Number)
+      out.queue_depth_hi = v->number;
+    if (const Json* v = b->get("burn_rate_hi");
+        v && v->type == Json::Type::Number)
+      out.burn_rate_hi = v->number;
+    if (const Json* v = b->get("clamp_max_tokens");
+        v && v->type == Json::Type::Number)
+      out.clamp_max_tokens = static_cast<int>(v->number);
+  }
+  // truthiness mirrors python: empty {} sub-blocks do not enable the gate
+  out.enabled = !out.tenants.empty() ||
+                (d && d->is_object() && !d->obj.empty()) ||
+                (b && b->is_object() && !b->obj.empty());
+}
+
+// --qos-selftest FILE: drive the shared QoS test vectors
+// (tests/data/qos_vectors.json) against this implementation and verify
+// every expectation. The python side runs the same file through
+// server/qos.py (tests/test_qos.py) — together they hold the two routers
+// byte-compatible on QoS semantics. Exit 0 = all checks pass.
+static int qos_selftest(const std::string& file) {
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "qos-selftest: cannot open %s\n", file.c_str());
+    return 1;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  JsonPtr root = JsonParser::parse(ss.str());
+  if (!root || !root->is_object()) {
+    fprintf(stderr, "qos-selftest: malformed vectors file\n");
+    return 1;
+  }
+  int checks = 0, failures = 0;
+  auto fail = [&](const std::string& what) {
+    fprintf(stderr, "qos-selftest: FAIL %s\n", what.c_str());
+    ++failures;
+  };
+  auto num = [](const Json* o, const char* k, double d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Number ? v->number : d;
+  };
+  auto str = [](const Json* o, const char* k,
+                const std::string& d) -> std::string {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->is_string() ? v->str : d;
+  };
+
+  if (const Json* sec = root->get("retry_after");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      int got = qos_retry_after_s(num(it.get(), "seconds", 0.0));
+      int want = static_cast<int>(num(it.get(), "expect", -1.0));
+      if (got != want)
+        fail("retry_after(" + std::to_string(num(it.get(), "seconds", 0.0)) +
+             ") = " + std::to_string(got) + ", want " + std::to_string(want));
+    }
+
+  if (const Json* sec = root->get("token_charge");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      int got = qos_token_charge(it->get("doc"));
+      int want = static_cast<int>(num(it.get(), "expect", -1.0));
+      if (got != want)
+        fail("token_charge = " + std::to_string(got) + ", want " +
+             std::to_string(want));
+    }
+
+  if (const Json* sec = root->get("resolve");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      QosConfig qc;
+      parse_qos_config(it->get("config"), qc);
+      const Json* doc = it->get("doc");
+      if (doc && !doc->is_object()) doc = nullptr;
+      std::string tenant =
+          qos_tenant_of(doc, str(it.get(), "resolved_model", ""));
+      const Json* hdr = it->get("header");
+      std::string hdr_s = hdr && hdr->is_string() ? hdr->str : "";
+      const std::string* hdr_p = hdr && hdr->is_string() ? &hdr_s : nullptr;
+      std::string priority = qos_resolve_priority(
+          hdr_p, qc.entry(tenant).priority, qc.default_entry.priority);
+      std::string want_t = str(it.get(), "expect_tenant", "");
+      std::string want_p = str(it.get(), "expect_priority", "");
+      if (tenant != want_t || priority != want_p)
+        fail("resolve -> (" + tenant + ", " + priority + "), want (" +
+             want_t + ", " + want_p + ")");
+    }
+
+  if (const Json* sec = root->get("gate");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& group : sec->arr) {
+      QosConfig qc;
+      parse_qos_config(group->get("config"), qc);
+      std::map<std::string, QosTenantBuckets> buckets;
+      const Json* seq = group->get("checks");
+      if (!seq || seq->type != Json::Type::Array) continue;
+      int i = -1;
+      for (const auto& it : seq->arr) {
+        ++checks;
+        ++i;
+        QosVerdict v = qos_check(
+            qc, buckets, str(it.get(), "tenant", ""),
+            str(it.get(), "priority", "normal"),
+            static_cast<int>(num(it.get(), "charge", 16)),
+            num(it.get(), "queue_depth", 0.0),
+            num(it.get(), "burn_rate", 0.0),
+            static_cast<int>(num(it.get(), "forced_level", 0.0)),
+            num(it.get(), "at", 0.0));
+        const Json* ex = it->get("expect");
+        std::string tag = "gate check #" + std::to_string(i);
+        if (v.action != str(ex, "action", "pass"))
+          fail(tag + " action=" + v.action);
+        if (v.reason != str(ex, "reason", ""))
+          fail(tag + " reason=" + v.reason);
+        if (v.retry_after != static_cast<int>(num(ex, "retry_after", 0.0)))
+          fail(tag + " retry_after=" + std::to_string(v.retry_after));
+        if (v.clamp_max_tokens !=
+            static_cast<int>(num(ex, "clamp_max_tokens", 0.0)))
+          fail(tag + " clamp=" + std::to_string(v.clamp_max_tokens));
+        const Json* msg = ex ? ex->get("message") : nullptr;
+        if (msg && msg->is_string() && v.message != msg->str)
+          fail(tag + " message='" + v.message + "', want '" + msg->str +
+               "'");
+      }
+    }
+
+  printf("qos-selftest: %d checks, %d failures\n", checks, failures);
+  return failures ? 1 : 0;
+}
 
 static bool load_config_json(const std::string& file, Config& cfg) {
   std::ifstream in(file);
@@ -2377,6 +2937,7 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("hedge_ms");
       t && t->type == Json::Type::Number)
     cfg.hedge_ms = std::max(0.0, t->number);
+  parse_qos_config(root->get("qos"), cfg.qos);
   return true;
 }
 
@@ -2480,7 +3041,7 @@ int main(int argc, char** argv) {
       0, static_cast<int>(env_double("LLMK_RESUME_ATTEMPTS",
                                      cfg.resume_attempts)));
   cfg.hedge_ms = std::max(0.0, env_double("LLMK_HEDGE_MS", cfg.hedge_ms));
-  std::string config_file, models_inline, adapters_inline;
+  std::string config_file, models_inline, adapters_inline, qos_selftest_file;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* {
@@ -2496,10 +3057,20 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       models_inline = v;
+      // absorb bare continuation tokens ("--models a=u b=u" is the same
+      // spec as "--models a=u,b=u" — shells split on the space)
+      while (i + 1 < argc && strncmp(argv[i + 1], "--", 2) != 0) {
+        models_inline += ",";
+        models_inline += argv[++i];
+      }
     } else if (a == "--adapters") {
       const char* v = next();
       if (!v) return 2;
       adapters_inline = v;
+      while (i + 1 < argc && strncmp(argv[i + 1], "--", 2) != 0) {
+        adapters_inline += ",";
+        adapters_inline += argv[++i];
+      }
     } else if (a == "--port") {
       const char* v = next();
       if (!v) return 2;
@@ -2554,6 +3125,10 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       cfg.hedge_ms = std::max(0.0, atof(v));
+    } else if (a == "--qos-selftest") {
+      const char* v = next();
+      if (!v) return 2;
+      qos_selftest_file = v;
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
@@ -2563,10 +3138,15 @@ int main(int argc, char** argv) {
               "[--connect-timeout S] [--retries N] [--retry-backoff-ms MS] "
               "[--breaker-threshold N] [--breaker-open S] "
               "[--probe-interval S] [--no-stream-resume] "
-              "[--resume-attempts N] [--hedge-ms MS]\n");
+              "[--resume-attempts N] [--hedge-ms MS] "
+              "[--qos-selftest VECTORS_JSON]\n");
       return 2;
     }
   }
+
+  // parity harness for the shared QoS semantics: validate the vectors and
+  // exit without serving (tests/test_native_router.py drives this)
+  if (!qos_selftest_file.empty()) return qos_selftest(qos_selftest_file);
 
   if (!config_file.empty()) {
     if (!load_config_json(config_file, cfg)) return 1;

@@ -113,6 +113,29 @@ sys.exit(0 if doc.get("resume_client_visible_drops") == 0
     fails=$((fails + 1))
   fi
 
+  note "fairness smoke (noisy neighbor: QoS keeps interactive TTFT bounded)"
+  # the smoke's fairness phase floods a rate-limited batch tenant at 4x
+  # its admitted capacity while paced interactive probes run; QoS must
+  # keep the interactive p95 TTFT under 2x the unloaded baseline, land
+  # >=90% of the sheds on the noisy tenant, let every tenant complete
+  # at least one request, and shed batch with the overload 429 body
+  # under a forced brownout
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+ratio = doc.get("fairness_ttft_ratio")
+frac = doc.get("fairness_shed_noisy_fraction")
+sys.exit(0 if ratio is not None and ratio < 2.0
+         and (doc.get("fairness_min_tenant_completed") or 0) >= 1
+         and frac is not None and frac >= 0.9
+         and doc.get("fairness_overload_shed_ok") is True else 1)'; then
+    echo "ci: fairness smoke OK (interactive p95 bounded, sheds on noisy)"
+  else
+    echo "ci: fairness smoke FAILED (starvation, unbounded TTFT, or"
+    echo "    sheds not landing on the noisy tenant)"
+    fails=$((fails + 1))
+  fi
+
   note "fused decode smoke (K>1 window actually amortizes dispatches)"
   # the smoke engine runs the fused multi-step decode path (decode_steps
   # defaults to 4); dispatches_per_token is per slot, so anything >= 1

@@ -215,6 +215,13 @@ class TestPartialEmission:
         assert data["spike_completed_streams"] > 0
         assert data["spike_preempted_replicas"] == 1
         assert data["spike_cold_start_s"].get("ready", 0) > 0
+        # ISSUE 10: the fairness scenario too — the noisy batch tenant
+        # absorbs the sheds, interactive TTFT stays bounded, nobody
+        # starves, and the forced brownout sheds with the overload body
+        assert data["fairness_ttft_ratio"] < 2.0
+        assert data["fairness_shed_noisy_fraction"] >= 0.9
+        assert data["fairness_min_tenant_completed"] >= 1
+        assert data["fairness_overload_shed_ok"] is True
         repo = pathlib.Path(bench.__file__).resolve().parent
         binary = repo / "native" / "router" / "llkt-router"
         if binary.exists():

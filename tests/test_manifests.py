@@ -608,3 +608,61 @@ def test_renderer_consumes_chart_values_verbatim():
         if d["kind"] == "Deployment" and d["metadata"]["name"].startswith("model-"):
             res = d["spec"]["template"]["spec"]["containers"][0].get("resources", {})
             assert "google.com/tpu" not in res.get("requests", {})
+
+
+def test_router_config_qos_block():
+    """ISSUE 10: the qos: block flows verbatim into router.json (the
+    python and native routers parse identical keys), validates its keys,
+    and rolls the router pods via the config hash when tuned."""
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    cfg = router_config(load_spec(BASE_YAML))
+    assert "qos" not in cfg  # absent block = no key at all
+
+    qos_yaml = BASE_YAML + """
+qos:
+  tenants:
+    frontend: {priority: interactive, weight: 4}
+    analytics: {priority: batch, rps: 5, tokens_per_min: 6000}
+  default: {rps: 50}
+  brownout:
+    queue_depth_hi: 32
+    burn_rate_hi: 2.0
+    clamp_max_tokens: 48
+"""
+    spec = load_spec(qos_yaml)
+    cfg2 = router_config(spec)
+    # passed verbatim — field-level parity with the Go template's toJson
+    assert cfg2["qos"] == {
+        "tenants": {
+            "frontend": {"priority": "interactive", "weight": 4},
+            "analytics": {"priority": "batch", "rps": 5,
+                          "tokens_per_min": 6000},
+        },
+        "default": {"rps": 50},
+        "brownout": {"queue_depth_hi": 32, "burn_rate_hi": 2.0,
+                     "clamp_max_tokens": 48},
+    }
+    assert config_hash(spec) != config_hash(load_spec(BASE_YAML))
+    # the python Router accepts the rendered block and enables its gate
+    r = Router(cfg2["backends"], cfg2["default_model"], cfg2["strict"],
+               qos=cfg2["qos"])
+    assert r.qos_gate.enabled
+    tenant, prio = r.qos_gate.resolve({"user": "frontend"}, "llama-3-8b",
+                                      None)
+    assert (tenant, prio) == ("frontend", "interactive")
+
+    # an EMPTY block disables cleanly (matches both routers' truthiness)
+    assert "qos" not in router_config(load_spec(BASE_YAML + "\nqos: {}\n"))
+
+    # unknown keys and invalid values are rejected at spec load
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nqos: {tenants: {t: {rate: 5}}}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nqos: {shed: true}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nqos: {tenants: {t: {priority: vip}}}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nqos: {tenants: {t: {weight: 0}}}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nqos: {brownout: {queue_depth_hi: -1}}\n")

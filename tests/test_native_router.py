@@ -96,6 +96,7 @@ class FakeBackend(http.server.BaseHTTPRequestHandler):
             "x_fwd": self.headers.get("X-Forwarded-For", ""),
             "deadline_ms": self.headers.get("X-LLMK-Deadline-Ms", ""),
             "rid": self.headers.get("X-LLMK-Request-Id", ""),
+            "priority": self.headers.get("X-LLMK-Priority", ""),
         }).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -1557,3 +1558,135 @@ def test_native_stream_metrics_families_exposed(stack):
         assert f"# TYPE {family} " in text, family
     assert 'llm_stream_resume_total{outcome="ok"} 0' in text
     assert 'llm_hedged_requests_total{outcome="hedge_won"} 0' in text
+
+
+# -- per-tenant QoS (ISSUE 10): shared-vector parity + live gate --------
+
+
+def test_native_qos_selftest_shared_vectors(binary):
+    """tests/data/qos_vectors.json is the byte-compatibility contract for
+    QoS semantics between the Python and native routers; the native side
+    validates every expectation in-process via --qos-selftest (the Python
+    side runs the same file in tests/test_qos.py)."""
+    out = subprocess.run(
+        [str(binary), "--qos-selftest",
+         str(REPO / "tests" / "data" / "qos_vectors.json")],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ", 0 failures" in out.stdout
+    # a non-trivial number of checks actually ran
+    checks = int(out.stdout.split("qos-selftest:")[1].split("checks")[0])
+    assert checks >= 40
+
+
+def _start_qos_router(binary, tmp_path, backend_port, qos):
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {"qmodel": f"http://127.0.0.1:{backend_port}"},
+        "default_model": "qmodel",
+        "qos": qos,
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+            conn.request("GET", "/health")
+            if conn.getresponse().read() == b"OK":
+                conn.close()
+                return proc, port
+        except OSError:
+            time.sleep(0.02)
+    proc.terminate()
+    raise RuntimeError("qos router did not come up")
+
+
+def _qos_post(port, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    payload = json.dumps(body).encode()
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", "/v1/chat/completions", body=payload, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    retry = resp.getheader("Retry-After")
+    conn.close()
+    return resp.status, data, retry
+
+
+def test_native_qos_rate_limit_and_priority_header(binary, tmp_path):
+    """Live native gate: per-tenant request rate limit sheds with the
+    shared 429 body (code=rate_limited, Retry-After), the resolved
+    priority is injected upstream, and a client-supplied header value is
+    overwritten with the resolved one."""
+    backend = start_backend("qmodel")
+    proc, port = _start_qos_router(
+        binary, tmp_path, backend.server_address[1],
+        {"tenants": {"alice": {"rps": 1, "burst": 1,
+                               "priority": "interactive"}}})
+    try:
+        status, data, _ = _qos_post(port, {"model": "qmodel",
+                                           "user": "alice"})
+        assert status == 200
+        assert json.loads(data)["priority"] == "interactive"
+        status, data, retry = _qos_post(port, {"model": "qmodel",
+                                               "user": "alice"})
+        assert status == 429
+        err = json.loads(data)["error"]
+        assert err["code"] == "rate_limited"
+        assert err["type"] == "rate_limit_exceeded"
+        assert err["message"] == \
+            "tenant 'alice' exceeded its request rate limit"
+        assert retry == "1"
+        # another tenant is unaffected; a valid client header wins over
+        # the config priority and is re-injected in canonical form
+        status, data, _ = _qos_post(
+            port, {"model": "qmodel", "user": "bob"},
+            headers={"X-LLMK-Priority": "  BATCH  "})
+        assert status == 200
+        assert json.loads(data)["priority"] == "batch"
+        # the tenant series landed in /metrics with the shared label shape
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert ('llm_tenant_requests_total{tenant="alice",'
+                'priority="interactive"} 2' in text)
+        assert ('llm_tenant_router_shed_total{tenant="alice",'
+                'priority="interactive",reason="rate_limited"} 1' in text)
+        assert 'llm_tenant_tokens_total{tenant="alice"} 16' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+
+
+def test_native_qos_token_budget_rate_limit(binary, tmp_path):
+    """The generated-token budget path on the live native gate: distinct
+    message + Retry-After from the tokens bucket. (The brownout ladder and
+    the rps-refund-on-tokens-rejection semantics are exhaustively covered
+    by the shared-vector selftest above.)"""
+    backend = start_backend("qmodel")
+    proc, port = _start_qos_router(
+        binary, tmp_path, backend.server_address[1],
+        {"tenants": {"bulk": {"rps": 100, "burst": 100,
+                              "tokens_per_min": 60,
+                              "priority": "batch"}}})
+    try:
+        status, _, _ = _qos_post(port, {"model": "qmodel", "user": "bulk",
+                                        "max_tokens": 60})
+        assert status == 200
+        status, data, retry = _qos_post(
+            port, {"model": "qmodel", "user": "bulk", "max_tokens": 16})
+        assert status == 429
+        err = json.loads(data)["error"]
+        assert err["code"] == "rate_limited"
+        assert err["message"] == \
+            "tenant 'bulk' exceeded its generated-token rate limit"
+        assert int(retry) >= 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()

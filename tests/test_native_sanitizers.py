@@ -254,6 +254,125 @@ def _drive(binary: Path):
         assert "runtime error:" not in (fo_err or ""), fo_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (fo_err or ""), fo_err[-3000:]
 
+        # per-tenant QoS under the sanitizer: the gate's token buckets,
+        # tenant metric maps and priority resolution all sit behind one
+        # mutex that every request thread (and /metrics scraper) hits —
+        # hammer a shared rate-limited tenant from many threads while
+        # unlimited tenants with mixed priority headers pass through
+        import tempfile
+        qos_dir = tempfile.mkdtemp(prefix="llmk-qos-san-")
+        qos_cfg = Path(qos_dir) / "router.json"
+        qos_cfg.write_text(json.dumps({
+            "backends": {
+                "sanmodel": f"http://127.0.0.1:{backend.server_address[1]}"},
+            "default_model": "sanmodel",
+            "qos": {
+                "tenants": {
+                    "alice": {"priority": "interactive",
+                              "rps": 1, "burst": 1},
+                    "budget": {"priority": "batch",
+                               "tokens_per_min": 60},
+                },
+                "default": {"weight": 1},
+                "brownout": {"queue_depth_hi": 1000},
+            },
+        }))
+        qos_port = free_port()
+        qp = subprocess.Popen(
+            [str(binary), "router", "--config", str(qos_cfg),
+             "--port", str(qos_port), "--quiet"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", qos_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            def qos_traffic(i: int) -> tuple[int, int]:
+                """Mixed-tenant traffic; returns (#served, #shed)."""
+                served = shed = 0
+                prio = ("interactive", "normal", "batch")[i % 3]
+                for j in range(4):
+                    # every thread contends on alice's 1 rps bucket,
+                    # then sends as its own unlimited tenant
+                    user = "alice" if j % 2 == 0 else f"tenant-{i}"
+                    c = http.client.HTTPConnection("127.0.0.1", qos_port,
+                                                   timeout=15)
+                    c.request("POST", "/v1/chat/completions",
+                              body=json.dumps({"model": "sanmodel",
+                                               "user": user,
+                                               "max_tokens": 8}).encode(),
+                              headers={"Content-Type": "application/json",
+                                       "X-LLMK-Priority": prio})
+                    r = c.getresponse()
+                    body = json.loads(r.read())
+                    if r.status == 200:
+                        served += 1
+                        assert body["served_by"] == "sanmodel"
+                    else:
+                        shed += 1
+                        assert r.status == 429, body
+                        assert body["error"]["code"] == "rate_limited", body
+                        assert r.getheader("Retry-After"), body
+                    c.close()
+                    # scrape the tenant metric maps while writers run
+                    c = http.client.HTTPConnection("127.0.0.1", qos_port,
+                                                   timeout=15)
+                    c.request("GET", "/metrics")
+                    c.getresponse().read()
+                    c.close()
+                return served, shed
+
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                totals = list(pool.map(qos_traffic, range(16)))
+            assert sum(s for s, _ in totals) >= 16, totals   # own tenants pass
+            assert sum(d for _, d in totals) >= 1, totals    # alice got shed
+
+            # generated-token budget path: first charge drains the minute
+            # bucket, second request sheds with the token-budget message
+            def budget_req() -> tuple[int, dict]:
+                c = http.client.HTTPConnection("127.0.0.1", qos_port,
+                                               timeout=15)
+                c.request("POST", "/v1/chat/completions",
+                          body=json.dumps({"model": "sanmodel",
+                                           "user": "budget",
+                                           "max_tokens": 60}).encode(),
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                body = json.loads(r.read())
+                c.close()
+                return r.status, body
+            status, body = budget_req()
+            assert status == 200, body
+            status, body = budget_req()
+            assert status == 429, body
+            assert "generated-token" in body["error"]["message"], body
+
+            c = http.client.HTTPConnection("127.0.0.1", qos_port, timeout=15)
+            c.request("GET", "/metrics")
+            text = c.getresponse().read().decode()
+            c.close()
+            assert "llm_tenant_requests_total" in text
+            assert "llm_tenant_router_shed_total" in text
+        finally:
+            qp.terminate()
+            try:
+                _, qp_err = qp.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                qp.kill()
+                _, qp_err = qp.communicate()
+            shutil.rmtree(qos_dir, ignore_errors=True)
+        assert "ERROR: " not in (qp_err or ""), qp_err[-3000:]
+        assert "runtime error:" not in (qp_err or ""), qp_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (qp_err or ""), qp_err[-3000:]
+
         # kill-mid-stream + resume splice under the sanitizer: the journal
         # parser, re-framing relay and resume re-issue allocate per-line
         # buffers and share breaker/health state across the death — with

@@ -1,0 +1,562 @@
+"""Per-tenant QoS tests (ISSUE 10).
+
+Three layers, mirroring where the mechanisms live:
+
+1. ``TenantFairQueue`` units — weight ratios, priority classes, starvation
+   aging, and the sticky-peek contract the engine scheduler depends on.
+2. Shared-vector parity: ``tests/data/qos_vectors.json`` is the
+   byte-compatibility contract between the Python and native routers; this
+   file drives the Python side (the native side runs the same vectors via
+   ``llkt-router --qos-selftest``, see test_native_router.py).
+3. Engine integration — priority-ordered admission, greedy-output parity
+   under fair queuing (QoS must be semantically invisible), and
+   priority-aware preemption victim selection.
+"""
+
+import asyncio
+import json
+import pathlib
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu.engine.qos import (
+    MIN_WEIGHT,
+    TenantFairQueue,
+    normalize_priority,
+    priority_rank,
+)
+from llms_on_kubernetes_tpu.server.qos import (
+    PRIORITY_HEADER,
+    QoSGate,
+    default_token_charge,
+    retry_after_s,
+)
+
+VECTORS = json.loads(
+    (pathlib.Path(__file__).parent / "data" / "qos_vectors.json").read_text())
+
+
+class FakeReq:
+    """The attribute subset TenantFairQueue reads off engine Requests."""
+
+    def __init__(self, tenant, priority="normal", submitted_at=0.0):
+        self.tenant = tenant
+        self.priority = priority
+        self.submitted_at = submitted_at
+
+    def __repr__(self):
+        return f"<{self.tenant}/{self.priority}>"
+
+
+class FakeClock:
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self):
+        return self.value
+
+
+def drain_tenants(q):
+    out = []
+    while q:
+        out.append(q.popleft().tenant)
+    return out
+
+
+# -- 1. fair queue units ------------------------------------------------
+
+
+def test_priority_rank_and_normalize():
+    assert priority_rank("interactive") == 0
+    assert priority_rank("normal") == 1
+    assert priority_rank("batch") == 2
+    assert priority_rank(None) == 1
+    assert priority_rank("vip") == 1
+    assert normalize_priority(" Interactive ") == "interactive"
+    assert normalize_priority("vip") == "normal"
+    assert normalize_priority("vip", default="batch") == "batch"
+    assert normalize_priority(None, default="junk") == "normal"
+
+
+def test_drr_weight_ratio_over_backlog():
+    # weights 4:1 over deep backlogs: service interleaves 4-to-1 until the
+    # heavy tenant drains, then the light one gets the residue
+    q = TenantFairQueue(weights={"a": 4.0, "b": 1.0}, starvation_s=0)
+    for i in range(10):
+        q.append(FakeReq("a"))
+        q.append(FakeReq("b"))
+    assert "".join(drain_tenants(q)) == "aaaabaaaabaabbbbbbbb"
+
+
+def test_equal_weights_round_robin():
+    q = TenantFairQueue(starvation_s=0)
+    for _ in range(3):
+        q.append(FakeReq("a"))
+        q.append(FakeReq("b"))
+    assert "".join(drain_tenants(q)) == "ababab"
+
+
+def test_priority_classes_strict_order():
+    q = TenantFairQueue(starvation_s=0)
+    q.append(FakeReq("t", "batch"))
+    q.append(FakeReq("t", "normal"))
+    q.append(FakeReq("t", "interactive"))
+    got = []
+    while q:
+        got.append(q.popleft().priority)
+    assert got == ["interactive", "normal", "batch"]
+
+
+def test_starvation_aging_promotes_old_batch_head():
+    clock = FakeClock(0.0)
+    q = TenantFairQueue(starvation_s=5.0, clock=clock)
+    old_batch = FakeReq("bulk", "batch", submitted_at=0.0)
+    q.append(old_batch)
+    q.append(FakeReq("fe", "interactive", submitted_at=0.0))
+    # not starved yet: interactive wins
+    clock.value = 1.0
+    assert q.popleft().priority == "interactive"
+    q.append(FakeReq("fe", "interactive", submitted_at=1.0))
+    # batch head has now waited > starvation_s: it preempts the class scan
+    clock.value = 10.0
+    assert q.popleft() is old_batch
+    assert q.popleft().priority == "interactive"
+
+
+def test_starvation_disabled_means_strict_priority():
+    clock = FakeClock(1000.0)
+    q = TenantFairQueue(starvation_s=0, clock=clock)
+    q.append(FakeReq("bulk", "batch", submitted_at=0.0))
+    q.append(FakeReq("fe", "interactive", submitted_at=999.0))
+    assert q.popleft().priority == "interactive"
+
+
+def test_sticky_peek_until_popped():
+    q = TenantFairQueue(weights={"a": 1.0, "b": 100.0}, starvation_s=0)
+    a = FakeReq("a")
+    q.append(a)
+    head = q[0]
+    assert head is a
+    # arrivals (even far heavier tenants, even higher classes) must not
+    # silently change the head the scheduler already pinned resources for
+    q.append(FakeReq("b"))
+    q.append(FakeReq("c", "interactive"))
+    assert q[0] is head
+    assert q.popleft() is head
+
+
+def test_appendleft_takes_over_head():
+    q = TenantFairQueue(starvation_s=0)
+    q.append(FakeReq("a", "interactive"))
+    assert q[0].tenant == "a"
+    victim = FakeReq("v", "batch")
+    q.appendleft(victim)  # the preemption requeue jumps everything
+    assert q[0] is victim
+    assert q.popleft() is victim
+    assert q.popleft().tenant == "a"
+
+
+def test_remove_and_index_errors():
+    q = TenantFairQueue(starvation_s=0)
+    a, b = FakeReq("a"), FakeReq("b")
+    q.append(a)
+    q.append(b)
+    assert q[0] is a
+    q.remove(a)  # removing the sticky head re-plans the next peek
+    assert q[0] is b
+    with pytest.raises(ValueError):
+        q.remove(FakeReq("zzz"))
+    with pytest.raises(IndexError):
+        q[1]
+    q.remove(b)
+    assert len(q) == 0
+    with pytest.raises(IndexError):
+        q[0]
+    with pytest.raises(IndexError):
+        q.popleft()
+
+
+def test_iteration_len_clear():
+    q = TenantFairQueue(starvation_s=0)
+    reqs = [FakeReq("a"), FakeReq("b"), FakeReq("a", "batch")]
+    for r in reqs:
+        q.append(r)
+    assert len(q) == 3
+    assert set(iter(q)) == set(reqs)
+    assert bool(q)
+    q.clear()
+    assert len(q) == 0 and not bool(q)
+    assert list(q) == []
+
+
+def test_deficit_not_banked_across_idle():
+    q = TenantFairQueue(weights={"a": 50.0}, starvation_s=0)
+    q.append(FakeReq("a"))
+    q.popleft()
+    # an emptied tenant forgets its DRR state entirely
+    assert all(not d for d in q._deficit)
+    assert all(not o for o in q._order)
+
+
+def test_weight_floor():
+    q = TenantFairQueue(weights={"a": 0.0, "b": -5.0}, starvation_s=0)
+    assert q._weights["a"] == MIN_WEIGHT
+    assert q._weights["b"] == MIN_WEIGHT
+    # still terminates and serves everyone
+    q.append(FakeReq("a"))
+    q.append(FakeReq("b"))
+    assert sorted(drain_tenants(q)) == ["a", "b"]
+
+
+# -- 2. shared-vector parity (Python side) ------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["retry_after"])
+def test_vector_retry_after(case):
+    assert retry_after_s(case["seconds"]) == case["expect"]
+
+
+@pytest.mark.parametrize("case", VECTORS["token_charge"])
+def test_vector_token_charge(case):
+    assert default_token_charge(case["doc"]) == case["expect"]
+
+
+@pytest.mark.parametrize("case", VECTORS["resolve"])
+def test_vector_resolve(case):
+    gate = QoSGate(case["config"])
+    tenant, priority = gate.resolve(
+        case["doc"], case["resolved_model"], case["header"])
+    assert tenant == case["expect_tenant"]
+    assert priority == case["expect_priority"]
+
+
+@pytest.mark.parametrize("group", VECTORS["gate"],
+                         ids=[g.get("_comment", str(i))[:40]
+                              for i, g in enumerate(VECTORS["gate"])])
+def test_vector_gate(group):
+    clock = FakeClock(0.0)
+    gate = QoSGate(group["config"], clock=clock)
+    for i, check in enumerate(group["checks"]):
+        clock.value = float(check["at"])
+        v = gate.check(
+            check["tenant"], check["priority"], int(check["charge"]),
+            float(check.get("queue_depth", 0.0)),
+            float(check.get("burn_rate", 0.0)),
+            int(check.get("forced_level", 0)))
+        exp = check["expect"]
+        assert v.action == exp["action"], f"check {i}: {v.message}"
+        if "reason" in exp:
+            assert v.reason == exp["reason"], f"check {i}"
+        if "retry_after" in exp:
+            assert v.retry_after == exp["retry_after"], f"check {i}"
+        if "clamp_max_tokens" in exp:
+            assert v.clamp_max_tokens == exp["clamp_max_tokens"], f"check {i}"
+        if "message" in exp:
+            assert v.message == exp["message"], f"check {i}"
+
+
+def test_gate_enabled_truthiness():
+    assert not QoSGate(None).enabled
+    assert not QoSGate({}).enabled
+    # empty sub-blocks do NOT enable (both routers agree on this)
+    assert not QoSGate({"tenants": {}, "default": {}, "brownout": {}}).enabled
+    assert QoSGate({"tenants": {"t": {}}}).enabled
+    assert QoSGate({"default": {"rps": 1}}).enabled
+    assert QoSGate({"brownout": {"queue_depth_hi": 5}}).enabled
+
+
+def test_default_entry_applies_to_unlisted_tenants():
+    clock = FakeClock(0.0)
+    gate = QoSGate({"default": {"rps": 1, "burst": 1}}, clock=clock)
+    assert gate.check("anyone", "normal", 16, 0.0, 0.0).action == "pass"
+    v = gate.check("anyone", "normal", 16, 0.0, 0.0)
+    assert v.action == "shed" and v.reason == "rate_limited"
+    # independent bucket per tenant
+    assert gate.check("someone-else", "normal", 16, 0.0, 0.0).action == "pass"
+
+
+# -- 3. engine integration ---------------------------------------------
+
+
+def _engine_mod():
+    # deferred so layer-1/2 tests stay importable without jax
+    from tests.test_engine import GREEDY, make_engine
+    from llms_on_kubernetes_tpu.engine.engine import SamplingParams
+    return make_engine, SamplingParams, GREEDY
+
+
+def _run(eng, max_steps=3000):
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+
+
+def test_engine_priority_admission_order():
+    make_engine, SamplingParams, GREEDY = _engine_mod()
+    eng = make_engine(max_decode_slots=1)
+    p = SamplingParams(max_tokens=2, **GREEDY)
+    batch = eng.submit([1, 2, 3], p, tenant="bulk", priority="batch")
+    inter = eng.submit([4, 5, 6], p, tenant="fe", priority="interactive")
+    _run(eng)
+    assert batch.finished and inter.finished
+    # interactive overtook the earlier-submitted batch request
+    assert inter.admitted_at < batch.admitted_at
+    # admission accounting landed per (tenant, priority)
+    assert eng.tenant_admitted[("fe", "interactive")] == 1
+    assert eng.tenant_admitted[("bulk", "batch")] == 1
+    waits = {t: w for t, w, _p in eng.tenant_wait_obs}
+    assert set(waits) == {"fe", "bulk"}
+    assert all(w >= 0 for w in waits.values())
+
+
+def test_engine_weighted_share_under_contention():
+    make_engine, SamplingParams, GREEDY = _engine_mod()
+    eng = make_engine(max_decode_slots=1,
+                      qos_weights={"a": 4.0, "b": 1.0},
+                      qos_starvation_s=0)
+    p = SamplingParams(max_tokens=1, **GREEDY)
+    reqs = []
+    for _ in range(4):
+        reqs.append(eng.submit([7, 8], p, tenant="a"))
+        reqs.append(eng.submit([9, 10], p, tenant="b"))
+    _run(eng)
+    assert all(r.finished for r in reqs)
+    order = [r.tenant for r in sorted(reqs, key=lambda r: r.admitted_at)]
+    # 4:1 DRR: the first burst of admissions goes mostly to the heavy tenant
+    assert order[:4].count("a") == 4
+    # ...but the light tenant is never starved out
+    assert "b" in order[:5]
+
+
+def test_engine_config_priority_map_applies_at_submit():
+    make_engine, SamplingParams, GREEDY = _engine_mod()
+    eng = make_engine(qos_priorities={"fe": "interactive", "bulk": "batch"},
+                      qos_default_priority="normal")
+    p = SamplingParams(max_tokens=1, **GREEDY)
+    assert eng.submit([1], p, tenant="fe").priority == "interactive"
+    assert eng.submit([1], p, tenant="bulk").priority == "batch"
+    assert eng.submit([1], p, tenant="other").priority == "normal"
+    # explicit submit arg beats the config map; junk normalizes
+    assert eng.submit([1], p, tenant="bulk",
+                      priority="interactive").priority == "interactive"
+    assert eng.submit([1], p, tenant="x", priority="vip").priority == "normal"
+    _run(eng)
+
+
+def test_engine_greedy_parity_with_qos_active():
+    # fair queuing must be semantically invisible: same greedy outputs as
+    # isolated generation, whatever the tenant mix
+    make_engine, SamplingParams, GREEDY = _engine_mod()
+    p = SamplingParams(max_tokens=8, **GREEDY)
+    prompts = [[3, 17, 9], [40, 2], [7, 7, 7, 7], [100, 42, 5, 1, 9]]
+    solo = [make_engine().generate(pr, p) for pr in prompts]
+    eng = make_engine(qos_weights={"a": 3.0, "b": 1.0},
+                      qos_priorities={"b": "batch"})
+    tenants = ["a", "b", "a", "b"]
+    reqs = [eng.submit(pr, p, tenant=t) for pr, t in zip(prompts, tenants)]
+    _run(eng)
+    assert all(r.finished for r in reqs)
+    for r, expected in zip(reqs, solo):
+        assert r.output == expected, f"QoS changed greedy output for {r.id}"
+
+
+def test_engine_preemption_victims_lowest_priority_first():
+    # tight KV pool forces preemption; the victim must come from the
+    # lowest class on the device, and every stream must still finish with
+    # byte-identical greedy output (pages restored on re-admission)
+    make_engine, SamplingParams, GREEDY = _engine_mod()
+    p = SamplingParams(max_tokens=12, **GREEDY)
+    prompts = [[3, 17, 9], [40, 2, 8, 11], [7, 7, 7]]
+    prios = ["interactive", "interactive", "batch"]
+    solo = [make_engine().generate(pr, p) for pr in prompts]
+
+    eng = make_engine(num_pages=10, pages_per_slot=8, max_decode_slots=3,
+                      qos_starvation_s=0)
+    reqs = [eng.submit(pr, p, tenant=f"t{i}", priority=pr_)
+            for i, (pr, pr_) in enumerate(zip(prompts, prios))]
+    by_id = {id(r): r for r in reqs}
+
+    evicted = []
+    orig = eng._preempt_youngest
+
+    def spy():
+        before = {id(r) for r in eng.slots if r is not None}
+        orig()
+        after = {id(r) for r in eng.slots if r is not None}
+        evicted.extend(by_id[i].priority for i in before - after)
+
+    eng._preempt_youngest = spy
+    _run(eng)
+    assert all(r.finished for r in reqs)
+    assert eng.preemptions > 0, "pool was sized to force preemption"
+    # batch sheds before interactive ever does
+    assert evicted and all(pr == "batch" for pr in evicted)
+    for r, expected in zip(reqs, solo):
+        assert r.output == expected, f"preemption corrupted {r.id}"
+
+
+# -- 4. Python router end-to-end ---------------------------------------
+
+
+def _make_backend():
+    async def completions(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        return web.json_response({
+            "served_by": "b",
+            "max_tokens": body.get("max_tokens"),
+            "priority_hdr": request.headers.get(PRIORITY_HEADER, ""),
+        })
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", completions)
+    return app
+
+
+def run_with_qos_router(fn, qos, **router_kw):
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    async def go():
+        backend = TestClient(TestServer(_make_backend()))
+        await backend.start_server()
+        router = Router({"m": str(backend.make_url(""))}, qos=qos,
+                        **router_kw)
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+            await backend.close()
+    asyncio.run(go())
+
+
+def test_router_rate_limit_429_with_retry_after():
+    qos = {"tenants": {"alice": {"rps": 1, "burst": 1}}}
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "alice"})
+        assert r.status == 200
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "alice"})
+        assert r.status == 429
+        assert r.headers["Retry-After"] == "1"
+        err = (await r.json())["error"]
+        assert err["code"] == "rate_limited"
+        assert err["type"] == "rate_limit_exceeded"
+        assert "'alice'" in err["message"]
+        # an unlimited tenant is unaffected by alice's bucket
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "bob"})
+        assert r.status == 200
+    run_with_qos_router(body, qos)
+
+
+def test_router_token_budget_rate_limit():
+    qos = {"tenants": {"alice": {"rps": 100, "burst": 100,
+                                 "tokens_per_min": 60}}}
+
+    async def body(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m", "user": "alice", "max_tokens": 60})
+        assert r.status == 200
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m", "user": "alice", "max_tokens": 16})
+        assert r.status == 429
+        err = (await r.json())["error"]
+        assert err["code"] == "rate_limited"
+        assert "generated-token" in err["message"]
+        assert int(r.headers["Retry-After"]) >= 1
+    run_with_qos_router(body, qos)
+
+
+def test_router_overload_spike_sheds_by_priority(monkeypatch):
+    monkeypatch.setenv("LLMK_FAULT", "overload_spike:2")
+    qos = {"tenants": {"fe": {"priority": "interactive"},
+                       "bulk": {"priority": "batch"}},
+           "brownout": {"queue_depth_hi": 1000,
+                        "clamp_max_tokens": 24}}
+
+    async def body(client):
+        # level 2: batch sheds with the overloaded body...
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "bulk"})
+        assert r.status == 429
+        err = (await r.json())["error"]
+        assert err["code"] == "overloaded"
+        assert "brownout level 2" in err["message"]
+        assert r.headers["Retry-After"] == "4"
+        # ...normal degrades (max_tokens clamped)...
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m", "user": "norm", "max_tokens": 512})
+        assert r.status == 200
+        assert (await r.json())["max_tokens"] == 24
+        # ...interactive passes untouched
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m", "user": "fe", "max_tokens": 512})
+        assert r.status == 200
+        assert (await r.json())["max_tokens"] == 512
+    run_with_qos_router(body, qos)
+
+
+def test_router_priority_header_resolved_and_injected():
+    qos = {"tenants": {"fe": {"priority": "interactive"}}}
+
+    async def body(client):
+        # config-mapped priority is injected upstream
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "fe"})
+        assert (await r.json())["priority_hdr"] == "interactive"
+        # a valid client header wins; the client value is re-written (the
+        # upstream sees the RESOLVED priority, never raw client input)
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "fe"},
+                              headers={PRIORITY_HEADER: "  BATCH  "})
+        assert (await r.json())["priority_hdr"] == "batch"
+        # an invalid header falls through to the config mapping
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m", "user": "fe"},
+                              headers={PRIORITY_HEADER: "vip"})
+        assert (await r.json())["priority_hdr"] == "interactive"
+    run_with_qos_router(body, qos)
+
+
+def test_router_qos_disabled_passthrough():
+    async def body(client):
+        for _ in range(5):
+            r = await client.post("/v1/chat/completions",
+                                  json={"model": "m", "user": "anyone"})
+            assert r.status == 200
+        # header still scrubbed/injected even with no QoS config
+        r = await client.post("/v1/chat/completions",
+                              json={"model": "m"},
+                              headers={PRIORITY_HEADER: "batch"})
+        assert (await r.json())["priority_hdr"] == "batch"
+    run_with_qos_router(body, qos=None)
+
+
+def test_router_tenant_metrics_exported():
+    qos = {"tenants": {"alice": {"rps": 1, "burst": 1}}}
+
+    async def body(client):
+        await client.post("/v1/chat/completions",
+                          json={"model": "m", "user": "alice"})
+        await client.post("/v1/chat/completions",
+                          json={"model": "m", "user": "alice"})
+        text = await (await client.get("/metrics")).text()
+        assert ('llm_tenant_requests_total{tenant="alice",'
+                'priority="normal"} 2.0' in text)
+        assert ('llm_tenant_router_shed_total{tenant="alice",'
+                'priority="normal",reason="rate_limited"} 1.0' in text)
+        assert 'llm_tenant_tokens_total{tenant="alice"}' in text
+    run_with_qos_router(body, qos)

@@ -1,0 +1,135 @@
+"""Schema-drift guard (ISSUE 10 satellite): every key present in each
+chart's shipped values.yaml must be *described* by its values.schema.json.
+
+``test_values_schema_validates_chart_defaults`` (test_manifests.py) only
+proves the defaults validate — against a schema without top-level
+``additionalProperties: false`` (tpu-models), a brand-new values key that
+nobody added to the schema still validates silently and ships
+undocumented. This walk closes that gap for both charts, resolving keys
+through ``properties``, object ``additionalProperties`` sub-schemas,
+array ``items``, local ``$ref``s, and ``allOf`` compositions.
+"""
+
+import json
+import pathlib
+
+import pytest
+import yaml
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+CHARTS = ("tpu-models", "local-models")
+
+
+def _deref(schema, root):
+    """Resolve local $ref / allOf composition into a list of candidate
+    sub-schemas describing one node."""
+    out = []
+    stack = [schema]
+    while stack:
+        s = stack.pop()
+        if not isinstance(s, dict):
+            continue
+        if "$ref" in s:
+            ref = s["$ref"]
+            assert ref.startswith("#/"), f"non-local $ref: {ref}"
+            node = root
+            for part in ref[2:].split("/"):
+                node = node[part]
+            stack.append(node)
+        if "allOf" in s:
+            stack.extend(s["allOf"])
+        out.append(s)
+    return out
+
+
+def _undocumented(value, schema, root, path):
+    """Yield dotted paths of keys in ``value`` with no matching schema."""
+    candidates = _deref(schema, root)
+    if isinstance(value, dict):
+        structured = any(
+            "properties" in c or c.get("additionalProperties") is False
+            or isinstance(c.get("additionalProperties"), dict)
+            for c in candidates)
+        if not structured:
+            # a deliberately free-form object (e.g. resources:) — every
+            # key under it is described by the schema saying "anything"
+            return
+        for k, v in value.items():
+            sub = None
+            for c in candidates:
+                props = c.get("properties", {})
+                if k in props:
+                    sub = props[k]
+                    break
+                ap = c.get("additionalProperties")
+                if isinstance(ap, dict):
+                    sub = ap
+                    break
+            child_path = f"{path}.{k}" if path else k
+            if sub is None:
+                yield child_path
+            else:
+                yield from _undocumented(v, sub, root, child_path)
+    elif isinstance(value, list):
+        items = None
+        for c in candidates:
+            if isinstance(c.get("items"), dict):
+                items = c["items"]
+                break
+        if items is not None:
+            for i, v in enumerate(value):
+                yield from _undocumented(v, items, root, f"{path}[{i}]")
+        # free-form arrays (no items schema) are considered described
+
+
+@pytest.mark.parametrize("chart", CHARTS)
+def test_every_values_key_is_described_in_schema(chart):
+    cdir = ROOT / chart / "helm-chart"
+    schema = json.loads((cdir / "values.schema.json").read_text())
+    values = yaml.safe_load((cdir / "values.yaml").read_text())
+    missing = sorted(_undocumented(values, schema, schema, ""))
+    assert not missing, (
+        f"{chart}: values.yaml keys undescribed by values.schema.json "
+        f"(add them to the schema — undocumented knobs drift): {missing}")
+
+
+@pytest.mark.parametrize("chart", CHARTS)
+def test_drift_walk_actually_detects_a_rogue_key(chart):
+    """The walk itself must not silently pass everything: inject a key
+    the schema has never heard of and require a finding."""
+    cdir = ROOT / chart / "helm-chart"
+    schema = json.loads((cdir / "values.schema.json").read_text())
+    values = yaml.safe_load((cdir / "values.yaml").read_text())
+    values["router"]["definitelyNotAKnob"] = 1
+    values["models"][0]["alsoNotAKnob"] = True
+    missing = set(_undocumented(values, schema, schema, ""))
+    assert "router.definitelyNotAKnob" in missing
+    assert "models[0].alsoNotAKnob" in missing
+
+
+@pytest.mark.parametrize("chart", CHARTS)
+def test_qos_block_schema_round_trip(chart):
+    """The qos: block validates (shipped defaults) and rejects unknown
+    tenant keys / invalid priorities — the schema mirrors deploy.spec's
+    _qos_from validation so helm users fail at install, not at runtime."""
+    jsonschema = pytest.importorskip("jsonschema")
+    cdir = ROOT / chart / "helm-chart"
+    schema = json.loads((cdir / "values.schema.json").read_text())
+    values = yaml.safe_load((cdir / "values.yaml").read_text())
+    assert "qos" in values, "chart lost its qos: block"
+    jsonschema.validate(values, schema)
+
+    import copy
+    bad = copy.deepcopy(values)
+    bad["qos"]["tenants"]["frontend" if chart == "tpu-models"
+                          else "webui"]["rate"] = 5  # not a wire key
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+    bad = copy.deepcopy(values)
+    bad["qos"]["brownout"] = {"queueDepthHi": 1}  # camelCase ≠ wire name
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+    bad = copy.deepcopy(values)
+    bad["qos"]["tenants"] = {"t": {"priority": "vip"}}
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
