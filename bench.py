@@ -1333,6 +1333,89 @@ def fairness_bench() -> dict:
     }
 
 
+def spec_bench() -> dict:
+    """Speculative decoding on the fused window (ISSUE 12).
+
+    Three greedy runs on the tiny CPU config: (1) speculation OFF — the
+    parity reference; (2) speculation ON over lookup-friendly traffic
+    (logit-bias-pinned output: the drafter's n-gram always continues
+    correctly, so every draft is accepted — the best case the engine
+    must actually reach); (3) speculation ON over adversarial traffic
+    (unpinned pseudo-random continuations the prompt cannot predict).
+    Reports the accept ratio and per-row dispatches/token for each, plus
+    ``spec_parity_ok`` — outputs bit-identical with speculation on/off —
+    which scripts/ci.sh gates alongside accept_ratio > 0 and the
+    dispatches_per_token ceiling on the smoke run.
+
+    Runs on debug-tiny regardless of BENCH_MODEL: the scenario measures
+    the drafting/verify/accept machinery, not the model.
+    """
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import (
+        Engine, EngineConfig, SamplingParams,
+    )
+
+    model = "debug-tiny"
+    cfg = get_config(model)
+    K = 4
+
+    def mk(speculation):
+        return Engine(EngineConfig(
+            model=model, dtype="float32", max_decode_slots=8,
+            page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
+            prefill_buckets=(32,), async_scheduling=True, async_depth=2,
+            decode_steps=K, speculation=speculation))
+
+    def run(eng, pinned: bool, gen: int = 24) -> tuple[list, dict]:
+        rng = np.random.default_rng(7)
+        reqs = []
+        for i in range(6):
+            prompt = list(rng.integers(1, cfg.vocab_size - 1, 24))
+            # pinned: one token dominates the logits, so generated output
+            # is a run the prompt-lookup drafter extends perfectly
+            sp = SamplingParams(
+                temperature=0.0, max_tokens=gen,
+                logit_bias=(((42 + i % 2, 90.0),) if pinned else ()))
+            reqs.append(eng.submit(prompt, sp))
+        steps = 0
+        while any(not r.finished for r in reqs):
+            eng.step()
+            steps += 1
+            assert steps < 100_000, "spec bench wedged"
+        drafted = getattr(eng, "spec_drafted_tokens", 0)
+        accepted = getattr(eng, "spec_accepted_tokens", 0)
+        obs = list(getattr(eng, "steps_obs", ()) or ())
+        return [list(r.output) for r in reqs], {
+            "accept_ratio": (round(accepted / drafted, 4) if drafted
+                             else 0.0),
+            "dispatches_per_token": (round(len(obs) / sum(obs), 4)
+                                     if sum(obs) else None),
+            "drafted": int(drafted),
+        }
+
+    ref_eng = mk(None)
+    ref_out, _ = run(ref_eng, pinned=True)
+    del ref_eng
+
+    spec_eng = mk("ngram")
+    spec_out, friendly = run(spec_eng, pinned=True)
+    del spec_eng
+
+    adv_eng = mk("ngram")
+    _, adversarial = run(adv_eng, pinned=False)
+    del adv_eng
+
+    return {
+        "spec_parity_ok": spec_out == ref_out,
+        "spec_accept_ratio": friendly["accept_ratio"],
+        "spec_dispatches_per_token": friendly["dispatches_per_token"],
+        "spec_drafted_tokens": friendly["drafted"],
+        "spec_adversarial_accept_ratio": adversarial["accept_ratio"],
+        "spec_adversarial_dispatches_per_token":
+            adversarial["dispatches_per_token"],
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1555,6 +1638,13 @@ def _main() -> int:
         fairness = with_retries("fairness", fairness_bench, errors,
                                 attempts=1) or {}
 
+    # --- phase 7: speculative decoding (lookup-friendly vs adversarial) -
+    # Tiny-CPU-sized; ci.sh gates spec_parity_ok, accept_ratio > 0 and
+    # the dispatches_per_token ceiling on the smoke run.
+    spec = {}
+    if smoke or os.environ.get("BENCH_SPEC"):
+        spec = with_retries("spec", spec_bench, errors, attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -1569,6 +1659,7 @@ def _main() -> int:
         **spike,
         **resume,
         **fairness,
+        **spec,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

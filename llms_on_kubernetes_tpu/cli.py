@@ -52,6 +52,16 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="decode tokens sampled per fused device dispatch "
                         "(default: $LLMK_DECODE_STEPS or 4; forced to 1 "
                         "on multihost)")
+    p.add_argument("--speculation", choices=["ngram", "draft"], default=None,
+                   help="speculative decoding riding the fused decode "
+                        "window: ngram = model-free prompt lookup, draft = "
+                        "small draft model via --draft-model (default: "
+                        "$LLMK_SPECULATION or off; greedy outputs are "
+                        "bit-identical on/off; dropped on multihost)")
+    p.add_argument("--draft-model", default=None,
+                   help="draft model for --speculation draft (registry "
+                        "name or .gguf path; default: $LLMK_DRAFT_MODEL; "
+                        "implies --speculation draft)")
     def _positive_int(v: str) -> int:
         n = int(v)
         if n < 1:
@@ -361,6 +371,8 @@ def main(argv: list[str] | None = None) -> int:
         prefix_caching=args.prefix_caching,
         kv_cache_dtype=args.kv_cache_dtype,
         decode_steps=args.decode_steps,
+        speculation=args.speculation,
+        draft_model=args.draft_model,
         max_images_per_request=args.max_images_per_request,
         adapters=adapters,
         adapter_slots=args.adapter_slots,

@@ -143,6 +143,13 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
         # to 1 on multihost regardless of what the spec asks for
         c["env"].append({"name": "LLMK_DECODE_STEPS",
                          "value": str(m.decode_steps)})
+    if m.speculation is not None:
+        # same env convention as the decode window; the engine ignores
+        # speculation on multihost after its decode_steps clamp
+        c["env"].append({"name": "LLMK_SPECULATION",
+                         "value": m.speculation})
+    if m.draft is not None:
+        c["env"].append({"name": "LLMK_DRAFT_MODEL", "value": m.draft})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on

@@ -271,6 +271,37 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKSpecLowAccept",
+                        # advisory: speculation is burning verify FLOPs
+                        # without paying for itself. The engine demotes
+                        # drafting adaptively, so this is a tuning
+                        # signal (switch ngram <-> draft tier, or turn
+                        # speculation off for this traffic), never a
+                        # correctness problem — greedy outputs are
+                        # bit-identical either way.
+                        "expr": (
+                            "rate(llm_spec_accepted_total[15m]) / "
+                            "rate(llm_spec_drafted_total[15m]) < 0.2 "
+                            "and rate(llm_spec_drafted_total[15m]) > 1"
+                        ),
+                        "for": "30m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "speculative decoding accept "
+                                       "ratio persistently low",
+                            "description": (
+                                "Fewer than 20% of drafted tokens on "
+                                "{{ $labels.instance }} survive the "
+                                "verify pass over 30m. The drafter does "
+                                "not fit this traffic; consider the "
+                                "draft-model tier (draft:) for free-form "
+                                "chat, prompt-lookup (speculation: "
+                                "ngram) for RAG/code/summarization, or "
+                                "disabling speculation for this model."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -381,6 +412,14 @@ def grafana_dashboard() -> dict[str, Any]:
                 "sum by (tenant) "
                 "(rate(llm_tenant_admitted_total[5m]))"], 12, 64,
                unit="s"),
+        _panel(19, "Speculative decode: accept ratio",
+               ["llm_spec_accept_ratio",
+                "rate(llm_spec_accepted_total[5m]) / "
+                "rate(llm_spec_drafted_total[5m])"], 0, 72,
+               unit="percentunit"),
+        _panel(20, "Speculative decode: drafted / accepted rate",
+               ["rate(llm_spec_drafted_total[5m])",
+                "rate(llm_spec_accepted_total[5m])"], 12, 72),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

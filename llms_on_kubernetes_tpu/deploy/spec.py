@@ -281,6 +281,13 @@ class ModelSpec:
     # clamp to 1 at engine start until the broadcast protocol carries
     # the window, so the spec accepts it everywhere.
     decode_steps: Optional[int] = None
+    # speculative decoding tier (LLMK_SPECULATION): None = off,
+    # "ngram" = model-free prompt lookup, "draft" = small draft model.
+    # `draft` names the draft checkpoint (registry name or .gguf path,
+    # LLMK_DRAFT_MODEL) and implies speculation: draft. Needs
+    # decodeSteps >= 2 — drafts ride the fused decode window.
+    speculation: Optional[str] = None
+    draft: Optional[str] = None
     # multi-tenant LoRA: adapters served on this model's replicas, the
     # device slot count (LRU-recycled) and max rank the slots are sized for
     adapters: tuple = ()                   # tuple[AdapterSpec, ...]
@@ -308,6 +315,28 @@ class ModelSpec:
             raise SpecError(
                 f"model {self.model_name}: decodeSteps must be >= 1, "
                 f"got {self.decode_steps}"
+            )
+        if self.speculation not in (None, "ngram", "draft"):
+            raise SpecError(
+                f"model {self.model_name}: speculation must be 'ngram' or "
+                f"'draft', got {self.speculation!r}"
+            )
+        if self.speculation == "draft" and not self.draft:
+            raise SpecError(
+                f"model {self.model_name}: speculation: draft needs a "
+                f"draft: model reference (registry name or .gguf path)"
+            )
+        if self.draft and self.speculation == "ngram":
+            raise SpecError(
+                f"model {self.model_name}: draft: {self.draft!r} is unused "
+                f"under speculation: ngram — drop one of them"
+            )
+        if self.speculation is not None and self.decode_steps is not None \
+                and self.decode_steps < 2:
+            raise SpecError(
+                f"model {self.model_name}: speculation needs "
+                f"decodeSteps >= 2 (drafts ride the fused decode window), "
+                f"got decodeSteps: {self.decode_steps}"
             )
         if self.quantization not in (None, "int8", "fp8", "awq"):
             raise SpecError(
@@ -537,6 +566,7 @@ def _model_from(d: dict) -> ModelSpec:
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype", "decodeSteps",
+        "speculation", "draft",
         "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
     unknown = set(d) - known
@@ -567,6 +597,10 @@ def _model_from(d: dict) -> ModelSpec:
         dtype=d.get("dtype"),
         decode_steps=(int(d["decodeSteps"]) if "decodeSteps" in d
                       else None),
+        # draft: alone implies speculation: draft (mirrors EngineConfig)
+        speculation=(d.get("speculation")
+                     or ("draft" if d.get("draft") else None)),
+        draft=d.get("draft"),
         adapters=tuple(_adapter_from(a, d.get("modelName", ""))
                        for a in d.get("adapters", ()) or ()),
         adapter_slots=int(d.get("adapterSlots", 4)),

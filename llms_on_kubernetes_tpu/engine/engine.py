@@ -44,7 +44,8 @@ from llms_on_kubernetes_tpu.engine.sampling import (
     MAX_CANDIDATES, HostSample, sample,
 )
 from llms_on_kubernetes_tpu.models.decoder import (
-    forward_chunk, forward_decode, forward_prefill, init_params,
+    forward_chunk, forward_decode, forward_prefill, forward_verify,
+    init_params,
 )
 
 Params = dict[str, Any]
@@ -216,6 +217,18 @@ class EngineConfig:
     # (same PRNG positions, same penalty-count evolution) — pinned by
     # tests/test_decode_multistep.py.
     decode_steps: Optional[int] = None
+    # speculative decoding on the fused window (engine/speculation.py):
+    # "ngram" drafts up to decode_steps-1 tokens per slot by prompt-lookup
+    # over the request's own context; "draft" rolls out a small draft
+    # model (draft_model: registry name or .gguf path). Drafted tokens
+    # ride the packed window and the target scores all K positions in one
+    # verify dispatch — greedy outputs are bit-identical to speculation
+    # off (exact-match acceptance), seeded sampling matches through the
+    # fold_in(base, seed)+position PRNG chain. None/"off" disables. Forced
+    # off under multihost (the K=1 clamp leaves no draft room anyway).
+    # Env: LLMK_SPECULATION / LLMK_DRAFT_MODEL.
+    speculation: Optional[str] = None
+    draft_model: Optional[str] = None
     # per-tenant QoS (engine/qos.py): admission runs deficit-weighted fair
     # queuing across tenants inside strict priority classes. qos_weights /
     # qos_priorities are (tenant, value) pairs (dicts normalize); unlisted
@@ -250,6 +263,27 @@ class EngineConfig:
             # followers mirror single-step MSG_DECODE programs; the packed
             # broadcast does not carry the window yet
             self.decode_steps = 1
+        if self.speculation is None:
+            self.speculation = os.environ.get("LLMK_SPECULATION") or None
+        if self.draft_model is None:
+            self.draft_model = os.environ.get("LLMK_DRAFT_MODEL") or None
+        if self.speculation in ("off", "none", ""):
+            self.speculation = None
+        if self.speculation is None and self.draft_model is not None:
+            self.speculation = "draft"  # a draft model implies the tier
+        if self.speculation not in (None, "ngram", "draft"):
+            raise ValueError(
+                f"speculation must be one of None/'off'/'ngram'/'draft', "
+                f"got {self.speculation!r}")
+        if self.speculation == "draft" and self.draft_model is None:
+            raise ValueError(
+                "speculation='draft' requires draft_model (registry name "
+                "or .gguf path)")
+        if self.multihost and self.speculation is not None:
+            # the K=1 clamp above leaves no draft room, and followers
+            # could not mirror a variable-accept window — reject cleanly
+            # rather than diverge
+            self.speculation = None
         if self.watchdog_stall_s is None:
             self.watchdog_stall_s = float(
                 os.environ.get("LLMK_WATCHDOG_S", "120"))
@@ -421,6 +455,9 @@ class InflightStep:
     active: list[tuple[int, Request]]      # (slot, request) snapshot at launch
     seq: int = -1                          # harvester sequence number
     planned: Optional[dict] = None         # slot -> tokens planned this window
+    spec: bool = False                     # speculative verify dispatch:
+    #                                        pack is (packs [K,B,W], accept [B])
+    drafted: Optional[dict] = None         # slot -> drafted tokens this window
 
 
 class _Harvester(threading.Thread):
@@ -874,6 +911,107 @@ def _decode_multi_packed_step(params, cfg, K, packed, last_toks,
     return packs, toks, k_pages, v_pages, counts, new_state
 
 
+def _decode_spec_packed_step(params, cfg, K, packed, k_pages, v_pages,
+                             counts, base_key, fsm=None):
+    """Speculative verify dispatch: ONE forward pass scores a window of
+    [committed token, K-1 drafted tokens] per slot (forward_verify), then
+    K sampling iterations run over the precomputed logits — no further
+    model dispatches. Returns ((packs [K, B, W], accept [B]), toks, ...):
+    ``accept`` is the per-row count of VALID sampled tokens, which the
+    harvest consumes instead of the planned budget.
+
+    Parity with the fused scan (_decode_multi_packed_step) — and therefore
+    with K=1 — is exact under greedy decoding and under seeded sampling:
+    iteration j sees the SAME logits (forward_verify is the same chunk
+    attention the sequential path produces position-by-position, pinned
+    bit-identical by tests/test_speculation.py), the same PRNG key
+    (_slot_keys folds base+seed+position), and the same penalty-count
+    evolution. The only new exit condition is draft mismatch: iteration
+    j's sampled token must equal draft j for iteration j+1's logits
+    (conditioned on draft j) to be valid — exact-match acceptance, i.e.
+    standard greedy speculative decoding. Rejected suffixes already wrote
+    KV, but the next dispatch starts at the accepted length and overwrites
+    them in place (the PR-8 tail-discard contract); page COUNTS never
+    include rejected tokens because the host advances slot_len only for
+    accepted ones.
+
+    The packed layout appends K-1 draft columns AFTER the page table:
+    [..., _DEC_COLS + pages_per_slot) is the page table, the trailing K-1
+    columns are drafts (-1 = none; a row's drafts are prefix-contiguous).
+    Spec dispatches launch only with host-known input tokens (src == 1 by
+    construction), so last_toks/prefill_toks merging is unnecessary."""
+    D = K - 1
+    lengths0 = packed[:, 0]
+    top_ks = packed[:, 3]
+    temps = jax.lax.bitcast_convert_type(packed[:, 4], jnp.float32)
+    top_ps = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+    seeds = packed[:, 6]
+    presence = jax.lax.bitcast_convert_type(packed[:, 8], jnp.float32)
+    frequency = jax.lax.bitcast_convert_type(packed[:, 9], jnp.float32)
+    pos_delta = packed[:, 10]
+    adapter_idx = packed[:, _ADP_DEC]
+    budget = packed[:, _BUD_DEC]
+    stop_ids = packed[:, _STOP_DEC:_STOP_DEC + STOP_SLOTS]
+    bias = _unpack_bias(packed, _BIAS_DEC)
+    page_table = packed[:, _DEC_COLS:packed.shape[1] - D]
+    drafts = packed[:, packed.shape[1] - D:]                       # [B, D]
+
+    toks0 = packed[:, 2]                                  # src==1 host value
+    if fsm is not None:
+        g_rows = packed[:, _FSM_DEC]
+        state0 = jnp.where(packed[:, _FSM_DEC + 1] == 1,
+                           packed[:, _FSM_DEC + 2], fsm[0])
+    else:
+        state0 = jnp.zeros_like(lengths0)
+    alive0 = (lengths0 > 0) & (budget > 0)
+
+    # verify window: committed token + drafts; write length w covers only
+    # prefix-contiguous drafts and never exceeds the planned page budget
+    has = jnp.cumprod((drafts >= 0).astype(jnp.int32), axis=1)     # [B, D]
+    n_drafts = has.sum(axis=1)
+    w = jnp.where(alive0, jnp.minimum(budget, 1 + n_drafts), 0)
+    verify = jnp.concatenate(
+        [toks0[:, None], jnp.maximum(drafts, 0)], axis=1)          # [B, K]
+    history = jnp.maximum(lengths0 - 1, 0)
+    logits_all, k_pages, v_pages = forward_verify(
+        params, cfg, verify, history, w, k_pages, v_pages, page_table,
+        pos_delta=pos_delta, adapter_idx=adapter_idx,
+    )
+
+    cur, alive, state = toks0, alive0, state0
+    accept = jnp.zeros_like(lengths0)
+    packs = []
+    for j in range(K):
+        lengths = jnp.where(alive, lengths0 + j, 0)
+        # the input token is always a previously-committed OUTPUT token:
+        # count it before sampling so this iteration's draw sees it
+        counts = _count_decode_tokens(counts, cur, lengths > 0)
+        keys = _slot_keys(base_key, seeds, lengths)
+        allowed = nxt_all = constrained = None
+        if fsm is not None:
+            allowed, nxt_all, constrained = _fsm_apply(fsm, g_rows, state)
+        res = sample(logits_all[:, j], keys, temps, top_ks, top_ps,
+                     penalties=(presence, frequency, counts), bias=bias,
+                     allowed=allowed)
+        new_toks = jnp.where(alive, res.tokens, cur)
+        if fsm is not None:
+            state = jnp.where(constrained & alive,
+                              _fsm_next(nxt_all, res.tokens), state)
+        accept = accept + alive.astype(accept.dtype)
+        packs.append(res.host_pack())
+        stopped = ((stop_ids >= 0)
+                   & (stop_ids == res.tokens[:, None])).any(axis=1)
+        alive = alive & ~stopped & (j + 1 < budget)
+        if j < D:
+            # iteration j+1's logits were conditioned on draft j: they are
+            # valid only if the sampled token exactly matches the draft
+            alive = alive & (res.tokens == drafts[:, j]) & (has[:, j] > 0)
+        cur = new_toks
+    new_state = state if fsm is not None else None
+    return ((jnp.stack(packs), accept), cur, k_pages, v_pages, counts,
+            new_state)
+
+
 # packed prefill columns: 0 lengths, 1 top_k, 2 temps(bits), 3 top_p(bits),
 # 4 seed, 5 presence(bits), 6 frequency(bits), 7 slot, 8 prompt_len,
 # 9 adapter_slot (-1 = base), 10-11 fsm (row, init), 12.. logit_bias
@@ -1033,11 +1171,13 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
 
 
 def _start_host_copy(pack) -> None:
-    """Begin async device->host transfer of a step's packed result."""
-    try:
-        pack.copy_to_host_async()
-    except (AttributeError, RuntimeError):
-        pass
+    """Begin async device->host transfer of a step's packed result (a
+    device array, or a tuple of them for spec steps: (packs, accept))."""
+    for arr in pack if isinstance(pack, (tuple, list)) else (pack,):
+        try:
+            arr.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
 
 
 def _lp_entry(host_res, row: int) -> tuple:
@@ -1180,6 +1320,12 @@ class Engine:
         self.decode_dispatches = 0   # decode device dispatches
         self.decode_tokens = 0       # tokens committed to streams by decode
         self.early_exit_steps = 0    # planned row-steps wasted mid-window
+        # speculative decoding accounting (metrics + bench): drafted /
+        # accepted count DRAFT tokens only (the bonus token is ordinary
+        # decode output), so accepted/drafted is the pure draft hit-rate
+        self.spec_dispatches = 0
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
         # per-dispatch consumed window depth; drained by the serving
         # loop into the llm_decode_steps_per_dispatch histogram
         self.steps_obs: "collections.deque[int]" = collections.deque(
@@ -1198,6 +1344,10 @@ class Engine:
         self._decode_multi = jax.jit(
             _decode_multi_packed_step, static_argnums=(1, 2),
             donate_argnums=(6, 7, 8)
+        )
+        self._decode_spec = jax.jit(
+            _decode_spec_packed_step, static_argnums=(1, 2),
+            donate_argnums=(4, 5, 6)
         )
         self._chunk_packed = jax.jit(
             _chunk_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
@@ -1297,6 +1447,19 @@ class Engine:
         self._adapters = None
         if engine_config.adapters:
             self._init_adapters()
+
+        # speculative decoding (engine/speculation.py): built only when
+        # configured AND structurally possible — the async fused window is
+        # the substrate (drafts ride _BUD_DEC rows), so sync scheduling or
+        # K=1 (incl. the multihost clamp) leaves self._spec = None and the
+        # engine byte-identical to the pre-speculation program
+        self._spec = None
+        if (engine_config.speculation is not None and self._async
+                and engine_config.decode_steps > 1):
+            from llms_on_kubernetes_tpu.engine.speculation import (
+                build_speculator,
+            )
+            self._spec = build_speculator(engine_config, self.model_config)
 
     # ------------------------------------------------------------------
     # multi-tenant LoRA (engine/adapters.py, ops/lora.py)
@@ -2520,6 +2683,14 @@ class Engine:
             # LLMK_FAULT=queue_stall: admission refuses while the flag is
             # set (see _admit_one)
             return None
+        if any(s.spec for s in self._inflight):
+            # a speculative verify is in flight: its consumed-vs-planned
+            # delta is unknown until harvest, so defer admission one step
+            # (an admission's decode launch must run the same iteration,
+            # and spec dispatches serialize). step() harvests the verify
+            # this iteration and admits first thing next iteration — no
+            # starvation, bounded by one dispatch.
+            return None
         # clear BEFORE scanning: a submit after this point re-sets the flag
         # (at worst a spurious backpressure wakeup), while anything already
         # queued is handled right here
@@ -2666,6 +2837,11 @@ class Engine:
         (just-admitted slots). Returns "launched", "paced" (deliberately
         deferred — the device queue is deep enough), or "idle"."""
         if self.config.decode_steps > 1:
+            if self._spec is not None:
+                st = self._launch_decode_spec(self.config.decode_steps,
+                                              admitted, events)
+                if st is not None:
+                    return st
             return self._launch_decode_multi(self.config.decode_steps,
                                              admitted, events)
         B = self.config.max_decode_slots
@@ -2880,6 +3056,114 @@ class Engine:
         self._busy_until = max(now, self._busy_until) + self._est_step
         return "launched"
 
+    def _launch_decode_spec(self, K: int, admitted,
+                            events: list[StepEvent]) -> Optional[str]:
+        """Try to launch a speculative verify dispatch; returns None to
+        fall through to the plain fused window (_launch_decode_multi).
+
+        Spec dispatches SERIALIZE: a window that consumes fewer tokens
+        than it planned would break the slot_len + inflight-tokens
+        invariant every pipelined launch's position math relies on, so a
+        spec step launches only into an empty pipeline (no in-flight
+        steps, no pending firsts, no prefill merge) — every slot's last
+        token is then host-known (src == 1) and doubles as the drafter's
+        context tail. While the verify is in flight the engine reports
+        "paced"; admission is deferred by _admit_async for the same
+        reason. The accept-ratio policy demotes drafting on adversarial
+        traffic, which silently restores the plain fused pipeline."""
+        if any(s.spec for s in self._inflight):
+            return "paced"          # serialize: wait for the verify
+        if admitted is not None:
+            return None             # the admission's merge launches NOW
+        if not self._spec.policy.should_draft():
+            return None
+        if self._inflight or self._pending_first:
+            # drafting needs every slot's committed tail host-known: pace
+            # until the pipeline drains (each spec dispatch then carries
+            # up to K tokens, which is what pipelining amortized)
+            return "paced"
+        B = self.config.max_decode_slots
+        max_len = self.config.max_model_len
+
+        # plan windows + grow page tables (the multi ladder, with an empty
+        # pipeline: MemoryError goes straight to preemption)
+        plan: dict[int, int] = {}
+        i = 0
+        while i < B:
+            r = self.slots[i]
+            if r is None:
+                i += 1
+                continue
+            base0 = int(self.slot_len[i]) + 1
+            budget = r.params.max_tokens - len(r.output)
+            p = max(0, min(K, budget, max_len - base0 + 1))
+            plan[i] = p
+            if p == 0:
+                i += 1
+                continue
+            try:
+                self.allocator.allocate(i, base0 + p - 1)
+                i += 1
+            except MemoryError:
+                self._preempt_youngest()
+
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return None
+        # draft only rows with window room (p >= 2) and a committed tail
+        ctxs: list = [None] * B
+        for i, r in active:
+            if plan.get(i, 0) >= 2 and r.pending_token >= 0:
+                ctxs[i] = np.asarray(r.prompt + r.output, np.int32)
+        if not any(c is not None for c in ctxs):
+            return None               # no row has draft room: plain window
+        proposals = self._spec.propose_batch(ctxs)
+        drafted: dict[int, int] = {}
+        D = K - 1
+        ext = np.full((B, D), -1, np.int32)
+        for i, _r in active:
+            d = proposals[i][:max(0, plan.get(i, 0) - 1)]
+            if d.size:
+                ext[i, :d.size] = d
+                drafted[i] = int(d.size)
+        if not drafted:
+            # an attempted-but-empty draft pass is evidence against this
+            # traffic: feed the policy so adversarial streams demote to
+            # the pipelined plain window instead of serializing forever
+            self._spec.policy.note_empty()
+            return None               # nothing proposed: plain window
+
+        packed = self._dec_template(active)
+        for i, r in active:
+            p = plan.get(i, 0)
+            packed[i, 0] = 0 if p <= 0 else int(self.slot_len[i]) + 1
+            packed[i, _BUD_DEC] = p
+            if r.fsm_row >= 0 and r.pending_fsm_state is not None:
+                packed[i, _FSM_DEC + 1] = 1      # resume: force state
+                packed[i, _FSM_DEC + 2] = r.pending_fsm_state
+                r.pending_fsm_state = None
+            packed[i, 1], packed[i, 2] = 1, r.pending_token
+        full = np.concatenate([packed, ext], axis=1)
+
+        use_fsm = self._fsm_any_active()
+        (pack, toks, self.k_pages, self.v_pages, self.token_counts,
+         new_state) = self._decode_spec(
+            self.params, self.model_config, K, jnp.asarray(full),
+            self.k_pages, self.v_pages, self.token_counts, self._key,
+            self._fsm_args() if use_fsm else None,
+        )
+        if new_state is not None:
+            self._fsm_state = new_state
+        seq = next(self._seq_counter)
+        step = InflightStep(pack, toks, active, seq,
+                            planned={i: plan.get(i, 0) for i, _r in active},
+                            spec=True, drafted=drafted)
+        self._inflight.append(step)
+        self._harvester.push(seq, pack)
+        now = time.monotonic()
+        self._busy_until = max(now, self._busy_until) + self._est_step
+        return "launched"
+
     def _harvest(self, drain: bool) -> list[StepEvent]:
         """Consume host copies of completed device work from the harvester
         thread, in dispatch order, WITHOUT blocking on device execution.
@@ -3005,17 +3289,29 @@ class Engine:
             if self._head_blocking_first() is not None:
                 break  # the step's request still awaits its first token
             step = self._inflight.popleft()
-            arr = np.asarray(self._harvester.get(step.seq))
+            res = self._harvester.get(step.seq)
+            accept = None
+            if isinstance(res, (tuple, list)):   # spec: (packs, accept)
+                res, accept = res
+                accept = np.asarray(accept)
+            arr = np.asarray(res)
             if arr.ndim == 2:    # single-step pack [B, W] => window of 1
                 arr = arr[None]
             hosts = [HostSample(arr[k]) for k in range(arr.shape[0])]
             processed = step.seq
             n_steps += 1
             consumed_total = wasted = max_consumed = 0
+            spec_accepted = 0
             for slot, req in step.active:
                 p = 1 if step.planned is None else step.planned.get(slot, 0)
                 if p <= 0:
                     continue
+                # a spec row consumes only its device-verified prefix: the
+                # suffix rows after a draft mismatch hold tokens sampled
+                # from logits conditioned on the REJECTED draft — garbage
+                # by construction, discarded exactly like an early exit
+                # (the rejected tail still counts as wasted window)
+                cap = p if accept is None else min(p, int(accept[slot]))
                 # skip slots whose request finished/aborted/was preempted
                 # after this step launched — their sampled tokens are
                 # garbage (and the whole window is wasted speculation)
@@ -3023,7 +3319,7 @@ class Engine:
                     wasted += p
                     continue
                 consumed = 0
-                for k in range(p):
+                for k in range(cap):
                     self.slot_len[slot] += 1
                     tok = int(hosts[k].tokens[slot])
                     req.pending_token = tok
@@ -3036,10 +3332,23 @@ class Engine:
                 consumed_total += consumed
                 wasted += p - consumed
                 max_consumed = max(max_consumed, consumed)
+                if step.spec and step.drafted and slot in step.drafted:
+                    # accepted drafts = consumed tokens minus the one the
+                    # plain path would have produced anyway
+                    spec_accepted += max(0, consumed - 1)
             self.decode_dispatches += 1
             self.decode_tokens += consumed_total
             self.early_exit_steps += wasted
             self.steps_obs.append(max_consumed)
+            if step.spec:
+                drafted_n = sum((step.drafted or {}).values())
+                self.spec_dispatches += 1
+                self.spec_drafted_tokens += drafted_n
+                self.spec_accepted_tokens += spec_accepted
+                if self._spec is not None:
+                    self._spec.policy.note(drafted_n, spec_accepted)
+            elif self._spec is not None:
+                self._spec.policy.tick()
         if processed >= 0:
             self._harvester.discard_upto(processed)
         return n_steps

@@ -1,0 +1,301 @@
+"""Speculative decoding: drafters + accept-ratio policy.
+
+Two draft tiers behind one interface (Leviathan et al. 2023; Saxena 2023,
+*Prompt Lookup Decoding*):
+
+- ``PromptLookupDrafter`` (tier A, model-free): matches the tail n-gram of
+  each slot's committed context (prompt + generated output) against an
+  earlier occurrence in the same context and proposes the tokens that
+  followed it. Zero extra FLOPs; very effective on RAG / code /
+  summarization traffic where the model restates its input.
+- ``DraftModelDrafter`` (tier B): a small draft model (random-init by
+  registry name for tests, or a ``.gguf`` checkpoint via
+  ``engine/gguf.py``) rolled out greedily over a bounded context window.
+
+Drafts ride the engine's fused decode window: the target model scores the
+committed token plus all drafted tokens in ONE dispatch
+(``forward_verify``), and acceptance under greedy decoding is exact-match —
+a pure-performance transform with bit-identical outputs. ``SpecPolicy``
+tracks the accept ratio and demotes speculation on adversarial (low-accept)
+traffic so wasted verify rows never exceed the re-probe budget.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "PromptLookupDrafter",
+    "DraftModelDrafter",
+    "SpecPolicy",
+    "Speculator",
+    "build_speculator",
+]
+
+_EMPTY = np.zeros((0,), np.int32)
+
+
+class PromptLookupDrafter:
+    """Model-free prompt-lookup drafter (Saxena 2023).
+
+    ``propose`` scans the last ``window`` tokens of the context for the
+    most recent earlier occurrence of the context's tail n-gram (longest
+    n-gram first) and returns the continuation that followed it. Host-side
+    numpy only — the scan is a vectorized sliding-window compare over at
+    most ``window`` tokens per n-gram size.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 window: int = 1024):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.window = window
+
+    def propose(self, ctx: np.ndarray, max_draft: int) -> np.ndarray:
+        n = int(ctx.shape[0])
+        if max_draft <= 0 or n < self.min_ngram + 1:
+            return _EMPTY
+        hay = np.asarray(ctx[max(0, n - self.window):], np.int32)
+        m = int(hay.shape[0])
+        for g in range(min(self.max_ngram, m - 1), self.min_ngram - 1, -1):
+            tail = hay[m - g:]
+            sub = np.lib.stride_tricks.sliding_window_view(hay, g)
+            match = np.all(sub == tail[None, :], axis=1)
+            match[-1] = False  # the tail matching itself proposes nothing
+            idxs = np.nonzero(match)[0]
+            # most recent occurrence wins (local repetition — code, lists,
+            # JSON — predicts the continuation best), but only among
+            # occurrences with a FULL continuation window: on a run of
+            # repeated tokens the latest match ends flush with the tail
+            # and would propose a single token where max_draft are there
+            # for the taking
+            best = _EMPTY
+            for i in idxs[::-1]:
+                cont = hay[i + g:i + g + max_draft]
+                if cont.size == max_draft:
+                    return cont.astype(np.int32, copy=True)
+                if cont.size > best.size:
+                    best = cont
+            if best.size:
+                return best.astype(np.int32, copy=True)
+        return _EMPTY
+
+    def propose_batch(self, ctxs: Sequence[Optional[np.ndarray]],
+                      max_draft: int) -> List[np.ndarray]:
+        return [self.propose(c, max_draft) if c is not None and c.size
+                else _EMPTY for c in ctxs]
+
+
+class DraftModelDrafter:
+    """Draft-model drafter: greedy rollout of a small model over a bounded
+    context window.
+
+    The drafter owns a tiny private paged pool (page 0 per layer is the
+    pool's trash page, so row pages start at 1): each ``propose_batch``
+    re-prefills the [B, window] context tail and runs ``max_draft - 1``
+    single-token decode steps, all inside one jitted callable. Stale cache
+    contents from the previous call are invisible — attention is bounded
+    by the row's current length.
+    """
+
+    def __init__(self, params, cfg, *, window: int = 64, max_draft: int = 7,
+                 batch: int = 8, page_size: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        from llms_on_kubernetes_tpu.engine.cache import CacheConfig, init_pages
+
+        self.params = params
+        self.cfg = cfg
+        self.window = window
+        self.max_draft = max(1, max_draft)
+        self.batch = batch
+        per_row = -(-(window + self.max_draft) // page_size)
+        cc = CacheConfig(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim, num_pages=1 + batch * per_row,
+            page_size=page_size, pages_per_slot=per_row, dtype="float32",
+        )
+        self._kp, self._vp = init_pages(cc)
+        self._pt = jnp.asarray(
+            1 + np.arange(batch * per_row, dtype=np.int32).reshape(
+                batch, per_row))
+
+        from llms_on_kubernetes_tpu.models.decoder import (
+            forward_decode, forward_prefill,
+        )
+
+        def rollout(params, tokens, lengths, kp, vp, pt):
+            logits, kp, vp = forward_prefill(
+                params, cfg, tokens, lengths, kp, vp, pt)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            drafts = [nxt]
+            for j in range(1, self.max_draft):
+                logits, kp, vp = forward_decode(
+                    params, cfg, nxt, lengths + j, kp, vp, pt)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                drafts.append(nxt)
+            return jnp.stack(drafts, axis=1), kp, vp  # [B, max_draft]
+
+        self._rollout = jax.jit(rollout, donate_argnums=(3, 4))
+
+    def propose_batch(self, ctxs: Sequence[Optional[np.ndarray]],
+                      max_draft: int) -> List[np.ndarray]:
+        import jax.numpy as jnp
+
+        max_draft = min(max_draft, self.max_draft)
+        out: List[np.ndarray] = [_EMPTY] * len(ctxs)
+        if max_draft <= 0:
+            return out
+        rows = [i for i, c in enumerate(ctxs) if c is not None and c.size]
+        for s in range(0, len(rows), self.batch):
+            group = rows[s:s + self.batch]
+            toks = np.zeros((self.batch, self.window), np.int32)
+            lens = np.zeros((self.batch,), np.int32)
+            for b, i in enumerate(group):
+                tail = np.asarray(ctxs[i][-self.window:], np.int32)
+                toks[b, :tail.shape[0]] = tail
+                lens[b] = tail.shape[0]
+            drafts, self._kp, self._vp = self._rollout(
+                self.params, jnp.asarray(toks), jnp.asarray(lens),
+                self._kp, self._vp, self._pt)
+            host = np.asarray(drafts)
+            for b, i in enumerate(group):
+                out[i] = host[b, :max_draft].astype(np.int32, copy=True)
+        return out
+
+    def propose(self, ctx: np.ndarray, max_draft: int) -> np.ndarray:
+        return self.propose_batch([ctx], max_draft)[0]
+
+
+@dataclass
+class SpecPolicy:
+    """Adaptive accept-ratio gate.
+
+    Tracks an EMA of accepted-per-drafted across spec dispatches. When the
+    ratio stays below ``min_accept`` after ``min_dispatches`` observations,
+    speculation is demoted (wasted verify rows cost real FLOPs on
+    adversarial traffic); every ``probe_interval`` decode dispatches while
+    demoted, one probe dispatch re-measures so a traffic shift back to
+    lookup-friendly content re-enables drafting.
+    """
+
+    min_accept: float = 0.3
+    min_dispatches: int = 8
+    probe_interval: int = 64
+    decay: float = 0.9
+    dispatches: int = 0
+    drafted: int = 0
+    accepted: int = 0
+    _ema: float = field(default=1.0, repr=False)
+    _demoted: bool = field(default=False, repr=False)
+    _idle: int = field(default=0, repr=False)
+
+    def note(self, drafted: int, accepted: int) -> None:
+        """Record one spec dispatch's outcome (counts exclude the bonus
+        token — pure draft hit-rate)."""
+        if drafted <= 0:
+            return
+        self._idle = 0  # a probe (or regular spec dispatch) just ran
+        self.dispatches += 1
+        self.drafted += drafted
+        self.accepted += accepted
+        self._ema = (self.decay * self._ema
+                     + (1.0 - self.decay) * (accepted / drafted))
+        if self.dispatches >= self.min_dispatches:
+            self._demoted = self._ema < self.min_accept
+
+    def note_empty(self) -> None:
+        """Record a draft attempt that proposed nothing (an accept-ratio
+        observation of 0 without inflating the drafted/accepted counters —
+        those feed the accept-ratio metric)."""
+        self._idle = 0
+        self.dispatches += 1
+        self._ema = self.decay * self._ema
+        if self.dispatches >= self.min_dispatches:
+            self._demoted = self._ema < self.min_accept
+
+    def tick(self) -> None:
+        """Count one non-spec decode dispatch (drives re-probing)."""
+        if self._demoted:
+            self._idle += 1
+
+    def should_draft(self) -> bool:
+        """Pure check (no side effects): draft unless demoted, probing
+        once every probe_interval plain dispatches while demoted. The
+        probe's note()/note_empty() resets the idle counter."""
+        if not self._demoted:
+            return True
+        return self._idle >= self.probe_interval
+
+    @property
+    def accept_ratio(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+
+class Speculator:
+    """One engine-facing handle: drafter + policy + window size."""
+
+    def __init__(self, drafter, policy: SpecPolicy, max_draft: int):
+        self.drafter = drafter
+        self.policy = policy
+        self.max_draft = max(1, max_draft)
+
+    def propose_batch(self, ctxs: Sequence[Optional[np.ndarray]],
+                      max_draft: Optional[int] = None) -> List[np.ndarray]:
+        k = self.max_draft if max_draft is None else min(max_draft,
+                                                         self.max_draft)
+        return self.drafter.propose_batch(ctxs, k)
+
+
+def _load_draft_model(ref: str, target_cfg, dtype: str, seed: int):
+    """Resolve a draft-model reference to (params, cfg).
+
+    ``*.gguf`` paths load through ``engine/gguf.py``; anything else is a
+    registry name given random weights (tests / smoke benchmarks — the
+    policy demotes a useless drafter, so a random draft model is safe,
+    just pointless). The draft vocab must cover the target's: drafted ids
+    are fed straight into the target's verify window.
+    """
+    if ref.endswith(".gguf") or os.path.exists(ref):
+        from llms_on_kubernetes_tpu.engine.gguf import load_gguf_params
+        cfg, params = load_gguf_params(ref, dtype=dtype)
+    else:
+        import jax
+
+        from llms_on_kubernetes_tpu.configs import get_config
+        from llms_on_kubernetes_tpu.models.decoder import init_params
+        cfg = get_config(ref)
+        params = init_params(cfg, jax.random.key(seed), dtype=dtype)
+    if cfg.vocab_size < target_cfg.vocab_size:
+        raise ValueError(
+            f"draft model {ref!r} vocab_size={cfg.vocab_size} < target "
+            f"vocab_size={target_cfg.vocab_size}: drafted token ids would "
+            "be unverifiable")
+    return params, cfg
+
+
+def build_speculator(engine_config, target_cfg) -> Optional[Speculator]:
+    """Build the engine's speculator from its config, or None when
+    speculation is off / structurally unavailable (multihost, K=1)."""
+    mode = engine_config.speculation
+    if mode is None or engine_config.decode_steps <= 1:
+        return None
+    max_draft = engine_config.decode_steps - 1
+    policy = SpecPolicy()
+    if mode == "ngram":
+        drafter = PromptLookupDrafter()
+    elif mode == "draft":
+        params, cfg = _load_draft_model(
+            engine_config.draft_model, target_cfg,
+            engine_config.dtype, engine_config.seed)
+        drafter = DraftModelDrafter(params, cfg, max_draft=max_draft)
+    else:
+        raise ValueError(f"unknown speculation mode {mode!r}")
+    return Speculator(drafter, policy, max_draft)

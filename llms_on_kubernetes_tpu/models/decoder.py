@@ -562,6 +562,48 @@ def forward_chunk(
     return _logits(params, cfg, x_last), k_pages, v_pages
 
 
+def forward_verify(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, T] candidate window: [committed, drafts...]
+    history: jnp.ndarray,     # [B] tokens already cached before this window
+    lengths: jnp.ndarray,     # [B] valid tokens in THIS window; 0 => idle row
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    pos_delta: "jnp.ndarray | None" = None,  # [B] mrope position offset
+    adapter_idx: "jnp.ndarray | None" = None,  # [B] LoRA slot; -1 = base
+):
+    """Speculative-decoding verify pass: ``forward_chunk`` over a short
+    candidate window, but returning logits at EVERY window position
+    [B, T, V] instead of only the last. Position t's logits are the
+    target model's distribution for the token FOLLOWING tokens[:, t] —
+    one dispatch scores a committed token plus up to T-1 drafted
+    continuations. KV for all T positions is written to the paged pool;
+    a rejected suffix is simply overwritten by the next dispatch, which
+    starts at the accepted length (the same tail-discard contract the
+    fused decode window relies on). T is the fused window size (<= 8),
+    so the [B, T, V] f32 buffer stays small."""
+    B, T = tokens.shape
+    offs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    positions = history[:, None] + offs
+    write_positions = jnp.where(offs < lengths[:, None], positions, -1)
+    rope_positions = (None if pos_delta is None
+                      else positions + pos_delta[:, None])
+    x = _embed(params, cfg, tokens)
+    x, k_pages, v_pages = _run_layers(
+        cfg, params, x, k_pages, v_pages, page_table,
+        positions, write_positions, lengths, "chunk",
+        rope_positions=rope_positions, adapter_idx=adapter_idx,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 style=cfg.norm_style)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return softcap(logits, cfg.logit_softcap), k_pages, v_pages
+
+
 def forward_decode(
     params: Params,
     cfg: ModelConfig,

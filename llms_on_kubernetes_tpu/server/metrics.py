@@ -351,6 +351,22 @@ def engine_metrics(registry: Registry) -> dict:
             "accounting; a high rate vs llm_tokens_generated_total means "
             "decode_steps is oversized for typical generations)",
             registry),
+        "spec_drafted": Counter(
+            "llm_spec_drafted_total",
+            "Draft tokens proposed into speculative verify windows "
+            "(prompt-lookup or draft-model tier; excludes the bonus "
+            "token every window commits regardless)", registry),
+        "spec_accepted": Counter(
+            "llm_spec_accepted_total",
+            "Draft tokens accepted by the target model's verify pass "
+            "(exact-match under greedy decoding)", registry),
+        "spec_accept_ratio": Gauge(
+            "llm_spec_accept_ratio",
+            "Lifetime accepted/drafted ratio of speculative decoding "
+            "(0 when speculation is off or no drafts were proposed; a "
+            "low ratio on steady traffic means the drafter does not fit "
+            "the workload — the engine demotes drafting adaptively)",
+            registry),
         "tenant_admitted": Counter(
             "llm_tenant_admitted_total",
             "Requests admitted into a decode slot, by fair-queue tenant "

@@ -127,6 +127,7 @@ class EngineLoop(threading.Thread):
         self._ttft_seen: set[str] = set()
         self._preempt_seen = 0
         self._early_exit_seen = 0
+        self._spec_seen = {"drafted": 0, "accepted": 0}
         self._adapter_seen = {"hits": 0, "misses": 0, "evictions": 0}
         self._tenant_admitted_seen: "collections.Counter" = (
             collections.Counter())
@@ -228,6 +229,18 @@ class EngineLoop(threading.Thread):
                     m["decode_early_exit"].inc(
                         early_exit - self._early_exit_seen)
                     self._early_exit_seen = early_exit
+                drafted = getattr(eng, "spec_drafted_tokens", 0)
+                if drafted > self._spec_seen["drafted"]:
+                    m["spec_drafted"].inc(
+                        drafted - self._spec_seen["drafted"])
+                    self._spec_seen["drafted"] = drafted
+                accepted = getattr(eng, "spec_accepted_tokens", 0)
+                if accepted > self._spec_seen["accepted"]:
+                    m["spec_accepted"].inc(
+                        accepted - self._spec_seen["accepted"])
+                    self._spec_seen["accepted"] = accepted
+                if drafted > 0:
+                    m["spec_accept_ratio"].set(accepted / drafted)
                 adp = getattr(eng, "adapters", None)
                 if adp is not None:
                     for k, seen in self._adapter_seen.items():

@@ -41,7 +41,8 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"
-                            r"|shed_noisy_fraction|min_tenant_completed)")
+                            r"|shed_noisy_fraction|min_tenant_completed"
+                            r"|accept_ratio|spec_drafted_tokens)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

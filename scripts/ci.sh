@@ -152,6 +152,27 @@ sys.exit(0 if (doc.get("decode_steps") or 1) > 1
     fails=$((fails + 1))
   fi
 
+  note "spec decode smoke (drafts accepted, outputs bit-identical)"
+  # the smoke's spec phase runs greedy traffic with speculation on/off:
+  # outputs must match exactly (speculation is a pure-perf transform),
+  # drafts must actually be accepted on lookup-friendly traffic, and the
+  # per-row dispatch rate must beat the plain fused window's 1/(K-1)
+  # (0.334 at K=4 — the spec window carries K tokens where the plain
+  # multi path pays a dispatch per K-1 after the pipelined overlap)
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+dpt = doc.get("spec_dispatches_per_token")
+sys.exit(0 if doc.get("spec_parity_ok") is True
+         and (doc.get("spec_accept_ratio") or 0) > 0
+         and dpt is not None and dpt < 0.286 else 1)'; then
+    echo "ci: spec decode smoke OK (parity, accepts, dispatch rate)"
+  else
+    echo "ci: spec decode smoke FAILED (parity broken, no accepted"
+    echo "    drafts, or spec_dispatches_per_token >= 0.286)"
+    fails=$((fails + 1))
+  fi
+
   note "metrics lint (Prometheus exposition format on scraped /metrics)"
   if [ -s "$metrics_dump/api_metrics.txt" ] \
       && [ -s "$metrics_dump/gateway_metrics.txt" ] \

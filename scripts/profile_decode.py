@@ -224,9 +224,11 @@ def main():
           "(packed-array build; template-cached)", flush=True)
     print(f"  host share  {breakdown['host_share']:8.3f}  "
           f"(K=1 baseline {breakdown['host_share_k1']:.3f})", flush=True)
-    print("PROFILE:" + json.dumps(breakdown), flush=True)
 
     # --- engine-loop comparison ---------------------------------------
+    # (the PROFILE line prints after this phase: it carries the loop's
+    # speculation counters — drafted/accepted/wasted rows — when
+    # LLMK_SPECULATION is on)
     for r in reqs:
         eng.abort(r)
     eng.step()
@@ -235,6 +237,9 @@ def main():
                        SamplingParams(temperature=0.0, max_tokens=gen_len))
             for _ in range(B - 1)]
     disp0, tok0 = eng.decode_dispatches, eng.decode_tokens
+    drafted0 = getattr(eng, "spec_drafted_tokens", 0)
+    accepted0 = getattr(eng, "spec_accepted_tokens", 0)
+    wasted0 = getattr(eng, "early_exit_steps", 0)
     t0 = time.monotonic()
     total = 0
     window_start = window_tokens = None
@@ -258,6 +263,22 @@ def main():
     if toks_n:
         print(f"engine-loop dispatches/token: {disp / toks_n:.3f} "
               f"({disp} dispatches, {toks_n} tokens, K={K})", flush=True)
+    # speculation accounting over the engine-loop window: drafted rows
+    # ridden, drafts that survived the verify pass, and row-steps whose
+    # launch was wasted (rejected tails + early exits) — the FLOPs
+    # speculation risks against the dispatches it saves
+    breakdown["spec_drafted"] = getattr(eng, "spec_drafted_tokens",
+                                        0) - drafted0
+    breakdown["spec_accepted"] = getattr(eng, "spec_accepted_tokens",
+                                         0) - accepted0
+    breakdown["wasted_rows"] = getattr(eng, "early_exit_steps", 0) - wasted0
+    print(f"  spec-drafted  {breakdown['spec_drafted']:6d}  "
+          "(draft tokens ridden on decode windows)", flush=True)
+    print(f"  spec-accepted {breakdown['spec_accepted']:6d}  "
+          "(drafts surviving the verify pass)", flush=True)
+    print(f"  wasted-rows   {breakdown['wasted_rows']:6d}  "
+          "(row-steps launched then discarded)", flush=True)
+    print("PROFILE:" + json.dumps(breakdown), flush=True)
     print(f"total wall {time.monotonic() - t0:.1f}s", flush=True)
 
 

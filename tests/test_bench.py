@@ -222,6 +222,13 @@ class TestPartialEmission:
         assert data["fairness_shed_noisy_fraction"] >= 0.9
         assert data["fairness_min_tenant_completed"] >= 1
         assert data["fairness_overload_shed_ok"] is True
+        # ISSUE 12: the speculative-decoding scenario — greedy outputs
+        # bit-identical with speculation on/off, drafts accepted on
+        # lookup-friendly traffic, dispatch rate beating the plain fused
+        # window's post-pipeline 1/(K-1)
+        assert data["spec_parity_ok"] is True
+        assert data["spec_accept_ratio"] > 0
+        assert data["spec_dispatches_per_token"] < 0.286
         repo = pathlib.Path(bench.__file__).resolve().parent
         binary = repo / "native" / "router" / "llkt-router"
         if binary.exists():

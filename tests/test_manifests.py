@@ -254,6 +254,59 @@ models:
         load_spec("models: [{modelName: a, huggingfaceId: x, decodeSteps: 0}]")
 
 
+def test_speculation_spec_validation():
+    """ISSUE 12: speculation/draft knobs are validated at spec load, not
+    at pod start — a typo'd tier or a draft tier with no model fails
+    `deploy validate`, not the rollout."""
+    with pytest.raises(SpecError, match="speculation"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, "
+                  "speculation: banana}]")
+    with pytest.raises(SpecError, match="draft"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, "
+                  "speculation: draft}]")
+    with pytest.raises(SpecError, match="unused"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, "
+                  "speculation: ngram, draft: tiny}]")
+    with pytest.raises(SpecError, match="decodeSteps >= 2"):
+        load_spec("models: [{modelName: a, huggingfaceId: x, "
+                  "speculation: ngram, decodeSteps: 1}]")
+    # draft: alone implies speculation: draft (mirrors EngineConfig)
+    spec = load_spec("models: [{modelName: a, huggingfaceId: x, "
+                     "draft: /models/d.gguf}]")
+    assert spec.models[0].speculation == "draft"
+    load_spec("models: [{modelName: a, huggingfaceId: x, "
+              "speculation: ngram, decodeSteps: 4}]")
+
+
+def test_speculation_threads_to_engine_env():
+    """ISSUE 12: speculation/draft ride as LLMK_SPECULATION /
+    LLMK_DRAFT_MODEL env, same convention as the decode window."""
+    spec = load_spec("""
+namespace: tpu-models
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    decodeSteps: 8
+    speculation: ngram
+    tpu: {accelerator: v5e, chips: 8}
+  - modelName: mistral-7b
+    huggingfaceId: mistralai/Mistral-7B-Instruct-v0.2
+    draft: /models/draft.gguf
+    tpu: {accelerator: v5e, chips: 8}
+""")
+    ms = render_manifests(spec)
+    env = {e["name"]: e.get("value") for e in
+           by_name(ms, "Deployment", "model-llama-3-8b")
+           ["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["LLMK_SPECULATION"] == "ngram"
+    assert "LLMK_DRAFT_MODEL" not in env
+    env2 = {e["name"]: e.get("value") for e in
+            by_name(ms, "Deployment", "model-mistral-7b")
+            ["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env2["LLMK_SPECULATION"] == "draft"
+    assert env2["LLMK_DRAFT_MODEL"] == "/models/draft.gguf"
+
+
 def test_decode_steps_threads_to_engine_env():
     """ISSUE 8: decodeSteps rides as LLMK_DECODE_STEPS env (not an engine
     arg, keeping the argv contract stable); absent by default."""
