@@ -48,6 +48,12 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
                    help="store KV quantized (halved decode HBM traffic, "
                         "2x token capacity; ~1/127 per-element error)")
+    p.add_argument("--kv-host-cache-gb", type=float, default=None,
+                   help="host-RAM KV offload tier capacity in GiB: "
+                        "finished/preempted sessions park their pages in "
+                        "host memory; a returning session re-uploads and "
+                        "skips re-prefill (default: $LLMK_KV_HOST_CACHE_GB "
+                        "or off; needs prefix caching, single-host only)")
     p.add_argument("--decode-steps", type=int, default=None,
                    help="decode tokens sampled per fused device dispatch "
                         "(default: $LLMK_DECODE_STEPS or 4; forced to 1 "
@@ -370,6 +376,7 @@ def main(argv: list[str] | None = None) -> int:
         quantization=args.quantization,
         prefix_caching=args.prefix_caching,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_host_cache_gb=args.kv_host_cache_gb,
         decode_steps=args.decode_steps,
         speculation=args.speculation,
         draft_model=args.draft_model,

@@ -150,6 +150,13 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
                          "value": m.speculation})
     if m.draft is not None:
         c["env"].append({"name": "LLMK_DRAFT_MODEL", "value": m.draft})
+    if m.kv_dtype is not None:
+        # env, like the decode window: KV storage width is an engine
+        # runtime knob, not part of the pinned argv contract
+        c["env"].append({"name": "LLMK_KV_DTYPE", "value": m.kv_dtype})
+    if m.kv_host_cache_gb > 0:
+        c["env"].append({"name": "LLMK_KV_HOST_CACHE_GB",
+                         "value": str(m.kv_host_cache_gb)})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on

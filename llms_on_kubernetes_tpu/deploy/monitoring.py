@@ -174,6 +174,33 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKKVHostCacheThrash",
+                        # pages churn through the host tier without ever
+                        # being re-used: the parked-session working set
+                        # exceeds the tier, so spills evict pages faster
+                        # than resumes consume them
+                        "expr": (
+                            "rate(llm_kv_host_cache_evictions_total[10m])"
+                            " > 1 and rate("
+                            "llm_kv_host_cache_hits_total[10m]) < 0.1 * "
+                            "rate(llm_kv_host_cache_evictions_total[10m])"
+                        ),
+                        "for": "15m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "host KV offload tier thrashing",
+                            "description": (
+                                "Engine on {{ $labels.instance }} is "
+                                "evicting host-tier KV pages much faster "
+                                "than resuming sessions re-use them; "
+                                "parked sessions age out before they "
+                                "return. Raise kvHostCacheGB (and the "
+                                "pod memory request) or accept "
+                                "re-prefills for long-idle sessions."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKColdStartSlow",
                         # phase="ready" is process start -> taking
                         # traffic; with the persistent compile cache a
@@ -420,6 +447,15 @@ def grafana_dashboard() -> dict[str, Any]:
         _panel(20, "Speculative decode: drafted / accepted rate",
                ["rate(llm_spec_drafted_total[5m])",
                 "rate(llm_spec_accepted_total[5m])"], 12, 72),
+        _panel(21, "KV host tier: hit ratio / evictions",
+               ["rate(llm_kv_host_cache_hits_total[5m]) / "
+                "(rate(llm_kv_host_cache_hits_total[5m]) + "
+                "rate(llm_kv_host_cache_misses_total[5m]))",
+                "rate(llm_kv_host_cache_evictions_total[5m])"], 0, 80),
+        _panel(22, "KV: upload p95 / bytes per token",
+               ["histogram_quantile(0.95, "
+                "rate(llm_kv_upload_seconds_bucket[5m]))",
+                "llm_kv_bytes_per_token"], 12, 80),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -288,6 +288,14 @@ class ModelSpec:
     # decodeSteps >= 2 — drafts ride the fused decode window.
     speculation: Optional[str] = None
     draft: Optional[str] = None
+    # KV-cache storage dtype (LLMK_KV_DTYPE): None = full-width (the model
+    # compute dtype), "int8" = quantized pages with per-token scales —
+    # roughly 2x the resident streams per chip at equal HBM
+    kv_dtype: Optional[str] = None
+    # host-RAM offload tier capacity in GiB (LLMK_KV_HOST_CACHE_GB):
+    # finished/preempted sessions park their KV pages in host memory and
+    # a returning session re-uploads instead of re-prefilling. 0 = off.
+    kv_host_cache_gb: float = 0.0
     # multi-tenant LoRA: adapters served on this model's replicas, the
     # device slot count (LRU-recycled) and max rank the slots are sized for
     adapters: tuple = ()                   # tuple[AdapterSpec, ...]
@@ -342,6 +350,24 @@ class ModelSpec:
             raise SpecError(
                 f"model {self.model_name}: unknown quantization "
                 f"{self.quantization!r}"
+            )
+        if self.kv_dtype not in (None, "int8"):
+            raise SpecError(
+                f"model {self.model_name}: kvDtype must be 'int8' or "
+                f"omitted, got {self.kv_dtype!r}"
+            )
+        if self.kv_host_cache_gb < 0:
+            raise SpecError(
+                f"model {self.model_name}: kvHostCacheGB must be >= 0, "
+                f"got {self.kv_host_cache_gb}"
+            )
+        if (self.kv_host_cache_gb > 0 and self.tpu is not None
+                and self.tpu.multi_host):
+            raise SpecError(
+                f"model {self.model_name}: kvHostCacheGB is unsupported on "
+                f"a multi-host slice (page uploads are coordinator-local "
+                f"and would desync follower pods) — drop it or use a "
+                f"single-host topology"
             )
         if self.tpu is not None:
             if self.tpu.accelerator not in CHIPS_PER_HOST:
@@ -566,7 +592,7 @@ def _model_from(d: dict) -> ModelSpec:
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype", "decodeSteps",
-        "speculation", "draft",
+        "speculation", "draft", "kvDtype", "kvHostCacheGB",
         "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
     unknown = set(d) - known
@@ -601,6 +627,8 @@ def _model_from(d: dict) -> ModelSpec:
         speculation=(d.get("speculation")
                      or ("draft" if d.get("draft") else None)),
         draft=d.get("draft"),
+        kv_dtype=d.get("kvDtype"),
+        kv_host_cache_gb=float(d.get("kvHostCacheGB", 0) or 0),
         adapters=tuple(_adapter_from(a, d.get("modelName", ""))
                        for a in d.get("adapters", ()) or ()),
         adapter_slots=int(d.get("adapterSlots", 4)),

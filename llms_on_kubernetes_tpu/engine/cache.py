@@ -70,6 +70,14 @@ class CacheConfig:
                        * jnp.dtype(self.dtype).itemsize)
         return 2 * self.num_layers * self.page_size * per_tok
 
+    @property
+    def bytes_per_token(self) -> int:
+        """KV bytes per cached token across all layers, both sides — the
+        capacity-planning number behind llm_kv_bytes_per_token. int8 is
+        (head_dim + 4) bytes per (head, token, side) vs 2 * head_dim for
+        bf16: ~2x smaller at head_dim 128."""
+        return self.bytes_per_page // self.page_size
+
 
 @jax.tree_util.register_pytree_node_class
 class KVPool:
@@ -155,8 +163,11 @@ _MAX_RMW_PAGES = 33
 #
 # "fused": the decode write folds INTO the Pallas attention kernel
 # (ops/attention.dispatch_paged_attention_write) — no separate write op
-# at all; falls back to "dus" behavior wherever the fused kernel doesn't
-# apply (CP meshes, int8 KV, traced windows, small head_dim). Opt-in
+# at all; int8 KV pools route to the quantize-at-write twin kernel
+# (pool bytes match this module's quantize_kv bit-for-bit); falls back
+# to "dus" behavior wherever the fused kernels don't apply (CP meshes,
+# traced windows, small head_dim, int8 with page_size % 128 on real
+# TPU). Opt-in
 # (LLMK_KV_WRITE=fused) until validated on hardware: the kernel is only
 # interpreter-tested on CPU, and a silent KV corruption is the worst
 # failure mode a serving engine can ship as a default.
@@ -521,6 +532,13 @@ class PageAllocator:
             return self.free_pages.pop()
         if self._lru:  # evict the oldest cached page
             p = next(iter(self._lru))
+            if p in self.refcount:
+                # the LRU must only ever hold refcount-0 pages; evicting a
+                # page some slot still reads would silently corrupt that
+                # slot's KV — fail loudly instead (eviction-edge guard)
+                raise RuntimeError(
+                    f"evictable page {p} is still referenced "
+                    f"(refcount={self.refcount[p]}) — LRU invariant broken")
             del self._lru[p]
             d = self._page_digest.pop(p, None)
             if d is not None and self._prefix_map.get(d) == p:
@@ -545,6 +563,14 @@ class PageAllocator:
 
     def free(self, slot: int) -> None:
         for p in self.slot_pages[slot]:
+            if p not in self.refcount:
+                # refcount underflow = a double free (the page was already
+                # released through another slot list or a stale free): the
+                # page may be on the free list or in another slot by now,
+                # so continuing would hand the same page to two sequences
+                raise RuntimeError(
+                    f"double free of page {p} (slot {slot}): page has no "
+                    "outstanding references")
             self.refcount[p] -= 1
             if self.refcount[p] == 0:
                 del self.refcount[p]
@@ -662,3 +688,105 @@ class PageAllocator:
                 continue  # page already published under another digest
             self._prefix_map[d] = p
             self._page_digest[p] = d
+
+
+class HostKVCache:
+    """Host-RAM offload tier for inactive sessions' KV pages
+    (AttentionStore/CachedAttention pattern; PAPERS.md).
+
+    Device HBM holds the pages of RESIDENT streams; when a slot is freed
+    (finish) or preempted, its full pages spill here — one entry per
+    page, keyed by (tenant, chained-prefix digest), the SAME digest the
+    PageAllocator's device prefix cache chains (salt included), so a
+    returning session's token stream addresses both tiers with one hash
+    pass. On a matching resume the engine re-uploads the pages and skips
+    straight to decode for the covered tokens instead of re-prefilling
+    (engine._adopt_cached_prefix), which turns per-chip session capacity
+    from "resident streams" into "resident + parked sessions".
+
+    Payloads are raw pool bytes per page, all layers stacked —
+    ``{"k": [n_kv, L, page, d], "v": ..., "ks": [n_kv, L, page] | None,
+    "vs": ...}`` (int8 data + f32 scales for quantized pools, the pool
+    dtype otherwise) — so a reuse round-trips the exact bytes the device
+    wrote and greedy streams stay bit-identical with the tier on or off.
+
+    Keyed by tenant so one tenant's sessions can never be served another
+    tenant's KV even on a (cryptographically impossible) digest collision,
+    and so per-tenant flushes stay possible. Plain LRU over bytes;
+    single-threaded like the allocator (engine-thread only)."""
+
+    def __init__(self, capacity_bytes: int, page_size: int):
+        self.capacity_bytes = int(capacity_bytes)
+        self.page_size = page_size
+        self._entries: "dict[tuple[str, bytes], dict]" = {}
+        self._bytes = 0
+        self.hits = 0        # pages served to a resuming session
+        self.misses = 0      # lookups where the chain had no next page
+        self.evictions = 0   # pages dropped by LRU pressure
+        self.spilled_pages = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def _nbytes(payload: dict) -> int:
+        return sum(int(a.nbytes) for a in payload.values() if a is not None)
+
+    def put(self, tenant: str, digest: bytes, payload: dict) -> None:
+        key = (tenant, digest)
+        old = self._entries.pop(key, None)
+        if old is not None:  # same prefix re-spilled: refresh recency
+            self._bytes -= self._nbytes(old)
+        nb = self._nbytes(payload)
+        if nb > self.capacity_bytes:
+            return  # one page larger than the whole tier: unconfigurable
+        self._entries[key] = payload
+        self._bytes += nb
+        self.spilled_pages += 1
+        while self._bytes > self.capacity_bytes and self._entries:
+            k, v = next(iter(self._entries.items()))
+            if k == key:  # never evict the page just stored
+                break
+            del self._entries[k]
+            self._bytes -= self._nbytes(v)
+            self.evictions += 1
+
+    def match_chain(self, tenant: str, digests: "list[bytes]",
+                    start: int) -> "tuple[list[bytes], list[dict]]":
+        """(matched digests, payloads) for the longest run of consecutive
+        pages present, walking ``digests[start:]``. Pure peek: no stats,
+        no recency — a blocked admission re-probes every engine iteration
+        and must not spin the hit/miss counters or churn the LRU order.
+        Call :meth:`commit` once when the admission actually lands."""
+        matched: "list[bytes]" = []
+        out: "list[dict]" = []
+        for d in digests[start:]:
+            e = self._entries.get((tenant, d))
+            if e is None:
+                break
+            matched.append(d)
+            out.append(e)
+        return matched, out
+
+    def commit(self, tenant: str, digests: "list[bytes]") -> None:
+        """Record a landed admission's outcome: one hit per page served
+        (refreshing its LRU recency), or one miss for an empty match.
+        Entries evicted between probe and commit are skipped silently —
+        the engine uploads the payload objects it captured at probe time,
+        so the reuse itself is unaffected."""
+        served = 0
+        for d in digests:
+            key = (tenant, d)
+            e = self._entries.pop(key, None)
+            if e is None:
+                continue
+            self._entries[key] = e  # move-to-end: LRU recency
+            served += 1
+        if served:
+            self.hits += served
+        else:
+            self.misses += 1

@@ -36,7 +36,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from llms_on_kubernetes_tpu.configs import ModelConfig, get_config
-from llms_on_kubernetes_tpu.engine.cache import CacheConfig, PageAllocator, init_pages
+from llms_on_kubernetes_tpu.engine.cache import (
+    CacheConfig, HostKVCache, PageAllocator, init_pages,
+)
 from llms_on_kubernetes_tpu.engine.qos import (
     TenantFairQueue, normalize_priority, priority_rank,
 )
@@ -167,8 +169,18 @@ class EngineConfig:
     max_images_per_request: int = 4
     # KV cache storage dtype: None => engine dtype; "int8" => per-token
     # quantized KV (halved decode-attention HBM traffic, doubled token
-    # capacity; accuracy pinned by logit-tolerance tests)
+    # capacity; accuracy pinned by logit-tolerance tests). None also
+    # falls through to env LLMK_KV_DTYPE ("" / "none" => off) so the
+    # deployment chart can set it without CLI plumbing.
     kv_cache_dtype: Optional[str] = None
+    # host-RAM offload tier (engine/cache.HostKVCache): finished and
+    # preempted slots spill their full KV pages to a host-side LRU of
+    # this many GB, keyed by (tenant, prefix digest); a returning session
+    # whose prompt extends a spilled prefix re-uploads the pages and
+    # skips straight to decode instead of re-prefilling. Requires
+    # prefix_caching (the digest chain IS the addressing scheme).
+    # None => env LLMK_KV_HOST_CACHE_GB; <= 0 disables.
+    kv_host_cache_gb: Optional[float] = None
     # grammar-constrained decoding device-table capacities (static jit
     # shapes). A grammar whose tables exceed states/classes caps is
     # rejected at submit (400); distinct RESIDENT grammars beyond
@@ -287,6 +299,19 @@ class EngineConfig:
         if self.watchdog_stall_s is None:
             self.watchdog_stall_s = float(
                 os.environ.get("LLMK_WATCHDOG_S", "120"))
+        if self.kv_cache_dtype is None:
+            self.kv_cache_dtype = os.environ.get("LLMK_KV_DTYPE") or None
+        if self.kv_cache_dtype in ("off", "none", ""):
+            self.kv_cache_dtype = None
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None/'int8', got "
+                f"{self.kv_cache_dtype!r}")
+        if self.kv_host_cache_gb is None:
+            self.kv_host_cache_gb = float(
+                os.environ.get("LLMK_KV_HOST_CACHE_GB", "0"))
+        if self.kv_host_cache_gb < 0:
+            self.kv_host_cache_gb = 0.0
         if self.kv_write not in KV_WRITE_STRATEGIES:
             raise ValueError(
                 f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
@@ -1170,6 +1195,33 @@ def _chunk_packed_step(params, cfg, tokens, packed, k_pages, v_pages,
     return res.host_pack(), res.tokens, k_pages, v_pages, counts, new_state
 
 
+def _spill_gather_pages(k_pages, v_pages, flat_idx):
+    """Gather m spilling pages' bytes across all layers for the host tier:
+    ``flat_idx`` [m, L] holds each page's flat pool index per layer
+    (l * P + page_id). Returns (k [n_kv, m, L, page, d], v, k_scale
+    [n_kv, m, L, page] | None, v_scale) — raw pool bytes, so the later
+    re-upload round-trips exactly (no requantize, no dtype change)."""
+    k = jnp.take(k_pages.data, flat_idx, axis=1)
+    v = jnp.take(v_pages.data, flat_idx, axis=1)
+    ks = jnp.take(k_pages.scale, flat_idx, axis=1) if k_pages.quantized else None
+    vs = jnp.take(v_pages.scale, flat_idx, axis=1) if v_pages.quantized else None
+    return k, v, ks, vs
+
+
+def _upload_scatter_pages(k_pages, v_pages, flat_idx, k, v, ks, vs):
+    """Inverse of _spill_gather_pages: splice m host-cached pages back
+    into freshly allocated pool pages (pools donated — in place)."""
+    from llms_on_kubernetes_tpu.engine.cache import KVPool
+
+    kd = k_pages.data.at[:, flat_idx].set(k)
+    vd = v_pages.data.at[:, flat_idx].set(v)
+    ksc, vsc = k_pages.scale, v_pages.scale
+    if ks is not None:
+        ksc = ksc.at[:, flat_idx].set(ks)
+        vsc = vsc.at[:, flat_idx].set(vs)
+    return KVPool(kd, ksc), KVPool(vd, vsc)
+
+
 def _start_host_copy(pack) -> None:
     """Begin async device->host transfer of a step's packed result (a
     device array, or a tuple of them for spec steps: (packs, accept))."""
@@ -1297,6 +1349,33 @@ class Engine:
         )
         self.slots: list[Optional[Request]] = [None] * B
         self.slot_len = np.zeros((B,), np.int64)  # tokens whose KV is cached
+        # host-RAM offload tier: finished/preempted slots spill full pages
+        # here; a returning session re-uploads them and skips straight to
+        # decode (engine/cache.HostKVCache). Requires prefix caching — the
+        # allocator's digest chain is the addressing scheme for both tiers.
+        # (disabled under multihost: uploads mutate the pools outside the
+        # broadcast protocol, so follower pods would silently diverge)
+        self.host_kv: Optional[HostKVCache] = None
+        if (engine_config.kv_host_cache_gb > 0
+                and engine_config.prefix_caching
+                and not engine_config.multihost):
+            self.host_kv = HostKVCache(
+                int(engine_config.kv_host_cache_gb * (1 << 30)),
+                engine_config.page_size)
+        # spills in flight: [(tenant, [digests], device gather)] — the
+        # device->host copy is dispatched at free/preempt time but the
+        # blocking np.asarray read happens at the next admission probe
+        # (_drain_spills), keeping it off the decode hot path
+        self._pending_spills: list = []
+        # per-slot host-tier adoption staged between the admission probe
+        # and its commit/rollback: (matched digests, payloads)
+        self._host_adopt: dict = {}
+        self.kv_upload_obs: "collections.deque[float]" = collections.deque(
+            maxlen=4096)  # seconds per host->device page upload batch
+        self.kv_uploaded_tokens = 0  # tokens whose re-prefill was skipped
+        self._spill_gather = jax.jit(_spill_gather_pages)
+        self._upload_scatter = jax.jit(_upload_scatter_pages,
+                                       donate_argnums=(0, 1))
         # per-tenant fair admission (engine/qos.py): priority classes +
         # deficit round-robin keyed by Request.tenant; deque-compatible
         # for every scheduler call site (peek/popleft/appendleft/...)
@@ -2061,18 +2140,130 @@ class Engine:
         """Adopt the longest usable cached prefix for an admission attempt
         (shared by the sync and async paths). A multimodal hit must cover
         every image token — the remainder prefills via forward_chunk,
-        which has no embedding substitution — else it is rolled back."""
+        which has no embedding substitution — else it is rolled back.
+
+        With the host tier on, the device hit is extended by walking the
+        SAME digest chain through ``host_kv`` from where the device map
+        stopped; the combined token count is returned so every caller's
+        existing logic (can_allocate / commit_adopt / chunk ``start=hit``)
+        is tier-agnostic. The probe is pure peek — payload references are
+        staged in ``_host_adopt[slot]`` and only ``_host_kv_commit`` (at
+        admission commit) uploads pages and touches stats/recency, since
+        a blocked admission re-probes every engine iteration."""
         if req.cache_salt is None:
             return 0
         hit = self.allocator.adopt_prefix(
             slot, prefill_tokens[:len(req.prompt)], salt=req.cache_salt)
-        if hit and req.images is not None:
+        combined = hit
+        if self.host_kv is not None:
+            self._drain_spills()
+            page = self.allocator.page_size
+            # the host chain runs over the FULL prefill stream (prompt +
+            # replayed output for a resume) — spills digest generated
+            # tokens too, so a returning session skips those as well.
+            # Cap leaves >= 1 token to prefill (its logits seed sampling).
+            cap_pages = (len(prefill_tokens) - 1) // page
+            start = hit // page
+            if cap_pages > start:
+                digests = self.allocator._digests(
+                    prefill_tokens[:cap_pages * page], salt=req.cache_salt)
+                matched, payloads = self.host_kv.match_chain(
+                    req.tenant, digests, start)
+                combined = hit + len(matched) * page
+                self._host_adopt[slot] = (start, matched, payloads)
+        if combined and req.images is not None:
             last_img = max(i for i, t in enumerate(req.prompt)
                            if t == self.model_config.image_token_id)
-            if hit <= last_img:
-                self.allocator.rollback_adopt(slot)
+            if combined <= last_img:
+                if hit:
+                    self.allocator.rollback_adopt(slot)
+                self._host_adopt.pop(slot, None)
                 return 0
-        return hit
+        return combined
+
+    def _drain_spills(self) -> None:
+        """Land pending device->host page copies in the host tier. The
+        gathers were DISPATCHED at free/preempt time (device program order
+        makes the bytes exactly the committed KV); the blocking host read
+        happens here, off the decode hot path."""
+        if not self._pending_spills:
+            return
+        for tenant, digests, (k, v, ks, vs) in self._pending_spills:
+            k = np.asarray(jax.device_get(k))
+            v = np.asarray(jax.device_get(v))
+            ks = None if ks is None else np.asarray(jax.device_get(ks))
+            vs = None if vs is None else np.asarray(jax.device_get(vs))
+            for j, d in enumerate(digests):
+                self.host_kv.put(tenant, d, {
+                    "k": k[:, j].copy(), "v": v[:, j].copy(),
+                    "ks": None if ks is None else ks[:, j].copy(),
+                    "vs": None if vs is None else vs[:, j].copy(),
+                })
+        self._pending_spills.clear()
+
+    def _spill_slot(self, req: Request) -> None:
+        """Queue a finishing/preempted slot's full pages for the host
+        tier. Must run BEFORE ``allocator.free`` reuses the pages: the
+        gather is dispatched now (device order => it reads this slot's
+        committed writes, not a successor's), only the host copy is
+        deferred to :meth:`_drain_spills`."""
+        if (self.host_kv is None or req.cache_salt is None
+                or req.slot < 0):
+            return
+        slot = req.slot
+        page = self.allocator.page_size
+        tokens = req.prompt + req.output
+        n_full = min(len(tokens), int(self.slot_len[slot])) // page
+        if n_full <= 0:
+            return
+        digests = self.allocator._digests(tokens[:n_full * page],
+                                          salt=req.cache_salt)
+        pages = self.allocator.slot_pages[slot][:n_full]
+        keep = [(d, p) for d, p in zip(digests, pages) if p != 0]
+        if not keep:  # page 0 is the never-read trash page: never spilled
+            return
+        L = self.cache_config.num_layers
+        P = self.cache_config.num_pages
+        flat = np.asarray([[l * P + p for l in range(L)]
+                           for _, p in keep], np.int32)
+        gathered = self._spill_gather(self.k_pages, self.v_pages,
+                                      jnp.asarray(flat))
+        self._pending_spills.append(
+            (req.tenant, [d for d, _ in keep], gathered))
+        if len(self._pending_spills) > 32:
+            self._drain_spills()
+
+    def _host_kv_commit(self, slot: int, req: Request) -> None:
+        """An admission with a staged host-tier match landed: upload the
+        matched pages into the slot's freshly allocated device pages
+        (before the chunk prefill that reads them is dispatched), count
+        hits/misses, refresh recency. No-op without a staged probe."""
+        staged = self._host_adopt.pop(slot, None)
+        if staged is None or self.host_kv is None:
+            return
+        dev_pages, matched, payloads = staged
+        self.host_kv.commit(req.tenant, matched)
+        if not payloads:
+            return
+        t0 = time.perf_counter()
+        m = len(payloads)
+        pages = self.allocator.slot_pages[slot][dev_pages:dev_pages + m]
+        L = self.cache_config.num_layers
+        P = self.cache_config.num_pages
+        flat = np.asarray([[l * P + p for l in range(L)] for p in pages],
+                          np.int32)
+        k = np.stack([pl["k"] for pl in payloads], axis=1)
+        v = np.stack([pl["v"] for pl in payloads], axis=1)
+        quant = payloads[0]["ks"] is not None
+        ks = (np.stack([pl["ks"] for pl in payloads], axis=1)
+              if quant else None)
+        vs = (np.stack([pl["vs"] for pl in payloads], axis=1)
+              if quant else None)
+        self.k_pages, self.v_pages = self._upload_scatter(
+            self.k_pages, self.v_pages, jnp.asarray(flat),
+            k, v, ks, vs)
+        self.kv_upload_obs.append(time.perf_counter() - t0)
+        self.kv_uploaded_tokens += m * self.allocator.page_size
 
     def _mm_grids(self, images) -> list[tuple[int, int]]:
         """Per-BLOCK merged grids (rows, cols) in prompt-run order: one
@@ -2348,12 +2539,16 @@ class Engine:
             if not self.allocator.can_allocate(slot, n + 1):
                 if hit:
                     self.allocator.rollback_adopt(slot)
+                self._host_adopt.pop(slot, None)
                 return []  # wait for pages to free up
             self.waiting.popleft()
         self.allocator.allocate(slot, n + 1)
         if hit:
             self.allocator.commit_adopt(slot, hit)
         self._note_admission(req)
+        # host-tier pages upload BEFORE the prefill below is dispatched,
+        # so its history attention reads the restored KV
+        self._host_kv_commit(slot, req)
         self.slots[slot] = req
         req.slot = slot
         if resumed and req.fsm_row >= 0:
@@ -2435,6 +2630,11 @@ class Engine:
         self._g_release(req)
         self._release_adapter(req)
         if req.slot >= 0:
+            # spill full pages to the host tier BEFORE the allocator can
+            # hand them to another sequence (skip a wedged device: the
+            # gather would never complete)
+            if reason != "stalled" and not self.wedged:
+                self._spill_slot(req)
             self.allocator.free(req.slot)
             self.slot_len[req.slot] = 0
             self.slots[req.slot] = None
@@ -2515,6 +2715,9 @@ class Engine:
             victim.trace.event("preempted", request=victim.id,
                                tokens=len(victim.output))
         slot = victim.slot
+        # park the victim's KV in the host tier: its re-admission resumes
+        # from uploaded pages instead of re-prefilling from scratch
+        self._spill_slot(victim)
         self.allocator.free(slot)
         self.slot_len[slot] = 0
         self.slots[slot] = None
@@ -2723,6 +2926,7 @@ class Engine:
                     if picked or not self.allocator.can_allocate(slot, n + 1):
                         if hit:
                             self.allocator.rollback_adopt(slot)
+                        self._host_adopt.pop(slot, None)
                         break  # runs by itself next iteration / wait
                     self.waiting.popleft()
                     self.allocator.allocate(slot, n + 1)
@@ -2743,6 +2947,8 @@ class Engine:
                 self.waiting.popleft()
                 self.allocator.allocate(slot, n + 1)
                 self._note_admission(req)
+                # combined hit was 0, so this is counter-only (host miss)
+                self._host_kv_commit(slot, req)
                 self.slots[slot] = req
                 req.slot = slot
                 if resumed and req.fsm_row >= 0:
@@ -2750,6 +2956,10 @@ class Engine:
                 picked.append((slot, req, resumed, prefill_tokens))
         if long_pick is not None:
             slot, req, resumed, prefill_tokens, hit = long_pick
+            # upload host-tier pages (if staged) before the chunk prefill
+            # below is dispatched — its history attention reads them.
+            # Outside the lock: the np.stack memcpy must not block submit()
+            self._host_kv_commit(slot, req)
             if req.images is not None and hit == 0:
                 pack, toks = self._dispatch_mm_prefill(slot, req,
                                                        prefill_tokens)

@@ -309,10 +309,14 @@ def dispatch_paged_attention_write(q, k_pages, v_pages, page_table, lengths,
     (pallas_paged.pallas_paged_attention_write): the per-slot program DMAs
     the new row into the pool in place and merges the current token's
     contribution in registers — eliminating the per-slot DUS write loop
-    (~3 ms/step of dispatch overhead at B=64, round-4 profile). Anywhere
-    the fused kernel doesn't apply (CP meshes, int8 KV pools, traced
-    gemma windows, sub-128 head_dim on real TPU, kv_write config other
-    than "fused") this is exactly write_tokens + dispatch_paged_attention.
+    (~3 ms/step of dispatch overhead at B=64, round-4 profile). int8 KV
+    pools take the quantize-at-write twin
+    (pallas_paged_attention_write_int8): the new row is quantized in
+    registers with the same arithmetic as cache.quantize_kv, so pool
+    bytes match the DUS path exactly. Anywhere the fused kernels don't
+    apply (CP meshes, traced gemma windows, sub-128 head_dim on real TPU,
+    kv_write config other than "fused") this is exactly write_tokens +
+    dispatch_paged_attention.
 
     q [B, n_q, d]; k_new/v_new [B, n_kv, d] (post-rope);
     write_positions [B, 1] (negative => idle/trash).
@@ -327,11 +331,29 @@ def dispatch_paged_attention_write(q, k_pages, v_pages, page_table, lengths,
     # sub-8 page sizes can't host an aligned block
     kd_shape = getattr(k_pages, "data", k_pages).shape
     page_ok = kd_shape[2] % 8 == 0 or on_cpu
+    quantized = getattr(k_pages, "quantized", False)
+    # the int8 twin additionally RMWs full [n_kv, page] scale rows, which
+    # Mosaic only accepts 128-lane-aligned on real TPU (same constraint
+    # as the read-only int8 decode kernel below)
+    page_ok_int8 = kd_shape[2] % 128 == 0 or on_cpu
     fused = (kv_write_strategy() == "fused"
              and seq_parallelism() == 1
-             and not getattr(k_pages, "quantized", False)
              and use_pallas_kernels() and _static_window(sliding_window)
-             and d_ok and page_ok)
+             and d_ok and page_ok
+             and (not quantized or page_ok_int8))
+    if fused and quantized:
+        from llms_on_kubernetes_tpu.engine.cache import KVPool
+        from llms_on_kubernetes_tpu.ops.pallas_paged import (
+            pallas_paged_attention_write_int8,
+        )
+
+        attn, kd, ks, vd, vs = pallas_paged_attention_write_int8(
+            q, k_pages.data, k_pages.scale, v_pages.data, v_pages.scale,
+            page_table, lengths, k_new, v_new, scale=scale,
+            sliding_window=sliding_window, attn_softcap=attn_softcap,
+            interpret=on_cpu,
+        )
+        return attn, KVPool(kd, ks), KVPool(vd, vs)
     if fused:
         from llms_on_kubernetes_tpu.ops.pallas_paged import (
             pallas_paged_attention_write,

@@ -669,6 +669,449 @@ def pallas_paged_write_window(
     return k_pages, v_pages
 
 
+def _quantize_row(xf):
+    """In-register per-token symmetric int8 — MUST match cache.quantize_kv
+    bit-for-bit (same max/clip/round chain), so a page written by this
+    kernel is byte-identical to one written by the host-side write path.
+    xf [n_kv, d] f32 -> (int8 [n_kv, d], f32 scale [n_kv])."""
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    data = jnp.clip(jnp.round(xf / s[:, None]), -127, 127).astype(jnp.int8)
+    return data, s
+
+
+def _paged_kernel_write_int8(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    lengths_ref,      # SMEM [B]                (scalar prefetch)
+    q_ref,            # VMEM [1, n_kv, group, d]
+    kd_hbm,           # ANY  [n_kv, P, page, d] int8 (aliased with kd_out)
+    ks_hbm,           # ANY  [n_kv, P, page] f32     (aliased with ks_out)
+    vd_hbm,           # ANY  [n_kv, P, page, d] int8
+    vs_hbm,           # ANY  [n_kv, P, page] f32
+    k_new_ref,        # VMEM [1, n_kv, d] — current token's K (full width)
+    v_new_ref,        # VMEM [1, n_kv, d]
+    o_ref,            # VMEM [1, n_kv, group, d]
+    kd_out,           # ANY  (alias of kd_hbm)
+    ks_out,           # ANY  (alias of ks_hbm)
+    vd_out,           # ANY  (alias of vd_hbm)
+    vs_out,           # ANY  (alias of vs_hbm)
+    k_buf,            # VMEM [n_kv, S, d] int8 scratch
+    v_buf,            # VMEM [n_kv, S, d] int8 scratch
+    ks_buf,           # VMEM [n_kv, S] f32 scratch
+    vs_buf,           # VMEM [n_kv, S] f32 scratch
+    kblk,             # VMEM [n_kv, 8, d] int8 write-block scratch
+    vblk,             # VMEM [n_kv, 8, d] int8
+    ksrow,            # VMEM [n_kv, page] f32 scale-row scratch
+    vsrow,            # VMEM [n_kv, page] f32
+    sems,             # DMA semaphores [4, pages_per_seq]
+    wsem,             # DMA semaphores [4] (write-block RMW)
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    attn_softcap: Optional[float],
+    page_size: int,
+    pages_per_seq: int,
+):
+    """int8 decode attention WITH the current token QUANTIZED AND WRITTEN
+    in the same program — the storage-side twin of _paged_kernel_write.
+
+    The new K/V row arrives full-width, is quantized in registers
+    (bit-identical to cache.quantize_kv, so fused and host write paths
+    produce the same pool bytes), and lands in the pool via the same
+    8-sublane-tile data RMW as the fp kernel plus a FULL-PAGE scale-row
+    RMW ([n_kv, page] is a whole aligned lane row — an 8-lane scale
+    slice would violate Mosaic's 128-lane tiling, a full page row never
+    does). The current token folds into the online softmax using its
+    DEQUANTIZED value (data * scale), so the output matches a
+    write-then-attend over the quantized pool, not the fp input."""
+    b = pl.program_id(0)
+    S = pages_per_seq * page_size
+    length = lengths_ref[b]
+    cached = length - 1                       # tokens already in the pool
+    n_pages = (cached + page_size - 1) // page_size
+
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _start(i=i):
+            pid = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                kd_hbm.at[:, pid],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i]).start()
+            pltpu.make_async_copy(
+                vd_hbm.at[:, pid],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i]).start()
+            pltpu.make_async_copy(
+                ks_hbm.at[:, pid],
+                ks_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[2, i]).start()
+            pltpu.make_async_copy(
+                vs_hbm.at[:, pid],
+                vs_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[3, i]).start()
+    for i in range(pages_per_seq):
+        @pl.when(i < n_pages)
+        def _wait(i=i):
+            pid = page_table_ref[b, i]
+            pltpu.make_async_copy(
+                kd_hbm.at[:, pid],
+                k_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[0, i]).wait()
+            pltpu.make_async_copy(
+                vd_hbm.at[:, pid],
+                v_buf.at[:, pl.ds(i * page_size, page_size), :],
+                sems.at[1, i]).wait()
+            pltpu.make_async_copy(
+                ks_hbm.at[:, pid],
+                ks_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[2, i]).wait()
+            pltpu.make_async_copy(
+                vs_hbm.at[:, pid],
+                vs_buf.at[:, pl.ds(i * page_size, page_size)],
+                sems.at[3, i]).wait()
+
+    # quantize the incoming row once; both the write-back and the in-
+    # register softmax contribution use the SAME quantized values
+    kq, ks_new = _quantize_row(k_new_ref[0].astype(jnp.float32))
+    vq, vs_new = _quantize_row(v_new_ref[0].astype(jnp.float32))
+
+    pos = jnp.maximum(cached, 0)
+    w_pid = page_table_ref[b, pos // page_size]
+    off8 = pl.multiple_of((pos % page_size) // 8 * 8, 8)
+
+    @pl.when(length > 0)
+    def _write_fetch():
+        pltpu.make_async_copy(
+            kd_hbm.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).start()
+        pltpu.make_async_copy(
+            vd_hbm.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).start()
+        pltpu.make_async_copy(
+            ks_hbm.at[:, w_pid], ksrow, wsem.at[2]).start()
+        pltpu.make_async_copy(
+            vs_hbm.at[:, w_pid], vsrow, wsem.at[3]).start()
+
+    @pl.when(length > 0)
+    def _write_back():
+        pltpu.make_async_copy(
+            kd_hbm.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).wait()
+        pltpu.make_async_copy(
+            vd_hbm.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).wait()
+        pltpu.make_async_copy(
+            ks_hbm.at[:, w_pid], ksrow, wsem.at[2]).wait()
+        pltpu.make_async_copy(
+            vs_hbm.at[:, w_pid], vsrow, wsem.at[3]).wait()
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (1, 8, 1), 1) == (pos % page_size) - off8
+        kblk[...] = jnp.where(row, kq[:, None, :], kblk[...])
+        vblk[...] = jnp.where(row, vq[:, None, :], vblk[...])
+        lane = jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1) == pos % page_size
+        ksrow[...] = jnp.where(lane, ks_new[:, None], ksrow[...])
+        vsrow[...] = jnp.where(lane, vs_new[:, None], vsrow[...])
+        pltpu.make_async_copy(
+            kblk, kd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).start()
+        pltpu.make_async_copy(
+            vblk, vd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).start()
+        pltpu.make_async_copy(
+            ksrow, ks_out.at[:, w_pid], wsem.at[2]).start()
+        pltpu.make_async_copy(
+            vsrow, vs_out.at[:, w_pid], wsem.at[3]).start()
+
+    q = q_ref[0].astype(jnp.float32)                   # [n_kv, group, d]
+    k = k_buf[:].astype(jnp.float32)                   # [n_kv, S, d] UNSCALED
+    v = v_buf[:].astype(jnp.float32)
+    n_kv, group, d = q.shape
+    sc_k = ks_buf[:][:, None, :]                       # [n_kv, 1, S]
+    sc_v = vs_buf[:][:, None, :]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (n_kv, group, S), 2)
+    valid = k_pos < cached
+
+    logits = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [n_kv, group, S]
+    logits = logits * sc_k
+    logits = softcap(logits, attn_softcap)
+
+    mask = valid
+    if sliding_window is not None:
+        mask &= k_pos > cached - sliding_window        # q_pos == cached
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    # current token, dequantized in registers (always inside any window)
+    k_cur = kq.astype(jnp.float32) * ks_new[:, None]   # [n_kv, d]
+    v_cur = vq.astype(jnp.float32) * vs_new[:, None]
+    l_cur = jnp.sum(q * k_cur[:, None, :], axis=-1) * scale  # [n_kv, group]
+    l_cur = softcap(l_cur, attn_softcap)
+
+    m1 = jnp.max(logits, axis=-1)                      # [n_kv, group]
+    m = jnp.maximum(m1, l_cur)
+    p = jnp.exp(logits - m[..., None])
+    den = jnp.sum(p, axis=-1)
+    p = p * jnp.where(valid[:, :1], sc_v, 0.0)         # per-value dequant
+    num = jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                  # [n_kv, group, d]
+    w_cur = jnp.exp(l_cur - m)                         # [n_kv, group]
+    num = num + w_cur[..., None] * v_cur[:, None, :]
+    den = den + w_cur
+    o_ref[0] = (num / den[..., None]).astype(o_ref.dtype)
+
+    @pl.when(length > 0)
+    def _finish():
+        pltpu.make_async_copy(
+            kblk, kd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).wait()
+        pltpu.make_async_copy(
+            vblk, vd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).wait()
+        pltpu.make_async_copy(
+            ksrow, ks_out.at[:, w_pid], wsem.at[2]).wait()
+        pltpu.make_async_copy(
+            vsrow, vs_out.at[:, w_pid], wsem.at[3]).wait()
+
+
+def pallas_paged_attention_write_int8(
+    q: jnp.ndarray,            # [B, n_q, d]
+    k_data: jnp.ndarray,       # [n_kv, P, page, d] int8 (donated)
+    k_scale: jnp.ndarray,      # [n_kv, P, page] f32    (donated)
+    v_data: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    lengths: jnp.ndarray,      # [B] int32 (incl. current token; 0 => idle)
+    k_new: jnp.ndarray,        # [B, n_kv, d] current token's K (post-rope)
+    v_new: jnp.ndarray,        # [B, n_kv, d]
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    attn_softcap: Optional[float] = None,
+    interpret: bool = False,
+):
+    """Fused int8 decode attention + quantize-at-write KV append (see
+    _paged_kernel_write_int8). Returns
+    (attn [B, n_q, d], k_data, k_scale, v_data, v_scale)."""
+    B, n_q, d = q.shape
+    n_kv, P, page_size, _ = k_data.shape
+    pages_per_seq = page_table.shape[1]
+    S = pages_per_seq * page_size
+    group = n_q // n_kv
+
+    kernel = functools.partial(
+        _paged_kernel_write_int8,
+        scale=scale, sliding_window=sliding_window,
+        attn_softcap=attn_softcap,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+    )
+    qg = q.reshape(B, n_kv, group, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, n_kv, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, n_kv, d), lambda b, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_kv, group, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, S, d), k_data.dtype),
+            pltpu.VMEM((n_kv, S, d), v_data.dtype),
+            pltpu.VMEM((n_kv, S), jnp.float32),
+            pltpu.VMEM((n_kv, S), jnp.float32),
+            pltpu.VMEM((n_kv, 8, d), k_data.dtype),
+            pltpu.VMEM((n_kv, 8, d), v_data.dtype),
+            pltpu.VMEM((n_kv, page_size), jnp.float32),
+            pltpu.VMEM((n_kv, page_size), jnp.float32),
+            pltpu.SemaphoreType.DMA((4, pages_per_seq)),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    out, kd, ks, vd, vs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, n_kv, group, d), q.dtype),
+            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        # inputs count scalar-prefetch args first: pt=0, lengths=1, q=2,
+        # k_data=3, k_scale=4, v_data=5, v_scale=6, k_new=7, v_new=8;
+        # outputs: attn=0, kd=1, ks=2, vd=3, vs=4
+        input_output_aliases={3: 1, 4: 2, 5: 3, 6: 4},
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_data, k_scale, v_data, v_scale,
+      k_new.astype(jnp.float32), v_new.astype(jnp.float32))
+    return out.reshape(B, n_q, d), kd, ks, vd, vs
+
+
+def _paged_kernel_write_window_int8(
+    page_table_ref,   # SMEM [B, pages_per_seq] (scalar prefetch)
+    base_ref,         # SMEM [B] first token's 0-based pool position
+    width_ref,        # SMEM [B] tokens to write (0 => idle row)
+    kd_hbm,           # ANY  [n_kv, P, page, d] int8 (aliased with kd_out)
+    ks_hbm,           # ANY  [n_kv, P, page] f32     (aliased with ks_out)
+    vd_hbm,           # ANY  [n_kv, P, page, d] int8
+    vs_hbm,           # ANY  [n_kv, P, page] f32
+    k_new_ref,        # VMEM [1, W, n_kv, d] — window of new K rows (f32)
+    v_new_ref,        # VMEM [1, W, n_kv, d]
+    kd_out,           # ANY  (alias of kd_hbm)
+    ks_out,           # ANY  (alias of ks_hbm)
+    vd_out,           # ANY  (alias of vd_hbm)
+    vs_out,           # ANY  (alias of vs_hbm)
+    kblk,             # VMEM [n_kv, 8, d] int8 write-block scratch
+    vblk,             # VMEM [n_kv, 8, d] int8
+    ksrow,            # VMEM [n_kv, page] f32 scale-row scratch
+    vsrow,            # VMEM [n_kv, page] f32
+    wsem,             # DMA semaphores [4]
+    *,
+    window: int,
+    page_size: int,
+):
+    """In-place QUANTIZING append of a K-token window per slot — the int8
+    twin of _paged_kernel_write_window. Each committed token's row is
+    quantized in registers (bit-identical to cache.quantize_kv) and
+    spliced via the 8-sublane data RMW + full-page scale-row RMW (see
+    _paged_kernel_write_int8 for the lane-tiling rationale). The RMW
+    chain is ordered token-by-token: consecutive tokens often share a
+    data block AND always share the scale row while inside one page, so
+    every write-back completes before the next fetch."""
+    b = pl.program_id(0)
+    base = base_ref[b]
+    width = width_ref[b]
+
+    for t in range(window):
+        @pl.when(t < width)
+        def _rmw(t=t):
+            pos = base + t
+            w_pid = page_table_ref[b, pos // page_size]
+            off8 = pl.multiple_of((pos % page_size) // 8 * 8, 8)
+            pltpu.make_async_copy(
+                kd_out.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).start()
+            pltpu.make_async_copy(
+                vd_out.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).start()
+            pltpu.make_async_copy(
+                ks_out.at[:, w_pid], ksrow, wsem.at[2]).start()
+            pltpu.make_async_copy(
+                vs_out.at[:, w_pid], vsrow, wsem.at[3]).start()
+            pltpu.make_async_copy(
+                kd_out.at[:, w_pid, pl.ds(off8, 8)], kblk, wsem.at[0]).wait()
+            pltpu.make_async_copy(
+                vd_out.at[:, w_pid, pl.ds(off8, 8)], vblk, wsem.at[1]).wait()
+            pltpu.make_async_copy(
+                ks_out.at[:, w_pid], ksrow, wsem.at[2]).wait()
+            pltpu.make_async_copy(
+                vs_out.at[:, w_pid], vsrow, wsem.at[3]).wait()
+            kq, ks_new = _quantize_row(k_new_ref[0, t].astype(jnp.float32))
+            vq, vs_new = _quantize_row(v_new_ref[0, t].astype(jnp.float32))
+            row = jax.lax.broadcasted_iota(
+                jnp.int32, (1, 8, 1), 1) == (pos % page_size) - off8
+            kblk[...] = jnp.where(row, kq[:, None, :], kblk[...])
+            vblk[...] = jnp.where(row, vq[:, None, :], vblk[...])
+            lane = jax.lax.broadcasted_iota(
+                jnp.int32, (1, page_size), 1) == pos % page_size
+            ksrow[...] = jnp.where(lane, ks_new[:, None], ksrow[...])
+            vsrow[...] = jnp.where(lane, vs_new[:, None], vsrow[...])
+            pltpu.make_async_copy(
+                kblk, kd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).start()
+            pltpu.make_async_copy(
+                vblk, vd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).start()
+            pltpu.make_async_copy(
+                ksrow, ks_out.at[:, w_pid], wsem.at[2]).start()
+            pltpu.make_async_copy(
+                vsrow, vs_out.at[:, w_pid], wsem.at[3]).start()
+            pltpu.make_async_copy(
+                kblk, kd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[0]).wait()
+            pltpu.make_async_copy(
+                vblk, vd_out.at[:, w_pid, pl.ds(off8, 8)], wsem.at[1]).wait()
+            pltpu.make_async_copy(
+                ksrow, ks_out.at[:, w_pid], wsem.at[2]).wait()
+            pltpu.make_async_copy(
+                vsrow, vs_out.at[:, w_pid], wsem.at[3]).wait()
+
+
+def pallas_paged_write_window_int8(
+    k_data: jnp.ndarray,       # [n_kv, P, page, d] int8 (donated)
+    k_scale: jnp.ndarray,      # [n_kv, P, page] f32    (donated)
+    v_data: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, pages_per_seq] int32
+    base: jnp.ndarray,         # [B] int32 0-based position of token 0
+    widths: jnp.ndarray,       # [B] int32 tokens to write (<= window)
+    k_new: jnp.ndarray,        # [B, W, n_kv, d] window of new K rows
+    v_new: jnp.ndarray,        # [B, W, n_kv, d]
+    *,
+    interpret: bool = False,
+):
+    """Fused quantize-at-write append of up to W tokens per slot in ONE
+    kernel launch — the int8 storage mode of pallas_paged_write_window
+    (same entry-point contract: per-slot ``widths`` is the committed
+    window length, speculative rejects simply shrink it). Returns
+    (k_data, k_scale, v_data, v_scale) updated in place."""
+    n_kv, P, page_size, d = k_data.shape
+    B, W = k_new.shape[:2]
+
+    kernel = functools.partial(
+        _paged_kernel_write_window_int8,
+        window=W, page_size=page_size,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, W, n_kv, d), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, W, n_kv, d), lambda b, *_: (b, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, 8, d), k_data.dtype),
+            pltpu.VMEM((n_kv, 8, d), v_data.dtype),
+            pltpu.VMEM((n_kv, page_size), jnp.float32),
+            pltpu.VMEM((n_kv, page_size), jnp.float32),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    kd, ks, vd, vs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        # inputs count scalar-prefetch args first: pt=0, base=1, widths=2,
+        # k_data=3, k_scale=4, v_data=5, v_scale=6, k_new=7, v_new=8;
+        # outputs: kd=0, ks=1, vd=2, vs=3
+        input_output_aliases={3: 0, 4: 1, 5: 2, 6: 3},
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), base.astype(jnp.int32),
+      widths.astype(jnp.int32), k_data, k_scale, v_data, v_scale,
+      k_new.astype(jnp.float32), v_new.astype(jnp.float32))
+    return kd, ks, vd, vs
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "sliding_window", "attn_softcap", "interpret")
 )

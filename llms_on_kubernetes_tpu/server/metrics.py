@@ -387,6 +387,33 @@ def engine_metrics(registry: Registry) -> dict:
             "(overloaded = queue-depth backpressure / brownout, "
             "rate_limited = the tenant's own token-bucket limits)",
             registry, label_names=("tenant", "priority", "reason")),
+        "kv_host_cache_hits": Counter(
+            "llm_kv_host_cache_hits_total",
+            "KV pages served from the host-RAM offload tier to a "
+            "resuming/returning session (each page skips page_size "
+            "tokens of re-prefill)", registry),
+        "kv_host_cache_misses": Counter(
+            "llm_kv_host_cache_misses_total",
+            "Admissions whose prefix found no host-tier pages beyond "
+            "the device cache", registry),
+        "kv_host_cache_evictions": Counter(
+            "llm_kv_host_cache_evictions_total",
+            "Host-tier KV pages dropped by LRU capacity pressure "
+            "(sustained high rate vs hits = thrash; grow "
+            "kvHostCacheGB)", registry),
+        "kv_upload": Histogram(
+            "llm_kv_upload_seconds",
+            "Host->device KV page upload latency per resuming "
+            "admission (stage + dispatch of the re-upload that replaces "
+            "re-prefill)",
+            (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 1.0),
+            registry),
+        "kv_bytes_per_token": Gauge(
+            "llm_kv_bytes_per_token",
+            "Device KV-cache bytes per cached token across all layers, "
+            "both K and V, scales included (int8 pages roughly halve "
+            "this vs bf16)", registry),
     }
 
 

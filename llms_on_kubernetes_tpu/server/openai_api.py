@@ -129,6 +129,7 @@ class EngineLoop(threading.Thread):
         self._early_exit_seen = 0
         self._spec_seen = {"drafted": 0, "accepted": 0}
         self._adapter_seen = {"hits": 0, "misses": 0, "evictions": 0}
+        self._host_kv_seen = {"hits": 0, "misses": 0, "evictions": 0}
         self._tenant_admitted_seen: "collections.Counter" = (
             collections.Counter())
         self._shed_total = 0
@@ -250,6 +251,21 @@ class EngineLoop(threading.Thread):
                             self._adapter_seen[k] = v
                     while adp.load_times:
                         m["adapter_load"].observe(adp.load_times.pop(0))
+                hk = getattr(eng, "host_kv", None)
+                if hk is not None:
+                    for k in ("hits", "misses", "evictions"):
+                        v = getattr(hk, k)
+                        if v > self._host_kv_seen[k]:
+                            m["kv_host_cache_" + k].inc(
+                                v - self._host_kv_seen[k])
+                            self._host_kv_seen[k] = v
+                upl = getattr(eng, "kv_upload_obs", None)
+                if upl is not None:
+                    while upl:
+                        m["kv_upload"].observe(upl.popleft())
+                cc = getattr(eng, "cache_config", None)
+                if cc is not None:
+                    m["kv_bytes_per_token"].set(cc.bytes_per_token)
                 m["batch_occupancy"].set(occupancy)
                 m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))

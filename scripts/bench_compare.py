@@ -10,12 +10,14 @@ when any metric moved more than the threshold in the BAD direction:
 
 - latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``, adapter
   ``*evictions*``/``*load_seconds*`` churn, mid-stream failover
-  ``resume_gap_ms_*`` stalls and ``*visible_drops``): higher is worse;
+  ``resume_gap_ms_*`` stalls and ``*visible_drops``, KV footprint
+  ``kv_bytes_per_token`` and host-tier ``*cache_misses``): higher is
+  worse;
 - throughput-ish metrics (``*tokens_per_sec*`` — including the
   multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
   cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s —
-  and ``resumed_streams``, proof the failover drill actually spliced):
-  lower is worse;
+  and ``resumed_streams``, proof the failover drill actually spliced;
+  session-density ``*max_streams_ratio``): lower is worse;
 - anything else is reported but never gates (no direction known).
 
 With fewer than two comparable runs it prints a notice and exits 0 —
@@ -37,12 +39,14 @@ import sys
 _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|cold_start|dropped_streams|spike_first_token"
                            r"|dispatches_per_token|host_share|resume_gap"
-                           r"|visible_drops|gave_up)")
+                           r"|visible_drops|gave_up|kv_bytes_per_token"
+                           r"|cache_misses)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"
                             r"|shed_noisy_fraction|min_tenant_completed"
-                            r"|accept_ratio|spec_drafted_tokens)")
+                            r"|accept_ratio|spec_drafted_tokens"
+                            r"|max_streams_ratio)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

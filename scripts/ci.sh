@@ -27,6 +27,17 @@ if ! "$PY" -m pytest "$REPO/tests" -q -m unit \
   fails=$((fails + 1))
 fi
 
+note "int8 KV parity (teacher-forced margin triage + fused-write kernels)"
+# quantized KV pages are a capacity move, not an accuracy move: the
+# teacher-forced argmax must agree at every decisive position (PR-4-style
+# margin triage) and the quantize-at-write Pallas kernels must produce
+# pool bytes identical to the XLA write path
+if ! "$PY" -m pytest "$REPO/tests/test_kv_int8.py" -q \
+    -p no:cacheprovider --continue-on-collection-errors; then
+  echo "ci: int8 KV parity gate FAILED"
+  fails=$((fails + 1))
+fi
+
 if command -v make >/dev/null 2>&1 && command -v g++ >/dev/null 2>&1; then
   note "native router build"
   if make -C "$REPO/native/router"; then
@@ -170,6 +181,35 @@ sys.exit(0 if doc.get("spec_parity_ok") is True
   else
     echo "ci: spec decode smoke FAILED (parity broken, no accepted"
     echo "    drafts, or spec_dispatches_per_token >= 0.286)"
+    fails=$((fails + 1))
+  fi
+
+  note "session smoke (int8 KV + host offload tier: reuse beats reprefill)"
+  # the smoke's session phase interleaves multi-turn sessions on a device
+  # pool too small to keep idle sessions resident: returning turns must
+  # actually reuse cached pages (hit ratio > 0, host-tier hits land),
+  # produce bit-identical greedy output vs a cache-less engine, come
+  # back materially faster than a full re-prefill, report the int8
+  # density win (> 1.5x bytes/token vs full-width), and not thrash the
+  # host tier (evictions stay below the pages spilled)
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+hits = doc.get("kv_host_cache_hits") or 0
+ev = doc.get("kv_host_cache_evictions")
+spilled = doc.get("kv_host_cache_spilled_pages") or 0
+reuse = doc.get("session_ttft_reuse_ms")
+repre = doc.get("session_ttft_reprefill_ms")
+sys.exit(0 if doc.get("session_parity_ok") is True
+         and (doc.get("session_reuse_hit_ratio") or 0) > 0
+         and hits > 0
+         and reuse is not None and repre is not None and reuse < repre
+         and (doc.get("session_max_streams_ratio") or 0) > 1.5
+         and ev is not None and ev <= spilled else 1)'; then
+    echo "ci: session smoke OK (reuse hits, parity, TTFT < reprefill)"
+  else
+    echo "ci: session smoke FAILED (no reuse, parity broken, reuse TTFT"
+    echo "    not below reprefill, or host-tier eviction accounting off)"
     fails=$((fails + 1))
   fi
 

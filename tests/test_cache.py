@@ -34,6 +34,36 @@ def test_allocator_exhaustion_and_overflow():
         a2.allocate(1, 2)
 
 
+def test_eviction_refuses_pinned_page():
+    """The cached-page LRU must only ever hold refcount-0 pages; if a bug
+    parks a still-referenced page there, eviction must fail loudly instead
+    of silently corrupting the pinning slot's KV."""
+    a = PageAllocator(num_pages=3, page_size=2, num_slots=2, pages_per_slot=2,
+                      prefix_caching=True)
+    a.allocate(0, 4)
+    a.register_prefix(0, [1, 2, 3, 4])
+    a.free(0)                      # both pages parked, content kept
+    assert a.num_evictable_pages == 2 and a.num_free_pages == 0
+    assert a.adopt_prefix(1, [1, 2, 3, 4, 9]) == 4   # pinned by slot 1
+    # corrupt the invariant the way a buggy caller would: re-list a pinned
+    # page as evictable, then force an eviction (free list is empty)
+    a._lru[a.slot_pages[1][0]] = None
+    with pytest.raises(RuntimeError, match="still referenced"):
+        a._take_page()
+
+
+def test_double_free_detected():
+    """Freeing pages that already dropped their last reference (a stale
+    alias of another slot's list) must raise, not hand the same page to
+    two sequences."""
+    a = PageAllocator(num_pages=4, page_size=2, num_slots=2, pages_per_slot=2)
+    a.allocate(0, 4)
+    a.slot_pages[1] = list(a.slot_pages[0])   # stale alias
+    a.free(0)
+    with pytest.raises(RuntimeError, match="double free"):
+        a.free(1)
+
+
 def test_write_tokens_places_kv_in_pages_and_trash_for_padding():
     P, page, KV, d = 5, 4, 2, 3
     k_pages = KVPool(jnp.zeros((KV, P, page, d)))

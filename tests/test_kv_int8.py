@@ -101,6 +101,204 @@ def test_pallas_int8_kernel_matches_xla_reference():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("window,softcap", [(None, None), (9, None), (None, 40.0)])
+def test_fused_write_int8_k1_matches_write_tokens(window, softcap):
+    """The quantize-at-write twin of the fused decode kernel must match
+    write_tokens on an int8 pool: same attention rows, and — outside the
+    never-read trash page 0 — the same int8 bytes exactly, scales to
+    1 ulp (the kernel quantizes in f32 inside the program; the reference
+    quantizes under jit — XLA CPU's eager path rounds differently, so
+    the reference MUST be jitted). Lengths cover mid-page, a fresh-page
+    boundary, length-1 (prefill of 1 token + first decode), an idle row,
+    and the last row of the last page."""
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_attention_write_int8,
+    )
+
+    rng = np.random.default_rng(3)
+    KV, group, d, page, pps = 2, 2, 8, 8, 4
+    hist = np.asarray([13, 16, 1, 0, 31], np.int32)
+    B, n_q = len(hist), KV * group
+    P = B * pps + 1
+    cc = CacheConfig(num_layers=1, num_kv_heads=KV, head_dim=d, num_pages=P,
+                     page_size=page, pages_per_slot=pps, dtype="float32",
+                     kv_dtype="int8")
+    kp, vp = init_pages(cc)
+    table = np.zeros((B, pps), np.int32)
+    for b in range(B):
+        table[b] = 1 + b * pps + np.arange(pps)
+    table = jnp.asarray(table)
+
+    wt = jax.jit(write_tokens)
+    Tmax = int(hist.max())
+    k_hist = jnp.asarray(rng.normal(size=(B, Tmax, KV, d)), jnp.float32)
+    v_hist = jnp.asarray(rng.normal(size=(B, Tmax, KV, d)), jnp.float32)
+    pos = np.broadcast_to(np.arange(Tmax, dtype=np.int32), (B, Tmax)).copy()
+    pos[pos >= hist[:, None]] = -1
+    kp, vp = wt(kp, vp, k_hist, v_hist, table, jnp.asarray(pos))
+
+    lengths = jnp.asarray(np.where(hist > 0, hist + 1, 0).astype(np.int32))
+    k_new = jnp.asarray(rng.normal(size=(B, KV, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, KV, d)), jnp.float32)
+    wp = np.where(hist > 0, hist, -1)[:, None].astype(np.int32)
+    kp_ref, vp_ref = wt(kp, vp, k_new[:, None], v_new[:, None], table,
+                        jnp.asarray(wp))
+    q = jnp.asarray(rng.normal(size=(B, n_q, d)), jnp.float32)
+    ref = paged_attention(q, kp_ref, vp_ref, table, lengths, scale=d ** -0.5,
+                          sliding_window=window, attn_softcap=softcap)
+
+    out, kd2, ks2, vd2, vs2 = pallas_paged_attention_write_int8(
+        q, kp.data, kp.scale, vp.data, vp.scale, table, lengths,
+        k_new, v_new, scale=d ** -0.5, sliding_window=window,
+        attn_softcap=softcap, interpret=True)
+    act = np.asarray(lengths) > 0
+    np.testing.assert_allclose(np.asarray(out)[act], np.asarray(ref)[act],
+                               rtol=2e-5, atol=2e-5)
+    assert np.isfinite(np.asarray(out)).all()  # idle row must not NaN
+    np.testing.assert_array_equal(np.asarray(kd2)[:, 1:],
+                                  np.asarray(kp_ref.data)[:, 1:])
+    np.testing.assert_array_equal(np.asarray(vd2)[:, 1:],
+                                  np.asarray(vp_ref.data)[:, 1:])
+    np.testing.assert_allclose(np.asarray(ks2)[:, 1:],
+                               np.asarray(kp_ref.scale)[:, 1:], rtol=2e-7)
+    np.testing.assert_allclose(np.asarray(vs2)[:, 1:],
+                               np.asarray(vp_ref.scale)[:, 1:], rtol=2e-7)
+
+
+def test_fused_write_window_int8_matches_splice():
+    """Windowed quantize-at-write append vs a numpy splice of jitted
+    quantize_kv outputs: written rows carry the quantized window bytes
+    (int8 exact, scales to 1 ulp); every OTHER pool byte — data and
+    scale — must be bit-untouched. Windows start mid-page, at a page
+    boundary, at position 0, cross into a fresh page, and one row is
+    idle (width 0)."""
+    from llms_on_kubernetes_tpu.ops.pallas_paged import (
+        pallas_paged_write_window_int8,
+    )
+
+    rng = np.random.default_rng(4)
+    KV, d, page, pps, W = 2, 8, 8, 4, 4
+    base = np.asarray([7, 8, 0, 15, 3], np.int32)
+    widths = np.asarray([4, 3, 4, 2, 0], np.int32)
+    B = len(base)
+    P = B * pps + 1
+    kd = jnp.asarray(rng.integers(-127, 128, size=(KV, P, page, d)), jnp.int8)
+    vd = jnp.asarray(rng.integers(-127, 128, size=(KV, P, page, d)), jnp.int8)
+    ks = jnp.asarray(rng.random(size=(KV, P, page)) + 0.1, jnp.float32)
+    vs = jnp.asarray(rng.random(size=(KV, P, page)) + 0.1, jnp.float32)
+    table = np.zeros((B, pps), np.int32)
+    for b in range(B):
+        table[b] = 1 + b * pps + np.arange(pps)
+    k_new = jnp.asarray(rng.normal(size=(B, W, KV, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, W, KV, d)), jnp.float32)
+
+    qfn = jax.jit(quantize_kv)
+    kq_d, kq_s = qfn(k_new)   # [B, W, KV, d] int8, [B, W, KV] f32
+    vq_d, vq_s = qfn(v_new)
+    kd_ref, ks_ref = np.asarray(kd).copy(), np.asarray(ks).copy()
+    vd_ref, vs_ref = np.asarray(vd).copy(), np.asarray(vs).copy()
+    for b in range(B):
+        for t in range(int(widths[b])):
+            p = int(base[b]) + t
+            pid = table[b, p // page]
+            kd_ref[:, pid, p % page] = np.asarray(kq_d)[b, t]
+            ks_ref[:, pid, p % page] = np.asarray(kq_s)[b, t]
+            vd_ref[:, pid, p % page] = np.asarray(vq_d)[b, t]
+            vs_ref[:, pid, p % page] = np.asarray(vq_s)[b, t]
+
+    kd2, ks2, vd2, vs2 = pallas_paged_write_window_int8(
+        kd, ks, vd, vs, jnp.asarray(table), jnp.asarray(base),
+        jnp.asarray(widths), k_new, v_new, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kd2), kd_ref)
+    np.testing.assert_array_equal(np.asarray(vd2), vd_ref)
+    np.testing.assert_allclose(np.asarray(ks2), ks_ref, rtol=2e-7)
+    np.testing.assert_allclose(np.asarray(vs2), vs_ref, rtol=2e-7)
+
+
+def test_int8_kv_teacher_forced_parity_across_decode_windows():
+    """int8 KV acceptance gate (PR-4 margin-triage pattern): the fused
+    K=1 kernel, the K=4 window, and the K=4 speculative (ngram) path
+    must emit IDENTICAL greedy streams with int8 KV on — they quantize
+    with the same math, so divergence means a kernel bug, not noise.
+    Then teacher-force the stream through the fp32 model: wherever
+    fp32's top-1/top-2 logprob margin is decisive (0.05 nats — far above
+    the ~0.005 int8-KV perturbation), the int8-KV engine must have
+    picked fp32's argmax. Near-ties are excluded by construction, so
+    this does not inherit the autoregressive-cascade brittleness the
+    PR-4 weight-quant test fixed."""
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import (
+        Engine, EngineConfig, SamplingParams,
+    )
+    from llms_on_kubernetes_tpu.models.decoder import forward_score, init_params
+
+    def stream(steps, spec):
+        eng = Engine(EngineConfig(
+            model="debug-tiny", dtype="float32", max_decode_slots=2,
+            page_size=16, num_pages=64, pages_per_slot=8,
+            prefill_buckets=(16,), kv_cache_dtype="int8",
+            decode_steps=steps, speculation=spec))
+        return eng.generate([1, 2, 3, 4, 5],
+                            SamplingParams(temperature=0.0, max_tokens=8))
+
+    k1 = stream(1, None)
+    k4 = stream(4, None)
+    k4s = stream(4, "ngram")
+    assert k1 == k4 == k4s, (k1, k4, k4s)
+    assert len(k1) == 8
+
+    cfg = get_config("debug-tiny")
+    params = init_params(cfg, jax.random.key(0), dtype="float32")
+    seq = [1, 2, 3, 4, 5] + k1
+    tokens = jnp.asarray([seq], jnp.int32)
+    lengths = jnp.asarray([len(seq)], jnp.int32)
+    _, ids, top = forward_score(params, cfg, tokens, lengths, top_k=2)
+    margin = np.asarray(top[0, :, 0] - top[0, :, 1])
+    decisive = margin > 0.05
+    checked = 0
+    for t in range(4, len(seq) - 1):  # positions predicting generated tokens
+        if decisive[t]:
+            assert seq[t + 1] == int(ids[0, t, 0]), (
+                f"int8 KV flipped a decisive (margin {margin[t]:.3f}) "
+                f"argmax at position {t}: {ids[0, t, 0]} -> {seq[t + 1]}")
+            checked += 1
+    assert checked >= 4  # test has teeth
+
+
+def test_mid_window_abort_restores_page_accounting_int8():
+    """Aborting mid-flight with a K=4 window in the async pipeline and
+    int8 pages must restore the allocator exactly: no leaked refcounts,
+    the full free list back (prefix caching off so freed pages return to
+    the free list, not the LRU), and a zeroed page table — the PR-8/12
+    abort harness extended to the quantized pool."""
+    from llms_on_kubernetes_tpu.engine.engine import (
+        Engine, EngineConfig, SamplingParams,
+    )
+
+    eng = Engine(EngineConfig(
+        model="debug-tiny", dtype="float32", max_decode_slots=2,
+        page_size=4, num_pages=32, pages_per_slot=8, prefill_buckets=(16,),
+        kv_cache_dtype="int8", decode_steps=4, prefix_caching=False,
+        async_scheduling=True, async_depth=2))
+    free0 = eng.allocator.num_free_pages
+    req = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=200))
+    other = eng.submit([4, 5], SamplingParams(temperature=0.0, max_tokens=6))
+    for _ in range(3):
+        eng.step()
+    eng.abort(req, "client_disconnect")
+    steps = 0
+    while not (req.finished and other.finished):
+        eng.step()
+        steps += 1
+        assert steps < 500
+    for _ in range(5):  # drain any in-flight windows
+        eng.step()
+    assert eng.allocator.refcount == {}
+    assert eng.allocator.num_free_pages == free0
+    assert all(not p for p in eng.allocator.slot_pages)
+    assert (eng.allocator.page_tables == 0).all()
+
+
 def test_engine_generates_with_int8_kv():
     from llms_on_kubernetes_tpu.engine.engine import (
         Engine, EngineConfig, SamplingParams,
