@@ -68,6 +68,24 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="draft model for --speculation draft (registry "
                         "name or .gguf path; default: $LLMK_DRAFT_MODEL; "
                         "implies --speculation draft)")
+    p.add_argument("--no-ledger", dest="ledger", action="store_false",
+                   default=None,
+                   help="disable the goodput ledger (per-request chip-time "
+                        "attribution, MFU/MBU gauges, per-tenant chip-"
+                        "seconds; default: $LLMK_LEDGER or on)")
+    p.add_argument("--no-anomaly-profile", dest="anomaly_profile",
+                   action="store_false", default=None,
+                   help="disable the step-time anomaly watchdog's automatic "
+                        "profiler captures (default: $LLMK_ANOMALY_PROFILE "
+                        "or on)")
+    p.add_argument("--anomaly-z", type=float, default=None,
+                   help="z-score a dispatch's device time must exceed to "
+                        "count as anomalous (default: $LLMK_ANOMALY_Z or "
+                        "4.0)")
+    p.add_argument("--anomaly-cooldown-s", type=float, default=None,
+                   help="minimum seconds between automatic profiler "
+                        "captures — the watchdog's rate limit (default: "
+                        "$LLMK_ANOMALY_COOLDOWN_S or 600)")
     def _positive_int(v: str) -> int:
         n = int(v)
         if n < 1:
@@ -380,6 +398,10 @@ def main(argv: list[str] | None = None) -> int:
         decode_steps=args.decode_steps,
         speculation=args.speculation,
         draft_model=args.draft_model,
+        ledger=args.ledger,
+        anomaly_profile=args.anomaly_profile,
+        anomaly_z=args.anomaly_z,
+        anomaly_cooldown_s=args.anomaly_cooldown_s,
         max_images_per_request=args.max_images_per_request,
         adapters=adapters,
         adapter_slots=args.adapter_slots,

@@ -157,6 +157,19 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
     if m.kv_host_cache_gb > 0:
         c["env"].append({"name": "LLMK_KV_HOST_CACHE_GB",
                          "value": str(m.kv_host_cache_gb)})
+    if m.ledger is not None:
+        # goodput ledger on/off; engine default is on, so only an
+        # explicit spec value renders env
+        c["env"].append({"name": "LLMK_LEDGER",
+                         "value": "1" if m.ledger else "0"})
+    if m.anomaly_profile is not None:
+        ap = m.anomaly_profile
+        c["env"].append({"name": "LLMK_ANOMALY_PROFILE",
+                         "value": "1" if ap.enabled else "0"})
+        c["env"].append({"name": "LLMK_ANOMALY_Z",
+                         "value": str(ap.threshold)})
+        c["env"].append({"name": "LLMK_ANOMALY_COOLDOWN_S",
+                         "value": str(ap.cooldown_s)})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on

@@ -329,6 +329,40 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKGoodputCollapse",
+                        # the goodput ledger's headline failure mode:
+                        # chips are busy (or idle) but streams are not
+                        # being served — most chip time going to waste
+                        # phases or idle gaps WHILE work is queued. The
+                        # per-phase breakdown and any auto-profile
+                        # capture (llm_auto_profile_total) say where the
+                        # time went.
+                        "expr": (
+                            "sum(rate(llm_chip_seconds_total"
+                            '{phase=~"spec_waste|early_exit|idle"}[10m]))'
+                            " / sum(rate(llm_chip_seconds_total[10m]))"
+                            " > 0.6 and on() sum(llm_queue_depth) > 4"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "page"},
+                        "annotations": {
+                            "summary": "chip time mostly wasted/idle "
+                                       "while requests queue",
+                            "description": (
+                                "Over 60% of ledger chip-seconds are "
+                                "speculative rejected tails, early-exit "
+                                "rows, or idle gaps for 10m while the "
+                                "admission queue is non-empty. Serving "
+                                "capacity is being burned without "
+                                "producing stream tokens: check the "
+                                "spec accept ratio, decode_steps sizing "
+                                "vs typical generations, and the "
+                                "flight recorder / auto-profile capture "
+                                "for the slow phase."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -456,6 +490,21 @@ def grafana_dashboard() -> dict[str, Any]:
                ["histogram_quantile(0.95, "
                 "rate(llm_kv_upload_seconds_bucket[5m]))",
                 "llm_kv_bytes_per_token"], 12, 80),
+        _panel(23, "Goodput: chip-seconds by phase",
+               ['sum by (phase) (rate(llm_chip_seconds_total[5m]))'],
+               0, 88, unit="percentunit"),
+        _panel(24, "Hardware utilization: MFU / MBU",
+               ["llm_mfu_ratio", "llm_mbu_ratio"], 12, 88,
+               unit="percentunit"),
+        _panel(25, "Wasted chip fraction (spec tails + early exits)",
+               ['sum (rate(llm_chip_seconds_total'
+                '{phase=~"spec_waste|early_exit"}[5m])) / '
+                'sum (rate(llm_chip_seconds_total[5m]))'],
+               0, 96, unit="percentunit"),
+        _panel(26, "Per-tenant chip-seconds (chargeback)",
+               ["sum by (tenant) "
+                "(rate(llm_tenant_chip_seconds_total[5m]))",
+                "rate(llm_auto_profile_total[1h])"], 12, 96),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -137,6 +137,31 @@ class AutoscalingSpec:
                 f"in (0, 1], got {self.ttft_ok_ratio_floor}")
 
 
+@dataclasses.dataclass(frozen=True)
+class AnomalyProfileSpec:
+    """Step-time anomaly watchdog knobs (``anomalyProfile:`` block).
+
+    The goodput ledger feeds every dispatch's device time to an EWMA +
+    z-score detector; a sustained anomaly triggers ONE bounded profiler
+    capture (``llm_auto_profile_total{reason="step_anomaly"}``),
+    rate-limited by ``cooldownS``. Rendered as LLMK_ANOMALY_* env vars.
+    """
+
+    enabled: bool = True
+    threshold: float = 4.0      # z-score a dispatch must exceed
+    cooldown_s: float = 600.0   # min seconds between automatic captures
+
+    def validate(self, model_name: str) -> None:
+        if self.threshold <= 0:
+            raise SpecError(
+                f"model {model_name}: anomalyProfile.threshold must be "
+                f"> 0, got {self.threshold}")
+        if self.cooldown_s < 0:
+            raise SpecError(
+                f"model {model_name}: anomalyProfile.cooldownS must be "
+                f">= 0, got {self.cooldown_s}")
+
+
 _QOS_PRIORITIES = ("interactive", "normal", "batch")
 
 
@@ -296,6 +321,13 @@ class ModelSpec:
     # finished/preempted sessions park their KV pages in host memory and
     # a returning session re-uploads instead of re-prefilling. 0 = off.
     kv_host_cache_gb: float = 0.0
+    # goodput ledger (LLMK_LEDGER): per-request chip-time attribution +
+    # MFU/MBU accounting. None = engine default (on); False disables the
+    # per-dispatch bookkeeping entirely.
+    ledger: Optional[bool] = None
+    # step-time anomaly watchdog -> automatic profiler capture
+    # (LLMK_ANOMALY_PROFILE / _Z / _COOLDOWN_S); None = engine defaults
+    anomaly_profile: Optional[AnomalyProfileSpec] = None
     # multi-tenant LoRA: adapters served on this model's replicas, the
     # device slot count (LRU-recycled) and max rank the slots are sized for
     adapters: tuple = ()                   # tuple[AdapterSpec, ...]
@@ -369,6 +401,15 @@ class ModelSpec:
                 f"and would desync follower pods) — drop it or use a "
                 f"single-host topology"
             )
+        if self.anomaly_profile is not None:
+            self.anomaly_profile.validate(self.model_name)
+            if self.ledger is False and self.anomaly_profile.enabled:
+                raise SpecError(
+                    f"model {self.model_name}: anomalyProfile needs the "
+                    f"goodput ledger (the watchdog reads its per-dispatch "
+                    f"times) — drop `ledger: false` or disable the "
+                    f"watchdog"
+                )
         if self.tpu is not None:
             if self.tpu.accelerator not in CHIPS_PER_HOST:
                 raise SpecError(
@@ -520,6 +561,25 @@ def _autoscaling_from(d: Optional[dict], model_name: str) \
     )
 
 
+def _anomaly_from(d: Optional[dict], model_name: str) \
+        -> Optional[AnomalyProfileSpec]:
+    if d is None:
+        return None
+    if not isinstance(d, dict):
+        raise SpecError(
+            f"model {model_name}: anomalyProfile must be a mapping")
+    unknown = set(d) - {"enabled", "threshold", "cooldownS"}
+    if unknown:
+        raise SpecError(
+            f"model {model_name}: unknown anomalyProfile keys: "
+            f"{sorted(unknown)}")
+    return AnomalyProfileSpec(
+        enabled=bool(d.get("enabled", True)),
+        threshold=float(d.get("threshold", 4.0)),
+        cooldown_s=float(d.get("cooldownS", 600.0)),
+    )
+
+
 def _tenant_qos_from(d, label: str) -> TenantQoSSpec:
     if not isinstance(d, dict):
         raise SpecError(f"qos {label}: must be a mapping")
@@ -593,6 +653,7 @@ def _model_from(d: dict) -> ModelSpec:
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype", "decodeSteps",
         "speculation", "draft", "kvDtype", "kvHostCacheGB",
+        "ledger", "anomalyProfile",
         "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
     unknown = set(d) - known
@@ -629,6 +690,9 @@ def _model_from(d: dict) -> ModelSpec:
         draft=d.get("draft"),
         kv_dtype=d.get("kvDtype"),
         kv_host_cache_gb=float(d.get("kvHostCacheGB", 0) or 0),
+        ledger=(bool(d["ledger"]) if "ledger" in d else None),
+        anomaly_profile=_anomaly_from(d.get("anomalyProfile"),
+                                      d.get("modelName", "")),
         adapters=tuple(_adapter_from(a, d.get("modelName", ""))
                        for a in d.get("adapters", ()) or ()),
         adapter_slots=int(d.get("adapterSlots", 4)),

@@ -255,6 +255,19 @@ class EngineConfig:
     qos_default_weight: float = 1.0
     qos_default_priority: str = "normal"
     qos_starvation_s: float = 5.0
+    # goodput ledger (engine/ledger.py): per-request chip-time attribution
+    # across prefill/decode/spec_waste/early_exit, MFU/MBU accounting, and
+    # the step-time anomaly detector. None => env LLMK_LEDGER (default on).
+    # Off restores the exact pre-ledger hot path (no per-dispatch booking).
+    ledger: Optional[bool] = None
+    # anomaly-triggered auto-profiling: when the ledger's EWMA + z-score
+    # detector sees a sustained per-dispatch slowdown, the serving loop
+    # captures ONE bounded profile (rate-limited by the cooldown). None =>
+    # env LLMK_ANOMALY_PROFILE (default on while the ledger is on) /
+    # LLMK_ANOMALY_Z (z-score threshold) / LLMK_ANOMALY_COOLDOWN_S.
+    anomaly_profile: Optional[bool] = None
+    anomaly_z: Optional[float] = None
+    anomaly_cooldown_s: Optional[float] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -312,6 +325,23 @@ class EngineConfig:
                 os.environ.get("LLMK_KV_HOST_CACHE_GB", "0"))
         if self.kv_host_cache_gb < 0:
             self.kv_host_cache_gb = 0.0
+        _off = ("0", "false", "off", "no")
+        if self.ledger is None:
+            self.ledger = (os.environ.get("LLMK_LEDGER", "1")
+                           .strip().lower() not in _off)
+        if self.anomaly_profile is None:
+            self.anomaly_profile = (os.environ.get("LLMK_ANOMALY_PROFILE", "1")
+                                    .strip().lower() not in _off)
+        if self.anomaly_z is None:
+            self.anomaly_z = float(os.environ.get("LLMK_ANOMALY_Z", "4.0"))
+        if self.anomaly_z <= 0:
+            raise ValueError(
+                f"anomaly_z must be > 0, got {self.anomaly_z}")
+        if self.anomaly_cooldown_s is None:
+            self.anomaly_cooldown_s = float(
+                os.environ.get("LLMK_ANOMALY_COOLDOWN_S", "600"))
+        if self.anomaly_cooldown_s < 0:
+            self.anomaly_cooldown_s = 0.0
         if self.kv_write not in KV_WRITE_STRATEGIES:
             raise ValueError(
                 f"kv_write must be one of {KV_WRITE_STRATEGIES}, "
@@ -438,6 +468,11 @@ class Request:
     # lower-priority victims
     tenant: str = ""
     priority: str = "normal"
+    # goodput ledger: device milliseconds attributed to this request per
+    # phase (prefill/decode/spec_waste/early_exit) — written by the engine
+    # thread as dispatches harvest, surfaced in the OpenAI usage block,
+    # the X-LLMK-Chip-Ms header, and the request's trace spans
+    chip_ms: dict = dataclasses.field(default_factory=dict)
     finished: bool = False
     finish_reason: Optional[str] = None
     abort_reason: Optional[str] = None  # set by any thread; reaped by step()
@@ -483,6 +518,7 @@ class InflightStep:
     spec: bool = False                     # speculative verify dispatch:
     #                                        pack is (packs [K,B,W], accept [B])
     drafted: Optional[dict] = None         # slot -> drafted tokens this window
+    launched_at: float = 0.0               # dispatch time (goodput ledger)
 
 
 class _Harvester(threading.Thread):
@@ -516,6 +552,9 @@ class _Harvester(threading.Thread):
         self._prio: "collections.deque[tuple[int, Any]]" = collections.deque()
         self._staged: dict[int, Any] = {}   # read but predecessors not done
         self._done: dict[int, Any] = {}     # steps (dense prefix) + priority
+        # monotonic completion time per done key — when its device_get
+        # landed. The goodput ledger segments busy time on these.
+        self._done_t: dict[int, float] = {}
         self._done_upto = -1
         self._next_seq = 0                  # next step seq to mark done
         self._stopping = False
@@ -584,18 +623,21 @@ class _Harvester(threading.Thread):
                         self._error = e
                     self._cv.notify_all()
                 return
+            t_read = time.monotonic()
             with self._cv:
                 if priority:
                     for (key, _), h in zip(batch, host):
                         self._done[key] = h
+                        self._done_t[key] = t_read
                 else:
                     for (seq, _), h in zip(batch, host):
-                        self._staged[seq] = h
+                        self._staged[seq] = (h, t_read)
                     # done-ness stays a dense seq prefix even with
                     # overlapped readers finishing out of order
                     while self._next_seq in self._staged:
-                        self._done[self._next_seq] = self._staged.pop(
-                            self._next_seq)
+                        h, t = self._staged.pop(self._next_seq)
+                        self._done[self._next_seq] = h
+                        self._done_t[self._next_seq] = t
                         self._done_upto = self._next_seq
                         self._next_seq += 1
                 self._cv.notify_all()
@@ -618,6 +660,12 @@ class _Harvester(threading.Thread):
     def get(self, key: int) -> Any:
         with self._cv:
             return self._done[key]
+
+    def done_time(self, key: int) -> float:
+        """Monotonic time key's device_get completed (ledger segmenting);
+        falls back to now for keys whose stamp was already discarded."""
+        with self._cv:
+            return self._done_t.get(key, time.monotonic())
 
     def wait_done(self, seq: int, wake: Optional[threading.Event] = None,
                   timeout_s: Optional[float] = None) -> None:
@@ -669,10 +717,12 @@ class _Harvester(threading.Thread):
         with self._cv:
             for s in [s for s in self._done if 0 <= s <= seq]:
                 del self._done[s]
+                self._done_t.pop(s, None)
 
     def discard_key(self, key: int) -> None:
         with self._cv:
             self._done.pop(key, None)
+            self._done_t.pop(key, None)
 
     def stop(self) -> None:
         with self._cv:
@@ -1413,6 +1463,28 @@ class Engine:
         # path); async-path device waits land on the harvester thread's
         # own counter — device_wait_s() sums both for step attribution
         self._device_time_s = 0.0
+        # goodput ledger: chip-time attribution + MFU/MBU + step-time
+        # anomaly detection (engine/ledger.py); None = accounting off
+        self.ledger = None
+        if engine_config.ledger:
+            import os
+
+            from llms_on_kubernetes_tpu.engine.ledger import (
+                GoodputLedger, StepAnomalyDetector,
+            )
+
+            det = None
+            if engine_config.anomaly_profile:
+                det = StepAnomalyDetector(
+                    threshold=engine_config.anomaly_z,
+                    cooldown_s=engine_config.anomaly_cooldown_s,
+                    sustain=int(os.environ.get("LLMK_ANOMALY_SUSTAIN", "3")),
+                    warmup=int(os.environ.get("LLMK_ANOMALY_WARMUP", "12")),
+                )
+            self.ledger = GoodputLedger(cfg, detector=det)
+        # in-flight prefill dispatches awaiting their priority read:
+        # key -> (launch time, [(request, prefill tokens), ...])
+        self._ledger_prefills: dict[int, tuple[float, list]] = {}
 
         self._prefill_packed = jax.jit(
             _prefill_packed_step, static_argnums=(1,), donate_argnums=(4, 5, 6)
@@ -2554,6 +2626,7 @@ class Engine:
         if resumed and req.fsm_row >= 0:
             self._fsm_replay(req)  # stages fsm_set for the next decode
 
+        t_launch = time.monotonic()
         if req.images is not None and hit == 0:
             pack, _toks = self._dispatch_mm_prefill(slot, req, prefill_tokens)
         elif hit > 0 or n > max(self.config.prefill_buckets):
@@ -2597,6 +2670,9 @@ class Engine:
         t0 = time.perf_counter()
         host = HostSample(np.asarray(jax.device_get(pack)))
         self._device_time_s += time.perf_counter() - t0
+        if self.ledger is not None:
+            self.ledger.record(t_launch, time.monotonic(),
+                               [(req, "prefill", n - hit or n)])
         first = int(host.tokens[0])
         req.pending_token = first
         req.first_token_at = time.monotonic()
@@ -2685,6 +2761,7 @@ class Engine:
                 events.append(self._finish(req, "stalled"))
         self._inflight.clear()
         self._pending_first = []
+        self._ledger_prefills.clear()
         return events
 
     def _note_admission(self, req: Request) -> None:
@@ -2805,6 +2882,7 @@ class Engine:
         self.decode_dispatches += 1
         self.decode_tokens += len(active)
         self.steps_obs.append(1)
+        t_launch = time.monotonic()
         packed = self._dec_template(active)
         for i, r in active:
             packed[i, 0] = self.slot_len[i] + 1
@@ -2828,6 +2906,9 @@ class Engine:
         t0 = time.perf_counter()
         host = HostSample(np.asarray(jax.device_get(pack)))
         self._device_time_s += time.perf_counter() - t0
+        if self.ledger is not None:
+            self.ledger.record(t_launch, time.monotonic(),
+                               [(r, "decode", 1) for _i, r in active])
 
         events: list[StepEvent] = []
         for i, r in active:
@@ -2960,6 +3041,7 @@ class Engine:
             # below is dispatched — its history attention reads them.
             # Outside the lock: the np.stack memcpy must not block submit()
             self._host_kv_commit(slot, req)
+            t_launch = time.monotonic()
             if req.images is not None and hit == 0:
                 pack, toks = self._dispatch_mm_prefill(slot, req,
                                                        prefill_tokens)
@@ -2978,6 +3060,8 @@ class Engine:
                                 + 2.0 * n_chunks * self._est_step)
             merge = {"toks": toks, "slots": {}}
             if resumed:
+                # no priority read to segment on — the resumed re-prefill's
+                # device time folds into the next decode harvest's segment
                 req.pending_token = req.output[-1]
                 merge["slots"][slot] = (True, req.output[-1], 0)
             else:
@@ -2985,6 +3069,10 @@ class Engine:
                 self._harvester.push(key, pack, priority=True)
                 merge["slots"][slot] = (False, 0, 0)
                 self._pending_first.append((req, key, 0))
+                if self.ledger is not None:
+                    self._ledger_prefills[key] = (
+                        t_launch,
+                        [(req, max(1, len(prefill_tokens) - hit))])
             return merge
         if not picked:
             return None
@@ -3007,6 +3095,7 @@ class Engine:
             self.slot_len[slot] = n
 
         use_fsm = bool((packed[:, _FSM_PRE] >= 0).any())
+        t_launch = time.monotonic()
         self._mh_send(MSG_PREFILL, pre_tokens=tokens, pre_packed=packed,
                       fsm_used=use_fsm)
         (pack, toks, self.k_pages, self.v_pages, self.token_counts,
@@ -3027,6 +3116,12 @@ class Engine:
             # priority read: first tokens jump the decode-read queue
             key = -1 - next(self._first_counter)
             self._harvester.push(key, pack, priority=True)
+            if self.ledger is not None:
+                # every picked row rode the dispatch, resumed ones included
+                self._ledger_prefills[key] = (
+                    t_launch,
+                    [(req, max(1, len(ptoks)))
+                     for _slot, req, _resumed, ptoks in picked])
         merge = {"toks": toks, "slots": {}}
         for row, (slot, req, resumed, _ptoks) in enumerate(picked):
             if resumed:
@@ -3142,7 +3237,8 @@ class Engine:
             self._fsm_state = new_state
         seq = next(self._seq_counter)
         step = InflightStep(pack, toks, active, seq,
-                            planned={i: 1 for i, _r in active})
+                            planned={i: 1 for i, _r in active},
+                            launched_at=time.monotonic())
         self._inflight.append(step)
         self._harvester.push(seq, pack)
         now = time.monotonic()
@@ -3259,7 +3355,8 @@ class Engine:
             self._fsm_state = new_state
         seq = next(self._seq_counter)
         step = InflightStep(pack, toks, active, seq,
-                            planned={i: plan.get(i, 0) for i, _r in active})
+                            planned={i: plan.get(i, 0) for i, _r in active},
+                            launched_at=time.monotonic())
         self._inflight.append(step)
         self._harvester.push(seq, pack)
         now = time.monotonic()
@@ -3367,7 +3464,8 @@ class Engine:
         seq = next(self._seq_counter)
         step = InflightStep(pack, toks, active, seq,
                             planned={i: plan.get(i, 0) for i, _r in active},
-                            spec=True, drafted=drafted)
+                            spec=True, drafted=drafted,
+                            launched_at=time.monotonic())
         self._inflight.append(step)
         self._harvester.push(seq, pack)
         now = time.monotonic()
@@ -3491,6 +3589,12 @@ class Engine:
             self._pending_first = still
             done_keys = {k for _, k, _ in done_entries}
             for k in done_keys - {k for _, k, _ in still}:
+                led = self._ledger_prefills.pop(k, None)
+                if led is not None and self.ledger is not None:
+                    t_launch, rows = led
+                    self.ledger.record(
+                        t_launch, self._harvester.done_time(k),
+                        [(req, "prefill", n) for req, n in rows])
                 self._harvester.discard_key(k)
 
         processed = -1
@@ -3512,10 +3616,14 @@ class Engine:
             n_steps += 1
             consumed_total = wasted = max_consumed = 0
             spec_accepted = 0
+            led_rows: list = []
             for slot, req in step.active:
                 p = 1 if step.planned is None else step.planned.get(slot, 0)
                 if p <= 0:
                     continue
+                waste_phase = ("spec_waste"
+                               if step.spec and step.drafted
+                               and slot in step.drafted else "early_exit")
                 # a spec row consumes only its device-verified prefix: the
                 # suffix rows after a draft mismatch hold tokens sampled
                 # from logits conditioned on the REJECTED draft — garbage
@@ -3527,6 +3635,7 @@ class Engine:
                 # garbage (and the whole window is wasted speculation)
                 if req.finished or req.slot != slot:
                     wasted += p
+                    led_rows.append((req, waste_phase, p))
                     continue
                 consumed = 0
                 for k in range(cap):
@@ -3541,11 +3650,18 @@ class Engine:
                         break
                 consumed_total += consumed
                 wasted += p - consumed
+                led_rows.append((req, "decode", consumed))
+                if p > consumed:
+                    led_rows.append((req, waste_phase, p - consumed))
                 max_consumed = max(max_consumed, consumed)
                 if step.spec and step.drafted and slot in step.drafted:
                     # accepted drafts = consumed tokens minus the one the
                     # plain path would have produced anyway
                     spec_accepted += max(0, consumed - 1)
+            if self.ledger is not None:
+                self.ledger.record(
+                    step.launched_at, self._harvester.done_time(step.seq),
+                    led_rows, window=arr.shape[0])
             self.decode_dispatches += 1
             self.decode_tokens += consumed_total
             self.early_exit_steps += wasted

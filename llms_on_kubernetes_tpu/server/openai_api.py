@@ -55,6 +55,22 @@ from llms_on_kubernetes_tpu.server.router import (
 )
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
+# goodput-ledger per-request attribution: total device milliseconds this
+# request consumed (all phases, waste included); the phase breakdown rides
+# the response body's usage.chip_ms object
+CHIP_MS_HEADER = "X-LLMK-Chip-Ms"
+
+
+def _chip_ms_total(reqs) -> dict:
+    """Summed per-phase chip-time attribution across a request group
+    (n>1 / best_of fan-out serves one HTTP request with many engine
+    requests)."""
+    chip: dict = {}
+    for r in reqs:
+        for ph, v in getattr(r, "chip_ms", {}).items():
+            chip[ph] = chip.get(ph, 0.0) + v
+    return chip
+
 
 def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
     """Absolute monotonic deadline for this request, or None.
@@ -115,13 +131,15 @@ class EngineLoop(threading.Thread):
     def __init__(self, engine: Engine, metrics: Optional[dict] = None,
                  model_name: str = "",
                  flight: Optional[tracing.FlightRecorder] = None,
-                 telemetry: Optional[RuntimeTelemetry] = None):
+                 telemetry: Optional[RuntimeTelemetry] = None,
+                 profiles=None):
         super().__init__(daemon=True, name="engine-loop")
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
         self.flight = flight
         self.telemetry = telemetry
+        self.profiles = profiles  # ProfileManager for watchdog captures
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._ttft_seen: set[str] = set()
@@ -133,6 +151,12 @@ class EngineLoop(threading.Thread):
         self._tenant_admitted_seen: "collections.Counter" = (
             collections.Counter())
         self._shed_total = 0
+        # goodput-ledger drain state: cumulative ms already exported
+        # (delta-style, matching the other counters above)
+        self._led_phase_seen: dict[str, float] = {}
+        self._led_tenant_seen: dict[tuple, float] = {}
+        self._led_frame_seen = (0.0, 0.0)
+        self.auto_profiles = 0
 
     def _mlabel(self, r) -> str:
         """Per-request model label: ``base:adapter`` for LoRA requests so
@@ -200,6 +224,9 @@ class EngineLoop(threading.Thread):
             self._shed_total += sum(
                 1 for ev in events
                 if ev.finished and ev.finish_reason in ("timeout", "stalled"))
+            led = getattr(eng, "ledger", None)
+            led_snap = led.snapshot() if led is not None else None
+            led_util = led.utilization() if led is not None else None
             if self.metrics:
                 m = self.metrics
                 m["decode_step"].labels(model=self.model_name).observe(dt)
@@ -266,6 +293,24 @@ class EngineLoop(threading.Thread):
                 cc = getattr(eng, "cache_config", None)
                 if cc is not None:
                     m["kv_bytes_per_token"].set(cc.bytes_per_token)
+                if led_snap is not None:
+                    series = dict(led_snap["phase_ms"])
+                    series["idle"] = led_snap["idle_ms"]
+                    for ph, ms in series.items():
+                        seen = self._led_phase_seen.get(ph, 0.0)
+                        if ms > seen:
+                            m["chip_seconds"].labels(phase=ph).inc(
+                                (ms - seen) / 1000.0)
+                            self._led_phase_seen[ph] = ms
+                    for key, ms in led_snap["tenant_ms"].items():
+                        seen = self._led_tenant_seen.get(key, 0.0)
+                        if ms > seen:
+                            m["tenant_chip_seconds"].labels(
+                                tenant=key[0], phase=key[1]).inc(
+                                    (ms - seen) / 1000.0)
+                            self._led_tenant_seen[key] = ms
+                    m["mfu"].set(led_util[0])
+                    m["mbu"].set(led_util[1])
                 m["batch_occupancy"].set(occupancy)
                 m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))
@@ -295,7 +340,7 @@ class EngineLoop(threading.Thread):
                 # one flight-recorder frame per engine step: enough to
                 # reconstruct "what was the engine doing" after a stall
                 # or latency spike without a profiler attached
-                self.flight.record(
+                frame = dict(
                     step_ms=round(dt * 1000.0, 3),
                     device_ms=round(device_s * 1000.0, 3),
                     host_ms=round((dt - device_s) * 1000.0, 3),
@@ -309,6 +354,45 @@ class EngineLoop(threading.Thread):
                     shed=self._shed_total,
                     wedged=bool(getattr(eng, "wedged", False)),
                 )
+                if led_snap is not None:
+                    attr, waste = (led_snap["attributed_ms"],
+                                   led_snap["wasted_ms"])
+                    pa, pw = self._led_frame_seen
+                    self._led_frame_seen = (attr, waste)
+                    frame.update(
+                        chip_attr_ms=round(attr - pa, 3),
+                        chip_waste_ms=round(waste - pw, 3),
+                        mfu=round(led_util[0], 5),
+                    )
+                self.flight.record(**frame)
+            if led is not None and led.take_anomaly():
+                self._trigger_auto_profile()
+
+    def _trigger_auto_profile(self) -> None:
+        """One bounded, rate-limited profiler capture while the step-time
+        anomaly is still live (the detector's cooldown is the rate limit;
+        a capture already in flight is skipped, not queued)."""
+        self.auto_profiles += 1
+        if self.metrics:
+            self.metrics["auto_profile"].labels(reason="step_anomaly").inc()
+        if self.flight is not None:
+            self.flight.record(marker="auto_profile", reason="step_anomaly")
+        prof = self.profiles
+        if prof is None:
+            return
+        import os
+        duration_ms = float(os.environ.get("LLMK_ANOMALY_CAPTURE_MS", "2000"))
+
+        def _cap():
+            try:
+                prof.capture(duration_ms=duration_ms)
+            except RuntimeError:
+                pass  # a capture is already running — skip, don't queue
+            except Exception:
+                pass  # profiling must never take the serving loop down
+
+        threading.Thread(target=_cap, daemon=True,
+                         name="auto-profile").start()
 
 
 def _event_pusher(loop: asyncio.AbstractEventLoop, q: "asyncio.Queue"):
@@ -468,7 +552,8 @@ class OpenAIServer:
         self.loop_thread = EngineLoop(engine, self.metrics,
                                       model_name=model_name,
                                       flight=self.flight,
-                                      telemetry=self.telemetry)
+                                      telemetry=self.telemetry,
+                                      profiles=self.profiles)
         self.engine = engine
         # readiness lifecycle: loading -> serving -> draining; "wedged" is
         # derived from the engine watchdog and overrides everything.
@@ -1278,11 +1363,24 @@ class OpenAIServer:
             trace.add_span("queue", sub, adm if adm is not None else fin,
                            **meta)
             if adm is not None:
+                pre_kw = dict(meta)
+                if req.chip_ms:
+                    # goodput-ledger attribution: device time this stream
+                    # actually consumed, vs the wall-clock span bounds
+                    pre_kw["chip_ms"] = round(
+                        req.chip_ms.get("prefill", 0.0), 3)
                 trace.add_span("prefill", adm,
-                               ft if ft is not None else fin, **meta)
+                               ft if ft is not None else fin, **pre_kw)
             if ft is not None:
-                trace.add_span("decode", ft, fin,
-                               tokens=len(req.output), **meta)
+                dec_kw = dict(meta, tokens=len(req.output))
+                if req.chip_ms:
+                    dec_kw["chip_ms"] = round(
+                        req.chip_ms.get("decode", 0.0), 3)
+                    waste = (req.chip_ms.get("spec_waste", 0.0)
+                             + req.chip_ms.get("early_exit", 0.0))
+                    if waste:
+                        dec_kw["chip_waste_ms"] = round(waste, 3)
+                trace.add_span("decode", ft, fin, **dec_kw)
             if fin < now:
                 # engine finished before the response flushed: the tail is
                 # stream/serialization time on the API side
@@ -1818,11 +1916,17 @@ class OpenAIServer:
             "completion_tokens": completion_tokens,
             "total_tokens": prompt_tokens + completion_tokens,
         }
-        return web.json_response({
+        chip = _chip_ms_total(reqs)
+        if chip:
+            usage["chip_ms"] = {ph: round(v, 3) for ph, v in chip.items()}
+        resp = web.json_response({
             "id": rid, "object": "chat.completion" if chat else "text_completion",
             "created": created, "model": self._resp_model(reqs),
             "choices": choices, "usage": usage,
         })
+        if chip:
+            resp.headers[CHIP_MS_HEADER] = str(round(sum(chip.values()), 3))
+        return resp
 
     async def _stream_response(self, request, reqs, rid, created, chat, stops,
                                nlp: int = 0, include_usage: bool = False,
@@ -1981,8 +2085,15 @@ class OpenAIServer:
             await asyncio.gather(*(pump(i, r) for i, r in enumerate(reqs)))
             if include_usage:
                 prompt_tokens = sum(len(p) for p in (prompts or []))
+                usage = {"prompt_tokens": prompt_tokens,
+                         "completion_tokens": completion_tokens,
+                         "total_tokens": prompt_tokens + completion_tokens}
+                chip = _chip_ms_total(reqs)
+                if chip:
+                    usage["chip_ms"] = {
+                        ph: round(v, 3) for ph, v in chip.items()}
                 await resp.write(
-                    f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': resp_model, 'choices': [], 'usage': {'prompt_tokens': prompt_tokens, 'completion_tokens': completion_tokens, 'total_tokens': prompt_tokens + completion_tokens}})}\n\n".encode())
+                    f"data: {json.dumps({'id': rid, 'object': obj, 'created': created, 'model': resp_model, 'choices': [], 'usage': usage})}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel generation so slots/pages free up now

@@ -11,14 +11,18 @@ when any metric moved more than the threshold in the BAD direction:
 - latency-ish metrics (``*_ms``, ``*ttft*``, ``*latency*``, adapter
   ``*evictions*``/``*load_seconds*`` churn, mid-stream failover
   ``resume_gap_ms_*`` stalls and ``*visible_drops``, KV footprint
-  ``kv_bytes_per_token`` and host-tier ``*cache_misses``): higher is
-  worse;
+  ``kv_bytes_per_token`` and host-tier ``*cache_misses``, goodput
+  ``wasted_chip_fraction``): higher is worse;
 - throughput-ish metrics (``*tokens_per_sec*`` — including the
   multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
   cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s —
   and ``resumed_streams``, proof the failover drill actually spliced;
-  session-density ``*max_streams_ratio``): lower is worse;
+  session-density ``*max_streams_ratio``, goodput
+  ``goodput_tokens_per_chip_s`` and ``mfu``): lower is worse;
 - anything else is reported but never gates (no direction known).
+
+Runs whose ``parsed`` is null (crashed sessions) are skipped but named
+in the summary line so they never vanish silently.
 
 With fewer than two comparable runs it prints a notice and exits 0 —
 a fresh repo must not fail CI. Wired into scripts/ci.sh as an ADVISORY
@@ -40,13 +44,14 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|cold_start|dropped_streams|spike_first_token"
                            r"|dispatches_per_token|host_share|resume_gap"
                            r"|visible_drops|gave_up|kv_bytes_per_token"
-                           r"|cache_misses)")
+                           r"|cache_misses|wasted_chip_fraction)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"
                             r"|shed_noisy_fraction|min_tenant_completed"
                             r"|accept_ratio|spec_drafted_tokens"
-                            r"|max_streams_ratio)")
+                            r"|max_streams_ratio"
+                            r"|goodput_tokens_per_chip_s|^mfu$)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:
@@ -68,19 +73,25 @@ def _direction(name: str) -> int:
     return 0
 
 
-def load_runs(root: pathlib.Path) -> list[tuple[str, dict]]:
-    """(filename, parsed) for every run with a usable parsed dict,
-    ordered oldest -> newest by run number."""
-    runs = []
+def load_runs(root: pathlib.Path) -> tuple[list[tuple[str, dict]], list[str]]:
+    """(runs, skipped): runs is (filename, parsed) for every run with a
+    usable parsed dict, ordered oldest -> newest by run number; skipped
+    names the runs that exist on disk but had no usable payload
+    (``parsed: null`` crashes, unreadable files) so the summary can say
+    so instead of letting them vanish silently."""
+    runs, skipped = [], []
     for path in sorted(root.glob("BENCH_r*.json")):
         try:
             doc = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError):
+            skipped.append(path.name)
             continue
         parsed = doc.get("parsed")
         if isinstance(parsed, dict) and _numeric_items(parsed):
             runs.append((path.name, parsed))
-    return runs
+        else:
+            skipped.append(path.name)
+    return runs, skipped
 
 
 def compare(prev: dict, cur: dict, threshold: float) -> tuple[list, list]:
@@ -123,15 +134,18 @@ def main(argv: list[str]) -> int:
             print(__doc__.strip().splitlines()[0], file=sys.stderr)
             return 2
 
-    runs = load_runs(root)
+    runs, skipped = load_runs(root)
+    skipped_note = (f"; skipped {len(skipped)} unusable "
+                    f"(parsed: null): {', '.join(skipped)}"
+                    if skipped else "")
     if len(runs) < 2:
         print(f"bench-compare: {len(runs)} usable bench run(s) under "
-              f"{root} — need 2 to compare; nothing to do")
+              f"{root} — need 2 to compare; nothing to do{skipped_note}")
         return 0
 
     (prev_name, prev), (cur_name, cur) = runs[-2], runs[-1]
     print(f"bench-compare: {prev_name} -> {cur_name} "
-          f"(threshold {threshold:.0%})")
+          f"(threshold {threshold:.0%}){skipped_note}")
     rows, regressions = compare(prev, cur, threshold)
     width = max(len(r[0]) for r in rows) if rows else 10
     for name, p, c, delta, verdict in rows:

@@ -213,6 +213,31 @@ sys.exit(0 if doc.get("session_parity_ok") is True
     fails=$((fails + 1))
   fi
 
+  note "goodput ledger smoke (chip-time conservation within 5%)"
+  # the engine-phase ledger must conserve wall time: attributed (prefill
+  # + decode) + wasted (spec tails, early exits) + idle device gaps
+  # reproduce the independently measured engine-loop busy wall within 5%
+  # — a leak here means some dispatch path stopped being metered
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+attr = doc.get("chip_ms_attributed")
+wasted = doc.get("chip_ms_wasted")
+idle = doc.get("chip_ms_idle")
+wall = doc.get("engine_busy_wall_ms")
+if None in (attr, wasted, idle, wall) or wall <= 0:
+    sys.exit(1)
+total = attr + wasted + idle
+sys.exit(0 if abs(total - wall) / wall <= 0.05
+         and doc.get("goodput_tokens_per_chip_s") is not None
+         and (doc.get("mfu") or 0) > 0 else 1)'; then
+    echo "ci: goodput ledger smoke OK (conservation within 5%)"
+  else
+    echo "ci: goodput ledger smoke FAILED (attributed + wasted + idle"
+    echo "    drifts > 5% from the engine-loop busy wall, or no MFU)"
+    fails=$((fails + 1))
+  fi
+
   note "metrics lint (Prometheus exposition format on scraped /metrics)"
   if [ -s "$metrics_dump/api_metrics.txt" ] \
       && [ -s "$metrics_dump/gateway_metrics.txt" ] \
