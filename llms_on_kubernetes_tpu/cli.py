@@ -214,7 +214,7 @@ def main(argv: list[str] | None = None) -> int:
         # None = let Router fall back to the LLMK_STREAM_RESUME /
         # LLMK_RESUME_ATTEMPTS / LLMK_HEDGE_MS env knobs
         stream_resume = resume_attempts = hedge_ms = None
-        qos = None
+        qos = roles = handoff_retries = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -232,6 +232,11 @@ def main(argv: list[str] | None = None) -> int:
                 hedge_ms = float(cfg["hedge_ms"])
             if "qos" in cfg:
                 qos = cfg["qos"]  # per-tenant QoS block, passed verbatim
+            if "roles" in cfg:
+                # disaggregated serving: replica URL -> prefill|decode|both
+                roles = cfg["roles"]
+            if "handoff_retries" in cfg:
+                handoff_retries = int(cfg["handoff_retries"])
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -253,7 +258,7 @@ def main(argv: list[str] | None = None) -> int:
                    adapters=adapters or None,
                    stream_resume=stream_resume,
                    resume_attempts=resume_attempts, hedge_ms=hedge_ms,
-                   qos=qos)
+                   qos=qos, roles=roles, handoff_retries=handoff_retries)
         return 0
 
     # serve

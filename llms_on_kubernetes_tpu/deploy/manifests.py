@@ -49,6 +49,15 @@ MODEL_GRACE_S = 330    # worst-case long generation + preStop sleep
 ROUTER_GRACE_S = 30    # router only relays; in-flight proxying is short
 
 
+def _res_name(m: ModelSpec) -> str:
+    """Kubernetes resource base name for one models[] entry. A
+    disaggregated pair shares its modelName, so role-specific entries get
+    a role suffix — separate Deployments/Services per pool, while the
+    router still serves them as one model."""
+    base = f"model-{m.model_name}"
+    return base if m.role == "both" else f"{base}-{m.role}"
+
+
 def _lifecycle() -> dict[str, Any]:
     return {"lifecycle": {"preStop": {"exec": {
         "command": ["sh", "-c", f"sleep {PRESTOP_SLEEP_S}"]}}}}
@@ -157,6 +166,10 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
     if m.kv_host_cache_gb > 0:
         c["env"].append({"name": "LLMK_KV_HOST_CACHE_GB",
                          "value": str(m.kv_host_cache_gb)})
+    if m.role != "both":
+        # disaggregated serving role; the engine validates the combo
+        # (prefill needs the host tier, multihost rejects roles)
+        c["env"].append({"name": "LLMK_ROLE", "value": m.role})
     if m.ledger is not None:
         # goodput ledger on/off; engine default is on, so only an
         # explicit spec value renders env
@@ -208,7 +221,7 @@ def _volumes(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
         return [{
             "name": "hf-cache",
             "persistentVolumeClaim": {
-                "claimName": f"model-{m.model_name}-cache",
+                "claimName": f"{_res_name(m)}-cache",
                 **({"readOnly": True} if m.pvc_shared else {}),
             },
         }]
@@ -238,16 +251,17 @@ def render_model_single_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
     }
     if m.tpu is not None:
         pod_spec["nodeSelector"] = _tpu_node_selector(m)
+    name = _res_name(m)
     dep = {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": _meta(f"model-{m.model_name}", spec, "model-server"),
+        "metadata": _meta(name, spec, "model-server"),
         "spec": {
             "replicas": m.replicas,
-            "selector": {"matchLabels": {"app": f"model-{m.model_name}"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
                 "metadata": {
-                    "labels": _labels(f"model-{m.model_name}", "model-server"),
+                    "labels": _labels(name, "model-server"),
                     "annotations": _scrape_annotations(),
                 },
                 "spec": pod_spec,
@@ -319,7 +333,7 @@ def render_model_multi_host(m: ModelSpec, spec: DeploySpec) -> list[Manifest]:
 
 
 def render_model_service(m: ModelSpec, spec: DeploySpec) -> Manifest:
-    name = f"model-{m.model_name}"
+    name = _res_name(m)
     selector: Manifest = {"app": name}
     if m.tpu is not None and m.tpu.multi_host:
         # only the coordinator pod serves HTTP
@@ -358,7 +372,7 @@ def render_model_replica_service(m: ModelSpec,
     """
     if _peak_replicas(m) <= 1 or (m.tpu is not None and m.tpu.multi_host):
         return None
-    name = f"model-{m.model_name}"
+    name = _res_name(m)
     return {
         "apiVersion": "v1",
         "kind": "Service",
@@ -402,11 +416,34 @@ def render_model_autoscaler(m: ModelSpec,
     arrival rate (``llm_router_requests_total``) so a fully scaled-to-
     zero model — whose engines emit no queue depth at all — still wakes
     on incoming demand.
+
+    Disaggregated roles scale on the signal their pool actually bounds:
+    a ``prefill`` pool on its own queue depth only (tickets waiting for
+    prompt ingestion; gateway TTFT conflates both pools), a ``decode``
+    pool on the TTFT-attainment Object metric only (the decode fleet is
+    what keeps streams flowing; its queue is by construction shallow).
     """
     a = m.autoscaling
     if a is None:
         return None
-    name = f"model-{m.model_name}"
+    name = _res_name(m)
+    queue_metric = {"type": "Pods", "pods": {
+        "metric": {"name": "llm_queue_depth"},
+        "target": {"type": "AverageValue",
+                   "averageValue": str(a.queue_depth_target)}}}
+    ttft_metric = {"type": "Object", "object": {
+        "metric": {"name": "llm_slo_ttft_miss_ratio"},
+        "describedObject": {"apiVersion": "v1",
+                            "kind": "Service",
+                            "name": "api-gateway"},
+        "target": {"type": "Value",
+                   "value": f"{_ttft_miss_milli(a)}m"}}}
+    if m.role == "prefill":
+        metrics = [queue_metric]
+    elif m.role == "decode":
+        metrics = [ttft_metric]
+    else:
+        metrics = [queue_metric, ttft_metric]
     if a.min_replicas >= 1:
         return {
             "apiVersion": "autoscaling/v2",
@@ -417,20 +454,7 @@ def render_model_autoscaler(m: ModelSpec,
                                    "kind": "Deployment", "name": name},
                 "minReplicas": a.min_replicas,
                 "maxReplicas": a.max_replicas,
-                "metrics": [
-                    {"type": "Pods", "pods": {
-                        "metric": {"name": "llm_queue_depth"},
-                        "target": {"type": "AverageValue",
-                                   "averageValue":
-                                       str(a.queue_depth_target)}}},
-                    {"type": "Object", "object": {
-                        "metric": {"name": "llm_slo_ttft_miss_ratio"},
-                        "describedObject": {"apiVersion": "v1",
-                                            "kind": "Service",
-                                            "name": "api-gateway"},
-                        "target": {"type": "Value",
-                                   "value": f"{_ttft_miss_milli(a)}m"}}},
-                ],
+                "metrics": metrics,
                 "behavior": {"scaleDown": {
                     "stabilizationWindowSeconds": SCALE_DOWN_STABILIZATION_S,
                     "policies": [{"type": "Pods", "value": 1,
@@ -438,10 +462,31 @@ def render_model_autoscaler(m: ModelSpec,
                 }},
             },
         }
+    role_sel = "" if m.role == "both" else f',role="{m.role}"'
     queue_query = (
-        f'sum(llm_queue_depth{{model="{m.model_name}"}}) + '
+        f'sum(llm_queue_depth{{model="{m.model_name}"{role_sel}}}) + '
         f'sum(rate(llm_router_requests_total{{model="{m.model_name}"}}[1m]))'
     )
+    queue_trigger = {"type": "prometheus", "metadata": {
+        "serverAddress": spec.prometheus_url,
+        "metricName": "llm_queue_depth",
+        "query": queue_query,
+        "threshold": str(a.queue_depth_target)}}
+    # percent integer, not a float ratio: the Helm template
+    # must render the identical string without float math
+    ttft_trigger = {"type": "prometheus", "metadata": {
+        "serverAddress": spec.prometheus_url,
+        "metricName": "llm_slo_ttft_miss_ratio",
+        "query": "100 * max(llm_slo_ttft_miss_ratio)",
+        "threshold":
+            str(int(round((1.0 - a.ttft_ok_ratio_floor)
+                          * 100)))}}
+    if m.role == "prefill":
+        triggers = [queue_trigger]
+    elif m.role == "decode":
+        triggers = [ttft_trigger]
+    else:
+        triggers = [queue_trigger, ttft_trigger]
     return {
         "apiVersion": "keda.sh/v1alpha1",
         "kind": "ScaledObject",
@@ -451,22 +496,7 @@ def render_model_autoscaler(m: ModelSpec,
             "minReplicaCount": a.min_replicas,
             "maxReplicaCount": a.max_replicas,
             "cooldownPeriod": SCALE_DOWN_STABILIZATION_S,
-            "triggers": [
-                {"type": "prometheus", "metadata": {
-                    "serverAddress": spec.prometheus_url,
-                    "metricName": "llm_queue_depth",
-                    "query": queue_query,
-                    "threshold": str(a.queue_depth_target)}},
-                # percent integer, not a float ratio: the Helm template
-                # must render the identical string without float math
-                {"type": "prometheus", "metadata": {
-                    "serverAddress": spec.prometheus_url,
-                    "metricName": "llm_slo_ttft_miss_ratio",
-                    "query": "100 * max(llm_slo_ttft_miss_ratio)",
-                    "threshold":
-                        str(int(round((1.0 - a.ttft_ok_ratio_floor)
-                                      * 100)))}},
-            ],
+            "triggers": triggers,
         },
     }
 
@@ -478,7 +508,7 @@ def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
     pvc: Manifest = {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
-        "metadata": _meta(f"model-{m.model_name}-cache", spec, "weight-cache"),
+        "metadata": _meta(f"{_res_name(m)}-cache", spec, "weight-cache"),
         "spec": {
             "accessModes": [access],
             "resources": {"requests": {"storage": m.pvc_size}},
@@ -494,25 +524,35 @@ def render_model_pvc(m: ModelSpec, spec: DeploySpec) -> Optional[Manifest]:
 # ---------------------------------------------------------------------------
 
 def _backend_urls(m: ModelSpec, spec: DeploySpec) -> list[str]:
-    """Replica-set URLs for one model (always a list, even for one)."""
+    """Replica-set URLs for one models[] entry (always a list)."""
     if _peak_replicas(m) > 1 and not (m.tpu is not None and m.tpu.multi_host):
         # replicated single-host model: route via the headless -replicas
         # Service, whose DNS answers with the READY pod IPs (Deployment
         # pods have no stable per-pod names to enumerate). Explicit
         # multi-URL replica lists remain a config-level capability for
         # out-of-cluster replicas.
-        return [f"http://model-{m.model_name}-replicas."
+        return [f"http://{_res_name(m)}-replicas."
                 f"{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"]
-    return [f"http://model-{m.model_name}."
+    return [f"http://{_res_name(m)}."
             f"{spec.namespace}.svc.cluster.local:{ENGINE_PORT}"]
 
 
 def router_config(spec: DeploySpec) -> dict[str, Any]:
     """The router's model→replica-set table (consumed by server/router.py
     and by the native C++ router alike)."""
+    # a disaggregated pair shares its modelName: both entries' URLs merge
+    # into one replica set, and the roles map tells the router which URL
+    # serves which hop of the two-hop flow
+    backends: dict[str, list[str]] = {}
+    roles: dict[str, str] = {}
+    for m in spec.models:
+        urls = _backend_urls(m, spec)
+        backends.setdefault(m.model_name, []).extend(urls)
+        if m.role != "both":
+            for u in urls:
+                roles[u] = m.role
     cfg: dict[str, Any] = {
-        "backends": {m.model_name: _backend_urls(m, spec)
-                     for m in spec.models},
+        "backends": backends,
         "default_model": spec.resolved_default,
         "strict": spec.strict_routing,
         "probe_interval_s": spec.probe_interval_s,
@@ -522,6 +562,9 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         "resume_attempts": spec.resume_attempts,
         "hedge_ms": spec.hedge_ms,
     }
+    if roles:
+        cfg["roles"] = roles
+        cfg["handoff_retries"] = spec.handoff_retries
     adapters = {m.model_name: [a.name for a in m.adapters]
                 for m in spec.models if m.adapters}
     if adapters:

@@ -244,6 +244,39 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKHandoffDegraded",
+                        # disaggregated prefill/decode only: handoffs
+                        # that miss the fast path — decode re-prefilling
+                        # from scratch or the router falling back to a
+                        # colocated replica — still serve correctly, but
+                        # burn the chip-time disaggregation was meant to
+                        # save. A sustained degraded fraction means the
+                        # host tier is evicting pages before adoption or
+                        # the decode pool is unreachable.
+                        "expr": (
+                            "sum(rate(llm_handoff_total{outcome=~"
+                            '"reprefill|fallback_colocated"}[10m])) > '
+                            "0.2 * sum(rate(llm_handoff_total[10m]))"
+                        ),
+                        "for": "15m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "KV handoffs degrading to "
+                                       "re-prefill / colocated fallback",
+                            "description": (
+                                "More than 20% of prefill->decode KV "
+                                "handoffs have missed the fast path for "
+                                "15m (llm_handoff_total outcomes "
+                                "reprefill + fallback_colocated). "
+                                "Streams still complete, but decode "
+                                "replicas are repeating prefill work. "
+                                "Check decode-pool health/breakers, "
+                                "kvHostCacheGB pressure on the prefill "
+                                "pool, and LLMK_HANDOFF_RETRIES."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKStreamLoss",
                         # any truncation at all is a client that watched
                         # its generation die — the journal/resume path
@@ -505,6 +538,15 @@ def grafana_dashboard() -> dict[str, Any]:
                ["sum by (tenant) "
                 "(rate(llm_tenant_chip_seconds_total[5m]))",
                 "rate(llm_auto_profile_total[1h])"], 12, 96),
+        _panel(27, "KV handoff: outcomes (disaggregated)",
+               ["sum by (outcome) (rate(llm_handoff_total[5m]))"],
+               0, 104),
+        _panel(28, "KV handoff: latency p50 / p95",
+               ["histogram_quantile(0.50, "
+                "rate(llm_handoff_seconds_bucket[5m]))",
+                "histogram_quantile(0.95, "
+                "rate(llm_handoff_seconds_bucket[5m]))"], 12, 104,
+               unit="s"),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

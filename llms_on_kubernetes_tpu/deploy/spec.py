@@ -321,6 +321,15 @@ class ModelSpec:
     # finished/preempted sessions park their KV pages in host memory and
     # a returning session re-uploads instead of re-prefilling. 0 = off.
     kv_host_cache_gb: float = 0.0
+    # disaggregated serving role (LLMK_ROLE): "both" (default) keeps the
+    # colocated prefill+decode replica; "prefill" replicas run chunked
+    # prompt ingestion only and hand finished KV pages off via the host
+    # tier (so kvHostCacheGB > 0 is required); "decode" replicas adopt
+    # handed-off pages and run the fused K-step loop. Two models[]
+    # entries MAY share one modelName iff their roles are exactly
+    # {prefill, decode} — they render as separate Deployments that the
+    # router composes into one two-hop serving path.
+    role: str = "both"
     # goodput ledger (LLMK_LEDGER): per-request chip-time attribution +
     # MFU/MBU accounting. None = engine default (on); False disables the
     # per-dispatch bookkeeping entirely.
@@ -392,6 +401,26 @@ class ModelSpec:
             raise SpecError(
                 f"model {self.model_name}: kvHostCacheGB must be >= 0, "
                 f"got {self.kv_host_cache_gb}"
+            )
+        if self.role not in ("prefill", "decode", "both"):
+            raise SpecError(
+                f"model {self.model_name}: role must be 'prefill', "
+                f"'decode', or 'both', got {self.role!r}"
+            )
+        if self.role != "both" and self.tpu is not None \
+                and self.tpu.multi_host:
+            raise SpecError(
+                f"model {self.model_name}: role: {self.role} is "
+                f"unsupported on a multi-host slice (the KV handoff "
+                f"rides the coordinator-local host tier, which multihost "
+                f"rejects) — drop role: or use a single-host topology"
+            )
+        if self.role == "prefill" and self.kv_host_cache_gb <= 0:
+            raise SpecError(
+                f"model {self.model_name}: role: prefill needs "
+                f"kvHostCacheGB > 0 — the handoff ticket points decode "
+                f"replicas at pages spilled into the host tier, so a "
+                f"prefill replica without one has nowhere to put them"
             )
         if (self.kv_host_cache_gb > 0 and self.tpu is not None
                 and self.tpu.multi_host):
@@ -474,6 +503,10 @@ class DeploySpec:
     stream_resume: bool = True
     resume_attempts: int = 2
     hedge_ms: float = 0.0
+    # disaggregated serving: attempts across decode replicas to place a
+    # prefill handoff ticket before falling back to a colocated replica
+    # (LLMK_HANDOFF_RETRIES in both routers)
+    handoff_retries: int = 2
     # per-tenant QoS at the gateway (ISSUE 10); None = QoS disabled
     qos: Optional[QoSSpec] = None
     webui_enabled: bool = True
@@ -487,9 +520,20 @@ class DeploySpec:
         if not self.models:
             raise SpecError("at least one model is required")
         names = [m.model_name for m in self.models]
-        dupes = {n for n in names if names.count(n) > 1}
-        if dupes:
-            raise SpecError(f"duplicate modelName(s): {sorted(dupes)}")
+        # one entry per name, with one exception: a disaggregated pair —
+        # exactly two entries whose roles are {prefill, decode} — shares
+        # the modelName so the router serves them as one model
+        by_name: dict[str, list[str]] = {}
+        for m in self.models:
+            by_name.setdefault(m.model_name, []).append(m.role)
+        for name, roles in by_name.items():
+            if len(roles) == 1:
+                continue
+            if sorted(roles) != ["decode", "prefill"]:
+                raise SpecError(
+                    f"duplicate modelName(s): ['{name}'] (two entries may "
+                    f"share a modelName only as a disaggregated pair with "
+                    f"roles prefill + decode; got roles {sorted(roles)})")
         for m in self.models:
             m.validate()
         if self.default_model is not None and self.default_model not in names:
@@ -504,6 +548,10 @@ class DeploySpec:
         if self.hedge_ms < 0:
             raise SpecError(
                 f"router.hedgeMs must be >= 0, got {self.hedge_ms}")
+        if self.handoff_retries < 0:
+            raise SpecError(
+                f"router.handoffRetries must be >= 0, got "
+                f"{self.handoff_retries}")
         if self.qos is not None:
             self.qos.validate()
 
@@ -652,7 +700,7 @@ def _model_from(d: dict) -> ModelSpec:
         "modelName", "huggingfaceId", "modelPath", "replicas", "pvcSize",
         "pvcShared", "tpu", "sharding", "quantization", "maxModelLen",
         "engineArgs", "resources", "dtype", "decodeSteps",
-        "speculation", "draft", "kvDtype", "kvHostCacheGB",
+        "speculation", "draft", "kvDtype", "kvHostCacheGB", "role",
         "ledger", "anomalyProfile",
         "adapters", "adapterSlots", "adapterRank", "autoscaling",
     }
@@ -690,6 +738,7 @@ def _model_from(d: dict) -> ModelSpec:
         draft=d.get("draft"),
         kv_dtype=d.get("kvDtype"),
         kv_host_cache_gb=float(d.get("kvHostCacheGB", 0) or 0),
+        role=str(d.get("role", "both") or "both"),
         ledger=(bool(d["ledger"]) if "ledger" in d else None),
         anomaly_profile=_anomaly_from(d.get("anomalyProfile"),
                                       d.get("modelName", "")),
@@ -743,6 +792,8 @@ def load_spec(source: "str | dict") -> DeploySpec:
         resume_attempts=int(
             (data.get("router") or {}).get("resumeAttempts", 2)),
         hedge_ms=float((data.get("router") or {}).get("hedgeMs", 0.0)),
+        handoff_retries=int(
+            (data.get("router") or {}).get("handoffRetries", 2)),
         qos=_qos_from(data.get("qos")),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),

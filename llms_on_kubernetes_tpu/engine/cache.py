@@ -712,21 +712,29 @@ class HostKVCache:
 
     Keyed by tenant so one tenant's sessions can never be served another
     tenant's KV even on a (cryptographically impossible) digest collision,
-    and so per-tenant flushes stay possible. Plain LRU over bytes;
-    single-threaded like the allocator (engine-thread only)."""
+    and so per-tenant flushes stay possible. Plain LRU over bytes. The
+    engine thread owns the hot paths, but the disaggregated KV handoff
+    (openai_api) reads/writes the tier from server threads — a prefill
+    replica exports pages to a pulling decode replica, which ingests them
+    locally before submitting — so every entry-map touch takes ``_lock``.
+    """
 
     def __init__(self, capacity_bytes: int, page_size: int):
+        import threading
+
         self.capacity_bytes = int(capacity_bytes)
         self.page_size = page_size
         self._entries: "dict[tuple[str, bytes], dict]" = {}
         self._bytes = 0
+        self._lock = threading.RLock()
         self.hits = 0        # pages served to a resuming session
         self.misses = 0      # lookups where the chain had no next page
         self.evictions = 0   # pages dropped by LRU pressure
         self.spilled_pages = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     @property
     def used_bytes(self) -> int:
@@ -737,23 +745,24 @@ class HostKVCache:
         return sum(int(a.nbytes) for a in payload.values() if a is not None)
 
     def put(self, tenant: str, digest: bytes, payload: dict) -> None:
-        key = (tenant, digest)
-        old = self._entries.pop(key, None)
-        if old is not None:  # same prefix re-spilled: refresh recency
-            self._bytes -= self._nbytes(old)
-        nb = self._nbytes(payload)
-        if nb > self.capacity_bytes:
-            return  # one page larger than the whole tier: unconfigurable
-        self._entries[key] = payload
-        self._bytes += nb
-        self.spilled_pages += 1
-        while self._bytes > self.capacity_bytes and self._entries:
-            k, v = next(iter(self._entries.items()))
-            if k == key:  # never evict the page just stored
-                break
-            del self._entries[k]
-            self._bytes -= self._nbytes(v)
-            self.evictions += 1
+        with self._lock:
+            key = (tenant, digest)
+            old = self._entries.pop(key, None)
+            if old is not None:  # same prefix re-spilled: refresh recency
+                self._bytes -= self._nbytes(old)
+            nb = self._nbytes(payload)
+            if nb > self.capacity_bytes:
+                return  # one page larger than the whole tier: unconfigurable
+            self._entries[key] = payload
+            self._bytes += nb
+            self.spilled_pages += 1
+            while self._bytes > self.capacity_bytes and self._entries:
+                k, v = next(iter(self._entries.items()))
+                if k == key:  # never evict the page just stored
+                    break
+                del self._entries[k]
+                self._bytes -= self._nbytes(v)
+                self.evictions += 1
 
     def match_chain(self, tenant: str, digests: "list[bytes]",
                     start: int) -> "tuple[list[bytes], list[dict]]":
@@ -764,13 +773,24 @@ class HostKVCache:
         Call :meth:`commit` once when the admission actually lands."""
         matched: "list[bytes]" = []
         out: "list[dict]" = []
-        for d in digests[start:]:
-            e = self._entries.get((tenant, d))
-            if e is None:
-                break
-            matched.append(d)
-            out.append(e)
+        with self._lock:
+            for d in digests[start:]:
+                e = self._entries.get((tenant, d))
+                if e is None:
+                    break
+                matched.append(d)
+                out.append(e)
         return matched, out
+
+    def export(self, tenant: str, digests: "list[bytes]") \
+            -> "list[Optional[dict]]":
+        """Payloads for a decode replica pulling a handoff, one per
+        digest (None where the page is gone — evicted or never spilled).
+        Pure peek like :meth:`match_chain`: the prefill replica's stats
+        describe ITS sessions, and the decode replica counts the
+        adoption outcome on its side."""
+        with self._lock:
+            return [self._entries.get((tenant, d)) for d in digests]
 
     def commit(self, tenant: str, digests: "list[bytes]") -> None:
         """Record a landed admission's outcome: one hit per page served
@@ -779,14 +799,49 @@ class HostKVCache:
         the engine uploads the payload objects it captured at probe time,
         so the reuse itself is unaffected."""
         served = 0
-        for d in digests:
-            key = (tenant, d)
-            e = self._entries.pop(key, None)
-            if e is None:
-                continue
-            self._entries[key] = e  # move-to-end: LRU recency
-            served += 1
-        if served:
-            self.hits += served
-        else:
-            self.misses += 1
+        with self._lock:
+            for d in digests:
+                key = (tenant, d)
+                e = self._entries.pop(key, None)
+                if e is None:
+                    continue
+                self._entries[key] = e  # move-to-end: LRU recency
+                served += 1
+            if served:
+                self.hits += served
+            else:
+                self.misses += 1
+
+
+def payload_shape_ok(payload, cache_config) -> bool:
+    """True iff a host-tier payload has exactly the per-page shapes and
+    dtypes this engine's pools expect.
+
+    The engine's own spills are well-formed by construction; this guards
+    the HANDOFF ingest path, where payloads crossed a network from a
+    replica that may run a different model/topology (or were corrupted in
+    flight). A payload that fails is treated as a missing page — the
+    adoption chain stops and the remainder re-prefills (degraded, counted)
+    instead of crashing in ``np.stack`` or splicing wrong-shaped bytes
+    into the pools."""
+    cc = cache_config
+    if not isinstance(payload, dict):
+        return False
+    k, v = payload.get("k"), payload.get("v")
+    ks, vs = payload.get("ks"), payload.get("vs")
+    want = (cc.num_kv_heads, cc.num_layers, cc.page_size, cc.head_dim)
+    data_dtype = np.dtype(np.int8 if cc.kv_dtype == "int8"
+                          else jnp.dtype(cc.dtype).name)
+    for side in (k, v):
+        if (not isinstance(side, np.ndarray) or side.shape != want
+                or side.dtype != data_dtype):
+            return False
+    if cc.kv_dtype == "int8":
+        want_s = (cc.num_kv_heads, cc.num_layers, cc.page_size)
+        for scale in (ks, vs):
+            if (not isinstance(scale, np.ndarray) or scale.shape != want_s
+                    or scale.dtype != np.dtype(np.float32)):
+                return False
+    elif ks is not None or vs is not None:
+        return False
+    return True

@@ -181,6 +181,16 @@ class EngineConfig:
     # prefix_caching (the digest chain IS the addressing scheme).
     # None => env LLMK_KV_HOST_CACHE_GB; <= 0 disables.
     kv_host_cache_gb: Optional[float] = None
+    # disaggregated serving role: "both" (default) serves prefill+decode
+    # colocated; "prefill" replicas answer generation requests with a KV
+    # handoff ticket (prompt ingested, first token sampled, pages spilled
+    # to the host tier keyed by chained digest) instead of streaming;
+    # "decode" replicas adopt handed-off pages and run the fused K-step
+    # loop. The engine itself stays fully capable under every role — the
+    # role gates SERVER behavior (openai_api) and deployment shape, so a
+    # decode replica can always fall back to colocated serving (full
+    # re-prefill) when a handoff goes missing. None => env LLMK_ROLE.
+    role: Optional[str] = None
     # grammar-constrained decoding device-table capacities (static jit
     # shapes). A grammar whose tables exceed states/classes caps is
     # rejected at submit (400); distinct RESIDENT grammars beyond
@@ -325,6 +335,21 @@ class EngineConfig:
                 os.environ.get("LLMK_KV_HOST_CACHE_GB", "0"))
         if self.kv_host_cache_gb < 0:
             self.kv_host_cache_gb = 0.0
+        if self.role is None:
+            self.role = os.environ.get("LLMK_ROLE", "both").strip() or "both"
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'both', got "
+                f"{self.role!r}")
+        if self.role == "prefill" and self.kv_host_cache_gb <= 0:
+            raise ValueError(
+                "role='prefill' requires kv_host_cache_gb > 0 — handoff "
+                "tickets point decode replicas at pages spilled into the "
+                "host tier")
+        if self.multihost and self.role != "both":
+            raise ValueError(
+                "role is unsupported under multihost (the KV handoff "
+                "rides the coordinator-local host tier)")
         _off = ("0", "false", "off", "no")
         if self.ledger is None:
             self.ledger = (os.environ.get("LLMK_LEDGER", "1")
@@ -468,6 +493,11 @@ class Request:
     # lower-priority victims
     tenant: str = ""
     priority: str = "normal"
+    # disaggregated serving: True for a prefill-only handoff request (the
+    # server answers with a ticket, not a stream) — its spilled pages are
+    # drained to the host tier eagerly at finish even on a both-role
+    # replica, so the decode replica's pull never races a lazy drain
+    handoff: bool = False
     # goodput ledger: device milliseconds attributed to this request per
     # phase (prefill/decode/spec_waste/early_exit) — written by the engine
     # thread as dispatches harvest, surfaced in the OpenAI usage block,
@@ -1722,6 +1752,7 @@ class Engine:
         adapter: Optional[str] = None,
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
+        handoff: bool = False,
     ) -> Request:
         if self.wedged:
             raise EngineStallError(
@@ -1851,7 +1882,7 @@ class Engine:
             mrope_delta=mrope_delta,
             cache_salt=self._cache_salt_for(images),
             deadline=deadline, adapter=adapter,
-            tenant=tenant, priority=priority,
+            tenant=tenant, priority=priority, handoff=handoff,
             # a non-empty output at submit makes admission take the
             # resumed re-prefill path (prompt + output), continuing the
             # stream exactly where the prefix left off; logprob data for
@@ -2241,6 +2272,15 @@ class Engine:
                     prefill_tokens[:cap_pages * page], salt=req.cache_salt)
                 matched, payloads = self.host_kv.match_chain(
                     req.tenant, digests, start)
+                # handoff-ingested payloads crossed a network: a corrupt
+                # or truncated page is treated as missing (chain stops,
+                # remainder re-prefills) rather than crashing the upload
+                from llms_on_kubernetes_tpu.engine.cache import \
+                    payload_shape_ok
+                for i, pl in enumerate(payloads):
+                    if not payload_shape_ok(pl, self.cache_config):
+                        matched, payloads = matched[:i], payloads[:i]
+                        break
                 combined = hit + len(matched) * page
                 self._host_adopt[slot] = (start, matched, payloads)
         if combined and req.images is not None:
@@ -2336,6 +2376,44 @@ class Engine:
             k, v, ks, vs)
         self.kv_upload_obs.append(time.perf_counter() - t0)
         self.kv_uploaded_tokens += m * self.allocator.page_size
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode handoff (openai_api drives these from
+    # server threads; HostKVCache is internally locked for exactly this)
+    # ------------------------------------------------------------------
+
+    def handoff_digests(self, tokens, salt: bytes = b"") -> "list[bytes]":
+        """Chained digests of the FULL pages of ``tokens`` — the handoff
+        ticket's addressing of what :meth:`_finish` spilled. Pure hashing
+        (no allocator state), safe from any thread."""
+        page = self.allocator.page_size
+        n_full = len(tokens) // page
+        if n_full <= 0:
+            return []
+        return self.allocator._digests(tokens[:n_full * page], salt=salt)
+
+    def host_kv_export(self, tenant: str, digests: "list[bytes]") \
+            -> "list[Optional[dict]]":
+        """Host-tier payloads for a pulling decode replica (None per
+        missing page). Empty when the tier is off."""
+        if self.host_kv is None:
+            return [None] * len(digests)
+        return self.host_kv.export(tenant, digests)
+
+    def host_kv_ingest(self, tenant: str, digest: bytes,
+                       payload: dict) -> bool:
+        """Land one pulled handoff page in the LOCAL host tier so the
+        next admission's ``_adopt_cached_prefix`` chain walk finds it.
+        Shape/dtype-validated against this engine's pools; a payload that
+        does not match is refused (False) and the admission re-prefills
+        that page instead — degraded, never wrong bytes."""
+        from llms_on_kubernetes_tpu.engine.cache import payload_shape_ok
+
+        if self.host_kv is None or not payload_shape_ok(
+                payload, self.cache_config):
+            return False
+        self.host_kv.put(tenant, digest, payload)
+        return True
 
     def _mm_grids(self, images) -> list[tuple[int, int]]:
         """Per-BLOCK merged grids (rows, cols) in prompt-run order: one
@@ -2711,6 +2789,13 @@ class Engine:
             # gather would never complete)
             if reason != "stalled" and not self.wedged:
                 self._spill_slot(req)
+                # a prefill-role replica's whole product is the spilled
+                # pages: land them in the host tier BEFORE the finish
+                # event reaches the server, so the handoff ticket never
+                # races the decode replica's pull against a lazy drain
+                if ((self.config.role == "prefill" or req.handoff)
+                        and self.host_kv is not None):
+                    self._drain_spills()
             self.allocator.free(req.slot)
             self.slot_len[req.slot] = 0
             self.slots[req.slot] = None

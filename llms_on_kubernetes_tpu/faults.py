@@ -55,6 +55,20 @@ Known fault names (each documented at its injection site):
   regardless of the real queue-depth/burn-rate signals, so the
   shed-lowest-priority-first ladder is testable without generating real
   overload. See ``server/qos.py`` for the level -> action table.
+- ``kill_prefill_replica[:DELAY]`` — DELAY seconds (default 1.0) after a
+  ``prefill``-role server starts serving, it dies abruptly: readiness
+  goes 503 AND in-flight/new prefill requests are refused (no graceful
+  drain — a prefill pod crash, not a preemption notice). One-shot per
+  process via :func:`claim`, and only prefill-role servers arm it: with
+  a disaggregated fleet sharing one env, exactly ONE prefill replica is
+  killed — the point is proving the router retries surviving prefill
+  replicas or falls back to colocated serving with zero dropped streams.
+- ``drop_handoff[:N]`` — the first N (default 1) KV-handoff ingests on a
+  ``decode``-role server pretend every handed-off page is missing (the
+  pull is skipped entirely), forcing the counted full-re-prefill
+  degraded path. Claimed per-ingest via :func:`claim_n` so N spans the
+  whole process, however many decode replicas share it — the point is
+  proving a dropped handoff is never a client-visible error.
 
 Routers do not read ``LLMK_FAULT``, with one documented exception:
 ``overload_spike`` above, a brownout-ladder hook for the Python router
@@ -129,6 +143,7 @@ def inject_delay(name: str, default_s: float) -> None:
 
 # one-shot faults: first in-process claimer wins (see preempt_replica)
 _claimed: set[str] = set()
+_claim_counts: dict[str, int] = {}
 _claim_lock = threading.Lock()
 
 
@@ -147,7 +162,24 @@ def claim(name: str) -> bool:
         return True
 
 
+def claim_n(name: str, default_n: float = 1.0) -> bool:
+    """True for the first N claims of an active fault ``name``, where N
+    is the fault's arg (``default_n`` if bare). The N-shot sibling of
+    :func:`claim` — ``drop_handoff:3`` drops exactly three handoffs
+    process-wide, however many in-process replicas share the env."""
+    n = get_float(name, default_n)
+    if n is None:
+        return False
+    with _claim_lock:
+        used = _claim_counts.get(name, 0)
+        if used >= int(n):
+            return False
+        _claim_counts[name] = used + 1
+        return True
+
+
 def reset_claims() -> None:
     """Forget one-shot claims (test isolation between cases)."""
     with _claim_lock:
         _claimed.clear()
+        _claim_counts.clear()

@@ -227,13 +227,16 @@ class Registry:
 
 
 def build_info_metrics(registry: Registry, backend: str = "none",
-                       jax_version: Optional[str] = None) -> dict:
+                       jax_version: Optional[str] = None,
+                       role: str = "both") -> dict:
     """Identity + lifetime series every exposition must carry (engine, API
     server, both routers): which build/runtime answered this scrape, when
     the process started, and how long it has been up. ``backend`` is the
     serving backend ("tpu"/"cpu" for engines, "python-router"/
-    "native-router" for gateways); ``jax_version`` defaults to the
-    installed jax distribution WITHOUT importing (and thereby
+    "native-router" for gateways); ``role`` is the disaggregated serving
+    role ("prefill"/"decode"/"both" for engines, "router" for gateways) so
+    the cluster view can tell the pools apart; ``jax_version`` defaults to
+    the installed jax distribution WITHOUT importing (and thereby
     initializing) jax — routers must stay accelerator-free."""
     from llms_on_kubernetes_tpu import __version__
 
@@ -246,8 +249,9 @@ def build_info_metrics(registry: Registry, backend: str = "none",
     info = Gauge(
         "llm_build_info",
         "Build/runtime identity of this process (value is always 1)",
-        registry, label_names=("version", "jax", "backend"))
-    info.labels(version=__version__, jax=jax_version, backend=backend).set(1)
+        registry, label_names=("version", "jax", "backend", "role"))
+    info.labels(version=__version__, jax=jax_version, backend=backend,
+                role=role).set(1)
     start = Gauge(
         "llm_process_start_time_seconds",
         "Unix time this process started", registry)
@@ -296,9 +300,10 @@ def engine_metrics(registry: Registry) -> dict:
         # per model) — deploy/manifests.py render_model_autoscaler
         "queue_depth": Gauge(
             "llm_queue_depth",
-            "Requests queued for admission, per served model "
-            "(the replica-autoscaling signal)",
-            registry, label_names=("model",)),
+            "Requests queued for admission, per served model and serving "
+            "role (the replica-autoscaling signal; the prefill pool "
+            "scales on its own role's series)",
+            registry, label_names=("model", "role")),
         "cold_start": Histogram(
             "llm_cold_start_seconds",
             "Startup phase durations: compile=warmup executable builds, "
@@ -519,8 +524,15 @@ def router_metrics(registry: Registry) -> dict:
     return {
         "replica_healthy": Gauge(
             "llm_replica_healthy",
-            "Active /ready probe verdict per replica (1=routable)",
-            registry, label_names=("model", "replica")),
+            "Active /ready probe verdict per replica (1=routable), with "
+            "its serving role — a wedged prefill pool is visible without "
+            "hiding healthy decode replicas",
+            registry, label_names=("model", "replica", "role")),
+        "breaker_open": Gauge(
+            "llm_router_breaker_open",
+            "Circuit-breaker verdict per replica (1=open/half-open probe "
+            "pending, 0=admitting), per serving role",
+            registry, label_names=("model", "replica", "role")),
         "requests_total": Counter(
             "llm_router_requests_total",
             "Requests the router accepted, by resolved model — the "
@@ -562,6 +574,21 @@ def router_metrics(registry: Registry) -> dict:
             "client got a final SSE error event "
             "(finish_reason=upstream_lost) and a closed stream",
             registry, label_names=("model",)),
+        "handoff": Counter(
+            "llm_handoff_total",
+            "Disaggregated prefill->decode handoffs by outcome: ok=first "
+            "decode replica adopted the pages, retried=a later decode "
+            "replica did, reprefill=the decode replica could not adopt "
+            "and re-prefilled the prompt (degraded, correct), "
+            "fallback_colocated=the two-hop flow fell back to a "
+            "colocated replica",
+            registry, label_names=("outcome",)),
+        "handoff_seconds": Histogram(
+            "llm_handoff_seconds",
+            "Prefill-hop start to decode-hop response head for "
+            "disaggregated two-hop requests (ticket + KV adoption time)",
+            (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+            registry),
         "tenant_requests": Counter(
             "llm_tenant_requests_total",
             "Proxied requests by QoS tenant and resolved priority class "

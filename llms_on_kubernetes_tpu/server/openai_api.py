@@ -50,8 +50,10 @@ from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
 # already relayed; the engine continues decoding from that exact position,
 # and this layer journals token ids / suppresses the replayed prefix.
 from llms_on_kubernetes_tpu.server.router import (
-    DEADLINE_HEADER, JOURNAL_HEADER, RESUME_CREATED_HEADER,
-    RESUME_STREAM_ID_HEADER, RESUME_TOKENS_HEADER,
+    DEADLINE_HEADER, HANDOFF_ADOPTED_HEADER, HANDOFF_DIGESTS_HEADER,
+    HANDOFF_HEADER, HANDOFF_SEED_HEADER, HANDOFF_SOURCE_HEADER,
+    HANDOFF_TENANT_HEADER, HANDOFF_TICKET_HEADER, JOURNAL_HEADER,
+    RESUME_CREATED_HEADER, RESUME_STREAM_ID_HEADER, RESUME_TOKENS_HEADER,
 )
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
@@ -70,6 +72,61 @@ def _chip_ms_total(reqs) -> dict:
         for ph, v in getattr(r, "chip_ms", {}).items():
             chip[ph] = chip.get(ph, 0.0) + v
     return chip
+
+
+def _encode_kv_payload(pl: dict) -> dict:
+    """Wire form of one host-tier KV page for /internal/kv/fetch: each
+    array as base64 raw bytes + shape + dtype + a truncated sha256 so a
+    truncated or bit-flipped transfer is detected at ingest (and treated
+    as a missing page) instead of landing wrong bytes in the cache."""
+    import base64
+    import hashlib
+
+    import numpy as np
+
+    def arr(a):
+        if a is None:
+            return None
+        a = np.ascontiguousarray(a)
+        raw = a.tobytes()
+        return {"b64": base64.b64encode(raw).decode("ascii"),
+                "shape": list(a.shape), "dtype": str(a.dtype),
+                "sha": hashlib.sha256(raw).hexdigest()[:16]}
+
+    return {k: arr(pl.get(k)) for k in ("k", "v", "ks", "vs")}
+
+
+def _decode_kv_payload(doc) -> Optional[dict]:
+    """Inverse of :func:`_encode_kv_payload`; None for anything malformed
+    or checksum-failed (the caller treats that page as missing — shape/
+    dtype validation against the local pools happens in the engine)."""
+    import base64
+    import binascii
+    import hashlib
+
+    import numpy as np
+
+    if not isinstance(doc, dict):
+        return None
+
+    def arr(enc):
+        if enc is None:
+            return None
+        if not isinstance(enc, dict):
+            raise ValueError("bad array encoding")
+        raw = base64.b64decode(enc["b64"], validate=True)
+        if hashlib.sha256(raw).hexdigest()[:16] != enc.get("sha"):
+            raise ValueError("checksum mismatch")
+        a = np.frombuffer(raw, dtype=np.dtype(str(enc["dtype"])))
+        return a.reshape([int(s) for s in enc["shape"]]).copy()
+
+    try:
+        out = {k: arr(doc.get(k)) for k in ("k", "v", "ks", "vs")}
+    except (KeyError, ValueError, TypeError, binascii.Error):
+        return None
+    if out["k"] is None or out["v"] is None:
+        return None
+    return out
 
 
 def _deadline_from(request: web.Request, body: dict) -> Optional[float]:
@@ -314,8 +371,9 @@ class EngineLoop(threading.Thread):
                 m["batch_occupancy"].set(occupancy)
                 m["kv_pages_used"].set(pages_used)
                 m["waiting"].set(len(eng.waiting))
-                m["queue_depth"].labels(model=self.model_name).set(
-                    len(eng.waiting))
+                m["queue_depth"].labels(
+                    model=self.model_name,
+                    role=eng.config.role or "both").set(len(eng.waiting))
                 m["prefix_hit_tokens"].set(eng.allocator.hit_tokens_total)
                 for ev in events:
                     m["tokens_generated"].inc(len(ev.new_tokens))
@@ -536,7 +594,10 @@ class OpenAIServer:
             backend = jax.default_backend()
         except Exception:
             backend = "none"
-        build_info_metrics(self.registry, backend=backend)
+        build_info_metrics(
+            self.registry, backend=backend,
+            role=getattr(getattr(engine, "config", None), "role", None)
+            or "both")
         # runtime telemetry (device memory, live buffers, jit compile
         # counters) refreshed at scrape time by the /metrics handler
         self.telemetry = RuntimeTelemetry(self.registry)
@@ -567,6 +628,9 @@ class OpenAIServer:
         # use; compiled grammars are cached in engine/grammar.py
         self._token_bytes = None
         self._token_bytes_lock = threading.Lock()
+        # disaggregated handoff: lazy client session for pulling KV pages
+        # from a prefill replica (decode role); closed at shutdown
+        self._handoff_session = None
 
     # ------------------------------------------------------------------
 
@@ -611,6 +675,10 @@ class OpenAIServer:
         app.router.add_post("/detokenize", self.detokenize)
         app.router.add_get("/version", self.version)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        # disaggregated handoff: a decode replica pulls the host-tier KV
+        # pages a prefill replica spilled (serving-port internal surface,
+        # like /debug/*: the deployment keeps these ports cluster-local)
+        app.router.add_post("/internal/kv/fetch", self.kv_fetch)
         app.router.add_post("/debug/profile", self.profile_capture)
         app.router.add_get("/debug/profile", self.profile_list)
         app.router.add_get("/debug/profile/{capture_id}",
@@ -649,6 +717,36 @@ class OpenAIServer:
                 kwargs={"reason": "preempt_replica fault"})
             t.daemon = True
             t.start()
+        # injected fault: a prefill-role pod crashes abruptly DELAY
+        # seconds from now — no graceful drain, readiness AND liveness go
+        # 503, in-flight and new requests are refused. One-shot (claim)
+        # and armed only on prefill-role servers: the router must retry
+        # surviving prefill replicas or fall back to colocated serving.
+        crash = faults.get_float("kill_prefill_replica", 1.0)
+        if (crash is not None
+                and getattr(self.engine.config, "role", None) == "prefill"
+                and faults.claim("kill_prefill_replica")):
+            t = threading.Timer(max(crash, 0.0), self._kill_abrupt)
+            t.daemon = True
+            t.start()
+
+    def _kill_abrupt(self) -> None:
+        """Simulated prefill-pod crash (``kill_prefill_replica`` fault):
+        unlike :meth:`begin_drain` there is no grace — every in-flight
+        engine request is aborted, the serving state flips to ``killed``
+        (liveness and readiness both 503, new work refused), and the
+        engine loop stops. Idempotent."""
+        if self._state == "killed":
+            return
+        self._state = "killed"
+        self.metrics["engine_state"].set(self.STATE_CODES["killed"])
+        try:
+            for r in list(self.engine.waiting) + list(self.engine.slots):
+                if r is not None:
+                    self.loop_thread.abort(r, "kill_prefill_replica")
+        except Exception:
+            pass  # a fault hook must never take the process down itself
+        self.loop_thread.stop()
 
     def begin_drain(self, reason: str = "scale-in") -> None:
         """Enter the graceful drain from OUTSIDE the event loop.
@@ -668,7 +766,11 @@ class OpenAIServer:
         self.loop_thread.stop()
 
     async def _stop_loop(self, app) -> None:
-        self._state = "draining"
+        if self._state != "killed":
+            self._state = "draining"
+        if self._handoff_session is not None:
+            await self._handoff_session.close()
+            self._handoff_session = None
         self.loop_thread.stop()
         if self.loop_thread.is_alive():
             # join OFF the event loop so cleanup isn't blocked; the join
@@ -683,7 +785,8 @@ class OpenAIServer:
     # endpoints
     # ------------------------------------------------------------------
 
-    STATE_CODES = {"loading": 0, "serving": 1, "draining": 2, "wedged": 3}
+    STATE_CODES = {"loading": 0, "serving": 1, "draining": 2, "wedged": 3,
+                   "killed": 4}
 
     @property
     def state(self) -> str:
@@ -695,10 +798,11 @@ class OpenAIServer:
 
     async def health(self, request: web.Request) -> web.Response:
         # liveness: fail ONLY when a restart would help. Loading and
-        # draining are healthy; a wedged device step is not.
-        if self.state == "wedged":
+        # draining are healthy; a wedged device step is not, and neither
+        # is a fault-killed replica (a crashed pod fails liveness too).
+        if self.state in ("wedged", "killed"):
             return web.json_response(
-                {"error": {"message": "engine wedged: device step stalled",
+                {"error": {"message": f"engine {self.state}",
                            "type": "service_unavailable"}},
                 status=503)
         return web.Response(text="OK")
@@ -837,7 +941,166 @@ class OpenAIServer:
             limit=self._int_query(request, "limit", 0) or None)
         snap["state"] = self.state
         snap["model"] = self.model_name
+        snap["role"] = self.engine.config.role or "both"
         return web.json_response(snap)
+
+    # ----- disaggregated prefill/decode handoff (router-internal) -----
+
+    async def kv_fetch(self, request: web.Request) -> web.Response:
+        """KV-page export for the disaggregated handoff: a decode replica
+        POSTs ``{"tenant": ..., "digests": [hex, ...]}`` and gets back
+        ``{"payloads": [...]}`` — position-matched, ``null`` for any page
+        this replica's host tier no longer holds (evicted, never spilled,
+        or the tier is off). Pages travel checksummed (see
+        :func:`_encode_kv_payload`); the decode side treats a checksum
+        mismatch like a missing page. A killed/wedged replica refuses, so
+        the puller degrades to full re-prefill instead of hanging."""
+        if self.state in ("killed", "wedged"):
+            return web.json_response(
+                {"error": {"message": f"replica {self.state}",
+                           "type": "service_unavailable"}}, status=503)
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "malformed JSON body"}}, status=400)
+        raw = body.get("digests") if isinstance(body, dict) else None
+        if (not isinstance(raw, list) or len(raw) > 4096
+                or not all(isinstance(d, str) for d in raw)):
+            return web.json_response(
+                {"error": {"message": "digests must be a list of <= 4096 "
+                           "hex strings"}}, status=400)
+        try:
+            digests = [bytes.fromhex(d) for d in raw]
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "malformed digest hex"}}, status=400)
+        tenant = str(body.get("tenant") or "")
+        loop = asyncio.get_running_loop()
+        payloads = await loop.run_in_executor(
+            None, self.engine.host_kv_export, tenant, digests)
+        return web.json_response({"payloads": [
+            None if pl is None else _encode_kv_payload(pl)
+            for pl in payloads]})
+
+    async def _handoff_session_get(self):
+        import aiohttp
+        if self._handoff_session is None or self._handoff_session.closed:
+            self._handoff_session = aiohttp.ClientSession()
+        return self._handoff_session
+
+    async def _handoff_pull(self, request: web.Request,
+                            deadline: Optional[float]) -> int:
+        """Decode-side half of the handoff: pull the prefill replica's
+        spilled pages (named by the router's digest header) into the local
+        host tier and return how many landed. Every failure mode — fault
+        injection, network error, source refusing, corrupt payload, chain
+        gap — returns a smaller count, never raises: the request then
+        re-prefills whatever wasn't adopted, degraded but correct."""
+        from llms_on_kubernetes_tpu import faults
+        src = request.headers.get(HANDOFF_SOURCE_HEADER, "").strip()
+        src = src.rstrip("/")
+        raw = request.headers.get(HANDOFF_DIGESTS_HEADER, "")
+        try:
+            digests = [bytes.fromhex(x.strip())
+                       for x in raw.split(",") if x.strip()]
+        except ValueError:
+            digests = []
+        if not src or not digests:
+            return 0
+        if faults.claim_n("drop_handoff"):
+            # injected fault: the pull is skipped entirely — every
+            # handed-off page "missing", forcing the counted re-prefill
+            return 0
+        if getattr(self.engine, "host_kv", None) is None:
+            return 0
+        import os
+
+        import aiohttp
+        budget = float(os.environ.get("LLMK_HANDOFF_PULL_TIMEOUT_S", "10"))
+        if deadline is not None:
+            budget = max(0.05, min(budget, deadline - time.monotonic()))
+        tenant = request.headers.get(HANDOFF_TENANT_HEADER, "")
+        try:
+            sess = await self._handoff_session_get()
+            async with sess.post(
+                    src + "/internal/kv/fetch",
+                    json={"tenant": tenant,
+                          "digests": [d.hex() for d in digests]},
+                    timeout=aiohttp.ClientTimeout(total=budget)) as r:
+                if r.status != 200:
+                    return 0
+                doc = await r.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ValueError):
+            return 0
+        encs = doc.get("payloads") if isinstance(doc, dict) else None
+        if not isinstance(encs, list):
+            return 0
+        landed = 0
+        loop = asyncio.get_running_loop()
+        for digest, enc in zip(digests, encs):
+            if enc is None:
+                break  # chain gap: pages after it are unreachable anyway
+            pl = _decode_kv_payload(enc)
+            if pl is None:
+                break
+            ok = await loop.run_in_executor(
+                None, self.engine.host_kv_ingest, tenant, digest, pl)
+            if not ok:
+                break
+            landed += 1
+        return landed
+
+    async def _handoff_ticket_response(self, req) -> web.Response:
+        """Prefill-hop response: await the single-token prefill request
+        and answer with a handoff ticket — the chained page digests plus
+        the resolved seed — instead of a stream. The router re-issues the
+        original body to a decode replica, which pulls those pages and
+        regenerates the stream bit-identically from token zero."""
+        reason = None
+        try:
+            while True:
+                _toks, done, reason = await _next_event(req)
+                if done:
+                    break
+        except asyncio.CancelledError:
+            self.loop_thread.abort(req)
+            raise
+        if reason == "timeout" and not req.output:
+            self.metrics["deadline_exceeded"].labels(phase="queue").inc()
+            return web.json_response(
+                {"error": {"message": "deadline expired during prefill",
+                           "type": "timeout",
+                           "code": "deadline_exceeded"}}, status=504)
+        if reason not in ("length", "stop") and not req.output:
+            # stalled / aborted / killed mid-prefill: the router retries
+            # another prefill replica or falls back to colocated
+            return web.json_response(
+                {"error": {"message": f"prefill failed: {reason}",
+                           "type": "service_unavailable",
+                           "code": "handoff_prefill_failed"}},
+                status=503, headers={"Retry-After": "1"})
+        page = self.engine.allocator.page_size
+        n_pages = max(0, (len(req.prompt) - 1) // page)
+        digests = []
+        if n_pages > 0:
+            digests = self.engine.handoff_digests(
+                req.prompt[:n_pages * page], salt=req.cache_salt or b"")
+        doc = {
+            "object": "llmk.handoff_ticket",
+            "model": self._resp_model([req]),
+            "prompt_tokens": len(req.prompt),
+            "tenant": req.tenant,
+            "seed": req.seed,
+            "digests": [d.hex() for d in digests],
+        }
+        headers = {HANDOFF_TICKET_HEADER: "1"}
+        chip = _chip_ms_total([req])
+        if chip:
+            doc["chip_ms"] = {ph: round(v, 3) for ph, v in chip.items()}
+            headers[CHIP_MS_HEADER] = str(round(sum(chip.values()), 3))
+        return web.json_response(doc, headers=headers)
 
     async def models(self, request: web.Request) -> web.Response:
         created = int(time.time())
@@ -1402,13 +1665,15 @@ class OpenAIServer:
             EngineStallError, QueueFullError, UnknownAdapterError)
         from llms_on_kubernetes_tpu.engine.grammar import GrammarError
 
-        if self.state == "draining":
-            # shutdown in progress: in-flight streams run to completion,
-            # NEW work is refused so the client's retry lands on a live
-            # replica (the router's probe loop has already seen /ready 503)
+        if self.state in ("draining", "killed"):
+            # draining: in-flight streams run to completion, NEW work is
+            # refused so the client's retry lands on a live replica (the
+            # router's probe loop has already seen /ready 503). killed: a
+            # fault-injected prefill-pod crash — everything is refused.
             return web.json_response(
-                {"error": {"message": "server is draining; not accepting "
-                           "new requests", "type": "service_unavailable",
+                {"error": {"message": f"server is {self.state}; not "
+                           "accepting new requests",
+                           "type": "service_unavailable",
                            "code": "shutting_down"}},
                 status=503, headers={"Retry-After": "5"})
         deadline = _deadline_from(request, body)
@@ -1504,6 +1769,36 @@ class OpenAIServer:
         priority = (raw_prio.strip().lower()
                     if raw_prio is not None
                     and raw_prio.strip().lower() in PRIORITIES else None)
+        # --- disaggregated two-hop serving (router-internal headers) ---
+        # Decode hop: the router re-issues the ORIGINAL body here with the
+        # prefill replica's resolved seed, so this fresh request samples
+        # bit-identically to a colocated one; the pulled pages below make
+        # its prefill a host-tier hit instead of recompute.
+        raw_hseed = request.headers.get(HANDOFF_SEED_HEADER)
+        if raw_hseed is not None and params.seed is None:
+            try:
+                params = dataclasses.replace(
+                    params, seed=int(raw_hseed) & 0x7FFFFFFF)
+            except ValueError:
+                pass  # malformed internal header: still correct, new seed
+        # Prefill hop: answer with a handoff ticket instead of a stream.
+        # Ineligible shapes DECLINE by serving normally — the router sent
+        # the journal header too, so a declined ticket degrades to an
+        # ordinary relayable stream, never an error.
+        want_ticket = (
+            request.headers.get(HANDOFF_HEADER, "").strip().lower()
+            == "ticket"
+            and raw_resume is None and len(prompts) == 1
+            and n == 1 and best_of == 1
+            and getattr(self.engine, "host_kv", None) is not None)
+        if want_ticket:
+            # prompt ingestion only: one sampled token proves the prefill
+            # completed, and submit(handoff=True) drains the spilled pages
+            # to the host tier eagerly so the decode pull never races
+            params = dataclasses.replace(params, max_tokens=1)
+        elif request.headers.get(HANDOFF_SOURCE_HEADER):
+            adopted = await self._handoff_pull(request, deadline)
+            request["llmk_handoff_adopted"] = adopted
         # best_of choices per prompt (prompt-major choice order, per
         # OpenAI); usage counts each UNIQUE prompt once, not n times
         loop = asyncio.get_running_loop()
@@ -1525,7 +1820,8 @@ class OpenAIServer:
                     req = self.loop_thread.submit(
                         prompt_ids, p, on_event=_event_pusher(loop, q),
                         images=images, deadline=deadline, request_id=eng_id,
-                        adapter=adapter, tenant=tenant, priority=priority)
+                        adapter=adapter, tenant=tenant, priority=priority,
+                        handoff=want_ticket)
                     req.trace = trace
                     trace.engine_reqs.append(req)
                     req._aq = q
@@ -1572,6 +1868,9 @@ class OpenAIServer:
             for r in reqs:
                 self.loop_thread.abort(r)
             return web.json_response({"error": {"message": str(e)}}, status=400)
+
+        if want_ticket:
+            return await self._handoff_ticket_response(reqs[0])
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
@@ -1947,6 +2246,12 @@ class OpenAIServer:
             # set before prepare(): the middleware cannot add headers to an
             # already-prepared streaming response
             resp.headers[REQUEST_ID_HEADER] = rid_header
+        adopted = request.get("llmk_handoff_adopted")
+        if adopted is not None:
+            # decode hop of a disaggregated request: how many handed-off
+            # pages actually landed — the router counts 0-with-digests as
+            # a degraded (re-prefill) handoff, never a client error
+            resp.headers[HANDOFF_ADOPTED_HEADER] = str(adopted)
         await resp.prepare(request)
         obj = "chat.completion.chunk" if chat else "text_completion"
         resp_model = self._resp_model(reqs)

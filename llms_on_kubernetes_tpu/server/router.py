@@ -97,6 +97,30 @@ RESUME_TOKENS_HEADER = "X-LLMK-Resume-Tokens"
 RESUME_STREAM_ID_HEADER = "X-LLMK-Resume-Stream-Id"
 RESUME_CREATED_HEADER = "X-LLMK-Resume-Created"
 
+# Disaggregated prefill/decode two-hop protocol (router <-> API server,
+# internal). The router sends a streaming completion to a prefill-role
+# replica with ``X-LLMK-Handoff: ticket``; the replica runs chunked prompt
+# ingestion only, spills the prompt's full KV pages to its host tier, and
+# answers with a JSON handoff ticket (marked by the response header
+# ``X-LLMK-Handoff-Ticket``) carrying the page digests, host-tier tenant
+# key, and the resolved sampling seed. The router then re-issues the
+# ORIGINAL body to a decode-role replica with the Source/Digests/Tenant/
+# Seed headers; that replica pulls the pages from the prefill replica's
+# ``/internal/kv/fetch``, lands them in its own host tier, and serves the
+# request from scratch — admission adopts the pulled pages, the seed makes
+# the sampled stream bit-identical to colocated serving, and the client
+# sees one ordinary SSE stream (journal/resume engages normally for any
+# later mid-stream death). ``X-LLMK-Handoff-Adopted`` on the decode
+# response reports how many pages were adopted (0 with digests offered =
+# the counted degraded re-prefill).
+HANDOFF_HEADER = "X-LLMK-Handoff"
+HANDOFF_SOURCE_HEADER = "X-LLMK-Handoff-Source"
+HANDOFF_DIGESTS_HEADER = "X-LLMK-Handoff-Digests"
+HANDOFF_TENANT_HEADER = "X-LLMK-Handoff-Tenant"
+HANDOFF_SEED_HEADER = "X-LLMK-Handoff-Seed"
+HANDOFF_TICKET_HEADER = "X-LLMK-Handoff-Ticket"
+HANDOFF_ADOPTED_HEADER = "X-LLMK-Handoff-Adopted"
+
 HOP_BY_HOP = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
     "te", "trailers", "transfer-encoding", "upgrade", "host",
@@ -355,10 +379,12 @@ class CircuitBreaker:
 class Replica:
     """One upstream of a model's replica set, with its routing state."""
 
-    def __init__(self, model: str, url: str, breaker: CircuitBreaker):
+    def __init__(self, model: str, url: str, breaker: CircuitBreaker,
+                 role: str = "both"):
         self.model = model
         self.url = url                 # base URL, no trailing slash
         self.breaker = breaker
+        self.role = role               # prefill | decode | both
         self.healthy = True            # last active-probe verdict
         self.inflight = 0              # requests currently relayed through it
 
@@ -402,6 +428,8 @@ class Router:
         hedge_ms: Optional[float] = None,
         journal_max_tokens: int = 4096,
         qos: Optional[dict] = None,
+        roles: Optional[dict] = None,
+        handoff_retries: Optional[int] = None,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -445,6 +473,14 @@ class Router:
         if hedge_ms is None:
             hedge_ms = _env_float("LLMK_HEDGE_MS", 0.0)
         self.hedge_ms = max(0.0, hedge_ms)
+        # disaggregated serving: replica URL -> serving role. A model with
+        # BOTH a prefill and a decode replica gets the two-hop flow for
+        # streaming completions; everything else serves colocated.
+        self.roles: dict[str, str] = {
+            str(u).rstrip("/"): str(r) for u, r in (roles or {}).items()}
+        if handoff_retries is None:
+            handoff_retries = _env_int("LLMK_HANDOFF_RETRIES", 2)
+        self.handoff_retries = max(1, handoff_retries)
         self.journal_max_tokens = max(1, journal_max_tokens)
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
@@ -452,7 +488,13 @@ class Router:
         self.clock = clock
         self.registry = Registry()
         self.metrics = router_metrics(self.registry)
-        build_info_metrics(self.registry, backend="python-router")
+        build_info_metrics(self.registry, backend="python-router",
+                           role="router")
+        # a labeled counter with no children exports no samples: pre-seed
+        # every handoff outcome so rate() and the dashboard panels see an
+        # explicit 0 before the first disaggregated request
+        for oc in ("ok", "retried", "reprefill", "fallback_colocated"):
+            self.metrics["handoff"].labels(outcome=oc)
         # sliding-window SLO over proxied outcomes (llm_slo_* gauges read
         # it at scrape time); objectives from LLMK_SLO_* env vars
         self.slo = SLOTracker()
@@ -474,11 +516,17 @@ class Router:
                 if breaker is None:
                     breaker = self.breakers[url] = CircuitBreaker(
                         breaker_threshold, breaker_open_s, clock)
-                rep = Replica(name, url, breaker)
+                rep = Replica(name, url, breaker,
+                              role=self.roles.get(url, "both"))
                 reps.append(rep)
                 self.metrics["replica_healthy"].labels(
-                    model=name, replica=url).set(1)
+                    model=name, replica=url, role=rep.role).set(1)
             self.replicas[name] = reps
+        # models with at least one prefill AND one decode replica use the
+        # two-hop handoff flow for streaming completions
+        self._disagg: dict[str, bool] = {
+            name: {"prefill", "decode"} <= {r.role for r in reps}
+            for name, reps in self.replicas.items()}
         self._session: Optional[aiohttp.ClientSession] = None
         self._probe_task: Optional[asyncio.Task] = None
 
@@ -550,7 +598,8 @@ class Router:
                  verdict="re-admitted" if healthy else "ejected")
         rep.healthy = healthy
         self.metrics["replica_healthy"].labels(
-            model=rep.model, replica=rep.url).set(1 if healthy else 0)
+            model=rep.model, replica=rep.url,
+            role=rep.role).set(1 if healthy else 0)
 
     # ------------------------------------------------------------------
 
@@ -558,6 +607,13 @@ class Router:
         return web.Response(text="OK")
 
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
+        # breaker state is refreshed at scrape time (it changes on every
+        # request outcome; per-transition gauge writes would be hot-path)
+        for reps in self.replicas.values():
+            for r in reps:
+                self.metrics["breaker_open"].labels(
+                    model=r.model, replica=r.url, role=r.role).set(
+                        0 if r.breaker.state == CircuitBreaker.CLOSED else 1)
         return web.Response(text=self.registry.render(),
                             content_type="text/plain")
 
@@ -668,20 +724,56 @@ class Router:
             return now + float(timeout)
         return None
 
-    def _pick(self, model: str, exclude: set) -> Optional[Replica]:
+    def _serve_roles(self, model: str) -> Optional[tuple]:
+        """Role preference for ordinary (non-two-hop) traffic: when the
+        model has prefill-role replicas, prefer both/decode ones — a
+        prefill pod serving full generations starves the ticket flow —
+        falling back to prefill only when nothing else is routable."""
+        if any(r.role == "prefill" for r in self.replicas[model]):
+            return ("both", "decode")
+        return None
+
+    def _pick(self, model: str, exclude: set,
+              roles: Optional[tuple] = None) -> Optional[Replica]:
         """Power-of-two-choices over the model's routable replicas.
 
         Replicas in ``exclude`` (already failed this request) are skipped
         unless nothing else is routable; breaker half-open slots are only
-        claimed for the final choice (``blocked()`` peeks first).
+        claimed for the final choice (``blocked()`` peeks first). With
+        ``roles``, replicas of those roles are preferred and the rest are
+        a last resort (never preferred over an excluded preferred one is
+        NOT guaranteed — availability beats affinity).
         """
         reps = self.replicas[model]
-        cands = [r for r in reps
-                 if r.url not in exclude and r.healthy
+        pools = [reps]
+        if roles:
+            pref = [r for r in reps if r.role in roles]
+            pools = [pref, reps] if pref and len(pref) < len(reps) \
+                else ([pref] if pref else [reps])
+        for pool in pools:
+            cands = [r for r in pool
+                     if r.url not in exclude and r.healthy
+                     and not r.breaker.blocked()]
+            if not cands and exclude:
+                cands = [r for r in pool
+                         if r.healthy and not r.breaker.blocked()]
+            if not cands:
+                continue
+            if len(cands) == 1:
+                choice = cands[0]
+            else:
+                a, b = random.sample(cands, 2)
+                choice = a if a.inflight <= b.inflight else b
+            return choice if choice.breaker.allow() else None
+        return None
+
+    def _pick_role(self, model: str, exclude: set,
+                   role: str) -> Optional[Replica]:
+        """Strict single-role pick for the handoff hops (no cross-role
+        fallback — that decision belongs to the caller's ladder)."""
+        cands = [r for r in self.replicas[model]
+                 if r.role == role and r.url not in exclude and r.healthy
                  and not r.breaker.blocked()]
-        if not cands and exclude:
-            cands = [r for r in reps
-                     if r.healthy and not r.breaker.blocked()]
         if not cands:
             return None
         if len(cands) == 1:
@@ -855,7 +947,12 @@ class Router:
                                   JOURNAL_HEADER.lower(),
                                   RESUME_TOKENS_HEADER.lower(),
                                   RESUME_STREAM_ID_HEADER.lower(),
-                                  RESUME_CREATED_HEADER.lower())
+                                  RESUME_CREATED_HEADER.lower(),
+                                  HANDOFF_HEADER.lower(),
+                                  HANDOFF_SOURCE_HEADER.lower(),
+                                  HANDOFF_DIGESTS_HEADER.lower(),
+                                  HANDOFF_TENANT_HEADER.lower(),
+                                  HANDOFF_SEED_HEADER.lower())
         }
         headers[REQUEST_ID_HEADER] = rid
         # RESOLVED priority, never the client's raw header (an invalid or
@@ -881,6 +978,21 @@ class Router:
             if self.stream_resume:
                 headers[JOURNAL_HEADER] = "1"
 
+        # --- disaggregated two-hop: streaming completions on a model with
+        # separate prefill/decode pools go prefill-ticket -> decode-adopt.
+        # Every failure in the ladder falls through to the ordinary
+        # colocated path below — the two-hop flow is an optimization, never
+        # a new way to fail a request.
+        if journal is not None and self._disagg.get(model):
+            resp = await self._handoff_flow(
+                request, trace, rid, model, headers, body, deadline,
+                journal, t0)
+            if resp is not None:
+                return resp
+            self.metrics["handoff"].labels(
+                outcome="fallback_colocated").inc()
+            trace.event("handoff_fallback_colocated")
+
         # --- connect/request phase: bounded retries with backoff+jitter.
         # Only failures BEFORE a response head are retried (the buffered
         # body makes the resend safe); each transport failure feeds the
@@ -895,7 +1007,7 @@ class Router:
         t_connect0 = self.clock()
         attempt = 0
         for attempt in range(1, self.retry_attempts + 1):
-            replica = self._pick(model, tried)
+            replica = self._pick(model, tried, roles=self._serve_roles(model))
             if replica is None:
                 break
             never_picked = False
@@ -1008,6 +1120,148 @@ class Router:
     # journaled SSE relay: mid-stream failover splice + hedged requests
 
     _RELAY_ERRORS = (aiohttp.ClientError, TimeoutError, OSError)
+
+    async def _handoff_flow(self, request: web.Request,
+                            trace: "tracing.Trace", rid: str, model: str,
+                            headers: dict, body: bytes,
+                            deadline: Optional[float],
+                            journal: "_StreamJournal",
+                            t0: float) -> Optional[web.StreamResponse]:
+        """Two-hop disaggregated serving (protocol at the HANDOFF_*
+        constants): prefill-hop for a ticket, then re-issue the original
+        body to a decode replica that adopts the ticket's pages.
+
+        Returns the relayed response, or None to tell the caller to fall
+        back to the ordinary colocated path (prefill pool exhausted, no
+        decode replica took the request within ``handoff_retries``
+        attempts) — the fallback is degraded capacity, never an error.
+        A replica that answers but refuses (draining 503, ineligible
+        body) is skipped without feeding its breaker; only transport
+        failures do that.
+        """
+        t_h0 = self.clock()
+        path = request.match_info["path"]
+        qs = f"?{request.query_string}" if request.query_string else ""
+
+        # --- prefill hop: chunked prompt ingestion, ticket back
+        ticket: Optional[dict] = None
+        source: Optional[Replica] = None
+        tried_p: set = set()
+        for _ in range(self.retry_attempts):
+            replica = self._pick_role(model, tried_p, "prefill")
+            if replica is None:
+                return None
+            h = dict(headers)
+            h[HANDOFF_HEADER] = "ticket"
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    return self._deadline_response(rid)
+                h[DEADLINE_HEADER] = str(int(remaining * 1000))
+            replica.inflight += 1
+            try:
+                up = await self._session.request(
+                    request.method, f"{replica.url}/{path}{qs}",
+                    data=body or None, headers=h)
+            except self._RELAY_ERRORS:
+                replica.inflight -= 1
+                replica.breaker.record_failure()
+                tried_p.add(replica.url)
+                continue
+            ctype = up.headers.get("Content-Type", "").lower()
+            if up.status == 200 and up.headers.get(HANDOFF_TICKET_HEADER):
+                try:
+                    doc_t = await up.json(content_type=None)
+                except (*self._RELAY_ERRORS, ValueError):
+                    replica.inflight -= 1
+                    replica.breaker.record_failure()
+                    tried_p.add(replica.url)
+                    up.close()
+                    continue
+                replica.inflight -= 1
+                replica.breaker.record_success()
+                if not isinstance(doc_t, dict):
+                    tried_p.add(replica.url)
+                    continue
+                ticket, source = doc_t, replica
+                break
+            if up.status == 200 and ctype.startswith("text/event-stream"):
+                # the replica DECLINED the ticket (ineligible shape) and
+                # is serving the stream itself: relay it like any other —
+                # correct, just not disaggregated
+                replica.breaker.record_success()
+                trace.event("handoff_declined", replica=replica.url)
+                return await self._relay_stream(
+                    request, trace, rid, model, h, body, deadline, up,
+                    replica, tried_p, t0, journal)
+            # answered but refused (draining/killed 503, 4xx): not a
+            # transport failure — skip it, the colocated fallback will
+            # produce the authoritative response if nothing else works
+            replica.inflight -= 1
+            up.close()
+            tried_p.add(replica.url)
+        if ticket is None or source is None:
+            return None
+
+        digests = [d for d in ticket.get("digests", ())
+                   if isinstance(d, str) and d]
+        seed = ticket.get("seed")
+
+        # --- decode hop: fresh issue of the ORIGINAL body + adoption
+        # headers; the stream regenerates bit-identically from token zero
+        h2 = dict(headers)
+        if digests:
+            h2[HANDOFF_SOURCE_HEADER] = source.url
+            h2[HANDOFF_DIGESTS_HEADER] = ",".join(digests)
+            h2[HANDOFF_TENANT_HEADER] = str(ticket.get("tenant") or "")
+        if isinstance(seed, int) and not isinstance(seed, bool):
+            h2[HANDOFF_SEED_HEADER] = str(seed)
+        tried_d: set = set()
+        for attempt in range(1, self.handoff_retries + 1):
+            replica = self._pick_role(model, tried_d, "decode")
+            if replica is None:
+                break
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    return self._deadline_response(rid)
+                h2[DEADLINE_HEADER] = str(int(remaining * 1000))
+            replica.inflight += 1
+            try:
+                up = await self._session.request(
+                    request.method, f"{replica.url}/{path}{qs}",
+                    data=body or None, headers=h2)
+            except self._RELAY_ERRORS:
+                replica.inflight -= 1
+                replica.breaker.record_failure()
+                tried_d.add(replica.url)
+                continue
+            ctype = up.headers.get("Content-Type", "").lower()
+            if up.status != 200 or not ctype.startswith("text/event-stream"):
+                replica.inflight -= 1
+                up.close()
+                tried_d.add(replica.url)
+                continue
+            replica.breaker.record_success()
+            try:
+                adopted = int(up.headers.get(HANDOFF_ADOPTED_HEADER, "0"))
+            except ValueError:
+                adopted = 0
+            # reprefill = pages were offered but none adopted: the decode
+            # replica recomputed the prompt — degraded, counted, correct
+            outcome = ("reprefill" if digests and adopted <= 0
+                       else ("ok" if attempt == 1 else "retried"))
+            self.metrics["handoff"].labels(outcome=outcome).inc()
+            self.metrics["handoff_seconds"].observe(self.clock() - t_h0)
+            jlog("handoff", request_id=rid, component="router", model=model,
+                 prefill=source.url, decode=replica.url, outcome=outcome,
+                 pages_offered=len(digests), pages_adopted=adopted)
+            trace.event("handoff", outcome=outcome, adopted=adopted,
+                        prefill=source.url, decode=replica.url)
+            return await self._relay_stream(
+                request, trace, rid, model, h2, body, deadline, up,
+                replica, tried_d, t0, journal)
+        return None
 
     async def _relay_stream(self, request: web.Request,
                             trace: "tracing.Trace", rid: str, model: str,
@@ -1183,7 +1437,7 @@ class Router:
                          component="router", model=model, reason="deadline")
                     return None
                 h[DEADLINE_HEADER] = str(int(remaining * 1000))
-            replica = self._pick(model, tried)
+            replica = self._pick(model, tried, roles=self._serve_roles(model))
             if replica is None:
                 jlog("stream_resume_giveup", request_id=rid,
                      component="router", model=model,
@@ -1272,7 +1526,8 @@ class Router:
                 tried.add(active.url)
                 raise
             return upstream, active, chunk
-        hedge_rep = self._pick(model, tried | {active.url})
+        hedge_rep = self._pick(model, tried | {active.url},
+                               roles=self._serve_roles(model))
         if hedge_rep is None:
             # nowhere to hedge to: keep waiting on the primary
             try:
@@ -1357,11 +1612,13 @@ def run_router(
     resume_attempts: Optional[int] = None,
     hedge_ms: Optional[float] = None,
     qos: Optional[dict] = None,
+    roles: Optional[dict] = None,
+    handoff_retries: Optional[int] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s,
                     stream_resume=stream_resume,
                     resume_attempts=resume_attempts, hedge_ms=hedge_ms,
-                    qos=qos)
+                    qos=qos, roles=roles, handoff_retries=handoff_retries)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

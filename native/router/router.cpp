@@ -168,6 +168,12 @@ struct Config {
   // per-tenant QoS: rate limits + priority + adaptive brownout ("qos"
   // config block; absent = gate dormant)
   QosConfig qos;
+  // disaggregated prefill/decode (mirrors server/router.py): replica
+  // (host, port) -> role; absent = "both". A model with any prefill
+  // replica gets the two-hop ticket flow; handoff_retries bounds the
+  // decode-hop attempts per ticket before the colocated fallback.
+  std::map<std::pair<std::string, int>, std::string> roles;
+  int handoff_retries = 2;
   int port = 8080;
   bool quiet = false;
 
@@ -175,6 +181,28 @@ struct Config {
     for (const auto& kv : models)
       if (kv.first == name) return &kv.second;
     return nullptr;
+  }
+
+  const std::string& role_of(const Url& u) const {
+    static const std::string kBoth = "both";
+    auto it = roles.find({u.host, u.port});
+    return it == roles.end() ? kBoth : it->second;
+  }
+
+  bool has_role(const std::string& model, const char* role) const {
+    const std::vector<Url>* reps = find(model);
+    if (!reps) return false;
+    for (const auto& u : *reps)
+      if (role_of(u) == role) return true;
+    return false;
+  }
+  bool has_prefill(const std::string& model) const {
+    return has_role(model, "prefill");
+  }
+  // the two-hop flow engages only for a model with BOTH pools (mirrors
+  // the python router's _disagg map)
+  bool is_disagg(const std::string& model) const {
+    return has_role(model, "prefill") && has_role(model, "decode");
   }
 
   bool has_adapter(const std::string& base, const std::string& name) const {
@@ -235,6 +263,29 @@ static std::map<std::string, long> g_stream_truncated_by_model;
 static void count_stream_truncated(const std::string& model) {
   std::lock_guard<std::mutex> lock(g_stream_truncated_mu);
   ++g_stream_truncated_by_model[model];
+}
+
+// disaggregated KV handoff (mirror server/metrics.py router_metrics()):
+// llm_handoff_total{outcome=ok|retried|reprefill|fallback_colocated} —
+// all four series always exported so dashboards see explicit zeros —
+// and llm_handoff_seconds, ticket-to-adopted-stream latency, with the
+// same buckets as the python router's histogram
+static std::atomic<long> g_handoff_ok_total{0};
+static std::atomic<long> g_handoff_retried_total{0};
+static std::atomic<long> g_handoff_reprefill_total{0};
+static std::atomic<long> g_handoff_fallback_total{0};
+static const double kHandoffBuckets[10] = {0.01, 0.025, 0.05, 0.1, 0.25,
+                                           0.5,  1.0,   2.5,  5.0, 10.0};
+static std::atomic<long> g_handoff_bucket_hits[11];  // [10] = +Inf
+static std::mutex g_handoff_sum_mu;
+static double g_handoff_seconds_sum = 0.0;
+
+static void observe_handoff_seconds(double s) {
+  int i = 0;
+  while (i < 10 && s > kHandoffBuckets[i]) ++i;
+  g_handoff_bucket_hits[i].fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lock(g_handoff_sum_mu);
+  g_handoff_seconds_sum += s;
 }
 
 // build identity: must match the python package __version__ so
@@ -870,6 +921,13 @@ class Breaker {
     return failures_;
   }
 
+  // non-mutating state peek for the llm_router_breaker_open gauge:
+  // open AND half-open count as 1, matching the python router
+  bool open_state() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return state_ != kClosed;
+  }
+
  private:
   enum State { kClosed, kOpen, kHalfOpen };
   std::mutex mu_;
@@ -939,6 +997,19 @@ static std::string gen_request_id() {
   return out;
 }
 
+// Role filter for replica selection (disaggregated prefill/decode):
+// kRoleAny = every replica (no roles configured); kRolePreferServe =
+// prefer both/decode replicas but fall back to the whole set (a prefill
+// replica still serves a full stream correctly — it just spills eagerly);
+// kRoleStrictPrefill / kRoleStrictDecode = that role only (the two hops
+// of the handoff flow).
+enum RolePick {
+  kRoleAny = 0,
+  kRolePreferServe = 1,
+  kRoleStrictPrefill = 2,
+  kRoleStrictDecode = 3,
+};
+
 // Picks the next replica to try: healthy (per the active prober) and not
 // breaker-blocked, preferring ones not already tried this request;
 // power-of-two-choices on in-flight count among the survivors. When the
@@ -946,7 +1017,8 @@ static std::string gen_request_id() {
 // unblocked replica may be retried (single-replica retry path). Unhealthy
 // or breaker-blocked replicas are never picked — the caller answers 503.
 static const Url* pick_replica(const Config& cfg, const std::vector<Url>& reps,
-                               const std::vector<const Url*>& tried) {
+                               const std::vector<const Url*>& tried,
+                               int role_mode = kRoleAny) {
   auto is_tried = [&](const Url& u) {
     for (const Url* t : tried)
       if (t == &u) return true;
@@ -957,13 +1029,26 @@ static const Url* pick_replica(const Config& cfg, const std::vector<Url>& reps,
                .healthy.load(std::memory_order_relaxed) &&
            !g_breakers.get(u.host, u.port).blocked(cfg.breaker_open_s);
   };
-  std::vector<const Url*> pool;
-  for (const auto& u : reps)
-    if (!is_tried(u) && routable(u)) pool.push_back(&u);
-  if (pool.empty() && !tried.empty()) {
+  auto role_ok = [&](const Url& u, int mode) {
+    if (mode == kRoleAny) return true;
+    const std::string& r = cfg.role_of(u);
+    if (mode == kRoleStrictPrefill) return r == "prefill";
+    if (mode == kRoleStrictDecode) return r == "decode";
+    return r != "prefill";  // kRolePreferServe: both|decode first
+  };
+  auto build_pool = [&](int mode) {
+    std::vector<const Url*> pool;
     for (const auto& u : reps)
-      if (routable(u)) pool.push_back(&u);
-  }
+      if (!is_tried(u) && routable(u) && role_ok(u, mode)) pool.push_back(&u);
+    if (pool.empty() && !tried.empty()) {
+      for (const auto& u : reps)
+        if (routable(u) && role_ok(u, mode)) pool.push_back(&u);
+    }
+    return pool;
+  };
+  std::vector<const Url*> pool = build_pool(role_mode);
+  if (pool.empty() && role_mode == kRolePreferServe)
+    pool = build_pool(kRoleAny);
   if (pool.empty()) return nullptr;
   if (pool.size() == 1) return pool[0];
   size_t a = pick_rand(static_cast<unsigned>(pool.size()));
@@ -1653,6 +1738,24 @@ struct StreamBodyReader {
 };
 
 // one chunk of the router's own chunked framing toward the client
+// Drain one upstream response body into a string (handoff-ticket JSON):
+// any framing StreamBodyReader understands, bounded by `cap`. True only
+// when the body ended cleanly per its framing (or EOF for unframed).
+static bool read_body_text(SockReader& up, const ResponseHead& head,
+                           std::string* out, size_t cap = 1 << 20) {
+  StreamBodyReader br(up, head);
+  char buf[8 * 1024];
+  while (true) {
+    ssize_t n = br.next(buf, sizeof buf);
+    if (n > 0) {
+      out->append(buf, static_cast<size_t>(n));
+      if (out->size() > cap) return false;
+      continue;
+    }
+    return br.complete || br.mode == StreamBodyReader::Mode::Eof;
+  }
+}
+
 static bool write_client_chunk(int fd, const std::string& data) {
   if (data.empty()) return true;
   char hdr[32];
@@ -1684,12 +1787,31 @@ static std::string sse_truncation_event() {
 
 // Proxies one request; returns true iff the client connection can be
 // reused for another request.
+// Decode-hop bookkeeping for the disaggregated two-hop flow: whether the
+// prefill ticket offered digests (adopted=0 then counts as a reprefill)
+// and when the decode hop started (llm_handoff_seconds).
+struct HandoffCtx {
+  bool offered_digests = false;
+  std::chrono::steady_clock::time_point t0{};
+};
+
+// hop_extra rides on EVERY upstream head this call builds — including
+// mid-stream resume re-issues, so a third decode replica re-pulls the
+// handed-off pages. hctx != nullptr marks the decode hop of a handoff:
+// replica picks are strict decode-role, attempts are bounded by
+// handoff_retries, a refusing replica is skipped without a breaker hit,
+// and when no stream is obtained *served_out is cleared and NOTHING is
+// written to the client — the caller falls back to the colocated path.
 static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                           const std::string& client_ip, const std::string& model,
                           const std::string& rid,
                           const std::string& priority = "normal",
-                          bool hedge_ok = true) {
+                          bool hedge_ok = true,
+                          const std::string& hop_extra = std::string(),
+                          HandoffCtx* hctx = nullptr,
+                          bool* served_out = nullptr) {
   const std::vector<Url>& replicas = *cfg.find(model);
+  if (served_out) *served_out = true;
   const auto t0 = std::chrono::steady_clock::now();
   const std::string rid_header =
       std::string(kRequestIdHeader) + ": " + rid + "\r\n";
@@ -1777,6 +1899,12 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (n == "x-llmk-journal" || n == "x-llmk-resume-tokens" ||
           n == "x-llmk-resume-stream-id" || n == "x-llmk-resume-created")
         continue;
+      // internal handoff protocol: a forged source would make a decode
+      // replica pull KV from an attacker-chosen host
+      if (n == "x-llmk-handoff" || n == "x-llmk-handoff-source" ||
+          n == "x-llmk-handoff-digests" || n == "x-llmk-handoff-tenant" ||
+          n == "x-llmk-handoff-seed")
+        continue;
       out << kv.first << ": " << kv.second << "\r\n";
     }
     out << kRequestIdHeader << ": " << rid << "\r\n";
@@ -1793,6 +1921,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     }
     if (journal_mode && cfg.stream_resume)
       out << kJournalHeader << ": 1\r\n";
+    out << hop_extra;
     out << extra;
     out << "Content-Length: " << req.body.size() << "\r\n";
     out << "Connection: keep-alive\r\n\r\n";
@@ -1819,10 +1948,162 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   std::vector<const Url*> tried;
   ReplicaHealth* health = nullptr;
   std::chrono::steady_clock::time_point connected_at{};
-  int max_attempts = std::max(1, cfg.retry_attempts);
+  // replica-pick role filter: strict decode inside a handoff's decode
+  // hop; both/decode-preferred for a disaggregated model's normal path
+  // (the colocated fallback); unrestricted otherwise
+  const int role_mode =
+      hctx ? kRoleStrictDecode
+           : (cfg.has_prefill(model) ? kRolePreferServe : kRoleAny);
+  int max_attempts = hctx ? std::max(1, cfg.handoff_retries)
+                          : std::max(1, cfg.retry_attempts);
+
+  // --- disaggregated two-hop handoff (mirrors server/router.py
+  // _handoff_flow). Hop 1: ask a prefill replica for a handoff ticket —
+  // it runs prompt ingestion only, spills the KV pages to its host tier
+  // and answers JSON instead of streaming. Hop 2 (the recursive call
+  // below): re-issue the ORIGINAL request to a decode replica, which
+  // pulls the pages from the prefill source before admission. Every miss
+  // falls back to the colocated path — degraded and counted, never a
+  // client-visible error.
+  if (journal_mode && !hctx && cfg.is_disagg(model)) {
+    std::string tkt_digests, tkt_tenant, tkt_seed;
+    const Url* psrc = nullptr;
+    bool have_ticket = false;
+    std::vector<const Url*> tried_p;
+    for (int attempt = 0; attempt < std::max(1, cfg.retry_attempts);
+         ++attempt) {
+      if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
+      const Url* pt = pick_replica(cfg, replicas, tried_p, kRoleStrictPrefill);
+      if (!pt) break;
+      Breaker& pb = g_breakers.get(pt->host, pt->port);
+      double ra = 0.0;
+      if (!pb.allow(cfg.breaker_threshold, cfg.breaker_open_s, &ra)) {
+        bool seen = false;
+        for (const Url* p : tried_p)
+          if (p == pt) { seen = true; break; }
+        if (seen) break;
+        tried_p.push_back(pt);
+        --attempt;
+        continue;
+      }
+      ReplicaHealth* ph = &g_health.get(pt->host, pt->port);
+      ph->inflight.fetch_add(1, std::memory_order_relaxed);
+      int pfd = g_upstream_pool.acquire(pt->host, pt->port);
+      if (pfd < 0)
+        pfd = connect_to(pt->host, pt->port, cfg.upstream_timeout_s,
+                         cfg.connect_timeout_s);
+      if (pfd < 0) {
+        ph->inflight.fetch_sub(1, std::memory_order_relaxed);
+        pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        tried_p.push_back(pt);
+        continue;
+      }
+      ResponseHead phead;
+      std::optional<SockReader> pr;
+      bool sent =
+          send_all(pfd, build_head(*pt, "X-LLMK-Handoff: ticket\r\n")) &&
+          (req.body.empty() || send_all(pfd, req.body));
+      pr.emplace(pfd);
+      if (!sent || !read_response_head(*pr, phead)) {
+        ::close(pfd);
+        ph->inflight.fetch_sub(1, std::memory_order_relaxed);
+        pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        tried_p.push_back(pt);
+        continue;
+      }
+      const std::string* pct = phead.headers.get("content-type");
+      bool p_sse = phead.status == 200 && pct &&
+                   lower(*pct).compare(0, 17, "text/event-stream") == 0;
+      if (phead.status == 200 &&
+          phead.headers.get("x-llmk-handoff-ticket")) {
+        std::string tb;
+        bool okb = read_body_text(*pr, phead, &tb);
+        ph->inflight.fetch_sub(1, std::memory_order_relaxed);
+        ::close(pfd);
+        JsonPtr tkt = okb ? JsonParser::parse(tb) : nullptr;
+        if (!tkt || !tkt->is_object()) {
+          // mangled ticket: the same as a transport failure mid-answer
+          pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+          tried_p.push_back(pt);
+          continue;
+        }
+        pb.record_success();
+        if (const Json* ds = tkt->get("digests");
+            ds && ds->type == Json::Type::Array) {
+          for (const auto& item : ds->arr) {
+            if (!item->is_string()) continue;
+            if (!tkt_digests.empty()) tkt_digests += ",";
+            tkt_digests += item->str;
+          }
+        }
+        if (const Json* tn = tkt->get("tenant"); tn && tn->is_string())
+          tkt_tenant = tn->str;
+        if (const Json* sd = tkt->get("seed");
+            sd && sd->type == Json::Type::Number)
+          tkt_seed = std::to_string(static_cast<long>(sd->number));
+        psrc = pt;
+        have_ticket = true;
+        break;
+      }
+      if (p_sse) {
+        // the prefill-capable replica DECLINED the ticket (ineligible
+        // request shape) and is streaming the completion itself: adopt
+        // this connection as the active upstream — not a handoff
+        pb.record_success();
+        logf(cfg, "handoff declined %s: relaying from %s:%d", model.c_str(),
+             pt->host.c_str(), pt->port);
+        target = pt;
+        health = ph;
+        up = std::move(pr);
+        up_fd = pfd;
+        head = phead;
+        got_head = true;
+        attempted = true;
+        connected_at = std::chrono::steady_clock::now();
+        tried = tried_p;
+        break;
+      }
+      // answered but refused (409/429/503...): skip WITHOUT a breaker
+      // hit; the colocated fallback reproduces the authoritative error
+      ::close(pfd);
+      ph->inflight.fetch_sub(1, std::memory_order_relaxed);
+      tried_p.push_back(pt);
+    }
+    if (have_ticket) {
+      std::ostringstream hx;
+      hx << "X-LLMK-Handoff-Source: http://" << psrc->host << ":"
+         << psrc->port << "\r\n";
+      if (!tkt_digests.empty()) {
+        hx << "X-LLMK-Handoff-Digests: " << tkt_digests << "\r\n";
+        if (!tkt_tenant.empty())
+          hx << "X-LLMK-Handoff-Tenant: " << tkt_tenant << "\r\n";
+      }
+      if (!tkt_seed.empty())
+        hx << "X-LLMK-Handoff-Seed: " << tkt_seed << "\r\n";
+      HandoffCtx ctx;
+      ctx.offered_digests = !tkt_digests.empty();
+      ctx.t0 = std::chrono::steady_clock::now();
+      bool served = true;
+      bool r = proxy_request(cfg, req, client_fd, client_ip, model, rid,
+                             priority, /*hedge_ok=*/false, hx.str(), &ctx,
+                             &served);
+      if (served) return r;
+      g_handoff_fallback_total.fetch_add(1, std::memory_order_relaxed);
+      logf(cfg, "handoff fallback_colocated %s: decode hop exhausted",
+           model.c_str());
+    } else if (!got_head) {
+      // no prefill ticket at all (pool unroutable, or every prefill
+      // replica refused): colocated fallback, counted
+      g_handoff_fallback_total.fetch_add(1, std::memory_order_relaxed);
+      logf(cfg, "handoff fallback_colocated %s: no prefill ticket",
+           model.c_str());
+    }
+  }
+
+  if (!got_head)
   for (int attempt = 0; attempt < max_attempts; ++attempt) {
     if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
-    target = pick_replica(cfg, replicas, tried);
+    target = pick_replica(cfg, replicas, tried, role_mode);
     if (!target) break;
     Breaker& breaker = g_breakers.get(target->host, target->port);
     double retry_after_s = 0.0;
@@ -1877,6 +2158,43 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
               (req.body.empty() || send_all(up_fd, req.body));
     up.emplace(up_fd);
     if (ok && read_response_head(*up, head)) {
+      if (hctx) {
+        const std::string* hct = head.headers.get("content-type");
+        bool h_sse = head.status == 200 && hct &&
+                     lower(*hct).compare(0, 17, "text/event-stream") == 0;
+        if (!h_sse) {
+          // decode replica answered but refused the adoption: try a
+          // sibling without a breaker hit — if every decode replica
+          // refuses, the colocated fallback reproduces the error
+          ::close(up_fd);
+          up_fd = -1;
+          up.reset();
+          health->inflight.fetch_sub(1, std::memory_order_relaxed);
+          prev = target;
+          tried.push_back(target);
+          continue;
+        }
+        long adopted = -1;
+        if (const std::string* ah =
+                head.headers.get("x-llmk-handoff-adopted"))
+          adopted = std::atol(ah->c_str());
+        if (hctx->offered_digests && adopted <= 0) {
+          // pages were offered but the decode replica could not adopt
+          // them (evicted / digest mismatch): it re-prefilled locally.
+          // Degraded and counted — never a client-visible error.
+          g_handoff_reprefill_total.fetch_add(1, std::memory_order_relaxed);
+          logf(cfg, "handoff reprefill %s on %s:%d", model.c_str(),
+               target->host.c_str(), target->port);
+        } else if (tried.empty()) {
+          g_handoff_ok_total.fetch_add(1, std::memory_order_relaxed);
+        } else {
+          g_handoff_retried_total.fetch_add(1, std::memory_order_relaxed);
+        }
+        observe_handoff_seconds(
+            std::chrono::duration<double>(
+                std::chrono::steady_clock::now() - hctx->t0)
+                .count());
+      }
       breaker.record_success();
       got_head = true;
       break;
@@ -1903,6 +2221,12 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     break;
   }
   if (!got_head) {
+    if (hctx && served_out) {
+      // decode hop exhausted: write NOTHING to the client — the caller
+      // counts fallback_colocated and re-runs on a both-role replica
+      *served_out = false;
+      return true;
+    }
     if (!attempted) {
       // never reached the network: the replica set is unroutable right
       // now. Distinguish "breakers open" (retry when one half-opens) from
@@ -2017,7 +2341,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (pr == 0) {
         std::vector<const Url*> skip = tried;
         skip.push_back(target);
-        const Url* hr = pick_replica(cfg, replicas, skip);
+        const Url* hr = pick_replica(cfg, replicas, skip, role_mode);
         if (hr) {
           ReplicaHealth* hh = &g_health.get(hr->host, hr->port);
           hh->inflight.fetch_add(1, std::memory_order_relaxed);
@@ -2178,7 +2502,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
             why = "deadline";
             break;
           }
-          nt = pick_replica(cfg, replicas, tried);
+          nt = pick_replica(cfg, replicas, tried, role_mode);
           if (!nt) {
             why = "no healthy replica";
             break;
@@ -2418,7 +2742,7 @@ static void handle_connection(const Config& cfg, int client_fd,
            "(value is always 1)\n"
         << "# TYPE llm_build_info gauge\n"
         << "llm_build_info{version=\"" << kLlmkVersion
-        << "\",jax=\"none\",backend=\"native-router\"} 1\n"
+        << "\",jax=\"none\",backend=\"native-router\",role=\"router\"} 1\n"
         << "# HELP llm_process_start_time_seconds Unix time this process "
            "started\n"
         << "# TYPE llm_process_start_time_seconds gauge\n"
@@ -2489,7 +2813,41 @@ static void handle_connection(const Config& cfg, int client_fd,
         << "llm_hedged_requests_total{outcome=\"primary_won\"} "
         << g_hedged_primary_won_total.load(std::memory_order_relaxed) << "\n"
         << "llm_hedged_requests_total{outcome=\"hedge_won\"} "
-        << g_hedged_hedge_won_total.load(std::memory_order_relaxed) << "\n";
+        << g_hedged_hedge_won_total.load(std::memory_order_relaxed) << "\n"
+        << "# HELP llm_handoff_total Disaggregated KV handoffs by outcome "
+           "(ok=first decode attempt adopted, retried=a later attempt, "
+           "reprefill=decode replica re-ingested the prompt, "
+           "fallback_colocated=served on a both-role replica)\n"
+        << "# TYPE llm_handoff_total counter\n"
+        << "llm_handoff_total{outcome=\"ok\"} "
+        << g_handoff_ok_total.load(std::memory_order_relaxed) << "\n"
+        << "llm_handoff_total{outcome=\"retried\"} "
+        << g_handoff_retried_total.load(std::memory_order_relaxed) << "\n"
+        << "llm_handoff_total{outcome=\"reprefill\"} "
+        << g_handoff_reprefill_total.load(std::memory_order_relaxed) << "\n"
+        << "llm_handoff_total{outcome=\"fallback_colocated\"} "
+        << g_handoff_fallback_total.load(std::memory_order_relaxed) << "\n";
+      {
+        // ticket issue -> decode stream head, cumulative buckets
+        m << "# HELP llm_handoff_seconds Prefill ticket to decode "
+             "first-byte latency of the two-hop handoff\n"
+          << "# TYPE llm_handoff_seconds histogram\n";
+        unsigned long long cum = 0;
+        for (int i = 0; i < 10; ++i) {
+          cum += g_handoff_bucket_hits[i].load(std::memory_order_relaxed);
+          m << "llm_handoff_seconds_bucket{le=\"" << kHandoffBuckets[i]
+            << "\"} " << cum << "\n";
+        }
+        cum += g_handoff_bucket_hits[10].load(std::memory_order_relaxed);
+        m << "llm_handoff_seconds_bucket{le=\"+Inf\"} " << cum << "\n";
+        double hsum;
+        {
+          std::lock_guard<std::mutex> lock(g_handoff_sum_mu);
+          hsum = g_handoff_seconds_sum;
+        }
+        m << "llm_handoff_seconds_sum " << hsum << "\n"
+          << "llm_handoff_seconds_count " << cum << "\n";
+      }
       {
         std::lock_guard<std::mutex> lock(g_stream_truncated_mu);
         m << "# HELP llm_stream_truncated_total Client-visible stream "
@@ -2549,11 +2907,23 @@ static void handle_connection(const Config& cfg, int client_fd,
         for (const Url& u : kv.second)
           m << "llm_replica_healthy{model=\"" << prom_escape(kv.first)
             << "\",replica=\""
-            << "http://" << u.host << ":" << u.port << "\"} "
+            << "http://" << u.host << ":" << u.port << "\",role=\""
+            << cfg.role_of(u) << "\"} "
             << (g_health.get(u.host, u.port)
                         .healthy.load(std::memory_order_relaxed)
                     ? 1
                     : 0)
+            << "\n";
+      m << "# HELP llm_router_breaker_open Per-replica circuit breaker "
+           "state (1=open or half-open, 0=closed)\n"
+        << "# TYPE llm_router_breaker_open gauge\n";
+      for (const auto& kv : cfg.models)
+        for (const Url& u : kv.second)
+          m << "llm_router_breaker_open{model=\"" << prom_escape(kv.first)
+            << "\",replica=\""
+            << "http://" << u.host << ":" << u.port << "\",role=\""
+            << cfg.role_of(u) << "\"} "
+            << (g_breakers.get(u.host, u.port).open_state() ? 1 : 0)
             << "\n";
       keep = send_all(client_fd,
                       simple_response(200, "OK",
@@ -2937,6 +3307,28 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   if (const Json* t = root->get("hedge_ms");
       t && t->type == Json::Type::Number)
     cfg.hedge_ms = std::max(0.0, t->number);
+  // "roles": {"http://host:port": "prefill"|"decode"} — disaggregated
+  // serving pools; URLs absent from the map serve both hops
+  if (const Json* roles = root->get("roles"); roles && roles->is_object()) {
+    for (const auto& kv : roles->obj) {
+      if (!kv.second->is_string()) return false;
+      const std::string& role = kv.second->str;
+      if (role != "prefill" && role != "decode" && role != "both") {
+        fprintf(stderr, "llkt-router: bad role %s for %s\n", role.c_str(),
+                kv.first.c_str());
+        return false;
+      }
+      auto url = parse_url(kv.first);
+      if (!url) {
+        fprintf(stderr, "llkt-router: bad roles url %s\n", kv.first.c_str());
+        return false;
+      }
+      if (role != "both") cfg.roles[{url->host, url->port}] = role;
+    }
+  }
+  if (const Json* t = root->get("handoff_retries");
+      t && t->type == Json::Type::Number)
+    cfg.handoff_retries = std::max(1, static_cast<int>(t->number));
   parse_qos_config(root->get("qos"), cfg.qos);
   return true;
 }
@@ -3041,6 +3433,9 @@ int main(int argc, char** argv) {
       0, static_cast<int>(env_double("LLMK_RESUME_ATTEMPTS",
                                      cfg.resume_attempts)));
   cfg.hedge_ms = std::max(0.0, env_double("LLMK_HEDGE_MS", cfg.hedge_ms));
+  cfg.handoff_retries = std::max(
+      1, static_cast<int>(env_double("LLMK_HANDOFF_RETRIES",
+                                     cfg.handoff_retries)));
   std::string config_file, models_inline, adapters_inline, qos_selftest_file;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];

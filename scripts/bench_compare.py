@@ -44,13 +44,16 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|cold_start|dropped_streams|spike_first_token"
                            r"|dispatches_per_token|host_share|resume_gap"
                            r"|visible_drops|gave_up|kv_bytes_per_token"
-                           r"|cache_misses|wasted_chip_fraction)")
+                           r"|cache_misses|wasted_chip_fraction"
+                           r"|disagg_decode_idle_frac|handoff_reprefill"
+                           r"|handoff_fallback)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"
                             r"|shed_noisy_fraction|min_tenant_completed"
                             r"|accept_ratio|spec_drafted_tokens"
-                            r"|max_streams_ratio"
+                            r"|max_streams_ratio|decode_tps_ratio"
+                            r"|handoff_ok"
                             r"|goodput_tokens_per_chip_s|^mfu$)")
 
 

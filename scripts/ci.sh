@@ -213,6 +213,41 @@ sys.exit(0 if doc.get("session_parity_ok") is True
     fails=$((fails + 1))
   fi
 
+  note "disagg smoke (prefill/decode split: handoff parity, 0 drops)"
+  # the smoke's disagg phase runs a prefill + decode + both(fallback)
+  # stack behind the two-hop router flow, a long-context flood, and the
+  # kill_prefill_replica/drop_handoff fault waves. Gates: greedy stream
+  # parity with colocated, zero client-visible drops under faults, each
+  # degraded path proven live (ok/reprefill/fallback all fired), decode
+  # tok/s under flood at colocated level, the decode pod's ledger idle
+  # fraction below the colocated baseline, and interactive TTFT p50
+  # bounded under the flood (the 1.2x p99 target is a TPU-pod number;
+  # on this GIL-shared CPU sandbox every stack inflates together, so
+  # the gate trips on head-of-line blocking, not scheduler noise)
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+ratio = doc.get("disagg_ttft_flood_ratio_p50")
+tps = doc.get("disagg_decode_tps_ratio")
+idle = doc.get("disagg_decode_idle_frac")
+base = doc.get("colocated_decode_idle_frac")
+sys.exit(0 if doc.get("disagg_parity_ok") is True
+         and doc.get("disagg_dropped_streams") == 0
+         and (doc.get("disagg_handoff_ok") or 0) >= 1
+         and (doc.get("disagg_handoff_reprefill") or 0) >= 1
+         and (doc.get("disagg_handoff_fallback") or 0) >= 1
+         and tps is not None and tps >= 0.5
+         and idle is not None and base is not None and idle < base
+         and ratio is not None and ratio <= 6.0 else 1)'; then
+    echo "ci: disagg smoke OK (parity, 0 drops, degraded paths live)"
+  else
+    echo "ci: disagg smoke FAILED (parity broken, dropped streams,"
+    echo "    a degraded handoff path never fired, decode tok/s or"
+    echo "    idle fraction regressed vs colocated, or interactive"
+    echo "    TTFT blew up under the long-context flood)"
+    fails=$((fails + 1))
+  fi
+
   note "goodput ledger smoke (chip-time conservation within 5%)"
   # the engine-phase ledger must conserve wall time: attributed (prefill
   # + decode) + wasted (spec tails, early exits) + idle device gaps

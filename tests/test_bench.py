@@ -171,9 +171,14 @@ class TestRetryAfter:
 
 
 class TestPartialEmission:
+    @pytest.mark.slow
     def test_cpu_bench_end_to_end_emits_json(self, tmp_path):
         """The tiny-model CPU bench must print a parseable JSON line with
-        the contract keys even in this sandboxed environment."""
+        the contract keys even in this sandboxed environment.
+
+        Marked slow: ~20 s of subprocess bench run whose emission contract
+        is covered more strictly by the --smoke test below (the CI gate);
+        this one additionally exercises only the default non-smoke path."""
         import json
         import os
         import subprocess
@@ -229,6 +234,15 @@ class TestPartialEmission:
         assert data["spec_parity_ok"] is True
         assert data["spec_accept_ratio"] > 0
         assert data["spec_dispatches_per_token"] < 0.286
+        # ISSUE 16: the disaggregated prefill/decode scenario — streams
+        # bit-identical to colocated, both fault waves absorbed with zero
+        # client-visible drops, and every handoff outcome accounted for
+        assert data["disagg_parity_ok"] is True
+        assert data["disagg_dropped_streams"] == 0
+        assert data["disagg_handoff_ok"] >= 1
+        assert data["disagg_handoff_reprefill"] >= 1
+        assert data["disagg_handoff_fallback"] >= 1
+        assert data["disagg_decode_idle_frac"] < data["colocated_decode_idle_frac"]
         repo = pathlib.Path(bench.__file__).resolve().parent
         binary = repo / "native" / "router" / "llkt-router"
         if binary.exists():

@@ -1,0 +1,407 @@
+"""Disaggregated prefill/decode serving (ISSUE 16).
+
+Two layers, both CPU-only and deterministic:
+
+- engine level: a prefill engine's spilled pages, exported via
+  ``host_kv_export`` and ingested into a decode engine's host tier,
+  must make the decode replica's greedy stream BIT-IDENTICAL to a
+  colocated run — at decode_steps 1 and 4, speculation on and off.
+  Adoption failures (wrong salt, corrupt payload, expired deadline)
+  degrade to a full re-prefill with exact allocator accounting, never
+  wrong tokens and never a crash.
+- router level: the Python router's two-hop flow (prefill ticket ->
+  decode adoption) against real OpenAIServer replicas over HTTP,
+  including the declined-ticket relay, the drop_handoff /
+  kill_prefill_replica fault hooks, and the fallback-to-colocated
+  ladder. The native router's equivalents live in
+  tests/test_native_router.py.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llms_on_kubernetes_tpu import faults
+from llms_on_kubernetes_tpu.engine.engine import (
+    Engine, EngineConfig, SamplingParams,
+)
+from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+from llms_on_kubernetes_tpu.server.router import Router
+
+PROMPT = list(range(1, 21)) + [30, 31, 32]
+TENANT = "tenant-a"
+
+
+def _mk(role="both", **kw):
+    base = dict(model="debug-tiny", dtype="float32", max_decode_slots=4,
+                page_size=8, num_pages=64, pages_per_slot=8,
+                prefill_buckets=(16, 32), async_scheduling=False,
+                prefix_caching=True, kv_host_cache_gb=0.5, role=role)
+    base.update(kw)
+    return Engine(EngineConfig(**base))
+
+
+def _run(eng, prompt, max_tokens=8, **submit_kw):
+    req = eng.submit(list(prompt),
+                     SamplingParams(temperature=0.0, max_tokens=max_tokens),
+                     **submit_kw)
+    steps = 0
+    while not req.finished:
+        eng.step()
+        steps += 1
+        assert steps < 10000
+    return req
+
+
+def _prefill_and_export(prompt, **eng_kw):
+    """Run the prefill half of a handoff: ingest ``prompt`` on a
+    prefill-role engine, return (digests, payloads) for the decode side
+    to adopt — what openai_api's ticket + /internal/kv/fetch carry."""
+    pre = _mk(role="prefill", **eng_kw)
+    _run(pre, prompt, max_tokens=1, tenant=TENANT, handoff=True)
+    digests = pre.handoff_digests(prompt)
+    assert digests, "full prompt pages must produce handoff digests"
+    payloads = pre.host_kv_export(TENANT, digests)
+    assert all(pl is not None for pl in payloads), \
+        "handoff=True must drain every full prompt page eagerly"
+    return digests, payloads
+
+
+# ---------------------------------------------------------------------------
+# engine-level greedy parity: colocated vs prefill-export/decode-adopt
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k,spec", [(1, None), (4, None), (4, "ngram")])
+def test_handoff_adoption_bit_identical(k, spec):
+    """The acceptance bar of the ISSUE: a decode replica that adopts a
+    prefill replica's handed-off pages emits EXACTLY the tokens the
+    colocated engine would — K=1 and K=4 fused windows, speculation on
+    and off."""
+    kw = dict(decode_steps=k, speculation=spec)
+    cold = _run(_mk(**kw), PROMPT, tenant=TENANT).output
+
+    digests, payloads = _prefill_and_export(PROMPT, **kw)
+    dec = _mk(role="decode", **kw)
+    for d, pl in zip(digests, payloads):
+        assert dec.host_kv_ingest(TENANT, d, pl)
+    hot = _run(dec, PROMPT, tenant=TENANT)
+    assert hot.output == cold
+    assert dec.host_kv.hits > 0, "adoption must come from the handed-off pages"
+    assert dec.kv_uploaded_tokens > 0
+
+
+def test_handoff_digest_salt_mismatch_reprefills():
+    """Pages ingested under a different digest salt never match the
+    decode replica's chain walk: the admission re-prefills from scratch
+    — same greedy stream, zero adoptions, no crash and no wrong bytes."""
+    cold = _run(_mk(), PROMPT, tenant=TENANT).output
+
+    pre = _mk(role="prefill")
+    _run(pre, PROMPT, max_tokens=1, tenant=TENANT, handoff=True)
+    wrong = pre.handoff_digests(PROMPT, salt=b"some-other-salt")
+    good = pre.handoff_digests(PROMPT)
+    payloads = pre.host_kv_export(TENANT, good)
+
+    dec = _mk(role="decode")
+    for d, pl in zip(wrong, payloads):
+        assert dec.host_kv_ingest(TENANT, d, pl)
+    hot = _run(dec, PROMPT, tenant=TENANT)
+    assert hot.output == cold
+    assert dec.host_kv.hits == 0            # nothing matched the salted chain
+
+
+def test_handoff_corrupt_payload_refused_at_ingest():
+    """A payload truncated in flight fails the shape check at ingest
+    (False, page treated as missing) — the decode replica re-prefills
+    and still produces the colocated stream."""
+    cold = _run(_mk(), PROMPT, tenant=TENANT).output
+    digests, payloads = _prefill_and_export(PROMPT)
+
+    dec = _mk(role="decode")
+    for d, pl in zip(digests, payloads):
+        bad = dict(pl)
+        bad["k"] = np.asarray(pl["k"]).ravel()[:3].copy()  # truncated
+        assert dec.host_kv_ingest(TENANT, d, bad) is False
+    assert len(dec.host_kv) == 0
+    hot = _run(dec, PROMPT, tenant=TENANT)
+    assert hot.output == cold
+    assert dec.host_kv.hits == 0
+
+
+def test_handoff_payload_corrupted_in_tier_stops_chain():
+    """Corruption that lands AFTER ingest (bit rot in the tier) is caught
+    by the adoption walk's shape re-check: the chain stops at the bad
+    page, the remainder re-prefills, the stream is still bit-identical."""
+    cold = _run(_mk(), PROMPT, tenant=TENANT).output
+    digests, payloads = _prefill_and_export(PROMPT)
+    assert len(digests) >= 2
+
+    dec = _mk(role="decode")
+    for d, pl in zip(digests, payloads):
+        assert dec.host_kv_ingest(TENANT, d, pl)
+    # rot the SECOND page in place: the walk must adopt page 1 only
+    entry = dec.host_kv._entries[(TENANT, digests[1])]
+    entry["k"] = np.zeros(3, entry["k"].dtype)
+    hot = _run(dec, PROMPT, tenant=TENANT)
+    assert hot.output == cold
+    assert 0 < dec.host_kv.hits < len(digests)
+
+
+def test_handoff_deadline_expiry_restores_page_accounting():
+    """A handoff whose deadline expires mid-flight (the decode replica
+    adopted pages but the admission shed on deadline) must restore the
+    allocator and host tier exactly: free-page count unchanged, and the
+    next request still serves the full bit-identical stream."""
+    cold = _run(_mk(), PROMPT, tenant=TENANT).output
+    digests, payloads = _prefill_and_export(PROMPT)
+
+    dec = _mk(role="decode")
+    for d, pl in zip(digests, payloads):
+        assert dec.host_kv_ingest(TENANT, d, pl)
+    def _idle_pages(a):
+        # free list + prefix-cache LRU: everything not pinned by a live slot
+        return len(a.free_pages) + len(a._lru)
+
+    free_before = _idle_pages(dec.allocator)
+    req = dec.submit(list(PROMPT),
+                     SamplingParams(temperature=0.0, max_tokens=8),
+                     tenant=TENANT, deadline=time.monotonic() - 0.1)
+    steps = 0
+    while not req.finished:
+        dec.step()
+        steps += 1
+        assert steps < 10000
+    assert req.finish_reason == "timeout"
+    assert _idle_pages(dec.allocator) == free_before, \
+        "expired handoff admission must return every page"
+    # the tier survives the shed: the NEXT request adopts and matches
+    hot = _run(dec, PROMPT, tenant=TENANT)
+    assert hot.output == cold
+
+
+# ---------------------------------------------------------------------------
+# router-level two-hop flow over real HTTP
+# ---------------------------------------------------------------------------
+
+def _mk_server(role="both", **kw):
+    return OpenAIServer(_mk(role=role, **kw), ByteTokenizer(), "m")
+
+
+def _chat_body(**over):
+    body = {"model": "m",
+            "messages": [{"role": "user", "content": "hello disagg world"}],
+            "max_tokens": 8, "temperature": 0, "stream": True}
+    body.update(over)
+    return body
+
+
+def _sse_content(text: str) -> str:
+    """Concatenated delta content of an SSE chat stream (ids/timestamps
+    vary per replica; the token bytes are the parity surface)."""
+    out = []
+    for line in text.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        doc = json.loads(line[len("data: "):])
+        for ch in doc.get("choices", ()):
+            out.append(ch.get("delta", {}).get("content") or "")
+    return "".join(out)
+
+
+class _Disagg:
+    """Prefill + decode OpenAIServer replicas behind a Router, plus a
+    colocated single-replica stack for the parity reference."""
+
+    def __init__(self, pre_kw=None, dec_kw=None, **router_kw):
+        self.pre_kw = pre_kw or {}
+        self.dec_kw = dec_kw or {}
+        self.router_kw = router_kw
+
+    async def __aenter__(self):
+        self.s_pre = _mk_server(role="prefill", **self.pre_kw)
+        self.s_dec = _mk_server(role="decode", **self.dec_kw)
+        self.c_pre = TestClient(TestServer(self.s_pre.make_app()))
+        self.c_dec = TestClient(TestServer(self.s_dec.make_app()))
+        await self.c_pre.start_server()
+        await self.c_dec.start_server()
+        self.u_pre = str(self.c_pre.make_url("")).rstrip("/")
+        self.u_dec = str(self.c_dec.make_url("")).rstrip("/")
+        self.router = Router(
+            {"m": [self.u_pre, self.u_dec]},
+            roles={self.u_pre: "prefill", self.u_dec: "decode"},
+            **self.router_kw)
+        self.client = TestClient(TestServer(self.router.make_app()))
+        await self.client.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        await self.c_pre.close()
+        await self.c_dec.close()
+
+
+async def _colocated_reference(body) -> str:
+    srv = _mk_server(role="both")
+    client = TestClient(TestServer(srv.make_app()))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/chat/completions", json=body)
+        assert resp.status == 200
+        return _sse_content(await resp.text())
+    finally:
+        await client.close()
+
+
+def test_router_two_hop_handoff_parity_and_metrics():
+    """Happy path end to end: ticket from the prefill replica, adoption
+    on the decode replica, client stream bit-identical to a colocated
+    serve; outcome=ok counted with one latency observation."""
+    async def go():
+        ref = await _colocated_reference(_chat_body())
+        assert ref
+        async with _Disagg() as d:
+            resp = await d.client.post("/v1/chat/completions",
+                                       json=_chat_body())
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            got = _sse_content(await resp.text())
+            assert got == ref
+            m = d.router.metrics["handoff"]
+            assert m.labeled_value(outcome="ok") == 1
+            assert m.labeled_value(outcome="fallback_colocated") == 0
+            assert m.labeled_value(outcome="reprefill") == 0
+            # the decode replica adopted real pages over /internal/kv/fetch
+            assert d.s_dec.engine.host_kv.hits > 0
+    asyncio.run(go())
+
+
+def test_router_handoff_nonstream_skips_two_hop():
+    """Non-streaming requests serve single-hop on the decode replica
+    (ordinary traffic is steered away from the prefill pool)."""
+    async def go():
+        async with _Disagg() as d:
+            resp = await d.client.post(
+                "/v1/chat/completions", json=_chat_body(stream=False))
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["choices"][0]["message"]["content"]
+            m = d.router.metrics["handoff"]
+            for oc in ("ok", "retried", "reprefill", "fallback_colocated"):
+                assert m.labeled_value(outcome=oc) == 0
+            # the prefill replica saw no traffic at all
+            assert d.s_pre.engine.host_kv.spilled_pages == 0
+    asyncio.run(go())
+
+
+def test_router_drop_handoff_fault_counts_reprefill(monkeypatch):
+    """LLMK_FAULT=drop_handoff: the decode replica pretends every
+    handed-off page is missing — the stream is still served and
+    bit-identical (full re-prefill), counted outcome=reprefill, never a
+    client-visible error."""
+    async def go():
+        ref = await _colocated_reference(_chat_body())
+        faults.reset_claims()
+        monkeypatch.setenv("LLMK_FAULT", "drop_handoff:1")
+        try:
+            async with _Disagg() as d:
+                resp = await d.client.post("/v1/chat/completions",
+                                           json=_chat_body())
+                assert resp.status == 200
+                assert _sse_content(await resp.text()) == ref
+                m = d.router.metrics["handoff"]
+                assert m.labeled_value(outcome="reprefill") == 1
+                assert m.labeled_value(outcome="ok") == 0
+                assert d.s_dec.engine.host_kv.hits == 0
+        finally:
+            monkeypatch.delenv("LLMK_FAULT")
+            faults.reset_claims()
+    asyncio.run(go())
+
+
+def test_router_kill_prefill_replica_falls_back_colocated(monkeypatch):
+    """LLMK_FAULT=kill_prefill_replica: the prefill replica dies
+    abruptly after startup; the streaming request is served anyway (the
+    decode replica runs it colocated) and counted fallback_colocated —
+    zero dropped streams."""
+    async def go():
+        ref = await _colocated_reference(_chat_body())
+        faults.reset_claims()
+        monkeypatch.setenv("LLMK_FAULT", "kill_prefill_replica:0.0")
+        try:
+            async with _Disagg() as d:
+                deadline = time.monotonic() + 10
+                while d.s_pre.state != "killed" \
+                        and time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                assert d.s_pre.state == "killed", \
+                    "kill_prefill_replica never fired"
+                resp = await d.client.post("/v1/chat/completions",
+                                           json=_chat_body())
+                assert resp.status == 200
+                assert _sse_content(await resp.text()) == ref
+                m = d.router.metrics["handoff"]
+                assert m.labeled_value(outcome="fallback_colocated") == 1
+                assert m.labeled_value(outcome="ok") == 0
+        finally:
+            monkeypatch.delenv("LLMK_FAULT")
+            faults.reset_claims()
+    asyncio.run(go())
+
+
+def test_router_handoff_declined_ticket_relays_stream():
+    """A prefill replica that declines the ticket (ineligible request
+    shape: n>1 is not handoff-eligible) streams the completion itself;
+    the router relays it without counting a handoff."""
+    async def go():
+        async with _Disagg() as d:
+            resp = await d.client.post(
+                "/v1/chat/completions", json=_chat_body(n=2))
+            assert resp.status == 200
+            text = await resp.text()
+            assert _sse_content(text)
+            m = d.router.metrics["handoff"]
+            for oc in ("ok", "retried", "reprefill", "fallback_colocated"):
+                assert m.labeled_value(outcome=oc) == 0
+    asyncio.run(go())
+
+
+def test_router_role_labels_and_per_role_health():
+    """Per-role observability: replica_healthy carries the configured
+    role, llm_build_info identifies each process's role, and the
+    replicas' own /metrics expose role-labeled queue depth for the
+    per-role autoscaling signals."""
+    async def go():
+        async with _Disagg() as d:
+            healthy = d.router.metrics["replica_healthy"]
+            assert healthy.labeled_value(
+                model="m", replica=d.u_pre, role="prefill") == 1
+            assert healthy.labeled_value(
+                model="m", replica=d.u_dec, role="decode") == 1
+            text = await (await d.client.get("/metrics")).text()
+            assert 'role="router"' in text
+            # drive one request through both hops so each engine loop has
+            # published its per-role gauges at least once
+            resp = await d.client.post("/v1/chat/completions",
+                                       json=_chat_body())
+            assert resp.status == 200
+            await resp.text()
+            pre_text, stop = "", time.monotonic() + 10
+            while ('llm_queue_depth{' not in pre_text
+                   and time.monotonic() < stop):
+                pre_text = await (await d.c_pre.get("/metrics")).text()
+                await asyncio.sleep(0.02)
+            dec_text = await (await d.c_dec.get("/metrics")).text()
+            assert 'role="prefill"' in pre_text
+            assert 'role="decode"' in dec_text
+            assert 'llm_queue_depth{model="m",role="prefill"}' in pre_text
+            # the router's cluster merge keeps the role labels intact
+            cluster = await (await d.client.get("/metrics/cluster")).text()
+            assert 'role="prefill"' in cluster
+            assert 'role="decode"' in cluster
+    asyncio.run(go())

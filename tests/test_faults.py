@@ -707,6 +707,28 @@ def test_fault_claim_is_one_shot_per_process(monkeypatch):
         faults.reset_claims()
 
 
+def test_fault_claim_n_spans_process(monkeypatch):
+    """claim_n() is the N-shot sibling: drop_handoff:3 drops exactly
+    three handoff ingests process-wide, however many replicas share the
+    env; a bare fault name uses the hook's default count."""
+    monkeypatch.setenv("LLMK_FAULT", "drop_handoff:3")
+    faults.reset_claims()
+    try:
+        assert [faults.claim_n("drop_handoff") for _ in range(5)] \
+            == [True, True, True, False, False]
+        faults.reset_claims()
+        assert faults.claim_n("drop_handoff") is True     # test isolation
+        # bare name: default_n governs
+        monkeypatch.setenv("LLMK_FAULT", "drop_handoff")
+        faults.reset_claims()
+        assert faults.claim_n("drop_handoff") is True
+        assert faults.claim_n("drop_handoff") is False
+        # inactive fault names never claim
+        assert faults.claim_n("kill_prefill_replica") is False
+    finally:
+        faults.reset_claims()
+
+
 @pytest.mark.e2e
 def test_slow_cold_start_delays_readiness(monkeypatch):
     """LLMK_FAULT=slow_cold_start:S holds startup for S seconds — the
@@ -827,9 +849,11 @@ def test_bench_backend_hang_emits_error_json():
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_dryrun_multichip_untouched_by_backend_hang():
     # the CPU-subprocess path must never initialize the default backend,
-    # so a wedged accelerator runtime cannot stall it (round-5 rc=124)
+    # so a wedged accelerator runtime cannot stall it (round-5 rc=124).
+    # slow: ~20 s, dominated by a cold jax import in the child process.
     env = dict(os.environ)
     env["LLMK_FAULT"] = "backend_hang"
     r = subprocess.run([sys.executable, "__graft_entry__.py", "2"],

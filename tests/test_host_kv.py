@@ -174,3 +174,52 @@ def test_trash_page_never_spilled_to_host():
 def test_multihost_and_no_prefix_caching_disable_host_tier():
     eng = _mk(prefix_caching=False)
     assert eng.host_kv is None
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: handoff addressing — the prefill half of disaggregated serving
+# (adoption-failure edges live in tests/test_disagg.py)
+# ---------------------------------------------------------------------------
+
+def test_handoff_digests_full_pages_only_and_salted():
+    """handoff_digests addresses exactly the FULL pages of a prompt with
+    the same chained digests the spill path published, and a different
+    salt produces a disjoint chain (the wrong-cluster guard)."""
+    eng = _mk()
+    digests = eng.handoff_digests(PROMPT)
+    assert len(digests) == len(PROMPT) // 8  # page_size=8, partial excluded
+    assert eng.handoff_digests(PROMPT[:7]) == []     # no full page yet
+    salted = eng.handoff_digests(PROMPT, salt=b"other-cluster")
+    assert len(salted) == len(digests)
+    assert not set(salted) & set(digests)
+    # chaining: a one-token prefix change reshuffles EVERY digest
+    bent = eng.handoff_digests([99] + PROMPT[1:])
+    assert not set(bent) & set(digests)
+
+
+def test_handoff_submit_drains_spills_eagerly_for_export():
+    """submit(handoff=True) on a prefill-role engine drains the spilled
+    pages to the host tier at finish — host_kv_export must serve every
+    full prompt page immediately, with no _drain_spills() nudge, so the
+    decode replica's pull never races the spill queue."""
+    eng = _mk(role="prefill")
+    req = eng.submit(list(PROMPT),
+                     SamplingParams(temperature=0.0, max_tokens=1),
+                     tenant="t", handoff=True)
+    steps = 0
+    while not req.finished:
+        eng.step()
+        steps += 1
+        assert steps < 10000
+    digests = eng.handoff_digests(PROMPT)
+    payloads = eng.host_kv_export("t", digests)
+    assert payloads and all(pl is not None for pl in payloads)
+    # a digest the tier never saw answers None, positionally
+    miss = eng.host_kv_export("t", digests + [b"\x00" * 16])
+    assert miss[:-1] == payloads and miss[-1] is None
+    # wrong tenant: the tier is namespaced, nothing leaks across
+    assert eng.host_kv_export("other", digests) == [None] * len(digests)
+    # tier off: export degrades to all-None instead of raising
+    off = _mk(kv_host_cache_gb=0)
+    assert off.host_kv is None
+    assert off.host_kv_export("t", digests) == [None] * len(digests)

@@ -719,3 +719,151 @@ qos:
         load_spec(BASE_YAML + "\nqos: {tenants: {t: {weight: 0}}}\n")
     with pytest.raises(SpecError):
         load_spec(BASE_YAML + "\nqos: {brownout: {queue_depth_hi: -1}}\n")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: disaggregated prefill/decode roles
+# ---------------------------------------------------------------------------
+
+def _disagg_yaml(pre_scale="{minReplicas: 1, maxReplicas: 4}",
+                 dec_scale="{minReplicas: 1, maxReplicas: 8}"):
+    return f"""
+models:
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    pvcShared: true
+    tpu: {{accelerator: v5e, chips: 8}}
+    role: prefill
+    kvHostCacheGB: 16
+    autoscaling: {pre_scale}
+  - modelName: llama-3-8b
+    huggingfaceId: meta-llama/Meta-Llama-3-8B-Instruct
+    pvcShared: true
+    tpu: {{accelerator: v5e, chips: 8}}
+    role: decode
+    autoscaling: {dec_scale}
+router: {{handoffRetries: 3}}
+"""
+
+
+def test_disagg_roles_render_paired_deployments():
+    """A prefill/decode pair sharing one modelName renders role-suffixed
+    Deployments/Services/PVCs, threads LLMK_ROLE to the engines, and the
+    router config merges both pools under the one model with a roles map
+    steering the two-hop flow."""
+    spec = load_spec(_disagg_yaml())
+    ms = render_manifests(spec)
+    for role in ("prefill", "decode"):
+        dep = by_name(ms, "Deployment", f"model-llama-3-8b-{role}")
+        env = {e["name"]: e.get("value") for e in
+               dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["LLMK_ROLE"] == role
+        by_name(ms, "Service", f"model-llama-3-8b-{role}")
+        by_name(ms, "Service", f"model-llama-3-8b-{role}-replicas")
+        if role == "prefill":  # the handoff's spill target
+            assert float(env["LLMK_KV_HOST_CACHE_GB"]) == 16.0
+
+    cfg = router_config(spec)
+    urls = cfg["backends"]["llama-3-8b"]
+    assert len(urls) == 2 and len(set(urls)) == 2
+    assert cfg["roles"] == {
+        u: ("prefill" if "-prefill-" in u else "decode") for u in urls}
+    assert cfg["handoff_retries"] == 3
+    # colocated specs stay byte-for-byte free of the new keys (parity
+    # with the pre-disagg router.json contract)
+    colo = router_config(load_spec(BASE_YAML))
+    assert "roles" not in colo and "handoff_retries" not in colo
+
+
+def test_disagg_autoscaler_signals_split_per_role():
+    """Each pool scales on the signal it actually bounds: prefill on its
+    own role's queue depth only, decode on TTFT attainment only; a
+    colocated model keeps both metrics."""
+    ms = render_manifests(load_spec(_disagg_yaml()))
+    pre = by_name(ms, "HorizontalPodAutoscaler", "model-llama-3-8b-prefill")
+    dec = by_name(ms, "HorizontalPodAutoscaler", "model-llama-3-8b-decode")
+    (pm,) = pre["spec"]["metrics"]
+    assert pm["pods"]["metric"]["name"] == "llm_queue_depth"
+    (dm,) = dec["spec"]["metrics"]
+    assert dm["object"]["metric"]["name"] == "llm_slo_ttft_miss_ratio"
+
+    # KEDA scale-to-zero path: the prefill queue query selects its own
+    # role's series so the decode pool's depth can't mask a ticket backlog
+    ms0 = render_manifests(load_spec(_disagg_yaml(
+        pre_scale="{minReplicas: 0, maxReplicas: 4}",
+        dec_scale="{minReplicas: 0, maxReplicas: 8}")))
+    pre0 = by_name(ms0, "ScaledObject", "model-llama-3-8b-prefill")
+    (pt,) = pre0["spec"]["triggers"]
+    assert 'role="prefill"' in pt["metadata"]["query"]
+    dec0 = by_name(ms0, "ScaledObject", "model-llama-3-8b-decode")
+    (dt,) = dec0["spec"]["triggers"]
+    assert dt["metadata"]["metricName"] == "llm_slo_ttft_miss_ratio"
+
+
+def test_disagg_spec_validation():
+    base = """
+models:
+  - modelName: m
+    huggingfaceId: org/m
+    pvcShared: true
+"""
+    # roles ride the coordinator-local host tier: multi-host slices reject
+    with pytest.raises(SpecError, match="multi-host"):
+        load_spec(base + "    tpu: {accelerator: v5p, chips: 16}\n"
+                         "    role: decode\n")
+    # a prefill pool with no host tier has nowhere to spill the handoff
+    with pytest.raises(SpecError, match="kvHostCacheGB"):
+        load_spec(base + "    role: prefill\n")
+    with pytest.raises(SpecError, match="role"):
+        load_spec(base + "    role: ingest\n")
+    # shared modelName is legal ONLY as an exact {prefill, decode} pair
+    dup = """
+models:
+  - {modelName: m, huggingfaceId: org/m, pvcShared: true, role: %s%s}
+  - {modelName: m, huggingfaceId: org/m, pvcShared: true, role: %s}
+"""
+    with pytest.raises(SpecError, match="prefill \\+ decode"):
+        load_spec(dup % ("decode", "", "decode"))
+    with pytest.raises(SpecError, match="prefill \\+ decode"):
+        load_spec(dup % ("both", "", "both"))
+    with pytest.raises(SpecError):
+        load_spec(dup % ("prefill", ", kvHostCacheGB: 8", "decode")
+                  + "  - {modelName: m, huggingfaceId: org/m, "
+                    "pvcShared: true, role: both}\n")
+    with pytest.raises(SpecError, match="handoffRetries"):
+        load_spec(base + "\nrouter: {handoffRetries: -1}\n")
+
+
+def test_values_schema_role_and_handoff_parity():
+    """Both charts expose the same disagg contract: models[].role with
+    the same enum, router.handoffRetries — and a disaggregated values
+    doc validates end to end (schema drift between the charts and the
+    Python renderer is the failure mode this pins)."""
+    import copy
+    import json
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    for chart in ("tpu-models", "local-models"):
+        cdir = root / chart / "helm-chart"
+        schema = json.loads((cdir / "values.schema.json").read_text())
+        mprops = schema["properties"]["models"]["items"]["properties"]
+        assert mprops["role"]["enum"] == ["prefill", "decode", "both"]
+        rprops = schema["properties"]["router"]["properties"]
+        assert rprops["handoffRetries"]["type"] == "integer"
+
+        values = yaml.safe_load((cdir / "values.yaml").read_text())
+        good = copy.deepcopy(values)
+        pre = copy.deepcopy(good["models"][0])
+        dec = copy.deepcopy(good["models"][0])
+        pre.update(role="prefill", kvHostCacheGB=8)
+        dec.update(role="decode")
+        good["models"] = [pre, dec]
+        good.setdefault("router", {})["handoffRetries"] = 3
+        jsonschema.validate(good, schema)
+
+        bad = copy.deepcopy(good)
+        bad["models"][0]["role"] = "ingest"
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)

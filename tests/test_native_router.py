@@ -714,9 +714,11 @@ def test_native_replica_failover_zero_5xx(binary):
         text = _metrics(router)
         assert _metric_value(text, "llm_failover_total") >= 1
         assert (f'llm_replica_healthy{{model="m",'
-                f'replica="http://127.0.0.1:{dead_port}"}}') in text
+                f'replica="http://127.0.0.1:{dead_port}",role="both"}}'
+                ) in text
         assert (f'llm_replica_healthy{{model="m",'
-                f'replica="http://127.0.0.1:{live_port}"}}') in text
+                f'replica="http://127.0.0.1:{live_port}",role="both"}}'
+                ) in text
     finally:
         router.stop()
         srv.shutdown()
@@ -747,7 +749,7 @@ def test_native_probe_ejects_and_readmits(binary):
     u2 = f"http://127.0.0.1:{srv2.server_address[1]}"
     router = RouterProc(binary, {"m": f"{u1}|{u2}"},
                         extra_args=("--probe-interval", "0.1"))
-    gauge1 = f'llm_replica_healthy{{model="m",replica="{u1}"}}'
+    gauge1 = f'llm_replica_healthy{{model="m",replica="{u1}",role="both"}}'
 
     def wait_gauge(value: float):
         deadline = time.monotonic() + 5
@@ -1690,3 +1692,317 @@ def test_native_qos_token_budget_rate_limit(binary, tmp_path):
         proc.terminate()
         proc.wait(timeout=5)
         backend.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode: the two-hop KV handoff
+# ---------------------------------------------------------------------------
+
+
+class HandoffBackend(http.server.BaseHTTPRequestHandler):
+    """Role-aware fake replica for the two-hop handoff flow.
+
+    A ``prefill`` instance answers ``X-LLMK-Handoff: ticket`` requests with
+    a JSON handoff ticket (the ``X-LLMK-Handoff-Ticket: 1`` marker header);
+    every other completion request streams SSE — with an
+    ``X-LLMK-Handoff-Adopted`` header when ``adopted`` is set, so the
+    router's outcome accounting (ok vs reprefill) is steerable per test.
+    Request headers are recorded in the class-level ``seen`` list.
+    """
+
+    server_version = "HandoffBackend/1"
+    protocol_version = "HTTP/1.1"
+    name = "backend"
+    role = "both"
+    adopted = None
+    decline = False
+    seen = None
+
+    def log_message(self, *a):  # noqa: N802
+        pass
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            body = {}
+        if self.seen is not None:
+            self.seen.append({k.lower(): v for k, v in self.headers.items()})
+        if (self.role == "prefill" and not self.decline
+                and self.headers.get("X-LLMK-Handoff") == "ticket"):
+            ticket = json.dumps({
+                "object": "llmk.handoff_ticket",
+                "model": body.get("model"),
+                "prompt_tokens": 3,
+                "tenant": "tenant-a",
+                "seed": 7,
+                "digests": ["aabb", "ccdd"],
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(ticket)))
+            self.send_header("X-LLMK-Handoff-Ticket", "1")
+            self.end_headers()
+            self.wfile.write(ticket)
+            return
+        if not body.get("stream"):
+            payload = json.dumps({"served_by": self.name}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Transfer-Encoding", "chunked")
+        if self.adopted is not None:
+            self.send_header("X-LLMK-Handoff-Adopted", str(self.adopted))
+        self.end_headers()
+        for part in (f"data: {self.name}-tok\n\n", "data: [DONE]\n\n"):
+            data = part.encode()
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            self.wfile.flush()
+        self.wfile.write(b"0\r\n\r\n")
+
+
+def start_handoff_backend(name, role="both", adopted=None, decline=False):
+    seen = []
+    handler = type(f"Handoff_{name}", (HandoffBackend,), {
+        "name": name, "role": role, "adopted": adopted,
+        "decline": decline, "seen": seen})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, seen
+
+
+def _start_disagg_router(binary, tmp_path, urls, roles, retries=2):
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {"m": urls},
+        "roles": roles,
+        "handoff_retries": retries,
+        "default_model": "m",
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+            conn.request("GET", "/health")
+            if conn.getresponse().read() == b"OK":
+                conn.close()
+                return proc, port
+        except OSError:
+            time.sleep(0.02)
+    proc.terminate()
+    raise RuntimeError("disagg router did not come up")
+
+
+def _disagg_post(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps(body).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _disagg_metrics(port) -> str:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    return text
+
+
+def test_native_handoff_two_hop(binary, tmp_path):
+    """Happy path: the router fetches a ticket from the prefill replica,
+    re-issues the original request to the decode replica with the handoff
+    source/digests/tenant/seed headers, relays the decode stream, and
+    counts outcome=ok plus one llm_handoff_seconds observation."""
+    psrv, pseen = start_handoff_backend("pre", role="prefill")
+    dsrv, dseen = start_handoff_backend("dec", role="decode", adopted=2)
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl], {purl: "prefill", durl: "decode"})
+    try:
+        status, data = _disagg_post(port, {"model": "m", "stream": True})
+        assert status == 200
+        assert b"dec-tok" in data
+        assert len(pseen) == 1 and len(dseen) == 1
+        assert pseen[0].get("x-llmk-handoff") == "ticket"
+        assert dseen[0].get("x-llmk-handoff-source") == purl
+        assert dseen[0].get("x-llmk-handoff-digests") == "aabb,ccdd"
+        assert dseen[0].get("x-llmk-handoff-tenant") == "tenant-a"
+        assert dseen[0].get("x-llmk-handoff-seed") == "7"
+        assert "x-llmk-handoff" not in dseen[0]
+        text = _disagg_metrics(port)
+        assert 'llm_handoff_total{outcome="ok"} 1' in text
+        assert 'llm_handoff_total{outcome="reprefill"} 0' in text
+        assert 'llm_handoff_total{outcome="fallback_colocated"} 0' in text
+        assert _metric_value(text, "llm_handoff_seconds_count") == 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        dsrv.shutdown()
+
+
+def test_native_handoff_nonstream_prefers_decode(binary, tmp_path):
+    """Non-streaming requests never enter the handoff flow and are routed
+    away from the prefill pool (prefill replicas only ingest prompts)."""
+    psrv, pseen = start_handoff_backend("pre", role="prefill")
+    dsrv, _ = start_handoff_backend("dec", role="decode")
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl], {purl: "prefill", durl: "decode"})
+    try:
+        for _ in range(4):
+            status, data = _disagg_post(port, {"model": "m"})
+            assert status == 200
+            assert json.loads(data)["served_by"] == "dec"
+        assert pseen == []
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        dsrv.shutdown()
+
+
+def test_native_handoff_adoption_miss_counts_reprefill(binary, tmp_path):
+    """Digests offered but the decode replica adopted nothing (evicted or
+    digest mismatch): the stream is still served — degraded, counted as
+    outcome=reprefill, never a client error."""
+    psrv, _ = start_handoff_backend("pre", role="prefill")
+    dsrv, _ = start_handoff_backend("dec", role="decode", adopted=0)
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl], {purl: "prefill", durl: "decode"})
+    try:
+        status, data = _disagg_post(port, {"model": "m", "stream": True})
+        assert status == 200
+        assert b"dec-tok" in data
+        text = _disagg_metrics(port)
+        assert 'llm_handoff_total{outcome="reprefill"} 1' in text
+        assert 'llm_handoff_total{outcome="ok"} 0' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        dsrv.shutdown()
+
+
+def test_native_handoff_prefill_down_falls_back_colocated(binary, tmp_path):
+    """Prefill pool unreachable: no ticket, the request is served by a
+    non-prefill replica and counted fallback_colocated — the client sees
+    a normal stream, zero 5xx."""
+    dead_port = free_port()
+    dsrv, _ = start_handoff_backend("dec", role="decode")
+    bsrv, _ = start_handoff_backend("colo", role="both")
+    purl = f"http://127.0.0.1:{dead_port}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    burl = f"http://127.0.0.1:{bsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl, burl],
+        {purl: "prefill", durl: "decode"})
+    try:
+        status, data = _disagg_post(port, {"model": "m", "stream": True})
+        assert status == 200
+        assert b"-tok" in data  # dec or colo — either non-prefill works
+        text = _disagg_metrics(port)
+        assert 'llm_handoff_total{outcome="fallback_colocated"} 1' in text
+        assert 'llm_handoff_total{outcome="ok"} 0' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        dsrv.shutdown()
+        bsrv.shutdown()
+
+
+def test_native_handoff_decode_down_falls_back_colocated(binary, tmp_path):
+    """Ticket issued but every decode replica is dead: the decode hop
+    exhausts its retries writing NOTHING to the client, then the both-role
+    replica serves the stream (fallback_colocated)."""
+    dead_port = free_port()
+    psrv, pseen = start_handoff_backend("pre", role="prefill")
+    bsrv, _ = start_handoff_backend("colo", role="both")
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dead_port}"
+    burl = f"http://127.0.0.1:{bsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl, burl],
+        {purl: "prefill", durl: "decode"})
+    try:
+        status, data = _disagg_post(port, {"model": "m", "stream": True})
+        assert status == 200
+        assert b"colo-tok" in data
+        assert len(pseen) >= 1  # the ticket WAS issued before the fallback
+        text = _disagg_metrics(port)
+        assert 'llm_handoff_total{outcome="fallback_colocated"} 1' in text
+        assert 'llm_handoff_total{outcome="ok"} 0' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        bsrv.shutdown()
+
+
+def test_native_handoff_declined_ticket_relays_directly(binary, tmp_path):
+    """A prefill-capable replica that declines the ticket (answers the
+    completion as a normal SSE stream) is relayed as-is: no handoff is
+    counted and the decode pool is never touched."""
+    psrv, _ = start_handoff_backend("pre", role="prefill", decline=True)
+    dsrv, dseen = start_handoff_backend("dec", role="decode")
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl], {purl: "prefill", durl: "decode"})
+    try:
+        status, data = _disagg_post(port, {"model": "m", "stream": True})
+        assert status == 200
+        assert b"pre-tok" in data
+        assert dseen == []
+        text = _disagg_metrics(port)
+        assert 'llm_handoff_total{outcome="ok"} 0' in text
+        assert 'llm_handoff_total{outcome="fallback_colocated"} 0' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        dsrv.shutdown()
+
+
+def test_native_handoff_role_labels_on_metrics(binary, tmp_path):
+    """Per-replica gauges carry the configured role label; llm_build_info
+    identifies the router with role=router."""
+    psrv, _ = start_handoff_backend("pre", role="prefill")
+    dsrv, _ = start_handoff_backend("dec", role="decode")
+    purl = f"http://127.0.0.1:{psrv.server_address[1]}"
+    durl = f"http://127.0.0.1:{dsrv.server_address[1]}"
+    proc, port = _start_disagg_router(
+        binary, tmp_path, [purl, durl], {purl: "prefill", durl: "decode"})
+    try:
+        text = _disagg_metrics(port)
+        assert 'role="router"' in text.split("llm_build_info{", 1)[1]
+        assert (f'llm_replica_healthy{{model="m",replica="{purl}",'
+                f'role="prefill"}}') in text
+        assert (f'llm_replica_healthy{{model="m",replica="{durl}",'
+                f'role="decode"}}') in text
+        assert (f'llm_router_breaker_open{{model="m",replica="{purl}",'
+                f'role="prefill"}} 0') in text
+        assert (f'llm_router_breaker_open{{model="m",replica="{durl}",'
+                f'role="decode"}} 0') in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        psrv.shutdown()
+        dsrv.shutdown()

@@ -199,12 +199,12 @@ def test_active_probe_ejects_and_readmits():
         await client.start_server()
         try:
             await router.probe_all()
-            assert healthy.labeled_value(model="m", replica=u1) == 1
-            assert healthy.labeled_value(model="m", replica=u2) == 1  # 404 ok
+            assert healthy.labeled_value(model="m", replica=u1, role="both") == 1
+            assert healthy.labeled_value(model="m", replica=u2, role="both") == 1  # 404 ok
 
             flap["status"] = 503           # draining: eject
             await router.probe_all()
-            assert healthy.labeled_value(model="m", replica=u1) == 0
+            assert healthy.labeled_value(model="m", replica=u1, role="both") == 0
             for _ in range(8):             # all traffic avoids the ejected one
                 r = await client.post("/v1/chat/completions",
                                       json={"model": "m"})
@@ -213,7 +213,7 @@ def test_active_probe_ejects_and_readmits():
 
             flap["status"] = 200           # recovered: re-admit
             await router.probe_all()
-            assert healthy.labeled_value(model="m", replica=u1) == 1
+            assert healthy.labeled_value(model="m", replica=u1, role="both") == 1
             seen = set()
             for _ in range(40):
                 r = await client.post("/v1/chat/completions",
