@@ -1132,6 +1132,326 @@ def resume_bench() -> dict:
     }
 
 
+def chaos_bench() -> dict:
+    """Gray-failure drill (ISSUE 17): latency-outlier ejection + cluster
+    retry budget, end to end through the python router.
+
+    Three identically-seeded debug-tiny replicas serve behind the router
+    with the outlier detector and the per-model retry budget armed. A
+    baseline wave establishes per-replica TTFT EWMAs, then the
+    ``degraded_replica:8`` fault lands on exactly one replica: it keeps
+    answering health probes (a probe-based ejector would never fire) but
+    decodes at 1/8 speed. The detector must quarantine it from in-band
+    TTFT alone within the drill window; after ejection the p95 TTFT of
+    the surviving pool must return to <= 1.5x the no-fault baseline, the
+    max-ejection-fraction guard must have held (exactly one of three
+    quarantined, pool never emptied), and every stream in every phase
+    must complete (``chaos_dropped_streams`` is a hard 0).
+
+    A second model whose two "replicas" accept-and-close every
+    connection then drives a retry wave: connect failovers draw from the
+    model's token bucket (ratio/min_per_s are 0 so the arithmetic is
+    exact) and once it empties the router must shed with
+    ``code=retry_budget_exhausted`` instead of retrying — the connection
+    count at the fake upstreams proves total retry volume never exceeded
+    the budget.
+
+    Tiny-CPU-sized like the spike/resume phases: the scenario measures
+    the detection/quarantine/budget control loop, not the model.
+    """
+    import http.client
+    import json as _json
+    import re as _re
+    import socket
+    import threading
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu import faults
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    model = "debug-tiny"
+    dead_model = "deadpool"
+    cfg = get_config(model)
+    ecfg = EngineConfig(model=model, dtype="float32", max_decode_slots=8,
+                        page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
+                        prefill_buckets=(32,))
+
+    n_replicas = 3
+    retry_burst = 4.0
+    # fast-drill detector tuning: high alpha so the victim's EWMA tracks
+    # its degraded TTFT within a couple of observations, a generous
+    # shadow period + readmit bar so it STAYS quarantined while the
+    # post-ejection p95 is measured, and the default 1/3 ejection guard
+    outlier_cfg = {
+        "ewma_alpha": 0.6, "z_threshold": 3.0, "min_samples": 3,
+        "streak": 2, "max_eject_fraction": 0.34, "shadow_every": 64,
+        "readmit_successes": 99,
+    }
+    budget_cfg = {"ratio": 0.0, "min_per_s": 0.0, "burst": retry_burst}
+
+    # the "dead" pool: listeners that complete the TCP handshake, count
+    # the connection, and slam it shut — every request/retry against them
+    # is a retryable transport error, and the accept count is the ground
+    # truth for how many attempts the router actually dispatched
+    dead_attempts = [0]
+    dead_socks: list = []
+    dead_urls: list = []
+    dead_stop = threading.Event()
+    for _ in range(2):
+        ls = socket.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(32)
+        dead_socks.append(ls)
+        dead_urls.append(f"http://127.0.0.1:{ls.getsockname()[1]}")
+
+        def drain(sock=ls):
+            while not dead_stop.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return
+                dead_attempts[0] += 1
+                conn.close()
+
+        threading.Thread(target=drain, daemon=True).start()
+
+    ports: dict = {}
+    ready = threading.Event()
+    stop_holder: dict = {}
+
+    def run_stack():
+        import asyncio
+
+        async def main_async():
+            stop = asyncio.Event()
+            stop_holder["stop"] = stop
+            stop_holder["loop"] = asyncio.get_running_loop()
+            runners = []
+            replica_urls = []
+            for _ in range(n_replicas):
+                srv = OpenAIServer(build_engine(ecfg, cfg), ByteTokenizer(),
+                                   model)
+                runner = web.AppRunner(srv.make_app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                runners.append(runner)
+                replica_urls.append(
+                    f"http://127.0.0.1:{runner.addresses[0][1]}")
+            # no active prober: the whole point is that the victim stays
+            # probe-green, and the dead pool must stay "healthy" so the
+            # budget arithmetic (not probe ejection) bounds its retries
+            router = Router({model: replica_urls, dead_model: dead_urls},
+                            default_model=model, strict=False,
+                            retry_backoff_s=0.02, breaker_threshold=1000,
+                            outlier_ejection=outlier_cfg,
+                            retry_budget=budget_cfg)
+            r_runner = web.AppRunner(router.make_app())
+            await r_runner.setup()
+            r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
+            await r_site.start()
+            runners.append(r_runner)
+            ports["router"] = r_runner.addresses[0][1]
+            ready.set()
+            await stop.wait()
+            for r in runners:
+                await r.cleanup()
+
+        asyncio.new_event_loop().run_until_complete(main_async())
+
+    rt = threading.Thread(target=run_stack, daemon=True)
+    rt.start()
+    if not ready.wait(timeout=180):
+        raise RuntimeError("chaos bench: stack failed to start")
+    rport = ports["router"]
+
+    def get_json(path: str) -> dict:
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=10)
+        conn.request("GET", path)
+        doc = _json.loads(conn.getresponse().read())
+        conn.close()
+        return doc
+
+    def scrape_metric(pattern: str) -> float:
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=10)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        m = _re.search(pattern, text)
+        return float(m.group(1)) if m else 0.0
+
+    stream_body = _json.dumps({
+        "model": model, "prompt": [1, 2, 3, 4, 5, 6, 7, 8],
+        "max_tokens": 12, "temperature": 0.0, "stream": True,
+    })
+    drops = [0]
+
+    def stream_client(i, ttfts):
+        t_send = time.monotonic()
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+        try:
+            conn.request("POST", "/v1/completions", stream_body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                drops[0] += 1
+                resp.read()
+                return
+            first = None
+            chunks = []
+            while True:
+                piece = resp.read1(65536)
+                if not piece:
+                    break
+                if first is None:
+                    first = time.monotonic()
+                chunks.append(piece)
+            if first is None or b"data: [DONE]" not in b"".join(chunks):
+                drops[0] += 1
+                return
+            ttfts[i] = (first - t_send) * 1000.0
+        except OSError:
+            drops[0] += 1
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def wave(n: int) -> list:
+        ttfts: list = [None] * n
+        threads = [threading.Thread(target=stream_client, args=(i, ttfts),
+                                    daemon=True) for i in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        return [t for t in ttfts if t is not None]
+
+    def p95(vals: list) -> float | None:
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(len(vals) * 0.95))], 1)
+
+    def quarantined_replicas() -> list:
+        doc = get_json("/debug/replicas")
+        return [r for r in doc["models"][model]["replicas"]
+                if (r.get("outlier") or {}).get("quarantined")]
+
+    prev_fault = os.environ.get("LLMK_FAULT")
+    detection_s = None
+    victim_reason = None
+    post_ttfts: list = []
+    guard_ok = False
+    try:
+        # warmup (uncounted): first-touch compiles land on all replicas
+        # at once, so no replica looks like an outlier to the others
+        for _ in range(2):
+            wave(n_replicas)
+        baseline_ttfts: list = []
+        for _ in range(4):
+            baseline_ttfts.extend(wave(n_replicas))
+
+        # fault lands: exactly ONE replica claims degraded_replica and
+        # starts pacing its streams 8x slower, probes still green
+        faults.reset_claims()
+        os.environ["LLMK_FAULT"] = "degraded_replica:8"
+        t_fault = time.monotonic()
+        for _ in range(15):
+            wave(n_replicas)
+            q = quarantined_replicas()
+            if q:
+                detection_s = round(time.monotonic() - t_fault, 2)
+                victim_reason = q[0]["outlier"].get("reason")
+                break
+            time.sleep(0.05)
+
+        # guard: exactly one of three quarantined, two still serving
+        q = quarantined_replicas()
+        doc = get_json("/debug/replicas")
+        serving = [r for r in doc["models"][model]["replicas"]
+                   if not (r.get("outlier") or {}).get("quarantined")]
+        guard_ok = len(q) == 1 and len(serving) == n_replicas - 1
+
+        # post-ejection: the surviving pool's p95 must be back at
+        # baseline level (waves sized to the 2-replica pool so both
+        # phases measure equal per-replica concurrency)
+        if detection_s is not None:
+            for _ in range(6):
+                post_ttfts.extend(wave(n_replicas - 1))
+    finally:
+        if prev_fault is None:
+            os.environ.pop("LLMK_FAULT", None)
+        else:
+            os.environ["LLMK_FAULT"] = prev_fault
+        faults.reset_claims()
+
+    base_p95 = p95(baseline_ttfts)
+    post_p95 = p95(post_ttfts)
+    ratio = (round(post_p95 / base_p95, 3)
+             if base_p95 and post_p95 is not None else None)
+
+    # --- retry wave against the dead pool: with ratio/min_per_s at 0
+    # the budget is exactly `burst` tokens, so total dispatched attempts
+    # minus primaries can never exceed it, and once it empties every
+    # request sheds with the distinct 503 body instead of retrying
+    dead_body = _json.dumps({"model": dead_model, "prompt": [1, 2, 3],
+                             "max_tokens": 4})
+    n_dead = 12
+    primaries = 0
+    exhausted_sheds = 0
+    for _ in range(n_dead):
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=30)
+        try:
+            conn.request("POST", "/v1/completions", dead_body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            primaries += 1
+            if resp.status == 503 and b"retry_budget_exhausted" in payload:
+                exhausted_sheds += 1
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    retry_volume = dead_attempts[0] - primaries
+    budget_total = scrape_metric(
+        r"llm_retry_budget_exhausted_total ([0-9.e+-]+)")
+
+    dead_stop.set()
+    for ls in dead_socks:
+        try:
+            ls.close()
+        except OSError:
+            pass
+    if "stop" in stop_holder:
+        stop_holder["loop"].call_soon_threadsafe(stop_holder["stop"].set)
+    rt.join(timeout=30)
+
+    return {
+        "chaos_dropped_streams": drops[0],
+        "chaos_quarantined_ok": detection_s is not None,
+        "chaos_detection_s": detection_s,
+        "chaos_victim_reason": victim_reason,
+        "chaos_guard_ok": guard_ok,
+        "chaos_baseline_p95_ttft_ms": base_p95,
+        "chaos_post_eject_p95_ttft_ms": post_p95,
+        "chaos_p95_ttft_ratio": ratio,
+        "chaos_retry_volume": retry_volume,
+        "chaos_retry_budget": retry_burst,
+        "chaos_retry_volume_ok": 0 <= retry_volume <= retry_burst,
+        "chaos_budget_exhausted_sheds": exhausted_sheds,
+        "chaos_budget_exhausted_metric": budget_total,
+    }
+
+
 def fairness_bench() -> dict:
     """Noisy-neighbor fairness under per-tenant QoS (ISSUE 10).
 
@@ -2139,6 +2459,14 @@ def _main() -> int:
         disagg = with_retries("disagg", disagg_bench, errors,
                               attempts=1) or {}
 
+    # --- phase 10: gray-failure drill (outlier ejection + retry budget) -
+    # Tiny-CPU-sized; ci.sh gates quarantine detection, the post-ejection
+    # p95 TTFT ratio, the ejection-fraction guard, exact retry-budget
+    # accounting and dropped_streams == 0 on the smoke run.
+    chaos = {}
+    if smoke or os.environ.get("BENCH_CHAOS"):
+        chaos = with_retries("chaos", chaos_bench, errors, attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -2156,6 +2484,7 @@ def _main() -> int:
         **spec,
         **session,
         **disagg,
+        **chaos,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

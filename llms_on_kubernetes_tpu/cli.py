@@ -215,6 +215,8 @@ def main(argv: list[str] | None = None) -> int:
         # LLMK_RESUME_ATTEMPTS / LLMK_HEDGE_MS env knobs
         stream_resume = resume_attempts = hedge_ms = None
         qos = roles = handoff_retries = None
+        # None = let Router fall back to LLMK_OUTLIER / LLMK_RETRY_BUDGET
+        outlier_ejection = retry_budget = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -237,6 +239,12 @@ def main(argv: list[str] | None = None) -> int:
                 roles = cfg["roles"]
             if "handoff_retries" in cfg:
                 handoff_retries = int(cfg["handoff_retries"])
+            if "outlier_ejection" in cfg:
+                # gray-failure layer: latency/error outlier quarantine,
+                # passed verbatim (non-empty block = enabled)
+                outlier_ejection = cfg["outlier_ejection"]
+            if "retry_budget" in cfg:
+                retry_budget = cfg["retry_budget"]
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -258,7 +266,9 @@ def main(argv: list[str] | None = None) -> int:
                    adapters=adapters or None,
                    stream_resume=stream_resume,
                    resume_attempts=resume_attempts, hedge_ms=hedge_ms,
-                   qos=qos, roles=roles, handoff_retries=handoff_retries)
+                   qos=qos, roles=roles, handoff_retries=handoff_retries,
+                   outlier_ejection=outlier_ejection,
+                   retry_budget=retry_budget)
         return 0
 
     # serve

@@ -575,6 +575,12 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         # per-tenant QoS (ISSUE 10): fair shares, rate limits, brownout —
         # identical wire keys for both router implementations
         cfg["qos"] = spec.qos.to_wire()
+    if spec.outlier_ejection is not None:
+        # gray-failure layer (ISSUE 17): latency/error outlier ejection —
+        # a non-empty block enables it in both router implementations
+        cfg["outlier_ejection"] = spec.outlier_ejection.to_wire()
+    if spec.retry_budget is not None:
+        cfg["retry_budget"] = spec.retry_budget.to_wire()
     return cfg
 
 

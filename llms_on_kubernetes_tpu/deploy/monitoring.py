@@ -396,6 +396,64 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKReplicaQuarantined",
+                        # gray failure: the replica answers health probes
+                        # but its in-band TTFT/error EWMA is a z-score
+                        # outlier vs peers, so the router quarantined it.
+                        # Traffic fails over; a ticket because the pod
+                        # needs a human look (probes will NOT catch it)
+                        "expr": "llm_replica_quarantined == 1",
+                        "for": "5m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "replica quarantined as a "
+                                       "gray-failure outlier",
+                            "description": (
+                                "Router quarantined replica "
+                                "{{ $labels.replica }} of model "
+                                "{{ $labels.model }} as a "
+                                "{{ $labels.reason }} outlier vs its "
+                                "peers while its health probes stayed "
+                                "green. Shadow traffic will readmit it "
+                                "if it recovers; a quarantine that "
+                                "holds for hours is a degraded pod "
+                                "(bad node, throttled NIC, sick HBM) "
+                                "that needs replacing."
+                            ),
+                        },
+                    },
+                    {
+                        "alert": "LLMKRetryBudgetExhausted",
+                        # sustained exhaustion = the cluster is in (or
+                        # one failover away from) a retry storm: enough
+                        # primaries are failing that the budget cannot
+                        # cover their retries. Page — this is the
+                        # metastable-failure guard actively shedding
+                        "expr": (
+                            "rate(llm_retry_budget_exhausted_total[5m])"
+                            " > 0.1"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "page"},
+                        "annotations": {
+                            "summary": "retry budget exhausted — "
+                                       "requests shedding instead of "
+                                       "retrying",
+                            "description": (
+                                "The router on {{ $labels.instance }} "
+                                "has been refusing retries (code="
+                                "retry_budget_exhausted) for 10m: "
+                                "failures are arriving faster than the "
+                                "budget refills, the signature of a "
+                                "fleet-wide problem a retry storm "
+                                "would only amplify. Find the failing "
+                                "replicas (llm_replica_quarantined, "
+                                "llm_replica_healthy, breaker states) "
+                                "instead of raising the budget."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -547,6 +605,13 @@ def grafana_dashboard() -> dict[str, Any]:
                 "histogram_quantile(0.95, "
                 "rate(llm_handoff_seconds_bucket[5m]))"], 12, 104,
                unit="s"),
+        _panel(29, "Gray failure: quarantined replicas / ejections",
+               ["sum by (model, replica, reason) "
+                "(llm_replica_quarantined)",
+                "sum by (reason) "
+                "(rate(llm_outlier_ejections_total[5m]))"], 0, 112),
+        _panel(30, "Retry budget: exhaustion rate",
+               ["rate(llm_retry_budget_exhausted_total[5m])"], 12, 112),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -254,6 +254,84 @@ class QoSSpec:
         return out
 
 
+# gray-failure layer (ISSUE 17): knob names are the router.json wire keys
+# — server/outlier.py is the executable spec, tests/data/outlier_vectors.json
+# pins both routers to identical semantics
+_OUTLIER_KEYS = frozenset({
+    "ewma_alpha", "z_threshold", "cv_floor", "err_spread_floor",
+    "min_ttft_ms", "err_floor", "min_samples", "streak",
+    "max_eject_fraction", "shadow_every", "readmit_successes",
+})
+_RETRY_BUDGET_KEYS = frozenset({"ratio", "min_per_s", "burst"})
+
+
+@dataclasses.dataclass(frozen=True)
+class OutlierEjectionSpec:
+    """Latency/error outlier ejection config (``outlierEjection:``): the
+    gray-failure detector that quarantines a replica whose in-band TTFT or
+    error EWMA is a z-score outlier vs same-model-same-role peers while
+    its probes stay green. Rendered verbatim into router.json — a
+    non-empty block enables the layer in both routers."""
+
+    # the values.yaml block as given; to_wire() emits it verbatim so the
+    # Python renderer and the Go template (`toJson .Values.outlierEjection`)
+    # produce byte-identical router.json blocks
+    raw: dict = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        unknown = set(self.raw) - _OUTLIER_KEYS
+        if unknown:
+            raise SpecError(
+                f"unknown outlierEjection keys: {sorted(unknown)} "
+                f"(known: {sorted(_OUTLIER_KEYS)})")
+        for k, v in self.raw.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SpecError(
+                    f"outlierEjection.{k} must be a number, got {v!r}")
+            if v < 0:
+                raise SpecError(
+                    f"outlierEjection.{k} must be >= 0, got {v}")
+        alpha = self.raw.get("ewma_alpha")
+        if alpha is not None and not (0 < alpha <= 1):
+            raise SpecError(
+                f"outlierEjection.ewma_alpha must be in (0, 1], got {alpha}")
+        frac = self.raw.get("max_eject_fraction")
+        if frac is not None and frac > 1:
+            raise SpecError(
+                f"outlierEjection.max_eject_fraction must be <= 1, "
+                f"got {frac}")
+
+    def to_wire(self) -> dict:
+        return self.raw  # callers serialize, never mutate
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryBudgetSpec:
+    """Cluster retry-budget config (``retryBudget:``): one per-model token
+    bucket every retry source draws from (connect failover, handoff
+    retries, stream resume, hedges) so localized failure cannot amplify
+    into a cluster-wide retry storm. Rendered verbatim into router.json —
+    a non-empty block enables the budget in both routers."""
+
+    raw: dict = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        unknown = set(self.raw) - _RETRY_BUDGET_KEYS
+        if unknown:
+            raise SpecError(
+                f"unknown retryBudget keys: {sorted(unknown)} "
+                f"(known: {sorted(_RETRY_BUDGET_KEYS)})")
+        for k, v in self.raw.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SpecError(
+                    f"retryBudget.{k} must be a number, got {v!r}")
+            if v < 0:
+                raise SpecError(f"retryBudget.{k} must be >= 0, got {v}")
+
+    def to_wire(self) -> dict:
+        return self.raw  # callers serialize, never mutate
+
+
 @dataclasses.dataclass(frozen=True)
 class AdapterSpec:
     """One LoRA adapter a model's replicas serve (multi-tenant serving):
@@ -509,6 +587,10 @@ class DeploySpec:
     handoff_retries: int = 2
     # per-tenant QoS at the gateway (ISSUE 10); None = QoS disabled
     qos: Optional[QoSSpec] = None
+    # gray-failure layer (ISSUE 17): latency/error outlier ejection and
+    # cluster retry budgets; None = layer disabled (dormant in routers)
+    outlier_ejection: Optional[OutlierEjectionSpec] = None
+    retry_budget: Optional[RetryBudgetSpec] = None
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -554,6 +636,10 @@ class DeploySpec:
                 f"{self.handoff_retries}")
         if self.qos is not None:
             self.qos.validate()
+        if self.outlier_ejection is not None:
+            self.outlier_ejection.validate()
+        if self.retry_budget is not None:
+            self.retry_budget.validate()
 
     @property
     def resolved_default(self) -> str:
@@ -680,6 +766,24 @@ def _qos_from(d: Optional[dict]) -> Optional[QoSSpec]:
     )
 
 
+def _outlier_from(d: Optional[dict]) -> Optional[OutlierEjectionSpec]:
+    if not d:
+        # absent OR empty block = disabled (matches both routers'
+        # truthiness: enabled iff the wire block is non-empty)
+        return None
+    if not isinstance(d, dict):
+        raise SpecError("outlierEjection must be a mapping")
+    return OutlierEjectionSpec(raw=d)
+
+
+def _retry_budget_from(d: Optional[dict]) -> Optional[RetryBudgetSpec]:
+    if not d:
+        return None
+    if not isinstance(d, dict):
+        raise SpecError("retryBudget must be a mapping")
+    return RetryBudgetSpec(raw=d)
+
+
 def _adapter_from(d: dict, model_name: str) -> AdapterSpec:
     if not isinstance(d, dict):
         raise SpecError(
@@ -795,6 +899,8 @@ def load_spec(source: "str | dict") -> DeploySpec:
         handoff_retries=int(
             (data.get("router") or {}).get("handoffRetries", 2)),
         qos=_qos_from(data.get("qos")),
+        outlier_ejection=_outlier_from(data.get("outlierEjection")),
+        retry_budget=_retry_budget_from(data.get("retryBudget")),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -63,6 +63,19 @@ Known fault names (each documented at its injection site):
   a disaggregated fleet sharing one env, exactly ONE prefill replica is
   killed — the point is proving the router retries surviving prefill
   replicas or falls back to colocated serving with zero dropped streams.
+- ``degraded_replica[:FACTOR]`` — the canonical GRAY failure: one
+  server's streams decode at 1/FACTOR speed (default 8; inter-event
+  pacing stretched in the delivery path) while ``/health`` and
+  ``/ready`` keep answering green, so probe-based ejection never fires.
+  One-shot per process via :func:`claim`: with several in-process
+  replicas sharing the env, exactly ONE degrades — the point is proving
+  the router's latency outlier detector quarantines it from in-band
+  TTFT alone (server/outlier.py, ISSUE 17's chaos_bench).
+- ``net_jitter[:MS]`` — every stream event on EVERY replica sharing the
+  env is delayed by a uniform random 0..MS ms (default 25): benign
+  network/scheduler latency noise. The outlier detector's cv/spread
+  floors must absorb this without ejecting anyone (the false-positive
+  half of the gray-failure story).
 - ``drop_handoff[:N]`` — the first N (default 1) KV-handoff ingests on a
   ``decode``-role server pretend every handed-off page is missing (the
   pull is skipped entirely), forcing the counted full-re-prefill

@@ -611,4 +611,21 @@ def router_metrics(registry: Registry) -> dict:
             "Requests admitted in degraded mode under brownout (clamped "
             "max_tokens, hedging disabled), by tenant and priority",
             registry, label_names=("tenant", "priority")),
+        "quarantined": Gauge(
+            "llm_replica_quarantined",
+            "Gray-failure quarantine verdict per replica (1=ejected from "
+            "P2C candidate sets, serving only shadow traffic), by the "
+            "outlier dimension that tripped it (latency|errors)",
+            registry, label_names=("model", "replica", "reason")),
+        "outlier_ejections": Counter(
+            "llm_outlier_ejections_total",
+            "Replicas quarantined by the latency/error outlier detector, "
+            "by reason (latency = TTFT EWMA z-score over peers, errors = "
+            "error-rate EWMA z-score)",
+            registry, label_names=("reason",)),
+        "retry_budget_exhausted": Counter(
+            "llm_retry_budget_exhausted_total",
+            "Retries (connect failover, stream resume, hedges, handoff "
+            "retries) refused because the per-model retry budget was "
+            "exhausted — the anti-retry-storm throttle", registry),
     }

@@ -631,6 +631,10 @@ class OpenAIServer:
         # disaggregated handoff: lazy client session for pulling KV pages
         # from a prefill replica (decode role); closed at shutdown
         self._handoff_session = None
+        # gray-failure fault state: >1.0 means this replica decodes at
+        # 1/factor speed while probes stay green (degraded_replica fault,
+        # claimed in _maybe_claim_degraded at startup or mid-run)
+        self._degraded_factor = 1.0
 
     # ------------------------------------------------------------------
 
@@ -729,6 +733,27 @@ class OpenAIServer:
             t = threading.Timer(max(crash, 0.0), self._kill_abrupt)
             t.daemon = True
             t.start()
+        # injected fault: the canonical GRAY failure — this replica
+        # streams at 1/FACTOR speed (event pacing stretched in _drain)
+        # while /health and /ready keep answering green, so probe-based
+        # ejection never fires. One-shot (claim): a multi-replica process
+        # degrades exactly ONE replica; the router's latency outlier
+        # detector must quarantine it from in-band TTFT alone.
+        self._maybe_claim_degraded()
+
+    def _maybe_claim_degraded(self) -> None:
+        """Arm the ``degraded_replica`` gray failure on THIS replica if
+        the fault is active and still unclaimed. Checked at startup AND
+        at stream-delivery time: real gray failures develop at runtime,
+        and chaos_bench sets the env only after its baseline waves, so a
+        healthy fleet must be able to grow exactly one live victim."""
+        if self._degraded_factor > 1.0:
+            return
+        from llms_on_kubernetes_tpu import faults
+        factor = faults.get_float("degraded_replica", 8.0)
+        if (factor is not None and factor > 1.0
+                and faults.claim("degraded_replica")):
+            self._degraded_factor = float(factor)
 
     def _kill_abrupt(self) -> None:
         """Simulated prefill-pod crash (``kill_prefill_replica`` fault):
@@ -1959,8 +1984,26 @@ class OpenAIServer:
                 self.loop_thread.abort(req)
                 yield "", True, "stop", total, [], []
                 return
+        from llms_on_kubernetes_tpu import faults
+        jitter_ms = faults.get_float("net_jitter", 25.0)
+        self._maybe_claim_degraded()
+        t_last = time.monotonic()
         while True:
             toks, done, reason = await _next_event(req)
+            # injected gray-failure faults, applied between the engine
+            # event and its delivery so probes/health stay untouched:
+            # degraded_replica stretches THIS replica's event pacing by
+            # (factor-1)x the real inter-event time (slow HBM/thermal
+            # throttle in miniature); net_jitter adds 0..MS ms of random
+            # delay on EVERY replica sharing the env (latency noise the
+            # outlier detector's floors must not trip on)
+            if self._degraded_factor > 1.0:
+                await asyncio.sleep((time.monotonic() - t_last)
+                                    * (self._degraded_factor - 1.0))
+            if jitter_ms is not None and jitter_ms > 0:
+                import random
+                await asyncio.sleep(random.uniform(0.0, jitter_ms / 1000.0))
+            t_last = time.monotonic()
             start = total
             total += len(toks)
             # exclude trailing stop token from visible text (OpenAI behavior)

@@ -1,0 +1,382 @@
+"""Gray-failure outlier ejection, retry budgets, and jittered backoff.
+
+Every edge defense before this module keys off *hard* signals: connect
+errors and 5xx feed the circuit breaker, a failed ``/ready`` probe ejects
+the replica. The dominant fleet-scale failure mode is softer — the *gray
+failure* (Huang et al., HotOS 2017): a replica that answers every probe
+but decodes at a fraction of its peers' speed (degraded HBM, thermal
+throttle, a noisy ICI neighbor). P2C keeps sending it traffic, its slow
+streams burn deadline budget, clients retry, and the retry wave melts the
+*healthy* replicas — the classic metastable retry storm.
+
+Three defenses, per Envoy outlier-detection / Google SRE practice:
+
+- **Latency/error outlier ejection** — a per-replica EWMA of TTFT and of
+  error rate is compared against the replica's peer population (same
+  model, same role). A replica whose z-score stays over threshold for a
+  sustained streak is *quarantined*: dropped from P2C candidate sets but
+  kept under active probing plus a trickle of shadow traffic (1 in N real
+  requests), and re-admitted after consecutive in-band successes. A
+  max-ejection-fraction guard never quarantines more than a configured
+  fraction of a pool (and never empties one), so a common-mode slowdown
+  degrades instead of self-DoSing.
+- **Cluster retry budgets** — every retry source (connect failover,
+  stream-resume re-issues, hedges, handoff retries) draws from one
+  per-model token bucket that refills as a fraction of primary traffic
+  (Envoy ``retry_budget`` / SRE retry throttling). An exhausted budget
+  sheds with ``code=retry_budget_exhausted`` instead of amplifying load.
+- **Deadline-aware jittered backoff** — a shared, capped, full-jitter
+  backoff that never sleeps past half the remaining deadline, so
+  synchronized client retries decorrelate.
+
+This module is the EXECUTABLE SPEC: the native router
+(``native/router/router.cpp``) reimplements the same decisions in C++,
+and ``tests/data/outlier_vectors.json`` holds both byte-compatible —
+the vectors run through this module via ``tests/test_outlier.py`` and
+through the native build via ``llkt-router --outlier-selftest``. Change
+semantics here and you must change the vectors and the C++ together.
+
+Like the QoS gate, everything is synchronous and lock-free under
+aiohttp's single-threaded event loop; clocks are injectable for tests.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+# ---------------------------------------------------------------------------
+# Pure decision functions (mirrored verbatim in router.cpp)
+# ---------------------------------------------------------------------------
+
+
+def ewma(prev, sample, alpha):
+    """One exponentially-weighted moving-average step.
+
+    ``prev is None`` means "no samples yet": the first sample seeds the
+    average directly instead of being diluted toward zero.
+    """
+    if prev is None:
+        return float(sample)
+    a = float(alpha)
+    return a * float(sample) + (1.0 - a) * float(prev)
+
+
+def peer_zscore(value, peers, rel_floor=0.0, abs_floor=0.0):
+    """z-score of ``value`` against its peer population (self excluded).
+
+    The population standard deviation is floored at
+    ``max(rel_floor * |mean|, abs_floor)`` — a homogeneous pool has
+    near-zero spread, and an unfloored z-score would hair-trigger on
+    noise (this is the same reason Envoy pairs its success-rate stdev
+    factor with minimum-host and request-volume guards). With fewer than
+    two peers there is no population to deviate from: 0.0, never an
+    ejection.
+    """
+    if len(peers) < 2:
+        return 0.0
+    mean = sum(float(p) for p in peers) / len(peers)
+    var = sum((float(p) - mean) ** 2 for p in peers) / len(peers)
+    std = max(math.sqrt(var), float(rel_floor) * abs(mean), float(abs_floor),
+              1e-9)
+    return (float(value) - mean) / std
+
+
+def backoff_s(base_s, attempt, rand01, cap_s=5.0, remaining_s=-1.0):
+    """Deadline-aware exponential backoff with full jitter.
+
+    ``base_s * 2^attempt * (1 + rand01)`` (``attempt`` is the 0-based
+    retry index), capped at ``cap_s``, and — when the request carries a
+    deadline — never longer than half the remaining budget (sleeping
+    past the deadline converts a retryable blip into a guaranteed 504).
+    Deterministic given ``rand01``; both routers feed their own RNG.
+    """
+    raw = float(base_s) * (2.0 ** int(attempt)) * (1.0 + float(rand01))
+    raw = min(raw, float(cap_s))
+    if remaining_s >= 0.0:
+        raw = min(raw, max(0.0, float(remaining_s) * 0.5))
+    return raw
+
+
+def max_quarantined(fraction, pool_size):
+    """How many replicas of a pool may be quarantined at once.
+
+    ``floor(fraction * pool_size)``, and always at least one replica
+    short of the whole pool — quarantine must degrade a pool, never
+    empty it. Pools of one or two replicas (with the default 1/3
+    fraction) are never ejected from: there is no peer population to
+    trust over the replica itself.
+    """
+    n = int(pool_size)
+    if n <= 0:
+        return 0
+    return min(int(float(fraction) * n), n - 1)
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+class OutlierConfig:
+    """Parsed ``outlier_ejection`` config block (raw dict, like QoSConfig).
+
+    The block travels verbatim through Helm ``outlierEjection`` values →
+    router.json → both routers, so key names here ARE the wire format.
+    An absent/empty block leaves the layer dormant.
+    """
+
+    def __init__(self, raw=None):
+        raw = raw or {}
+        self.enabled = bool(raw)
+        self.ewma_alpha = _num(raw.get("ewma_alpha"), 0.3)
+        self.z_threshold = _num(raw.get("z_threshold"), 3.0)
+        # relative (fraction-of-mean) std floor for the latency z-score
+        self.cv_floor = _num(raw.get("cv_floor"), 0.25)
+        # absolute std floor for the error-rate z-score (rates live in
+        # [0,1]; a relative floor would vanish on an all-healthy pool)
+        self.err_spread_floor = _num(raw.get("err_spread_floor"), 0.1)
+        # absolute floors: never a latency outlier below min_ttft_ms (a
+        # fast pool's jitter is not a gray failure), never an error
+        # outlier below err_floor EWMA error rate
+        self.min_ttft_ms = _num(raw.get("min_ttft_ms"), 25.0)
+        self.err_floor = _num(raw.get("err_floor"), 0.4)
+        self.min_samples = int(_num(raw.get("min_samples"), 5))
+        self.streak = int(_num(raw.get("streak"), 3))
+        self.max_eject_fraction = _num(raw.get("max_eject_fraction"), 0.34)
+        self.shadow_every = int(_num(raw.get("shadow_every"), 8))
+        self.readmit_successes = int(_num(raw.get("readmit_successes"), 3))
+
+
+class RetryBudgetConfig:
+    """Parsed ``retry_budget`` config block.
+
+    ``ratio`` retry tokens are earned per admitted primary request
+    (Envoy's budget-as-fraction-of-traffic), ``min_per_s`` is a time
+    refill floor so a low-traffic model can still retry at all, and
+    ``burst`` caps the bucket. Absent block = unlimited retries (the
+    pre-budget behavior).
+    """
+
+    def __init__(self, raw=None):
+        raw = raw or {}
+        self.enabled = bool(raw)
+        self.ratio = _num(raw.get("ratio"), 0.2)
+        self.min_per_s = _num(raw.get("min_per_s"), 1.0)
+        self.burst = _num(raw.get("burst"), 10.0)
+
+
+def _num(v, default):
+    try:
+        if v is None:
+            return float(default)
+        return float(v)
+    except (TypeError, ValueError):
+        return float(default)
+
+
+# ---------------------------------------------------------------------------
+# Per-replica stats + detector
+# ---------------------------------------------------------------------------
+
+
+class ReplicaStats:
+    """EWMA state and quarantine FSM for one replica."""
+
+    __slots__ = ("ewma_ttft_ms", "ewma_err", "samples", "streak",
+                 "quarantined", "reason", "quarantined_at", "readmit",
+                 "ejections")
+
+    def __init__(self):
+        self.ewma_ttft_ms = None
+        self.ewma_err = None
+        self.samples = 0
+        self.streak = 0
+        self.quarantined = False
+        self.reason = ""
+        self.quarantined_at = 0.0
+        self.readmit = 0
+        self.ejections = 0
+
+
+class OutlierDetector:
+    """Outlier ejection for ONE model's replica set.
+
+    ``record(url, group, ttft_ms, error)`` is the single entry point: it
+    folds a sample into the replica's EWMAs, evaluates the replica
+    against its peer ``group`` (same model AND same role — a prefill
+    pool's latency profile says nothing about a decode pool's), and
+    walks the quarantine state machine. Returned events:
+
+    - ``""``                  — nothing changed
+    - ``"quarantine:latency"``/``"quarantine:errors"`` — replica ejected
+    - ``"guard_blocked"``     — outlier streak complete, but ejecting
+      would exceed the max-ejection-fraction guard (common-mode slowdown:
+      degrade, don't self-DoS); the streak holds and re-tries
+    - ``"readmit"``           — consecutive in-band successes cleared it
+
+    The z-score compares against NON-quarantined peers with at least
+    ``min_samples`` samples — a quarantined peer's polluted average must
+    not drag the baseline it is judged against.
+    """
+
+    def __init__(self, config, clock=time.monotonic):
+        self.config = config if isinstance(config, OutlierConfig) \
+            else OutlierConfig(config)
+        self.clock = clock
+        self.stats = {}
+        self.shadow_count = 0
+
+    def get(self, url):
+        s = self.stats.get(url)
+        if s is None:
+            s = self.stats[url] = ReplicaStats()
+        return s
+
+    def is_quarantined(self, url):
+        s = self.stats.get(url)
+        return bool(s and s.quarantined)
+
+    def quarantined_in(self, group):
+        return sum(1 for u in group if self.is_quarantined(u))
+
+    def shadow_tick(self):
+        """True when THIS request should shadow-probe a quarantined
+        replica. Called once per routed request while the model has any
+        quarantined replica; fires on every ``shadow_every``-th call."""
+        self.shadow_count += 1
+        every = max(1, self.config.shadow_every)
+        return self.shadow_count % every == 0
+
+    def record(self, url, group, ttft_ms, error):
+        cfg = self.config
+        s = self.get(url)
+        s.samples += 1
+        s.ewma_err = ewma(s.ewma_err, 1.0 if error else 0.0, cfg.ewma_alpha)
+        if not error and ttft_ms is not None:
+            s.ewma_ttft_ms = ewma(s.ewma_ttft_ms, ttft_ms, cfg.ewma_alpha)
+
+        if s.quarantined:
+            if error:
+                s.readmit = 0
+            else:
+                s.readmit += 1
+                if s.readmit >= cfg.readmit_successes:
+                    s.quarantined = False
+                    s.reason = ""
+                    s.readmit = 0
+                    s.streak = 0
+                    return "readmit"
+            return ""
+
+        if s.samples < cfg.min_samples:
+            return ""
+
+        def peer_values(attr):
+            vals = []
+            for u in group:
+                if u == url:
+                    continue
+                p = self.stats.get(u)
+                if p is None or p.quarantined or p.samples < cfg.min_samples:
+                    continue
+                v = getattr(p, attr)
+                if v is not None:
+                    vals.append(v)
+            return vals
+
+        latency_outlier = (
+            s.ewma_ttft_ms is not None
+            and s.ewma_ttft_ms > cfg.min_ttft_ms
+            and peer_zscore(s.ewma_ttft_ms, peer_values("ewma_ttft_ms"),
+                            rel_floor=cfg.cv_floor) >= cfg.z_threshold)
+        error_outlier = (
+            not latency_outlier
+            and s.ewma_err is not None
+            and s.ewma_err >= cfg.err_floor
+            and peer_zscore(s.ewma_err, peer_values("ewma_err"),
+                            abs_floor=cfg.err_spread_floor)
+            >= cfg.z_threshold)
+
+        if not (latency_outlier or error_outlier):
+            s.streak = 0
+            return ""
+        s.streak += 1
+        if s.streak < cfg.streak:
+            return ""
+        allowed = max_quarantined(cfg.max_eject_fraction, len(group))
+        if self.quarantined_in(group) >= allowed:
+            return "guard_blocked"  # streak holds; re-tries next sample
+        s.quarantined = True
+        s.reason = "latency" if latency_outlier else "errors"
+        s.quarantined_at = self.clock()
+        s.readmit = 0
+        s.streak = 0
+        s.ejections += 1
+        return "quarantine:" + s.reason
+
+    def snapshot(self, url):
+        """One replica's state for /debug/replicas."""
+        s = self.stats.get(url)
+        if s is None:
+            s = ReplicaStats()
+        out = {
+            "quarantined": s.quarantined,
+            "reason": s.reason,
+            "ewma_ttft_ms": s.ewma_ttft_ms,
+            "ewma_err": s.ewma_err,
+            "samples": s.samples,
+            "streak": s.streak,
+            "readmit": s.readmit,
+            "ejections": s.ejections,
+        }
+        if s.quarantined:
+            out["quarantined_age_s"] = max(0.0,
+                                           self.clock() - s.quarantined_at)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Retry budget
+# ---------------------------------------------------------------------------
+
+
+class RetryBudget:
+    """Per-model token bucket all retry sources draw from.
+
+    Earns ``ratio`` tokens per admitted primary request plus a
+    ``min_per_s`` time refill, capped at ``burst``; each retry costs one
+    token. ``charge()`` is the gate; ``refund()`` returns a token when a
+    charged retry was never actually dispatched (no replica to send it
+    to), so bookkeeping matches bytes on the wire.
+    """
+
+    __slots__ = ("config", "clock", "level", "_last")
+
+    def __init__(self, config, clock=time.monotonic):
+        self.config = config if isinstance(config, RetryBudgetConfig) \
+            else RetryBudgetConfig(config)
+        self.clock = clock
+        self.level = self.config.burst
+        self._last = None
+
+    def _refill(self, now):
+        if self._last is not None and now > self._last:
+            self.level = min(self.config.burst,
+                             self.level
+                             + (now - self._last) * self.config.min_per_s)
+        self._last = now
+
+    def on_primary(self, now=None):
+        self._refill(self.clock() if now is None else now)
+        self.level = min(self.config.burst, self.level + self.config.ratio)
+
+    def charge(self, now=None):
+        self._refill(self.clock() if now is None else now)
+        if self.level >= 1.0:
+            self.level -= 1.0
+            return True
+        return False
+
+    def refund(self):
+        self.level = min(self.config.burst, self.level + 1.0)

@@ -67,7 +67,7 @@ import aiohttp
 from aiohttp import web
 
 from llms_on_kubernetes_tpu import faults
-from llms_on_kubernetes_tpu.server import tracing
+from llms_on_kubernetes_tpu.server import outlier, tracing
 from llms_on_kubernetes_tpu.server.cluster_metrics import (
     SLOTracker, merge_expositions, slo_gauges,
 )
@@ -150,6 +150,19 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _env_json(name: str) -> Optional[dict]:
+    """A JSON-object env var (the outlier/budget config blocks ride the
+    env as JSON strings, like LLMK_QOS); junk or non-objects are None."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
 
 
 def error_body(message: str, type_: str, code: str = "") -> dict:
@@ -430,6 +443,8 @@ class Router:
         qos: Optional[dict] = None,
         roles: Optional[dict] = None,
         handoff_retries: Optional[int] = None,
+        outlier_ejection: Optional[dict] = None,
+        retry_budget: Optional[dict] = None,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -527,6 +542,33 @@ class Router:
         self._disagg: dict[str, bool] = {
             name: {"prefill", "decode"} <= {r.role for r in reps}
             for name, reps in self.replicas.items()}
+        # gray-failure layer (server/outlier.py is the executable spec;
+        # the native router mirrors it): latency/error outlier quarantine
+        # plus the per-model retry budget every retry source draws from.
+        # Both stay dormant unless configured.
+        self.outlier_cfg = outlier.OutlierConfig(
+            outlier_ejection if outlier_ejection is not None
+            else _env_json("LLMK_OUTLIER"))
+        self.retry_budget_cfg = outlier.RetryBudgetConfig(
+            retry_budget if retry_budget is not None
+            else _env_json("LLMK_RETRY_BUDGET"))
+        self.outliers: dict[str, outlier.OutlierDetector] = {}
+        self.retry_budgets: dict[str, outlier.RetryBudget] = {}
+        if self.outlier_cfg.enabled:
+            for reason in ("latency", "errors"):
+                self.metrics["outlier_ejections"].labels(reason=reason)
+            for name, reps in self.replicas.items():
+                self.outliers[name] = outlier.OutlierDetector(
+                    self.outlier_cfg, clock=clock)
+                for rep in reps:
+                    for reason in ("latency", "errors"):
+                        self.metrics["quarantined"].labels(
+                            model=name, replica=rep.url,
+                            reason=reason).set(0)
+        if self.retry_budget_cfg.enabled:
+            for name in self.backends:
+                self.retry_budgets[name] = outlier.RetryBudget(
+                    self.retry_budget_cfg, clock=clock)
         self._session: Optional[aiohttp.ClientSession] = None
         self._probe_task: Optional[asyncio.Task] = None
 
@@ -536,6 +578,7 @@ class Router:
         app.router.add_get("/metrics", self.metrics_endpoint)
         app.router.add_get("/metrics/cluster", self.metrics_cluster)
         app.router.add_get("/debug/traces", self.debug_traces)
+        app.router.add_get("/debug/replicas", self.debug_replicas)
         app.router.add_get("/v1/models", self.models)
         app.router.add_route("*", "/{path:.*}", self.proxy)
         app.on_startup.append(self._startup)
@@ -734,7 +777,8 @@ class Router:
         return None
 
     def _pick(self, model: str, exclude: set,
-              roles: Optional[tuple] = None) -> Optional[Replica]:
+              roles: Optional[tuple] = None,
+              shadow: bool = False) -> Optional[Replica]:
         """Power-of-two-choices over the model's routable replicas.
 
         Replicas in ``exclude`` (already failed this request) are skipped
@@ -743,7 +787,14 @@ class Router:
         ``roles``, replicas of those roles are preferred and the rest are
         a last resort (never preferred over an excluded preferred one is
         NOT guaranteed — availability beats affinity).
+
+        Quarantined replicas (outlier detector) are excluded like
+        unhealthy ones, with two exceptions: a ``shadow`` pick steers the
+        request TO a quarantined member (the 1-in-N trickle that lets it
+        earn re-admission), and when nothing non-quarantined is routable
+        a quarantined replica still beats a 503.
         """
+        det = self.outliers.get(model)
         reps = self.replicas[model]
         pools = [reps]
         if roles:
@@ -751,12 +802,23 @@ class Router:
             pools = [pref, reps] if pref and len(pref) < len(reps) \
                 else ([pref] if pref else [reps])
         for pool in pools:
-            cands = [r for r in pool
-                     if r.url not in exclude and r.healthy
-                     and not r.breaker.blocked()]
+            live = [r for r in pool
+                    if r.healthy and not r.breaker.blocked()]
+            if det is not None and shadow:
+                qcands = [r for r in live if r.url not in exclude
+                          and det.is_quarantined(r.url)]
+                if qcands:
+                    choice = random.choice(qcands)
+                    return choice if choice.breaker.allow() else None
+            cands = [r for r in live if r.url not in exclude
+                     and not (det is not None
+                              and det.is_quarantined(r.url))]
+            if not cands and det is not None:
+                # every non-quarantined member is down/excluded: routing
+                # to a quarantined replica still beats failing the request
+                cands = [r for r in live if r.url not in exclude]
             if not cands and exclude:
-                cands = [r for r in pool
-                         if r.healthy and not r.breaker.blocked()]
+                cands = live
             if not cands:
                 continue
             if len(cands) == 1:
@@ -771,9 +833,14 @@ class Router:
                    role: str) -> Optional[Replica]:
         """Strict single-role pick for the handoff hops (no cross-role
         fallback — that decision belongs to the caller's ladder)."""
-        cands = [r for r in self.replicas[model]
-                 if r.role == role and r.url not in exclude and r.healthy
-                 and not r.breaker.blocked()]
+        det = self.outliers.get(model)
+        live = [r for r in self.replicas[model]
+                if r.role == role and r.url not in exclude and r.healthy
+                and not r.breaker.blocked()]
+        cands = [r for r in live
+                 if not (det is not None and det.is_quarantined(r.url))]
+        if not cands:
+            cands = live  # quarantined-only pool: degrade, don't refuse
         if not cands:
             return None
         if len(cands) == 1:
@@ -782,6 +849,69 @@ class Router:
             a, b = random.sample(cands, 2)
             choice = a if a.inflight <= b.inflight else b
         return choice if choice.breaker.allow() else None
+
+    # ------------------------------------------------------------------
+    # gray-failure layer plumbing (server/outlier.py holds the semantics)
+
+    def _outlier_group(self, rep: Replica) -> list:
+        """Peer population a replica is judged against: same model AND
+        same role — a prefill pool's latency profile says nothing about
+        a decode pool's."""
+        return [r.url for r in self.replicas[rep.model]
+                if r.role == rep.role]
+
+    def _observe_replica(self, rep: Replica, ttft_ms: Optional[float],
+                         error: bool) -> None:
+        """Fold one in-band outcome into the model's outlier detector
+        and export any quarantine transition it causes."""
+        det = self.outliers.get(rep.model)
+        if det is None:
+            return
+        event = det.record(rep.url, self._outlier_group(rep), ttft_ms,
+                           error)
+        if not event:
+            return
+        if event.startswith("quarantine:"):
+            reason = event.split(":", 1)[1]
+            s = det.get(rep.url)
+            self.metrics["quarantined"].labels(
+                model=rep.model, replica=rep.url, reason=reason).set(1)
+            self.metrics["outlier_ejections"].labels(reason=reason).inc()
+            jlog("replica_quarantined", component="router",
+                 model=rep.model, replica=rep.url, reason=reason,
+                 ewma_ttft_ms=round(s.ewma_ttft_ms or 0.0, 3),
+                 ewma_err=round(s.ewma_err or 0.0, 4))
+        elif event == "readmit":
+            for reason in ("latency", "errors"):
+                self.metrics["quarantined"].labels(
+                    model=rep.model, replica=rep.url, reason=reason).set(0)
+            jlog("replica_readmitted", component="router",
+                 model=rep.model, replica=rep.url)
+        elif event == "guard_blocked":
+            # outlier streak complete but ejecting would pass the
+            # max-ejection-fraction guard: common-mode slowdown, degrade
+            # instead of self-DoSing (the streak holds and re-tries)
+            jlog("quarantine_guard_blocked", component="router",
+                 model=rep.model, replica=rep.url)
+
+    def _charge_retry(self, model: str, rid: str, source: str) -> bool:
+        """Draw one token from the model's retry budget. False means the
+        caller must downgrade (shed / single-attempt / truncate) — never
+        dispatch the retry anyway."""
+        budget = self.retry_budgets.get(model)
+        if budget is None or budget.charge():
+            return True
+        self.metrics["retry_budget_exhausted"].inc()
+        jlog("retry_budget_exhausted", request_id=rid, component="router",
+             model=model, source=source)
+        return False
+
+    def _refund_retry(self, model: str) -> None:
+        """Return a charged token that never became bytes on the wire
+        (no replica to send the retry to)."""
+        budget = self.retry_budgets.get(model)
+        if budget is not None:
+            budget.refund()
 
     def _unroutable_response(self, model: str, rid: str = "") -> web.Response:
         reps = self.replicas[model]
@@ -833,6 +963,40 @@ class Router:
             limit=limit,
         )})
 
+    async def debug_replicas(self, request: web.Request) -> web.Response:
+        """Per-replica routing state: health, breaker, inflight, and —
+        when the gray-failure layer is on — the quarantine FSM and the
+        model's retry-budget level."""
+        models = {}
+        for name, reps in self.replicas.items():
+            det = self.outliers.get(name)
+            entry: dict = {"replicas": []}
+            for r in reps:
+                d = {
+                    "url": r.url,
+                    "role": r.role,
+                    "healthy": r.healthy,
+                    "inflight": r.inflight,
+                    "breaker": r.breaker.state,
+                }
+                if det is not None:
+                    d["outlier"] = det.snapshot(r.url)
+                entry["replicas"].append(d)
+            budget = self.retry_budgets.get(name)
+            if budget is not None:
+                entry["retry_budget"] = {
+                    "level": budget.level,
+                    "burst": budget.config.burst,
+                    "ratio": budget.config.ratio,
+                    "min_per_s": budget.config.min_per_s,
+                }
+            models[name] = entry
+        return web.json_response({
+            "outlier_ejection_enabled": self.outlier_cfg.enabled,
+            "retry_budget_enabled": self.retry_budget_cfg.enabled,
+            "models": models,
+        })
+
     # ------------------------------------------------------------------
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
@@ -881,6 +1045,12 @@ class Router:
         # scaled-to-zero model has no healthy replica, and this series'
         # rate is exactly what wakes it (KEDA trigger in manifests.py)
         self.metrics["requests_total"].labels(model=model).inc()
+        # every admitted primary request earns the retry budget its
+        # fractional token (SRE retry throttling: retries scale WITH
+        # traffic, never against a fixed allowance)
+        budget = self.retry_budgets.get(model)
+        if budget is not None:
+            budget.on_primary()
 
         # --- edge QoS gate: per-tenant rate limits, then the brownout
         # ladder (shed lowest-priority first, degrade before shedding the
@@ -1006,9 +1176,35 @@ class Router:
         never_picked = True
         t_connect0 = self.clock()
         attempt = 0
+        # shadow trickle: while the model has quarantined replicas, every
+        # shadow_every-th request is deliberately steered to one so it can
+        # earn re-admission (streaming clients keep resume/failover — the
+        # quarantined replica is never their only shot at a response)
+        det = self.outliers.get(model)
+        shadow = bool(
+            det is not None
+            and det.quarantined_in(
+                [r.url for r in self.replicas[model]]) > 0
+            and det.shadow_tick())
         for attempt in range(1, self.retry_attempts + 1):
-            replica = self._pick(model, tried, roles=self._serve_roles(model))
+            if attempt > 1 and not self._charge_retry(model, rid,
+                                                      "connect"):
+                trace.add_span("connect", t_connect0, self.clock(),
+                               error="retry budget exhausted",
+                               attempts=attempt - 1)
+                return web.json_response(
+                    error_body(
+                        "retry budget exhausted after upstream error: "
+                        f"{last_err}", "service_unavailable",
+                        "retry_budget_exhausted"),
+                    status=503, headers=self._rid_headers(
+                        rid, {"Retry-After": "1"}))
+            replica = self._pick(model, tried,
+                                 roles=self._serve_roles(model),
+                                 shadow=shadow and attempt == 1)
             if replica is None:
+                if attempt > 1:
+                    self._refund_retry(model)
                 break
             never_picked = False
             if prev is not None and replica.url != prev.url:
@@ -1036,22 +1232,30 @@ class Router:
             except RETRYABLE_ERRORS as e:
                 replica.inflight -= 1
                 replica.breaker.record_failure()
+                self._observe_replica(replica, None, True)
                 last_err = e
                 tried.add(replica.url)
                 prev = replica
                 if attempt >= self.retry_attempts:
                     break
                 # back off only when no untried alternate exists (a
-                # failover to a different replica is immediate)
+                # failover to a different replica is immediate); the
+                # shared deadline-aware full-jitter curve keeps both
+                # routers' retry waves decorrelated and never sleeps a
+                # doomed request past its budget
                 alternates = [r for r in self.replicas[model]
                               if r.url not in tried and r.healthy
                               and not r.breaker.blocked()]
                 if not alternates:
-                    backoff = self.retry_backoff_s * (2 ** (attempt - 1))
-                    await asyncio.sleep(backoff * (1.0 + random.random()))
+                    remaining = ((deadline - self.clock())
+                                 if deadline is not None else -1.0)
+                    await asyncio.sleep(outlier.backoff_s(
+                        self.retry_backoff_s, attempt - 1,
+                        random.random(), remaining_s=remaining))
             except (aiohttp.ClientError, TimeoutError, OSError) as e:
                 replica.inflight -= 1
                 replica.breaker.record_failure()
+                self._observe_replica(replica, None, True)
                 last_err = e
                 break
         if upstream is None or active is None:
@@ -1091,6 +1295,8 @@ class Router:
                         t_first = self.clock()
                         trace.add_span("first_byte", t_head, t_first)
                         request["llmk_ttft_ms"] = (t_first - t0) * 1000.0
+                        self._observe_replica(
+                            active, request["llmk_ttft_ms"], False)
                     relayed += len(chunk)
                     await resp.write(chunk)
                 await resp.write_eof()
@@ -1100,6 +1306,7 @@ class Router:
                 return resp
         except (aiohttp.ClientError, TimeoutError, OSError) as e:
             active.breaker.record_failure()
+            self._observe_replica(active, None, True)
             trace.event("relay_error", error=str(e), bytes=relayed)
             if resp is None or not resp.prepared:
                 return web.json_response(
@@ -1147,9 +1354,17 @@ class Router:
         ticket: Optional[dict] = None
         source: Optional[Replica] = None
         tried_p: set = set()
-        for _ in range(self.retry_attempts):
+        for p_attempt in range(1, self.retry_attempts + 1):
+            # prefill-hop retries are retries like any other: past the
+            # first attempt they draw from the model's budget, and an
+            # exhausted budget downgrades to the colocated single path
+            if p_attempt > 1 and not self._charge_retry(
+                    model, rid, "handoff_prefill"):
+                return None
             replica = self._pick_role(model, tried_p, "prefill")
             if replica is None:
+                if p_attempt > 1:
+                    self._refund_retry(model)
                 return None
             h = dict(headers)
             h[HANDOFF_HEADER] = "ticket"
@@ -1166,6 +1381,7 @@ class Router:
             except self._RELAY_ERRORS:
                 replica.inflight -= 1
                 replica.breaker.record_failure()
+                self._observe_replica(replica, None, True)
                 tried_p.add(replica.url)
                 continue
             ctype = up.headers.get("Content-Type", "").lower()
@@ -1175,6 +1391,7 @@ class Router:
                 except (*self._RELAY_ERRORS, ValueError):
                     replica.inflight -= 1
                     replica.breaker.record_failure()
+                    self._observe_replica(replica, None, True)
                     tried_p.add(replica.url)
                     up.close()
                     continue
@@ -1218,8 +1435,13 @@ class Router:
             h2[HANDOFF_SEED_HEADER] = str(seed)
         tried_d: set = set()
         for attempt in range(1, self.handoff_retries + 1):
+            if attempt > 1 and not self._charge_retry(
+                    model, rid, "handoff_decode"):
+                break
             replica = self._pick_role(model, tried_d, "decode")
             if replica is None:
+                if attempt > 1:
+                    self._refund_retry(model)
                 break
             if deadline is not None:
                 remaining = deadline - self.clock()
@@ -1234,6 +1456,7 @@ class Router:
             except self._RELAY_ERRORS:
                 replica.inflight -= 1
                 replica.breaker.record_failure()
+                self._observe_replica(replica, None, True)
                 tried_d.add(replica.url)
                 continue
             ctype = up.headers.get("Content-Type", "").lower()
@@ -1331,6 +1554,8 @@ class Router:
                         t_first = self.clock()
                         trace.add_span("first_byte", t_head, t_first)
                         request["llmk_ttft_ms"] = (t_first - t0) * 1000.0
+                        self._observe_replica(
+                            active, request["llmk_ttft_ms"], False)
                     relayed += len(chunk)
                     out = journal.feed(chunk) if sse else chunk
                     if out:
@@ -1342,6 +1567,7 @@ class Router:
                     break  # clean upstream EOF: relay complete
                 # --- upstream died mid-stream
                 active.breaker.record_failure()
+                self._observe_replica(active, None, True)
                 active.inflight -= 1
                 tried.add(active.url)
                 dead = active.url
@@ -1428,8 +1654,8 @@ class Router:
                 h[RESUME_CREATED_HEADER] = str(journal.created)
         # else: nothing reached the client yet — a clean re-issue
         used = 0
-        budget = self.resume_attempts - resumes
-        while used < budget:
+        attempts_left = self.resume_attempts - resumes
+        while used < attempts_left:
             if deadline is not None:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
@@ -1437,8 +1663,17 @@ class Router:
                          component="router", model=model, reason="deadline")
                     return None
                 h[DEADLINE_HEADER] = str(int(remaining * 1000))
+            # every re-issue is a retry: it draws from the model budget,
+            # and an exhausted budget truncates (explicit error event)
+            # instead of piling resume traffic onto a sick pool
+            if not self._charge_retry(model, rid, "stream_resume"):
+                jlog("stream_resume_giveup", request_id=rid,
+                     component="router", model=model,
+                     reason="retry budget exhausted")
+                return None
             replica = self._pick(model, tried, roles=self._serve_roles(model))
             if replica is None:
+                self._refund_retry(model)
                 jlog("stream_resume_giveup", request_id=rid,
                      component="router", model=model,
                      reason="no healthy replica")
@@ -1454,6 +1689,7 @@ class Router:
             except self._RELAY_ERRORS:
                 replica.inflight -= 1
                 replica.breaker.record_failure()
+                self._observe_replica(replica, None, True)
                 tried.add(replica.url)
                 continue
             ctype = up.headers.get("Content-Type", "").lower()
@@ -1522,18 +1758,22 @@ class Router:
                 _, chunk = prim.result()
             except self._RELAY_ERRORS:
                 active.breaker.record_failure()
+                self._observe_replica(active, None, True)
                 active.inflight -= 1
                 tried.add(active.url)
                 raise
             return upstream, active, chunk
         hedge_rep = self._pick(model, tried | {active.url},
                                roles=self._serve_roles(model))
-        if hedge_rep is None:
-            # nowhere to hedge to: keep waiting on the primary
+        # a hedge is a speculative retry: it draws from the same budget
+        # as every other retry source, and an exhausted budget downgrades
+        # to the plain single-attempt path (keep waiting on the primary)
+        if hedge_rep is None or not self._charge_retry(model, rid, "hedge"):
             try:
                 _, chunk = await prim
             except self._RELAY_ERRORS:
                 active.breaker.record_failure()
+                self._observe_replica(active, None, True)
                 active.inflight -= 1
                 tried.add(active.url)
                 raise
@@ -1572,6 +1812,7 @@ class Router:
                 if fut.exception() is not None:
                     last_err = fut.exception()
                     rep.breaker.record_failure()
+                    self._observe_replica(rep, None, True)
                     rep.inflight -= 1
                     tried.add(rep.url)
                     continue
@@ -1614,11 +1855,15 @@ def run_router(
     qos: Optional[dict] = None,
     roles: Optional[dict] = None,
     handoff_retries: Optional[int] = None,
+    outlier_ejection: Optional[dict] = None,
+    retry_budget: Optional[dict] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s,
                     stream_resume=stream_resume,
                     resume_attempts=resume_attempts, hedge_ms=hedge_ms,
-                    qos=qos, roles=roles, handoff_retries=handoff_retries)
+                    qos=qos, roles=roles, handoff_retries=handoff_retries,
+                    outlier_ejection=outlier_ejection,
+                    retry_budget=retry_budget)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

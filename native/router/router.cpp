@@ -125,6 +125,35 @@ struct QosConfig {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Gray-failure config (mirrors server/outlier.py OutlierConfig /
+// RetryBudgetConfig — that module is the executable spec; the two are held
+// byte-compatible by tests/data/outlier_vectors.json, driven here via
+// --outlier-selftest)
+// ---------------------------------------------------------------------------
+
+struct OutlierCfg {
+  bool enabled = false;
+  double ewma_alpha = 0.3;
+  double z_threshold = 3.0;
+  double cv_floor = 0.25;          // relative std floor for the latency z
+  double err_spread_floor = 0.1;   // absolute std floor for the error z
+  double min_ttft_ms = 25.0;       // never a latency outlier below this
+  double err_floor = 0.4;          // never an error outlier below this EWMA
+  int min_samples = 5;
+  int streak = 3;
+  double max_eject_fraction = 0.34;
+  int shadow_every = 8;
+  int readmit_successes = 3;
+};
+
+struct BudgetCfg {
+  bool enabled = false;
+  double ratio = 0.2;      // retry tokens earned per admitted primary
+  double min_per_s = 1.0;  // time-refill floor for low-traffic models
+  double burst = 10.0;     // bucket cap (and the starting level)
+};
+
 struct Config {
   // insertion-ordered: first model is the default (like the reference's
   // `default_backend` = first entry, model-gateway.yaml:20-22). Each model
@@ -168,6 +197,11 @@ struct Config {
   // per-tenant QoS: rate limits + priority + adaptive brownout ("qos"
   // config block; absent = gate dormant)
   QosConfig qos;
+  // gray-failure layer: latency/error outlier ejection
+  // ("outlier_ejection" block / LLMK_OUTLIER) and the cluster retry
+  // budget ("retry_budget" block / LLMK_RETRY_BUDGET); absent = dormant
+  OutlierCfg outlier;
+  BudgetCfg retry_budget;
   // disaggregated prefill/decode (mirrors server/router.py): replica
   // (host, port) -> role; absent = "both". A model with any prefill
   // replica gets the two-hop ticket flow; handoff_retries bounds the
@@ -648,6 +682,333 @@ static std::map<std::string, long> g_tenant_tokens;
 static std::map<std::pair<std::string, std::string>, long> g_tenant_degraded;
 
 // ---------------------------------------------------------------------------
+// Gray-failure semantics (mirrors server/outlier.py function by function —
+// that module is the executable spec; every constant here must match it,
+// held byte-compatible by tests/data/outlier_vectors.json via
+// --outlier-selftest)
+// ---------------------------------------------------------------------------
+
+// one EWMA step; has_prev=false seeds the average with the first sample
+static double o_ewma(bool has_prev, double prev, double sample, double alpha) {
+  if (!has_prev) return sample;
+  return alpha * sample + (1.0 - alpha) * prev;
+}
+
+// z-score of `value` against its peer population (self excluded); the
+// population std is floored at max(rel_floor*|mean|, abs_floor) so a
+// homogeneous pool cannot hair-trigger. <2 peers = no population = 0.
+static double o_peer_zscore(double value, const std::vector<double>& peers,
+                            double rel_floor, double abs_floor) {
+  if (peers.size() < 2) return 0.0;
+  double mean = 0.0;
+  for (double p : peers) mean += p;
+  mean /= static_cast<double>(peers.size());
+  double var = 0.0;
+  for (double p : peers) var += (p - mean) * (p - mean);
+  var /= static_cast<double>(peers.size());
+  double std_ = std::max(
+      std::max(std::sqrt(var), rel_floor * std::fabs(mean)),
+      std::max(abs_floor, 1e-9));
+  return (value - mean) / std_;
+}
+
+// deadline-aware exponential backoff with full jitter: base * 2^attempt *
+// (1 + rand01), capped, and never past half the remaining deadline
+// (remaining_s < 0 = no deadline)
+static double o_backoff_s(double base_s, int attempt, double rand01,
+                          double cap_s = 5.0, double remaining_s = -1.0) {
+  double raw = base_s * std::pow(2.0, attempt) * (1.0 + rand01);
+  raw = std::min(raw, cap_s);
+  if (remaining_s >= 0.0)
+    raw = std::min(raw, std::max(0.0, remaining_s * 0.5));
+  return raw;
+}
+
+// how many replicas of a pool may be quarantined at once: floor(f*n),
+// always at least one short of the whole pool
+static int o_max_quarantined(double fraction, int pool_size) {
+  if (pool_size <= 0) return 0;
+  return std::min(static_cast<int>(fraction * pool_size), pool_size - 1);
+}
+
+// EWMA state + quarantine FSM for one replica (ReplicaStats in the spec)
+struct OutlierStat {
+  double ewma_ttft_ms = 0.0;
+  bool has_ttft = false;
+  double ewma_err = 0.0;
+  bool has_err = false;
+  long samples = 0;
+  int streak = 0;
+  bool quarantined = false;
+  std::string reason;
+  double quarantined_at = 0.0;
+  int readmit = 0;
+  long ejections = 0;
+};
+
+// one model's replica stats, keyed "host:port"
+using OutlierStats = std::map<std::string, OutlierStat>;
+
+static int outlier_quarantined_in(const OutlierStats& stats,
+                                  const std::vector<std::string>& group) {
+  int n = 0;
+  for (const std::string& u : group) {
+    auto it = stats.find(u);
+    if (it != stats.end() && it->second.quarantined) ++n;
+  }
+  return n;
+}
+
+// The single decision entry point (OutlierDetector.record in the spec):
+// folds one sample into the replica's EWMAs, evaluates it against its
+// NON-quarantined min_samples peers, and walks the quarantine FSM.
+// Returns "", "quarantine:latency", "quarantine:errors", "guard_blocked"
+// or "readmit". Pure over (cfg, stats, now) so --outlier-selftest can
+// drive it with scripted time; ttft_ms < 0 means "no TTFT sample".
+static std::string outlier_record(const OutlierCfg& oc, OutlierStats& stats,
+                                  const std::string& url,
+                                  const std::vector<std::string>& group,
+                                  double ttft_ms, bool error, double now) {
+  OutlierStat& s = stats[url];
+  s.samples += 1;
+  s.ewma_err = o_ewma(s.has_err, s.ewma_err, error ? 1.0 : 0.0,
+                      oc.ewma_alpha);
+  s.has_err = true;
+  if (!error && ttft_ms >= 0.0) {
+    s.ewma_ttft_ms = o_ewma(s.has_ttft, s.ewma_ttft_ms, ttft_ms,
+                            oc.ewma_alpha);
+    s.has_ttft = true;
+  }
+
+  if (s.quarantined) {
+    if (error) {
+      s.readmit = 0;
+    } else {
+      s.readmit += 1;
+      if (s.readmit >= oc.readmit_successes) {
+        s.quarantined = false;
+        s.reason.clear();
+        s.readmit = 0;
+        s.streak = 0;
+        return "readmit";
+      }
+    }
+    return "";
+  }
+
+  if (s.samples < oc.min_samples) return "";
+
+  auto peer_values = [&](bool want_ttft) {
+    std::vector<double> vals;
+    for (const std::string& u : group) {
+      if (u == url) continue;
+      auto it = stats.find(u);
+      if (it == stats.end() || it->second.quarantined ||
+          it->second.samples < oc.min_samples)
+        continue;
+      const OutlierStat& p = it->second;
+      if (want_ttft) {
+        if (p.has_ttft) vals.push_back(p.ewma_ttft_ms);
+      } else {
+        if (p.has_err) vals.push_back(p.ewma_err);
+      }
+    }
+    return vals;
+  };
+
+  bool latency_outlier =
+      s.has_ttft && s.ewma_ttft_ms > oc.min_ttft_ms &&
+      o_peer_zscore(s.ewma_ttft_ms, peer_values(true), oc.cv_floor, 0.0) >=
+          oc.z_threshold;
+  bool error_outlier =
+      !latency_outlier && s.has_err && s.ewma_err >= oc.err_floor &&
+      o_peer_zscore(s.ewma_err, peer_values(false), 0.0,
+                    oc.err_spread_floor) >= oc.z_threshold;
+
+  if (!latency_outlier && !error_outlier) {
+    s.streak = 0;
+    return "";
+  }
+  s.streak += 1;
+  if (s.streak < oc.streak) return "";
+  int allowed = o_max_quarantined(oc.max_eject_fraction,
+                                  static_cast<int>(group.size()));
+  if (outlier_quarantined_in(stats, group) >= allowed)
+    return "guard_blocked";  // streak holds; re-tries next sample
+  s.quarantined = true;
+  s.reason = latency_outlier ? "latency" : "errors";
+  s.quarantined_at = now;
+  s.readmit = 0;
+  s.streak = 0;
+  s.ejections += 1;
+  return "quarantine:" + s.reason;
+}
+
+// per-model retry budget (RetryBudget in the spec): `ratio` tokens per
+// admitted primary + a min_per_s time refill, capped at burst; each retry
+// costs one token. Pure over (cfg, state, now) for the selftest.
+struct BudgetState {
+  double level = 0.0;
+  double last = 0.0;
+  bool has_last = false;
+  bool init = false;
+};
+
+static void budget_refill(const BudgetCfg& bc, BudgetState& s, double now) {
+  if (!s.init) {
+    s.level = bc.burst;
+    s.init = true;
+  }
+  if (s.has_last && now > s.last)
+    s.level = std::min(bc.burst, s.level + (now - s.last) * bc.min_per_s);
+  s.last = now;
+  s.has_last = true;
+}
+
+static void budget_on_primary_f(const BudgetCfg& bc, BudgetState& s,
+                                double now) {
+  budget_refill(bc, s, now);
+  s.level = std::min(bc.burst, s.level + bc.ratio);
+}
+
+static bool budget_charge_f(const BudgetCfg& bc, BudgetState& s, double now) {
+  budget_refill(bc, s, now);
+  if (s.level >= 1.0) {
+    s.level -= 1.0;
+    return true;
+  }
+  return false;
+}
+
+static void budget_refund_f(const BudgetCfg& bc, BudgetState& s) {
+  if (!s.init) {
+    s.level = bc.burst;
+    s.init = true;
+  }
+  s.level = std::min(bc.burst, s.level + 1.0);
+}
+
+// live gray-failure state: per-model stats maps + shadow counters +
+// budget buckets, mutex-guarded (the python layer is lock-free under the
+// aiohttp event loop instead); time is g_start_steady-relative like QoS
+static std::mutex g_outlier_mu;
+static std::map<std::string, OutlierStats> g_outlier_stats;
+static std::map<std::string, long> g_shadow_count;
+static std::mutex g_budget_mu;
+static std::map<std::string, BudgetState> g_budgets;
+
+// gray-failure counters (mirror server/metrics.py router_metrics():
+// llm_outlier_ejections_total{reason}, llm_retry_budget_exhausted_total;
+// llm_replica_quarantined is rendered from live state at scrape time)
+static std::atomic<long> g_outlier_eject_latency_total{0};
+static std::atomic<long> g_outlier_eject_errors_total{0};
+static std::atomic<long> g_retry_budget_exhausted_total{0};
+
+static double mono_s() {
+  return std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                       g_start_steady).count();
+}
+
+static std::string rep_key(const Url& u) {
+  return u.host + ":" + std::to_string(u.port);
+}
+
+static bool outlier_is_quarantined(const std::string& model, const Url& u) {
+  std::lock_guard<std::mutex> lock(g_outlier_mu);
+  auto mit = g_outlier_stats.find(model);
+  if (mit == g_outlier_stats.end()) return false;
+  auto it = mit->second.find(rep_key(u));
+  return it != mit->second.end() && it->second.quarantined;
+}
+
+static int outlier_quarantined_count(const std::string& model) {
+  std::lock_guard<std::mutex> lock(g_outlier_mu);
+  auto mit = g_outlier_stats.find(model);
+  if (mit == g_outlier_stats.end()) return 0;
+  int n = 0;
+  for (const auto& kv : mit->second)
+    if (kv.second.quarantined) ++n;
+  return n;
+}
+
+// true when THIS request should shadow-probe a quarantined replica
+// (called once per routed request while the model has one)
+static bool outlier_shadow_tick(const OutlierCfg& oc,
+                                const std::string& model) {
+  std::lock_guard<std::mutex> lock(g_outlier_mu);
+  long c = ++g_shadow_count[model];
+  int every = std::max(1, oc.shadow_every);
+  return c % every == 0;
+}
+
+// fold one in-band sample (success with TTFT, or an error) into the
+// replica's detector and act on the event. The peer group is same model
+// AND same role — a prefill pool's latency profile says nothing about a
+// decode pool's. ttft_ms < 0 = no TTFT sample.
+static void outlier_observe(const Config& cfg, const std::string& model,
+                            const std::vector<Url>& reps, const Url& u,
+                            double ttft_ms, bool error) {
+  if (!cfg.outlier.enabled) return;
+  const std::string& role = cfg.role_of(u);
+  std::vector<std::string> group;
+  for (const Url& p : reps)
+    if (cfg.role_of(p) == role) group.push_back(rep_key(p));
+  std::string ev;
+  {
+    std::lock_guard<std::mutex> lock(g_outlier_mu);
+    ev = outlier_record(cfg.outlier, g_outlier_stats[model], rep_key(u),
+                        group, ttft_ms, error, mono_s());
+  }
+  if (ev == "quarantine:latency") {
+    g_outlier_eject_latency_total.fetch_add(1, std::memory_order_relaxed);
+    logf(cfg, "replica quarantined %s: %s:%d (latency outlier)",
+         model.c_str(), u.host.c_str(), u.port);
+  } else if (ev == "quarantine:errors") {
+    g_outlier_eject_errors_total.fetch_add(1, std::memory_order_relaxed);
+    logf(cfg, "replica quarantined %s: %s:%d (error-rate outlier)",
+         model.c_str(), u.host.c_str(), u.port);
+  } else if (ev == "readmit") {
+    logf(cfg, "replica readmitted %s: %s:%d", model.c_str(), u.host.c_str(),
+         u.port);
+  } else if (ev == "guard_blocked") {
+    logf(cfg, "quarantine guard blocked %s: %s:%d (max ejection fraction)",
+         model.c_str(), u.host.c_str(), u.port);
+  }
+}
+
+static void retry_budget_on_primary(const Config& cfg,
+                                    const std::string& model) {
+  if (!cfg.retry_budget.enabled) return;
+  std::lock_guard<std::mutex> lock(g_budget_mu);
+  budget_on_primary_f(cfg.retry_budget, g_budgets[model], mono_s());
+}
+
+// gate one retry; a refusal is counted and logged (the anti-retry-storm
+// throttle firing is an operator-visible event)
+static bool retry_budget_charge(const Config& cfg, const std::string& model,
+                                const std::string& rid, const char* source) {
+  if (!cfg.retry_budget.enabled) return true;
+  bool ok;
+  {
+    std::lock_guard<std::mutex> lock(g_budget_mu);
+    ok = budget_charge_f(cfg.retry_budget, g_budgets[model], mono_s());
+  }
+  if (!ok) {
+    g_retry_budget_exhausted_total.fetch_add(1, std::memory_order_relaxed);
+    logf(cfg, "retry budget exhausted %s: %s retry shed (rid=%s)",
+         model.c_str(), source, rid.c_str());
+  }
+  return ok;
+}
+
+// return a token when a charged retry was never dispatched (no replica)
+static void retry_budget_refund(const Config& cfg, const std::string& model) {
+  if (!cfg.retry_budget.enabled) return;
+  std::lock_guard<std::mutex> lock(g_budget_mu);
+  budget_refund_f(cfg.retry_budget, g_budgets[model]);
+}
+
+// ---------------------------------------------------------------------------
 // Request IDs + structured access log (mirrors server/tracing.py)
 // ---------------------------------------------------------------------------
 
@@ -975,6 +1336,82 @@ class HealthRegistry {
 
 static HealthRegistry g_health;
 
+// /debug/replicas body: per-replica routing state (health, breaker,
+// inflight) plus — when the gray-failure layer is on — the quarantine
+// FSM snapshot and the model's retry-budget level. Shape mirrors the
+// python router's debug_replicas() so dashboards/tests read either.
+static std::string debug_replicas_json(const Config& cfg) {
+  auto root = Json::make(Json::Type::Object);
+  root->set("outlier_ejection_enabled", Json::of_bool(cfg.outlier.enabled));
+  root->set("retry_budget_enabled", Json::of_bool(cfg.retry_budget.enabled));
+  auto models = Json::make(Json::Type::Object);
+  for (const auto& kv : cfg.models) {
+    auto entry = Json::make(Json::Type::Object);
+    auto reps = Json::make(Json::Type::Array);
+    for (const Url& u : kv.second) {
+      auto d = Json::make(Json::Type::Object);
+      d->set("url", Json::of_string("http://" + u.host + ":" +
+                                    std::to_string(u.port)));
+      d->set("role", Json::of_string(cfg.role_of(u)));
+      ReplicaHealth& h = g_health.get(u.host, u.port);
+      d->set("healthy",
+             Json::of_bool(h.healthy.load(std::memory_order_relaxed)));
+      d->set("inflight",
+             Json::of_number(h.inflight.load(std::memory_order_relaxed)));
+      d->set("breaker",
+             Json::of_string(g_breakers.get(u.host, u.port).open_state()
+                                 ? "open" : "closed"));
+      if (cfg.outlier.enabled) {
+        auto o = Json::make(Json::Type::Object);
+        OutlierStat s;
+        {
+          std::lock_guard<std::mutex> lock(g_outlier_mu);
+          auto mit = g_outlier_stats.find(kv.first);
+          if (mit != g_outlier_stats.end()) {
+            auto it = mit->second.find(rep_key(u));
+            if (it != mit->second.end()) s = it->second;
+          }
+        }
+        o->set("quarantined", Json::of_bool(s.quarantined));
+        o->set("reason", Json::of_string(s.reason));
+        o->set("ewma_ttft_ms",
+               s.has_ttft ? Json::of_number(s.ewma_ttft_ms)
+                          : Json::make(Json::Type::Null));
+        o->set("ewma_err", s.has_err ? Json::of_number(s.ewma_err)
+                                     : Json::make(Json::Type::Null));
+        o->set("samples", Json::of_number(s.samples));
+        o->set("streak", Json::of_number(s.streak));
+        o->set("readmit", Json::of_number(s.readmit));
+        o->set("ejections", Json::of_number(s.ejections));
+        if (s.quarantined)
+          o->set("quarantined_age_s",
+                 Json::of_number(std::max(0.0, mono_s() - s.quarantined_at)));
+        d->set("outlier", o);
+      }
+      reps->arr.push_back(d);
+    }
+    entry->set("replicas", reps);
+    if (cfg.retry_budget.enabled) {
+      auto b = Json::make(Json::Type::Object);
+      double level;
+      {
+        std::lock_guard<std::mutex> lock(g_budget_mu);
+        BudgetState& st = g_budgets[kv.first];
+        if (!st.init) { st.level = cfg.retry_budget.burst; st.init = true; }
+        level = st.level;
+      }
+      b->set("level", Json::of_number(level));
+      b->set("burst", Json::of_number(cfg.retry_budget.burst));
+      b->set("ratio", Json::of_number(cfg.retry_budget.ratio));
+      b->set("min_per_s", Json::of_number(cfg.retry_budget.min_per_s));
+      entry->set("retry_budget", b);
+    }
+    models->set(kv.first, entry);
+  }
+  root->set("models", models);
+  return root->dump();
+}
+
 static thread_local unsigned g_pick_seed = 0;
 
 static unsigned pick_rand(unsigned bound) {
@@ -1018,7 +1455,9 @@ enum RolePick {
 // or breaker-blocked replicas are never picked — the caller answers 503.
 static const Url* pick_replica(const Config& cfg, const std::vector<Url>& reps,
                                const std::vector<const Url*>& tried,
-                               int role_mode = kRoleAny) {
+                               int role_mode = kRoleAny,
+                               const std::string* model = nullptr,
+                               bool shadow = false) {
   auto is_tried = [&](const Url& u) {
     for (const Url* t : tried)
       if (t == &u) return true;
@@ -1036,19 +1475,40 @@ static const Url* pick_replica(const Config& cfg, const std::vector<Url>& reps,
     if (mode == kRoleStrictDecode) return r == "decode";
     return r != "prefill";  // kRolePreferServe: both|decode first
   };
-  auto build_pool = [&](int mode) {
+  // quarantine filter (gray-failure layer, mirrors server/router.py
+  // _pick): quarantined replicas leave the candidate set, a shadow
+  // request prefers them (the re-admission probe), and a quarantined-
+  // only pool degrades instead of refusing
+  const bool oe = cfg.outlier.enabled && model != nullptr;
+  auto quarantined = [&](const Url& u) {
+    return oe && outlier_is_quarantined(*model, u);
+  };
+  // qmode: 0 = exclude quarantined, 1 = only quarantined, 2 = ignore
+  auto build_pool = [&](int mode, int qmode) {
     std::vector<const Url*> pool;
-    for (const auto& u : reps)
-      if (!is_tried(u) && routable(u) && role_ok(u, mode)) pool.push_back(&u);
-    if (pool.empty() && !tried.empty()) {
-      for (const auto& u : reps)
-        if (routable(u) && role_ok(u, mode)) pool.push_back(&u);
+    for (const auto& u : reps) {
+      if (is_tried(u) || !routable(u) || !role_ok(u, mode)) continue;
+      if (qmode == 0 && quarantined(u)) continue;
+      if (qmode == 1 && !quarantined(u)) continue;
+      pool.push_back(&u);
+    }
+    if (pool.empty() && qmode != 1 && !tried.empty()) {
+      for (const auto& u : reps) {
+        if (!routable(u) || !role_ok(u, mode)) continue;
+        if (qmode == 0 && quarantined(u)) continue;
+        pool.push_back(&u);
+      }
     }
     return pool;
   };
-  std::vector<const Url*> pool = build_pool(role_mode);
-  if (pool.empty() && role_mode == kRolePreferServe)
-    pool = build_pool(kRoleAny);
+  std::vector<const Url*> pool;
+  if (oe && shadow) pool = build_pool(role_mode, 1);
+  if (pool.empty()) pool = build_pool(role_mode, oe ? 0 : 2);
+  if (pool.empty() && oe) pool = build_pool(role_mode, 2);
+  if (pool.empty() && role_mode == kRolePreferServe) {
+    pool = build_pool(kRoleAny, oe ? 0 : 2);
+    if (pool.empty() && oe) pool = build_pool(kRoleAny, 2);
+  }
   if (pool.empty()) return nullptr;
   if (pool.size() == 1) return pool[0];
   size_t a = pick_rand(static_cast<unsigned>(pool.size()));
@@ -1343,16 +1803,21 @@ static std::string cluster_metrics_text(const Config& cfg) {
   return out.str();
 }
 
-// exponential backoff with full jitter: base * 2^attempt * (1 + U[0,1))
-static void backoff_sleep(const Config& cfg, int attempt) {
+// exponential backoff with full jitter: base * 2^attempt * (1 + U[0,1)),
+// capped and deadline-aware via the shared o_backoff_s spec function —
+// never sleeps past half the remaining budget (remaining_s < 0 = none)
+static void backoff_sleep(const Config& cfg, int attempt,
+                          double remaining_s = -1.0) {
   static thread_local unsigned seed =
       static_cast<unsigned>(std::chrono::steady_clock::now()
                                 .time_since_epoch().count()) ^
       static_cast<unsigned>(
           std::hash<std::thread::id>{}(std::this_thread::get_id()));
-  double jitter = 1.0 + static_cast<double>(rand_r(&seed)) / RAND_MAX;
-  long ms = static_cast<long>(cfg.retry_backoff_ms * (1L << attempt) * jitter);
-  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  double rand01 = static_cast<double>(rand_r(&seed)) / RAND_MAX;
+  double s = o_backoff_s(cfg.retry_backoff_ms / 1000.0, attempt, rand01,
+                         5.0, remaining_s);
+  if (s <= 0) return;
+  std::this_thread::sleep_for(std::chrono::duration<double>(s));
 }
 
 // ---------------------------------------------------------------------------
@@ -1820,6 +2285,18 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                std::chrono::steady_clock::now() - a).count();
   };
 
+  // every admitted primary request earns the model's retry budget its
+  // `ratio` fraction of a token; the recursive decode hop of a handoff
+  // is the SAME primary request, so it earns nothing extra
+  if (!hctx) retry_budget_on_primary(cfg, model);
+  // shadow decision (gray-failure layer): while the model has a
+  // quarantined replica, every shadow_every-th request steers its FIRST
+  // attempt there as the in-band re-admission probe — retries and hedges
+  // never land on a quarantined replica
+  const bool shadow = cfg.outlier.enabled &&
+                      outlier_quarantined_count(model) > 0 &&
+                      outlier_shadow_tick(cfg.outlier, model);
+
   // end-to-end deadline: the X-LLMK-Deadline-Ms header (ms of budget
   // remaining) wins over the body's OpenAI-style "timeout" seconds field;
   // whatever is left after gateway time is forwarded upstream
@@ -1973,7 +2450,8 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     for (int attempt = 0; attempt < std::max(1, cfg.retry_attempts);
          ++attempt) {
       if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
-      const Url* pt = pick_replica(cfg, replicas, tried_p, kRoleStrictPrefill);
+      const Url* pt =
+          pick_replica(cfg, replicas, tried_p, kRoleStrictPrefill, &model);
       if (!pt) break;
       Breaker& pb = g_breakers.get(pt->host, pt->port);
       double ra = 0.0;
@@ -1986,6 +2464,12 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
         --attempt;
         continue;
       }
+      // prefill retries draw from the same per-model budget as every
+      // other retry source; exhausted = stop hunting for a ticket and
+      // let the colocated fallback serve (degraded, never an error)
+      if (attempt > 0 &&
+          !retry_budget_charge(cfg, model, rid, "handoff_prefill"))
+        break;
       ReplicaHealth* ph = &g_health.get(pt->host, pt->port);
       ph->inflight.fetch_add(1, std::memory_order_relaxed);
       int pfd = g_upstream_pool.acquire(pt->host, pt->port);
@@ -1995,6 +2479,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (pfd < 0) {
         ph->inflight.fetch_sub(1, std::memory_order_relaxed);
         pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        outlier_observe(cfg, model, replicas, *pt, -1.0, true);
         tried_p.push_back(pt);
         continue;
       }
@@ -2008,6 +2493,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
         ::close(pfd);
         ph->inflight.fetch_sub(1, std::memory_order_relaxed);
         pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        outlier_observe(cfg, model, replicas, *pt, -1.0, true);
         tried_p.push_back(pt);
         continue;
       }
@@ -2024,6 +2510,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
         if (!tkt || !tkt->is_object()) {
           // mangled ticket: the same as a transport failure mid-answer
           pb.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+          outlier_observe(cfg, model, replicas, *pt, -1.0, true);
           tried_p.push_back(pt);
           continue;
         }
@@ -2103,7 +2590,8 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   if (!got_head)
   for (int attempt = 0; attempt < max_attempts; ++attempt) {
     if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
-    target = pick_replica(cfg, replicas, tried, role_mode);
+    target = pick_replica(cfg, replicas, tried, role_mode, &model,
+                          shadow && attempt == 0);
     if (!target) break;
     Breaker& breaker = g_breakers.get(target->host, target->port);
     double retry_after_s = 0.0;
@@ -2126,6 +2614,25 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
            prev->host.c_str(), prev->port, target->host.c_str(),
            target->port);
     }
+    // connect-phase failovers beyond the first attempt draw from the
+    // per-model retry budget; an exhausted budget sheds explicitly
+    // (code=retry_budget_exhausted) on the primary path and downgrades
+    // the decode hop to the colocated fallback
+    if (attempt > 0 &&
+        !retry_budget_charge(cfg, model, rid,
+                             hctx ? "handoff_decode" : "connect")) {
+      if (hctx) break;
+      std::string body = error_json(
+          "retry budget exhausted after upstream error: " + fail_msg,
+          "service_unavailable", "retry_budget_exhausted");
+      send_all(client_fd,
+               simple_response(503, "Service Unavailable", "application/json",
+                               body, req.keep_alive,
+                               "Retry-After: 1\r\n" + rid_header));
+      g_slo.observe(503, -1.0);
+      jlog_request(cfg, rid, model, "", 503, ms_since(t0), 0.0, ms_since(t0));
+      return req.keep_alive;
+    }
     attempted = true;
     health = &g_health.get(target->host, target->port);
     health->inflight.fetch_add(1, std::memory_order_relaxed);
@@ -2142,13 +2649,17 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (up_fd < 0) {
         health->inflight.fetch_sub(1, std::memory_order_relaxed);
         breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+        outlier_observe(cfg, model, replicas, *target, -1.0, true);
         fail_msg = "upstream connect failed: " + target->host + ":" +
                    std::to_string(target->port);
         prev = target;
         tried.push_back(target);
         if (attempt + 1 < max_attempts) {
           if (!has_untried_alternate(cfg, replicas, tried))
-            backoff_sleep(cfg, attempt);
+            backoff_sleep(cfg, attempt,
+                          budget_ms >= 0
+                              ? std::max(0.0, remaining_ms()) / 1000.0
+                              : -1.0);
           continue;
         }
         break;
@@ -2210,12 +2721,15 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       continue;
     }
     breaker.record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+    outlier_observe(cfg, model, replicas, *target, -1.0, true);
     fail_msg = timed_out ? "upstream read timed out" : "upstream error";
     prev = target;
     tried.push_back(target);
     if (virgin && !timed_out && attempt + 1 < max_attempts) {
       if (!has_untried_alternate(cfg, replicas, tried))
-        backoff_sleep(cfg, attempt);
+        backoff_sleep(cfg, attempt,
+                      budget_ms >= 0 ? std::max(0.0, remaining_ms()) / 1000.0
+                                     : -1.0);
       continue;
     }
     break;
@@ -2341,7 +2855,11 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       if (pr == 0) {
         std::vector<const Url*> skip = tried;
         skip.push_back(target);
-        const Url* hr = pick_replica(cfg, replicas, skip, role_mode);
+        const Url* hr = pick_replica(cfg, replicas, skip, role_mode, &model);
+        // a hedge is a speculative retry: it draws from the same budget;
+        // exhausted = wait on the primary alone (single-attempt path)
+        if (hr && !retry_budget_charge(cfg, model, rid, "hedge"))
+          hr = nullptr;
         if (hr) {
           ReplicaHealth* hh = &g_health.get(hr->host, hr->port);
           hh->inflight.fetch_add(1, std::memory_order_relaxed);
@@ -2355,11 +2873,13 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
             // secondary never reached the race: fall back to the primary.
             // Only a transport failure feeds the breaker — a non-200
             // answer means the replica is alive but refused.
-            if (fd2 >= 0)
+            if (fd2 >= 0) {
               ::close(fd2);
-            else
+            } else {
               g_breakers.get(hr->host, hr->port)
                   .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+              outlier_observe(cfg, model, replicas, *hr, -1.0, true);
+            }
             hh->inflight.fetch_sub(1, std::memory_order_relaxed);
             tried.push_back(hr);
             g_hedged_primary_won_total.fetch_add(1,
@@ -2430,8 +2950,14 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     while (true) {  // one iteration per body read; resumes splice inline
       ssize_t n = body_r->next(buf, sizeof buf);
       if (n > 0) {
-        if (first_at == std::chrono::steady_clock::time_point{})
+        if (first_at == std::chrono::steady_clock::time_point{}) {
           first_at = std::chrono::steady_clock::now();
+          // first relayed byte = the replica's in-band TTFT sample
+          outlier_observe(cfg, model, replicas, *target,
+                          std::chrono::duration<double, std::milli>(
+                              first_at - t0).count(),
+                          false);
+        }
         relayed += static_cast<size_t>(n);
         std::string fwd = journal.feed(buf, static_cast<size_t>(n));
         if (!fwd.empty() && !write_client_chunk(client_fd, fwd)) {
@@ -2449,6 +2975,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       // --- upstream died mid-stream
       g_breakers.get(target->host, target->port)
           .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+      outlier_observe(cfg, model, replicas, *target, -1.0, true);
       health->inflight.fetch_sub(1, std::memory_order_relaxed);
       health = nullptr;
       ::close(up_fd);
@@ -2496,14 +3023,21 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
             extra += std::string(kResumeCreatedHeader) + ": " +
                      std::to_string(journal.created) + "\r\n";
         }  // else: nothing reached the client yet — a clean re-issue
-        int budget = cfg.resume_attempts - resumes;
-        for (int used = 0; used < budget && fd2 < 0;) {
+        int attempts_left = cfg.resume_attempts - resumes;
+        for (int used = 0; used < attempts_left && fd2 < 0;) {
           if (budget_ms >= 0 && remaining_ms() <= 0) {
             why = "deadline";
             break;
           }
-          nt = pick_replica(cfg, replicas, tried, role_mode);
+          // a resume re-issue is a retry: it draws from the per-model
+          // budget (refunded when no replica exists to send it to)
+          if (!retry_budget_charge(cfg, model, rid, "stream_resume")) {
+            why = "retry budget exhausted";
+            break;
+          }
+          nt = pick_replica(cfg, replicas, tried, role_mode, &model);
           if (!nt) {
+            retry_budget_refund(cfg, model);
             why = "no healthy replica";
             break;
           }
@@ -2516,6 +3050,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
             nh->inflight.fetch_sub(1, std::memory_order_relaxed);
             g_breakers.get(nt->host, nt->port)
                 .record_failure(cfg.breaker_threshold, cfg.breaker_open_s);
+            outlier_observe(cfg, model, replicas, *nt, -1.0, true);
             tried.push_back(nt);
             continue;
           }
@@ -2630,10 +3165,15 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       first_at == std::chrono::steady_clock::time_point{}
           ? head_ms
           : std::chrono::duration<double, std::milli>(first_at - t0).count();
+  // SLO first: the client already has its last byte, so a fast /metrics
+  // scrape races this bookkeeping — keep that window free of the
+  // outlier layer's mutex
   g_slo.observe(head.status,
                 first_at == std::chrono::steady_clock::time_point{}
                     ? -1.0
                     : ttfb_ms);
+  if (first_at != std::chrono::steady_clock::time_point{})
+    outlier_observe(cfg, model, replicas, *target, ttfb_ms, false);
   jlog_request(cfg, rid, model,
                target->host + ":" + std::to_string(target->port),
                head.status, connect_ms, ttfb_ms, ms_since(t0));
@@ -2733,6 +3273,13 @@ static void handle_connection(const Config& cfg, int client_fd,
                                       req.keep_alive)) &&
              req.keep_alive;
       logf(cfg, "GET /metrics/cluster -> 200 (aggregated)");
+    } else if (path == "/debug/replicas" && req.method == "GET") {
+      keep = send_all(client_fd,
+                      simple_response(200, "OK", "application/json",
+                                      debug_replicas_json(cfg),
+                                      req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "GET /debug/replicas -> 200");
     } else if (path == "/metrics" && req.method == "GET") {
       SloTracker::Snap slo = g_slo.snapshot();
       double uptime_s = std::chrono::duration<double>(
@@ -2925,6 +3472,54 @@ static void handle_connection(const Config& cfg, int client_fd,
             << cfg.role_of(u) << "\"} "
             << (g_breakers.get(u.host, u.port).open_state() ? 1 : 0)
             << "\n";
+      // gray-failure layer (same family names + HELP as
+      // server/metrics.py router_metrics(); series appear only when the
+      // layer is configured, matching the python pre-seeding)
+      m << "# HELP llm_replica_quarantined Gray-failure quarantine "
+           "verdict per replica (1=ejected from P2C candidate sets, "
+           "serving only shadow traffic), by the outlier dimension that "
+           "tripped it (latency|errors)\n"
+        << "# TYPE llm_replica_quarantined gauge\n";
+      if (cfg.outlier.enabled) {
+        std::lock_guard<std::mutex> lock(g_outlier_mu);
+        for (const auto& kv : cfg.models) {
+          auto mit = g_outlier_stats.find(kv.first);
+          for (const Url& u : kv.second) {
+            const OutlierStat* s = nullptr;
+            if (mit != g_outlier_stats.end()) {
+              auto it = mit->second.find(rep_key(u));
+              if (it != mit->second.end()) s = &it->second;
+            }
+            for (const char* reason : {"latency", "errors"})
+              m << "llm_replica_quarantined{model=\""
+                << prom_escape(kv.first) << "\",replica=\"http://"
+                << u.host << ":" << u.port << "\",reason=\"" << reason
+                << "\"} "
+                << ((s && s->quarantined && s->reason == reason) ? 1 : 0)
+                << "\n";
+          }
+        }
+      }
+      m << "# HELP llm_outlier_ejections_total Replicas quarantined by "
+           "the latency/error outlier detector, by reason (latency = "
+           "TTFT EWMA z-score over peers, errors = error-rate EWMA "
+           "z-score)\n"
+        << "# TYPE llm_outlier_ejections_total counter\n";
+      if (cfg.outlier.enabled)
+        m << "llm_outlier_ejections_total{reason=\"latency\"} "
+          << g_outlier_eject_latency_total.load(std::memory_order_relaxed)
+          << "\n"
+          << "llm_outlier_ejections_total{reason=\"errors\"} "
+          << g_outlier_eject_errors_total.load(std::memory_order_relaxed)
+          << "\n";
+      m << "# HELP llm_retry_budget_exhausted_total Retries (connect "
+           "failover, stream resume, hedges, handoff retries) refused "
+           "because the per-model retry budget was exhausted — the "
+           "anti-retry-storm throttle\n"
+        << "# TYPE llm_retry_budget_exhausted_total counter\n"
+        << "llm_retry_budget_exhausted_total "
+        << g_retry_budget_exhausted_total.load(std::memory_order_relaxed)
+        << "\n";
       keep = send_all(client_fd,
                       simple_response(200, "OK",
                                       "text/plain; version=0.0.4", m.str(),
@@ -3058,6 +3653,44 @@ static void parse_qos_entry(const Json* e, QosEntry& out) {
   if (const Json* v = e->get("tokens_per_min");
       v && v->type == Json::Type::Number)
     out.tokens_per_min = v->number;
+}
+
+// "outlier_ejection" / "retry_budget" config blocks (same wire keys as
+// server/outlier.py OutlierConfig/RetryBudgetConfig; a present non-empty
+// block enables the layer, junk-typed fields keep their defaults)
+static void parse_outlier_config(const Json* o, OutlierCfg& out) {
+  if (!o || !o->is_object()) return;
+  out.enabled = !o->obj.empty();
+  auto num_field = [&](const char* key, double& dst) {
+    if (const Json* v = o->get(key); v && v->type == Json::Type::Number)
+      dst = v->number;
+  };
+  auto int_field = [&](const char* key, int& dst) {
+    if (const Json* v = o->get(key); v && v->type == Json::Type::Number)
+      dst = static_cast<int>(v->number);
+  };
+  num_field("ewma_alpha", out.ewma_alpha);
+  num_field("z_threshold", out.z_threshold);
+  num_field("cv_floor", out.cv_floor);
+  num_field("err_spread_floor", out.err_spread_floor);
+  num_field("min_ttft_ms", out.min_ttft_ms);
+  num_field("err_floor", out.err_floor);
+  int_field("min_samples", out.min_samples);
+  int_field("streak", out.streak);
+  num_field("max_eject_fraction", out.max_eject_fraction);
+  int_field("shadow_every", out.shadow_every);
+  int_field("readmit_successes", out.readmit_successes);
+}
+
+static void parse_budget_config(const Json* b, BudgetCfg& out) {
+  if (!b || !b->is_object()) return;
+  out.enabled = !b->obj.empty();
+  if (const Json* v = b->get("ratio"); v && v->type == Json::Type::Number)
+    out.ratio = v->number;
+  if (const Json* v = b->get("min_per_s"); v && v->type == Json::Type::Number)
+    out.min_per_s = v->number;
+  if (const Json* v = b->get("burst"); v && v->type == Json::Type::Number)
+    out.burst = v->number;
 }
 
 static void parse_qos_config(const Json* q, QosConfig& out) {
@@ -3208,6 +3841,209 @@ static int qos_selftest(const std::string& file) {
   return failures ? 1 : 0;
 }
 
+// --outlier-selftest FILE: drive the shared gray-failure vectors
+// (tests/data/outlier_vectors.json) against this implementation. The
+// python side runs the same file through server/outlier.py
+// (tests/test_outlier.py) — together they hold the two routers
+// byte-compatible on outlier-ejection / retry-budget / backoff
+// semantics. Exit 0 = all checks pass.
+static int outlier_selftest(const std::string& file) {
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "outlier-selftest: cannot open %s\n", file.c_str());
+    return 1;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  JsonPtr root = JsonParser::parse(ss.str());
+  if (!root || !root->is_object()) {
+    fprintf(stderr, "outlier-selftest: malformed vectors file\n");
+    return 1;
+  }
+  int checks = 0, failures = 0;
+  const double kTol = 1e-6;
+  auto fail = [&](const std::string& what) {
+    fprintf(stderr, "outlier-selftest: FAIL %s\n", what.c_str());
+    ++failures;
+  };
+  auto num = [](const Json* o, const char* k, double d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Number ? v->number : d;
+  };
+  auto str = [](const Json* o, const char* k,
+                const std::string& d) -> std::string {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->is_string() ? v->str : d;
+  };
+  auto flag = [](const Json* o, const char* k, bool d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Bool ? v->boolean : d;
+  };
+  auto close_to = [&](double a, double b) { return std::fabs(a - b) < kTol; };
+
+  if (const Json* sec = root->get("ewma");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      const Json* prev = it->get("prev");
+      bool has_prev = prev && prev->type == Json::Type::Number;
+      double got = o_ewma(has_prev, has_prev ? prev->number : 0.0,
+                          num(it.get(), "sample", 0.0),
+                          num(it.get(), "alpha", 0.0));
+      if (!close_to(got, num(it.get(), "expect", -1.0)))
+        fail("ewma = " + std::to_string(got));
+    }
+
+  if (const Json* sec = root->get("zscore");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      std::vector<double> peers;
+      if (const Json* p = it->get("peers"); p && p->type == Json::Type::Array)
+        for (const auto& v : p->arr)
+          if (v->type == Json::Type::Number) peers.push_back(v->number);
+      double got = o_peer_zscore(num(it.get(), "value", 0.0), peers,
+                                 num(it.get(), "rel_floor", 0.0),
+                                 num(it.get(), "abs_floor", 0.0));
+      if (!close_to(got, num(it.get(), "expect", -1.0)))
+        fail("zscore = " + std::to_string(got));
+    }
+
+  if (const Json* sec = root->get("backoff");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      double got = o_backoff_s(num(it.get(), "base_s", 0.0),
+                               static_cast<int>(num(it.get(), "attempt", 0.0)),
+                               num(it.get(), "rand01", 0.0),
+                               num(it.get(), "cap_s", 5.0),
+                               num(it.get(), "remaining_s", -1.0));
+      if (!close_to(got, num(it.get(), "expect", -1.0)))
+        fail("backoff = " + std::to_string(got));
+    }
+
+  if (const Json* sec = root->get("max_quarantined");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      int got = o_max_quarantined(num(it.get(), "fraction", 0.0),
+                                  static_cast<int>(num(it.get(), "pool", 0.0)));
+      if (got != static_cast<int>(num(it.get(), "expect", -1.0)))
+        fail("max_quarantined = " + std::to_string(got));
+    }
+
+  if (const Json* sec = root->get("detector");
+      sec && sec->type == Json::Type::Array) {
+    int gi = -1;
+    for (const auto& group : sec->arr) {
+      ++gi;
+      OutlierCfg oc;
+      parse_outlier_config(group->get("config"), oc);
+      std::vector<std::string> members;
+      if (const Json* g = group->get("group");
+          g && g->type == Json::Type::Array)
+        for (const auto& v : g->arr)
+          if (v->is_string()) members.push_back(v->str);
+      OutlierStats stats;
+      double clock = 0.0;
+      const Json* seq = group->get("checks");
+      if (!seq || seq->type != Json::Type::Array) continue;
+      int i = -1;
+      for (const auto& it : seq->arr) {
+        ++checks;
+        ++i;
+        clock += 1.0;
+        const Json* tt = it->get("ttft_ms");
+        double ttft = tt && tt->type == Json::Type::Number ? tt->number : -1.0;
+        std::string event =
+            outlier_record(oc, stats, str(it.get(), "url", ""), members, ttft,
+                           flag(it.get(), "error", false), clock);
+        const Json* ex = it->get("expect");
+        std::string tag = "detector group #" + std::to_string(gi) +
+                          " check #" + std::to_string(i);
+        if (event != str(ex, "event", ""))
+          fail(tag + " event='" + event + "'");
+        const OutlierStat& s = stats[str(it.get(), "url", "")];
+        if (const Json* v = ex ? ex->get("quarantined") : nullptr;
+            v && v->type == Json::Type::Bool && s.quarantined != v->boolean)
+          fail(tag + " quarantined=" + (s.quarantined ? "true" : "false"));
+        if (const Json* v = ex ? ex->get("streak") : nullptr;
+            v && v->type == Json::Type::Number &&
+            s.streak != static_cast<int>(v->number))
+          fail(tag + " streak=" + std::to_string(s.streak));
+        if (const Json* v = ex ? ex->get("ewma_ttft_ms") : nullptr;
+            v && v->type == Json::Type::Number &&
+            !(s.has_ttft && close_to(s.ewma_ttft_ms, v->number)))
+          fail(tag + " ewma_ttft_ms=" + std::to_string(s.ewma_ttft_ms));
+        if (const Json* v = ex ? ex->get("ewma_err") : nullptr;
+            v && v->type == Json::Type::Number &&
+            !(s.has_err && close_to(s.ewma_err, v->number)))
+          fail(tag + " ewma_err=" + std::to_string(s.ewma_err));
+      }
+    }
+  }
+
+  if (const Json* sec = root->get("budget");
+      sec && sec->type == Json::Type::Array) {
+    int gi = -1;
+    for (const auto& group : sec->arr) {
+      ++gi;
+      BudgetCfg bc;
+      parse_budget_config(group->get("config"), bc);
+      BudgetState st;
+      const Json* seq = group->get("ops");
+      if (!seq || seq->type != Json::Type::Array) continue;
+      int i = -1;
+      for (const auto& it : seq->arr) {
+        ++checks;
+        ++i;
+        std::string op = str(it.get(), "op", "");
+        std::string tag = "budget group #" + std::to_string(gi) + " op #" +
+                          std::to_string(i) + " (" + op + ")";
+        if (op == "charge") {
+          bool ok = budget_charge_f(bc, st, num(it.get(), "at", 0.0));
+          if (ok != flag(it.get(), "expect_ok", !ok))
+            fail(tag + " ok=" + (ok ? "true" : "false"));
+        } else if (op == "primary") {
+          budget_on_primary_f(bc, st, num(it.get(), "at", 0.0));
+        } else if (op == "refund") {
+          budget_refund_f(bc, st);
+        } else {
+          fail(tag + " unknown op");
+          continue;
+        }
+        if (!close_to(st.level, num(it.get(), "expect_level", -1.0)))
+          fail(tag + " level=" + std::to_string(st.level));
+      }
+    }
+  }
+
+  if (const Json* sec = root->get("shadow");
+      sec && sec->type == Json::Type::Array)
+    for (const auto& it : sec->arr) {
+      ++checks;
+      int every = std::max(1, static_cast<int>(num(it.get(), "every", 1.0)));
+      int ticks = static_cast<int>(num(it.get(), "ticks", 0.0));
+      std::vector<int> fired;
+      long counter = 0;
+      for (int i = 1; i <= ticks; ++i) {
+        ++counter;
+        if (counter % every == 0) fired.push_back(i);
+      }
+      std::vector<int> want;
+      if (const Json* w = it->get("expect_true");
+          w && w->type == Json::Type::Array)
+        for (const auto& v : w->arr)
+          want.push_back(static_cast<int>(v->number));
+      if (fired != want)
+        fail("shadow every=" + std::to_string(every) + " fired " +
+             std::to_string(fired.size()) + " ticks");
+    }
+
+  printf("outlier-selftest: %d checks, %d failures\n", checks, failures);
+  return failures ? 1 : 0;
+}
+
 static bool load_config_json(const std::string& file, Config& cfg) {
   std::ifstream in(file);
   if (!in) {
@@ -3330,6 +4166,8 @@ static bool load_config_json(const std::string& file, Config& cfg) {
       t && t->type == Json::Type::Number)
     cfg.handoff_retries = std::max(1, static_cast<int>(t->number));
   parse_qos_config(root->get("qos"), cfg.qos);
+  parse_outlier_config(root->get("outlier_ejection"), cfg.outlier);
+  parse_budget_config(root->get("retry_budget"), cfg.retry_budget);
   return true;
 }
 
@@ -3436,7 +4274,16 @@ int main(int argc, char** argv) {
   cfg.handoff_retries = std::max(
       1, static_cast<int>(env_double("LLMK_HANDOFF_RETRIES",
                                      cfg.handoff_retries)));
-  std::string config_file, models_inline, adapters_inline, qos_selftest_file;
+  std::string config_file, models_inline, adapters_inline, qos_selftest_file,
+      outlier_selftest_file;
+  // gray-failure knobs share the python router's env vars (JSON blocks in
+  // LLMK_OUTLIER / LLMK_RETRY_BUDGET); config-file keys override
+  if (const char* oj = getenv("LLMK_OUTLIER"); oj && *oj)
+    if (JsonPtr doc = JsonParser::parse(oj); doc && doc->is_object())
+      parse_outlier_config(doc.get(), cfg.outlier);
+  if (const char* bj = getenv("LLMK_RETRY_BUDGET"); bj && *bj)
+    if (JsonPtr doc = JsonParser::parse(bj); doc && doc->is_object())
+      parse_budget_config(doc.get(), cfg.retry_budget);
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* {
@@ -3524,6 +4371,10 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       qos_selftest_file = v;
+    } else if (a == "--outlier-selftest") {
+      const char* v = next();
+      if (!v) return 2;
+      outlier_selftest_file = v;
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
@@ -3534,14 +4385,18 @@ int main(int argc, char** argv) {
               "[--breaker-threshold N] [--breaker-open S] "
               "[--probe-interval S] [--no-stream-resume] "
               "[--resume-attempts N] [--hedge-ms MS] "
-              "[--qos-selftest VECTORS_JSON]\n");
+              "[--qos-selftest VECTORS_JSON] "
+              "[--outlier-selftest VECTORS_JSON]\n");
       return 2;
     }
   }
 
-  // parity harness for the shared QoS semantics: validate the vectors and
-  // exit without serving (tests/test_native_router.py drives this)
+  // parity harnesses for the shared QoS / gray-failure semantics:
+  // validate the vectors and exit without serving
+  // (tests/test_native_router.py drives these)
   if (!qos_selftest_file.empty()) return qos_selftest(qos_selftest_file);
+  if (!outlier_selftest_file.empty())
+    return outlier_selftest(outlier_selftest_file);
 
   if (!config_file.empty()) {
     if (!load_config_json(config_file, cfg)) return 1;

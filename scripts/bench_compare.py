@@ -12,7 +12,9 @@ when any metric moved more than the threshold in the BAD direction:
   ``*evictions*``/``*load_seconds*`` churn, mid-stream failover
   ``resume_gap_ms_*`` stalls and ``*visible_drops``, KV footprint
   ``kv_bytes_per_token`` and host-tier ``*cache_misses``, goodput
-  ``wasted_chip_fraction``): higher is worse;
+  ``wasted_chip_fraction``, gray-failure ``*detection_s``/
+  ``*ttft_ratio``/``*retry_volume``/``*budget_exhausted``): higher is
+  worse;
 - throughput-ish metrics (``*tokens_per_sec*`` — including the
   multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
   cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s —
@@ -46,7 +48,8 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|visible_drops|gave_up|kv_bytes_per_token"
                            r"|cache_misses|wasted_chip_fraction"
                            r"|disagg_decode_idle_frac|handoff_reprefill"
-                           r"|handoff_fallback)")
+                           r"|handoff_fallback|detection_s$|ttft_ratio"
+                           r"|retry_volume|budget_exhausted)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"

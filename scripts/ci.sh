@@ -248,6 +248,32 @@ sys.exit(0 if doc.get("disagg_parity_ok") is True
     fails=$((fails + 1))
   fi
 
+  note "chaos smoke (gray failure: outlier ejection + retry budget)"
+  # the smoke's chaos phase degrades one of three replicas to 1/8 decode
+  # speed while its probes stay green; the router's latency outlier
+  # detector must quarantine it from in-band TTFT alone, the surviving
+  # pool's p95 TTFT must return to <= 1.5x baseline, the 1/3 ejection
+  # guard must have held (one quarantined, two serving), every stream
+  # must complete, and a retry wave against an all-dead pool must stay
+  # within the token budget and shed with code=retry_budget_exhausted
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+ratio = doc.get("chaos_p95_ttft_ratio")
+sys.exit(0 if doc.get("chaos_quarantined_ok") is True
+         and doc.get("chaos_guard_ok") is True
+         and doc.get("chaos_dropped_streams") == 0
+         and ratio is not None and ratio <= 1.5
+         and doc.get("chaos_retry_volume_ok") is True
+         and (doc.get("chaos_budget_exhausted_sheds") or 0) >= 1
+         else 1)'; then
+    echo "ci: chaos smoke OK (quarantine, guard, bounded retries)"
+  else
+    echo "ci: chaos smoke FAILED (no quarantine, guard breached, p95"
+    echo "    not recovered, dropped streams, or retry volume over budget)"
+    fails=$((fails + 1))
+  fi
+
   note "goodput ledger smoke (chip-time conservation within 5%)"
   # the engine-phase ledger must conserve wall time: attributed (prefill
   # + decode) + wasted (spec tails, early exits) + idle device gaps

@@ -354,6 +354,43 @@ def test_router_kill_prefill_replica_falls_back_colocated(monkeypatch):
     asyncio.run(go())
 
 
+def test_router_handoff_downgrades_on_exhausted_budget(monkeypatch):
+    """Handoff-hop retries draw from the cluster retry budget; with the
+    budget exhausted, a failing prefill hop downgrades to the colocated
+    single-attempt path after ONE attempt instead of burning
+    retry_attempts or erroring: the client still gets the full stream
+    (served by the decode replica), counted fallback_colocated, and
+    exactly one budget shed is recorded."""
+    async def go():
+        ref = await _colocated_reference(_chat_body())
+        faults.reset_claims()
+        monkeypatch.setenv("LLMK_FAULT", "kill_prefill_replica:0.0")
+        try:
+            async with _Disagg(retry_budget={"ratio": 0, "min_per_s": 0,
+                                             "burst": 0}) as d:
+                deadline = time.monotonic() + 10
+                while d.s_pre.state != "killed" \
+                        and time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                assert d.s_pre.state == "killed", \
+                    "kill_prefill_replica never fired"
+                resp = await d.client.post("/v1/chat/completions",
+                                           json=_chat_body())
+                assert resp.status == 200
+                assert _sse_content(await resp.text()) == ref
+                m = d.router.metrics["handoff"]
+                assert m.labeled_value(outcome="fallback_colocated") == 1
+                assert m.labeled_value(outcome="ok") == 0
+                # one charge attempt (prefill-hop attempt 2) hit the empty
+                # bucket; the colocated fallback itself stayed free
+                assert d.router.metrics[
+                    "retry_budget_exhausted"].value == 1
+        finally:
+            monkeypatch.delenv("LLMK_FAULT")
+            faults.reset_claims()
+    asyncio.run(go())
+
+
 def test_router_handoff_declined_ticket_relays_stream():
     """A prefill replica that declines the ticket (ineligible request
     shape: n>1 is not handoff-eligible) streams the completion itself;

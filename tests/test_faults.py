@@ -71,6 +71,85 @@ def test_inject_delay_sleeps_its_arg(monkeypatch):
     assert 0.04 <= time.monotonic() - t0 < 1.0
 
 
+def test_gray_failure_fault_specs(monkeypatch):
+    """degraded_replica is a one-shot single-victim fault (claim), with a
+    default slowdown factor of 8; net_jitter is unclaimed (every replica
+    jitters) with a default of 25 ms."""
+    faults.reset_claims()
+    monkeypatch.setenv("LLMK_FAULT", "degraded_replica;net_jitter")
+    assert faults.get_float("degraded_replica", 8.0) == 8.0
+    assert faults.get_float("net_jitter", 25.0) == 25.0
+    assert faults.claim("degraded_replica")        # first replica wins
+    assert not faults.claim("degraded_replica")    # second stays healthy
+    monkeypatch.setenv("LLMK_FAULT", "degraded_replica:4;net_jitter:5")
+    assert faults.get_float("degraded_replica", 8.0) == 4.0
+    assert faults.get_float("net_jitter", 25.0) == 5.0
+    faults.reset_claims()
+
+
+@pytest.mark.e2e
+def test_degraded_replica_stays_probe_green(monkeypatch):
+    """The gray-failure victim claims the slowdown at startup but keeps
+    answering /health and /ready 200 and still serves requests — only
+    its in-band latency degrades (the router's probes must NOT save it;
+    that is the outlier detector's job)."""
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    faults.reset_claims()
+    monkeypatch.setenv("LLMK_FAULT", "degraded_replica:3")
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+    srv2 = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        client2 = TestClient(TestServer(srv2.make_app()))
+        await client.start_server()
+        await client2.start_server()
+        try:
+            # exactly one in-process replica degrades (single-victim)
+            assert srv._degraded_factor == 3.0
+            assert srv2._degraded_factor == 1.0
+            assert (await client.get("/health")).status == 200
+            r = await client.get("/ready")
+            assert r.status == 200 and (await r.json())["state"] == "serving"
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+            })
+            assert r.status == 200  # slow, not broken
+        finally:
+            await client.close()
+            await client2.close()
+    asyncio.run(go())
+    faults.reset_claims()
+
+
+@pytest.mark.e2e
+def test_net_jitter_delays_every_stream_but_serves(monkeypatch):
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+
+    monkeypatch.setenv("LLMK_FAULT", "net_jitter:2")
+    srv = OpenAIServer(_mk_engine(), ByteTokenizer(), "debug-tiny")
+
+    async def go():
+        client = TestClient(TestServer(srv.make_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "hi", "max_tokens": 4,
+                "stream": True,
+            })
+            assert r.status == 200
+            body = await r.read()
+            assert b"data: [DONE]" in body
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
 # ---------------------------------------------------------------------------
 # circuit breaker state machine (fake clock: fully deterministic)
 # ---------------------------------------------------------------------------

@@ -721,6 +721,106 @@ qos:
         load_spec(BASE_YAML + "\nqos: {brownout: {queue_depth_hi: -1}}\n")
 
 
+def test_router_config_gray_failure_blocks():
+    """ISSUE 17: outlierEjection/retryBudget flow verbatim into
+    router.json (both routers parse identical wire keys, pinned by
+    tests/data/outlier_vectors.json), validate their keys at spec load,
+    and roll the router pods via the config hash when tuned."""
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    cfg = router_config(load_spec(BASE_YAML))
+    assert "outlier_ejection" not in cfg  # absent block = no key at all
+    assert "retry_budget" not in cfg
+
+    gray_yaml = BASE_YAML + """
+outlierEjection:
+  ewma_alpha: 0.5
+  z_threshold: 2.5
+  min_samples: 4
+  streak: 2
+  max_eject_fraction: 0.25
+retryBudget:
+  ratio: 0.1
+  min_per_s: 0.5
+  burst: 6
+"""
+    spec = load_spec(gray_yaml)
+    cfg2 = router_config(spec)
+    # passed verbatim — field-level parity with the Go template's toJson
+    assert cfg2["outlier_ejection"] == {
+        "ewma_alpha": 0.5, "z_threshold": 2.5, "min_samples": 4,
+        "streak": 2, "max_eject_fraction": 0.25,
+    }
+    assert cfg2["retry_budget"] == {
+        "ratio": 0.1, "min_per_s": 0.5, "burst": 6,
+    }
+    assert config_hash(spec) != config_hash(load_spec(BASE_YAML))
+    # the python Router accepts the rendered blocks and arms the layer
+    r = Router(cfg2["backends"], cfg2["default_model"], cfg2["strict"],
+               outlier_ejection=cfg2["outlier_ejection"],
+               retry_budget=cfg2["retry_budget"])
+    assert r.outlier_cfg.enabled and r.outlier_cfg.ewma_alpha == 0.5
+    assert r.retry_budget_cfg.enabled and r.retry_budget_cfg.burst == 6.0
+
+    # an EMPTY block disables cleanly (matches both routers' truthiness)
+    cfg3 = router_config(load_spec(
+        BASE_YAML + "\noutlierEjection: {}\nretryBudget: {}\n"))
+    assert "outlier_ejection" not in cfg3 and "retry_budget" not in cfg3
+
+    # unknown keys and invalid values are rejected at spec load
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\noutlierEjection: {zscore: 3}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\noutlierEjection: {ewma_alpha: 1.5}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\noutlierEjection: {streak: -1}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML
+                  + "\noutlierEjection: {max_eject_fraction: 1.5}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nretryBudget: {percent: 20}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nretryBudget: {ratio: -0.1}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nretryBudget: {burst: nope}\n")
+
+
+def test_values_schema_gray_failure_parity():
+    """Both charts schematize outlierEjection/retryBudget with the wire
+    key names (schema drift between the charts and the renderer is the
+    failure mode this pins)."""
+    import copy
+    import json
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    for chart in ("tpu-models", "local-models"):
+        cdir = root / chart / "helm-chart"
+        schema = json.loads((cdir / "values.schema.json").read_text())
+        oprops = schema["properties"]["outlierEjection"]["properties"]
+        # schema keys == the spec's accepted wire keys, verbatim
+        from llms_on_kubernetes_tpu.deploy.spec import (
+            _OUTLIER_KEYS, _RETRY_BUDGET_KEYS)
+        assert set(oprops) == set(_OUTLIER_KEYS), chart
+        bprops = schema["properties"]["retryBudget"]["properties"]
+        assert set(bprops) == set(_RETRY_BUDGET_KEYS), chart
+
+        values = yaml.safe_load((cdir / "values.yaml").read_text())
+        assert values.get("outlierEjection"), (
+            f"{chart}: shipped values.yaml should demo the gray-failure "
+            f"layer")
+        jsonschema.validate(values, schema)
+        bad = copy.deepcopy(values)
+        bad["outlierEjection"]["zscore"] = 3  # unknown knob rejected
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+        bad = copy.deepcopy(values)
+        bad["retryBudget"] = {"ratio": -1}
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+
+
 # ---------------------------------------------------------------------------
 # ISSUE 16: disaggregated prefill/decode roles
 # ---------------------------------------------------------------------------

@@ -2006,3 +2006,192 @@ def test_native_handoff_role_labels_on_metrics(binary, tmp_path):
         proc.wait(timeout=5)
         psrv.shutdown()
         dsrv.shutdown()
+
+
+# -- gray-failure layer (ISSUE 17): shared-vector parity + live state ---
+
+
+def test_native_outlier_selftest_shared_vectors(binary):
+    """tests/data/outlier_vectors.json is the byte-compatibility contract
+    for the gray-failure layer (outlier ejection, retry budgets, jittered
+    backoff) between the Python and native routers; the native side
+    validates every expectation in-process via --outlier-selftest (the
+    Python side runs the same file in tests/test_outlier.py)."""
+    out = subprocess.run(
+        [str(binary), "--outlier-selftest",
+         str(REPO / "tests" / "data" / "outlier_vectors.json")],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ", 0 failures" in out.stdout
+    checks = int(out.stdout.split("outlier-selftest:")[1].split("checks")[0])
+    assert checks >= 70
+
+
+def _start_gray_router(binary, tmp_path, urls, outlier=None, budget=None,
+                       extra_args=()):
+    cfg = tmp_path / "router.json"
+    doc = {"backends": {"m": urls}, "default_model": "m"}
+    if outlier is not None:
+        doc["outlier_ejection"] = outlier
+    if budget is not None:
+        doc["retry_budget"] = budget
+    cfg.write_text(json.dumps(doc))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
+                             "--port", str(port), "--quiet", *extra_args])
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+            conn.request("GET", "/health")
+            if conn.getresponse().read() == b"OK":
+                conn.close()
+                return proc, port
+        except OSError:
+            time.sleep(0.02)
+    proc.terminate()
+    raise RuntimeError("gray router did not come up")
+
+
+def _get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data)
+
+
+def _get_metrics(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    return text
+
+
+def test_native_debug_replicas_shape(binary, tmp_path):
+    """/debug/replicas mirrors the Python router's JSON shape: per-replica
+    health/breaker/inflight always, the outlier snapshot and retry-budget
+    level only when the layer is configured."""
+    backend = start_backend("b1")
+    url = f"http://127.0.0.1:{backend.server_address[1]}"
+    proc, port = _start_gray_router(
+        binary, tmp_path, [url],
+        outlier={"min_samples": 2}, budget={"ratio": 0.5, "burst": 5})
+    try:
+        status, doc = _get_json(port, "/debug/replicas")
+        assert status == 200
+        assert doc["outlier_ejection_enabled"] is True
+        assert doc["retry_budget_enabled"] is True
+        rep = doc["models"]["m"]["replicas"][0]
+        assert rep["url"] == url
+        assert rep["healthy"] is True
+        assert rep["breaker"] == "closed"
+        assert rep["inflight"] == 0
+        snap = rep["outlier"]
+        assert snap["quarantined"] is False
+        assert snap["ewma_ttft_ms"] is None and snap["ewma_err"] is None
+        assert snap["samples"] == 0 and snap["ejections"] == 0
+        rb = doc["models"]["m"]["retry_budget"]
+        assert rb["level"] == 5 and rb["burst"] == 5
+        assert rb["ratio"] == 0.5
+        # a proxied request folds a TTFT sample into the snapshot
+        status, _, _ = _qos_post(port, {"model": "m"})
+        assert status == 200
+        _, doc = _get_json(port, "/debug/replicas")
+        snap = doc["models"]["m"]["replicas"][0]["outlier"]
+        assert snap["samples"] == 1
+        assert isinstance(snap["ewma_ttft_ms"], float)
+        assert snap["ewma_err"] == 0.0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+
+
+def test_native_debug_replicas_dormant_without_config(binary, tmp_path):
+    backend = start_backend("b1")
+    url = f"http://127.0.0.1:{backend.server_address[1]}"
+    proc, port = _start_gray_router(binary, tmp_path, [url])
+    try:
+        _, doc = _get_json(port, "/debug/replicas")
+        assert doc["outlier_ejection_enabled"] is False
+        assert doc["retry_budget_enabled"] is False
+        rep = doc["models"]["m"]["replicas"][0]
+        assert "outlier" not in rep
+        assert "retry_budget" not in doc["models"]["m"]
+        # dormant layer still exposes the (empty) metric families
+        text = _get_metrics(port)
+        assert "llm_retry_budget_exhausted_total 0" in text
+        assert "llm_replica_quarantined{" not in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+
+
+def test_native_retry_budget_exhausted_sheds(binary, tmp_path):
+    """With every replica dead and a one-token budget, the failover loop
+    charges its first retry, then sheds with code=retry_budget_exhausted
+    instead of burning the remaining attempts (anti-retry-storm)."""
+    urls = [f"http://127.0.0.1:{free_port()}" for _ in range(2)]
+    proc, port = _start_gray_router(
+        binary, tmp_path, urls,
+        budget={"ratio": 0, "min_per_s": 0, "burst": 1},
+        extra_args=("--retries", "4", "--retry-backoff-ms", "1"))
+    try:
+        status, data, retry = _qos_post(port, {"model": "m"})
+        assert status == 503
+        err = json.loads(data)["error"]
+        assert err["code"] == "retry_budget_exhausted"
+        assert retry == "1"
+        text = _get_metrics(port)
+        assert "llm_retry_budget_exhausted_total 1" in text
+        _, doc = _get_json(port, "/debug/replicas")
+        assert doc["models"]["m"]["retry_budget"]["level"] == 0.0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_native_error_outlier_quarantines_dead_replica(binary, tmp_path):
+    """A connect-refusing replica in a pool of three accumulates error-rate
+    EWMA through failover observations and lands in quarantine: visible on
+    /debug/replicas, the llm_replica_quarantined gauge, and the ejections
+    counter — while clients keep getting 200s via failover."""
+    b1 = start_backend("ok1")
+    b2 = start_backend("ok2")
+    dead = f"http://127.0.0.1:{free_port()}"
+    urls = [f"http://127.0.0.1:{b1.server_address[1]}",
+            f"http://127.0.0.1:{b2.server_address[1]}", dead]
+    proc, port = _start_gray_router(
+        binary, tmp_path, urls,
+        outlier={"ewma_alpha": 1.0, "min_samples": 1, "streak": 1,
+                 "readmit_successes": 99, "shadow_every": 1000},
+        extra_args=("--retries", "4", "--retry-backoff-ms", "1",
+                    "--breaker-threshold", "1000"))
+    try:
+        quarantined = False
+        for _ in range(40):
+            status, _, _ = _qos_post(port, {"model": "m"})
+            assert status == 200  # failover keeps clients whole
+            _, doc = _get_json(port, "/debug/replicas")
+            reps = {r["url"]: r for r in doc["models"]["m"]["replicas"]}
+            if reps[dead]["outlier"]["quarantined"]:
+                quarantined = True
+                break
+        assert quarantined, "dead replica never quarantined"
+        snap = reps[dead]["outlier"]
+        assert snap["reason"] == "errors"
+        assert snap["ejections"] == 1
+        assert snap["quarantined_age_s"] >= 0.0
+        text = _get_metrics(port)
+        assert (f'llm_replica_quarantined{{model="m",replica="{dead}",'
+                f'reason="errors"}} 1') in text
+        assert 'llm_outlier_ejections_total{reason="errors"} 1' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        b1.shutdown()
+        b2.shutdown()

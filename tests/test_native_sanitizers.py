@@ -6,7 +6,10 @@ errors get CI coverage).
   concurrent keep-alive traffic (thread-per-connection) must report no
   errors (ASan aborts the process on any finding → the request fails and
   the exit code is nonzero).
-- llkt-router under ThreadSanitizer: concurrent requests across threads.
+- llkt-router under ThreadSanitizer: concurrent requests across threads,
+  including the gray-failure layer (outlier quarantine → revival →
+  shadow re-admission, and retry-budget exhaustion) whose per-replica
+  EWMA state and per-model token bucket every request thread mutates.
 - libstload under ASan via a dedicated probe binary is skipped here —
   the ctypes path runs in-process with Python; the loader's bounds
   behaviour is covered by corrupt-file tests instead.
@@ -14,18 +17,20 @@ errors get CI coverage).
 
 import concurrent.futures
 import http.client
+import http.server
 import json
 import shutil
 import subprocess
+import threading
 import time
 from pathlib import Path
 
 import pytest
 
 from conftest import free_port
-from test_native_router import (RESUME_FULL_TEXT, _sse_content,
-                                _start_resume_backend, _stream_completion,
-                                start_backend)
+from test_native_router import (RESUME_FULL_TEXT, FakeBackend, _qos_post,
+                                _sse_content, _start_resume_backend,
+                                _stream_completion, start_backend)
 
 REPO = Path(__file__).resolve().parent.parent
 ROUTER_DIR = REPO / "native" / "router"
@@ -471,6 +476,163 @@ def _drive(binary: Path):
         assert "ERROR: " not in (th_err or ""), th_err[-3000:]
         assert "runtime error:" not in (th_err or ""), th_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (th_err or ""), th_err[-3000:]
+
+        # gray-failure layer under the sanitizer: the outlier EWMA folds,
+        # the quarantine/shadow/readmit state machine and the retry-budget
+        # token bucket all sit behind shared state that every request
+        # thread mutates; drive the full lifecycle — concurrent traffic
+        # quarantines a dead replica, the replica comes back, and shadow
+        # probes re-admit it while eight writer threads keep routing
+        gf_dir = tempfile.mkdtemp(prefix="llmk-gray-san-")
+        gb1 = start_backend("gsan1")
+        gb2 = start_backend("gsan2")
+        late_port = free_port()
+        late_url = f"http://127.0.0.1:{late_port}"
+        gf_cfg = Path(gf_dir) / "router.json"
+        gf_cfg.write_text(json.dumps({
+            "backends": {"m": [
+                f"http://127.0.0.1:{gb1.server_address[1]}",
+                f"http://127.0.0.1:{gb2.server_address[1]}",
+                late_url]},
+            "default_model": "m",
+            "outlier_ejection": {"ewma_alpha": 1.0, "min_samples": 1,
+                                 "streak": 1, "shadow_every": 2,
+                                 "readmit_successes": 2},
+            "retry_budget": {"ratio": 1.0, "burst": 100},
+        }))
+        gf_port = free_port()
+        gf = subprocess.Popen(
+            [str(binary), "router", "--config", str(gf_cfg),
+             "--port", str(gf_port), "--quiet",
+             "--retries", "4", "--retry-backoff-ms", "1",
+             "--breaker-threshold", "1000"],
+            stderr=subprocess.PIPE, text=True)
+        late_srv = None
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", gf_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            def gf_replicas() -> dict:
+                c = http.client.HTTPConnection("127.0.0.1", gf_port,
+                                               timeout=15)
+                c.request("GET", "/debug/replicas")
+                doc = json.loads(c.getresponse().read())
+                c.close()
+                return {r["url"]: r for r in doc["models"]["m"]["replicas"]}
+
+            def gf_wave(i: int) -> None:
+                for _ in range(4):
+                    status, body, _ = _qos_post(gf_port, {"model": "m"})
+                    assert status == 200, body  # failover keeps clients whole
+
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(pool.map(gf_wave, range(8)))
+            reps = gf_replicas()
+            assert reps[late_url]["outlier"]["quarantined"], reps
+
+            # revive the quarantined replica; shadow traffic (1-in-2 picks)
+            # must re-admit it while the writer threads stay in flight
+            handler = type("Backend_glate", (FakeBackend,),
+                           {"name": "glate"})
+            late_srv = http.server.ThreadingHTTPServer(
+                ("127.0.0.1", late_port), handler)
+            threading.Thread(target=late_srv.serve_forever,
+                             daemon=True).start()
+            readmitted = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not readmitted:
+                with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                    list(pool.map(gf_wave, range(8)))
+                reps = gf_replicas()
+                readmitted = not reps[late_url]["outlier"]["quarantined"]
+            assert readmitted, reps[late_url]
+            assert reps[late_url]["outlier"]["ejections"] >= 1, reps
+        finally:
+            gf.terminate()
+            try:
+                _, gf_err = gf.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                gf.kill()
+                _, gf_err = gf.communicate()
+            gb1.shutdown()
+            gb2.shutdown()
+            if late_srv is not None:
+                late_srv.shutdown()
+        assert "ERROR: " not in (gf_err or ""), gf_err[-3000:]
+        assert "runtime error:" not in (gf_err or ""), gf_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (gf_err or ""), gf_err[-3000:]
+
+        # retry-budget exhaustion from many threads: charges, refunds and
+        # the exhausted-shed counter all race on one token bucket; every
+        # response must be a clean 502 (budgeted retries burned) or the
+        # 503 retry_budget_exhausted shed — never a crash or a hang
+        bx_cfg = Path(gf_dir) / "budget.json"
+        bx_cfg.write_text(json.dumps({
+            "backends": {"m": [f"http://127.0.0.1:{free_port()}",
+                               f"http://127.0.0.1:{free_port()}"]},
+            "default_model": "m",
+            "retry_budget": {"ratio": 0, "min_per_s": 0, "burst": 2},
+        }))
+        bx_port = free_port()
+        bx = subprocess.Popen(
+            [str(binary), "router", "--config", str(bx_cfg),
+             "--port", str(bx_port), "--quiet",
+             "--retries", "4", "--retry-backoff-ms", "1",
+             "--breaker-threshold", "1000"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", bx_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            def bx_req(i: int) -> int:
+                status, body, retry = _qos_post(bx_port, {"model": "m"})
+                err = json.loads(body)["error"]
+                assert status in (502, 503), (status, err)
+                if status == 503:
+                    assert err["code"] == "retry_budget_exhausted", err
+                    assert retry == "1", retry
+                return status
+
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                statuses = list(pool.map(bx_req, range(16)))
+            assert statuses.count(503) >= 1, statuses
+            c = http.client.HTTPConnection("127.0.0.1", bx_port, timeout=15)
+            c.request("GET", "/metrics")
+            text = c.getresponse().read().decode()
+            c.close()
+            import re
+            m = re.search(r"llm_retry_budget_exhausted_total ([0-9.e+-]+)",
+                          text)
+            assert m and float(m.group(1)) >= 1, text[-500:]
+        finally:
+            bx.terminate()
+            try:
+                _, bx_err = bx.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                bx.kill()
+                _, bx_err = bx.communicate()
+            shutil.rmtree(gf_dir, ignore_errors=True)
+        assert "ERROR: " not in (bx_err or ""), bx_err[-3000:]
+        assert "runtime error:" not in (bx_err or ""), bx_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (bx_err or ""), bx_err[-3000:]
 
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")

@@ -1,0 +1,191 @@
+"""Python gate for the shared gray-failure vectors.
+
+tests/data/outlier_vectors.json pins the outlier-ejection / retry-budget /
+backoff semantics both routers must agree on: this module drives the
+vectors through the executable spec (server/outlier.py), and the native
+router replays the same file via `llkt-router --outlier-selftest`
+(tests/test_native_router.py). A change that breaks one side must update
+the vectors AND the other implementation.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from llms_on_kubernetes_tpu.server import outlier
+
+VECTORS = json.loads(
+    (pathlib.Path(__file__).parent / "data" /
+     "outlier_vectors.json").read_text())
+
+TOL = 1e-6
+
+
+def _ids(section):
+    return [c.get("_comment", f"case{i}")[:60]
+            for i, c in enumerate(VECTORS[section])]
+
+
+# ---------------------------------------------------------------------------
+# Pure functions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["ewma"], ids=_ids("ewma"))
+def test_ewma_vectors(case):
+    got = outlier.ewma(case["prev"], case["sample"], case["alpha"])
+    assert got == pytest.approx(case["expect"], abs=TOL)
+
+
+@pytest.mark.parametrize("case", VECTORS["zscore"], ids=_ids("zscore"))
+def test_zscore_vectors(case):
+    got = outlier.peer_zscore(case["value"], case["peers"],
+                              rel_floor=case["rel_floor"],
+                              abs_floor=case["abs_floor"])
+    assert got == pytest.approx(case["expect"], abs=TOL)
+
+
+@pytest.mark.parametrize("case", VECTORS["backoff"], ids=_ids("backoff"))
+def test_backoff_vectors(case):
+    got = outlier.backoff_s(case["base_s"], case["attempt"], case["rand01"],
+                            cap_s=case["cap_s"],
+                            remaining_s=case["remaining_s"])
+    assert got == pytest.approx(case["expect"], abs=TOL)
+
+
+@pytest.mark.parametrize("case", VECTORS["max_quarantined"],
+                         ids=_ids("max_quarantined"))
+def test_max_quarantined_vectors(case):
+    assert outlier.max_quarantined(case["fraction"],
+                                   case["pool"]) == case["expect"]
+
+
+# ---------------------------------------------------------------------------
+# Detector state machine
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self):
+        return self.value
+
+
+@pytest.mark.parametrize(
+    "group", VECTORS["detector"],
+    ids=[g.get("_comment", f"group{i}")[:60]
+         for i, g in enumerate(VECTORS["detector"])])
+def test_detector_vectors(group):
+    clock = FakeClock()
+    det = outlier.OutlierDetector(group["config"], clock=clock)
+    members = group["group"]
+    for i, check in enumerate(group["checks"]):
+        clock.value += 1.0
+        event = det.record(check["url"], members, check["ttft_ms"],
+                           check["error"])
+        ex = check["expect"]
+        tag = f"check #{i} ({check['url']})"
+        assert event == ex["event"], tag
+        s = det.get(check["url"])
+        if "quarantined" in ex:
+            assert s.quarantined is ex["quarantined"], tag
+        if "streak" in ex:
+            assert s.streak == ex["streak"], tag
+        if "ewma_ttft_ms" in ex:
+            assert s.ewma_ttft_ms == pytest.approx(ex["ewma_ttft_ms"],
+                                                   abs=TOL), tag
+        if "ewma_err" in ex:
+            assert s.ewma_err == pytest.approx(ex["ewma_err"], abs=TOL), tag
+
+
+@pytest.mark.parametrize(
+    "group", VECTORS["budget"],
+    ids=[g.get("_comment", f"group{i}")[:60]
+         for i, g in enumerate(VECTORS["budget"])])
+def test_budget_vectors(group):
+    clock = FakeClock()
+    budget = outlier.RetryBudget(group["config"], clock=clock)
+    for i, op in enumerate(group["ops"]):
+        tag = f"op #{i} ({op['op']})"
+        if op["op"] == "charge":
+            clock.value = float(op["at"])
+            ok = budget.charge()
+            assert ok is op["expect_ok"], tag
+        elif op["op"] == "primary":
+            clock.value = float(op["at"])
+            budget.on_primary()
+        elif op["op"] == "refund":
+            budget.refund()
+        else:  # pragma: no cover - malformed vectors
+            pytest.fail(f"unknown op {op['op']}")
+        assert budget.level == pytest.approx(op["expect_level"],
+                                             abs=TOL), tag
+
+
+@pytest.mark.parametrize("case", VECTORS["shadow"], ids=_ids("shadow"))
+def test_shadow_vectors(case):
+    det = outlier.OutlierDetector({"shadow_every": case["every"]})
+    fired = [i for i in range(1, case["ticks"] + 1) if det.shadow_tick()]
+    assert fired == case["expect_true"]
+
+
+# ---------------------------------------------------------------------------
+# Spec details the vectors can't express directly
+# ---------------------------------------------------------------------------
+
+
+def test_config_defaults_and_enablement():
+    cfg = outlier.OutlierConfig(None)
+    assert not cfg.enabled
+    assert cfg.z_threshold == 3.0
+    assert cfg.max_eject_fraction == pytest.approx(0.34)
+    assert outlier.OutlierConfig({"z_threshold": 2}).enabled
+    # junk values fall back instead of raising (config comes off the wire)
+    assert outlier.OutlierConfig({"z_threshold": "x"}).z_threshold == 3.0
+
+    b = outlier.RetryBudgetConfig(None)
+    assert not b.enabled
+    assert b.ratio == pytest.approx(0.2)
+    assert outlier.RetryBudgetConfig({"ratio": 0.1}).enabled
+
+
+def test_quarantined_peer_excluded_from_baseline():
+    # one slow quarantined replica must not drag the mean it is judged by
+    det = outlier.OutlierDetector({"ewma_alpha": 1.0, "min_samples": 1,
+                                   "streak": 1, "max_eject_fraction": 0.3,
+                                   "readmit_successes": 99})
+    group = ["a", "b", "c", "d"]
+    for u in ("b", "c", "d"):
+        det.record(u, group, 100, False)
+    assert det.record("a", group, 900, False) == "quarantine:latency"
+    # b at 300 vs peers c,d at 100: z = 200/25 = 8 — only because the
+    # quarantined a (at 900) is excluded from the population
+    assert det.record("b", group, 300, False) == "guard_blocked"
+
+
+def test_snapshot_shape():
+    clock = FakeClock(10.0)
+    det = outlier.OutlierDetector({"ewma_alpha": 1.0, "min_samples": 1,
+                                   "streak": 1}, clock=clock)
+    group = ["a", "b", "c"]
+    for u in ("b", "c"):
+        det.record(u, group, 100, False)
+    det.record("a", group, 900, False)
+    clock.value = 14.0
+    snap = det.snapshot("a")
+    assert snap["quarantined"] is True
+    assert snap["reason"] == "latency"
+    assert snap["quarantined_age_s"] == pytest.approx(4.0)
+    assert snap["ejections"] == 1
+    # unknown replica renders as zeros, not a KeyError
+    empty = det.snapshot("nope")
+    assert empty["samples"] == 0 and not empty["quarantined"]
+
+
+def test_budget_disabled_is_permissive_object():
+    # routers hold no RetryBudget at all when the block is absent; the
+    # config object still reports disabled for the debug endpoint
+    assert not outlier.RetryBudgetConfig({}).enabled

@@ -539,7 +539,7 @@ def make_laggy_backend(name: str, first_byte_delay: float) -> web.Application:
     return app
 
 
-def run_hedge(fn, delay1, delay2, hedge_ms):
+def run_hedge(fn, delay1, delay2, hedge_ms, **router_kw):
     async def go():
         b1 = TestClient(TestServer(make_laggy_backend("slow", delay1)))
         b2 = TestClient(TestServer(make_laggy_backend("fast", delay2)))
@@ -547,7 +547,7 @@ def run_hedge(fn, delay1, delay2, hedge_ms):
         await b2.start_server()
         u1 = str(b1.make_url("")).rstrip("/")
         u2 = str(b2.make_url("")).rstrip("/")
-        router = Router({"m": [u1, u2]}, hedge_ms=hedge_ms)
+        router = Router({"m": [u1, u2]}, hedge_ms=hedge_ms, **router_kw)
         # force the first backend to be the P2C primary: the second starts
         # with artificial load, so hedging must be what reaches it
         router.replicas["m"][1].inflight = 50
@@ -589,6 +589,30 @@ def test_hedge_primary_wins_when_faster():
     # primary's first byte lands after the hedge fires but well before the
     # (much slower) secondary's
     run_hedge(body, delay1=0.3, delay2=2.0, hedge_ms=40)
+
+
+def test_hedge_downgrades_to_single_attempt_on_exhausted_budget():
+    """A hedge is a speculative retry, so it draws from the cluster retry
+    budget; with the budget exhausted the hedge must NOT launch — the
+    request downgrades to the plain single-attempt path (keep waiting on
+    the primary) instead of erroring, and the shed is counted."""
+    async def body(client, router):
+        r = await client.post("/v1/chat/completions", json=STREAM_REQ)
+        assert r.status == 200
+        raw = await r.text()
+        events = sse_events(raw)
+        # the slow primary served it — the fast secondary would have won
+        # any hedge race, so its absence proves the hedge never launched
+        assert {e["id"] for e in events} == {"cmpl-slow"}
+        assert "".join(e["choices"][0]["delta"].get("content", "")
+                       for e in events) == FULL_TEXT
+        assert router.metrics["hedged"].labeled_value(
+            outcome="hedge_won") is None
+        assert router.metrics["hedged"].labeled_value(
+            outcome="primary_won") is None
+        assert router.metrics["retry_budget_exhausted"].value == 1
+    run_hedge(body, delay1=0.3, delay2=0.0, hedge_ms=40,
+              retry_budget={"ratio": 0, "min_per_s": 0, "burst": 0})
 
 
 def test_hedge_off_by_default():
