@@ -1452,6 +1452,430 @@ def chaos_bench() -> dict:
     }
 
 
+def affinity_bench() -> dict:
+    """Prefix-affinity + cache-aware routing (ISSUE 18), end to end
+    through the python router.
+
+    The workload is the one the feature exists for: many concurrent
+    multi-turn sessions that share a system prompt. Nine sessions run
+    four turns each against a three-replica debug-tiny stack; every
+    turn's prompt is a shared 16-token system prefix + a 48-token
+    per-session conversation (64 cacheable tokens = 4 full KV pages)
+    + a 4-token per-turn tail. Mode A routes blind P2C (PR-17
+    behavior); mode B arms ``prefix_affinity`` so the gateway
+    rendezvous-pins each session's affinity key and steers to
+    digest-filter claimers, with /ready probes refreshing the
+    advertised filters between turns.
+
+    Measured per mode from the same fresh stack: gateway TTFT p50
+    across all turns, the session reuse hit ratio (prefix-cache
+    ``hit_tokens_total`` over the cacheable tokens each turn could
+    have adopted) and total prefill chip-ms from the per-pod goodput
+    ledgers. scripts/ci.sh gates affinity TTFT p50 < blind, affinity
+    prefill chip-ms < blind, hit ratio > 0.5 and zero dropped streams.
+
+    A quarantine-integration wave then lands ``degraded_replica:8`` on
+    one replica of the affinity stack (probes stay green): the PR-17
+    outlier detector must quarantine it from in-band TTFT alone, the
+    keys pinned to it must re-pin to surviving peers (visible as
+    fallback reason="quarantined" and continued hits), and every
+    stream through the whole wave must complete.
+
+    Tiny-CPU-sized like the spike/chaos phases: the scenario measures
+    the placement control loop, not the model.
+    """
+    import http.client
+    import json as _json
+    import re as _re
+    import threading
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu import faults
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    model = "debug-tiny"
+    cfg = get_config(model)
+    # two prefill buckets so a cache-hit turn (4-token tail after 128
+    # adopted tokens) prefills the small bucket while a cold turn pays
+    # the large one — that's the chip-time the feature saves. The page
+    # pool is sized so one pod holds its PINNED third of the sessions'
+    # prefixes but not all nine: blind P2C scatters every session over
+    # every pod and thrashes the per-pod prefix cache, affinity makes
+    # the pods' aggregate cache usable — the same asymmetry that makes
+    # cache-aware placement pay on real multi-pod deployments
+    ecfg = EngineConfig(model=model, dtype="float32", max_decode_slots=8,
+                        page_size=16, pages_per_slot=16,
+                        num_pages=4 * 16 + 1, prefill_buckets=(32, 160))
+
+    n_replicas = 3
+    n_sessions = 9
+    n_turns = 4
+    cacheable_tokens = 128  # 8 full 16-token pages per turn
+
+    # all tokens two-digit (10..98) so the comma-joined canonical text
+    # of the 128-token session prefix is exactly 383 chars —
+    # prefix_chars below covers the whole session prefix and none of
+    # the turn tail, so every turn of a session maps to ONE affinity key
+    sys_prefix = [10 + (j % 89) for j in range(16)]
+
+    def session_prompt(sess: int, turn: int) -> list:
+        conv = [10 + ((sess * 7 + j) % 89) for j in range(112)]
+        tail = [10 + ((sess * 13 + turn * 5 + j) % 89) for j in range(4)]
+        return sys_prefix + conv + tail
+
+    affinity_cfg = {
+        "prefix_chars": 383, "filter_bits": 4096, "filter_hashes": 4,
+        "key_cache": 256, "max_digests": 8,
+    }
+    # fast-drill outlier tuning (chaos phase's): quarantine the degraded
+    # pinned replica quickly and keep it quarantined through the re-pin
+    # measurement window
+    outlier_cfg = {
+        "ewma_alpha": 0.6, "z_threshold": 3.0, "min_samples": 3,
+        "streak": 2, "max_eject_fraction": 0.34, "shadow_every": 64,
+        "readmit_successes": 99,
+    }
+
+    def p50(vals: list) -> float | None:
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[len(vals) // 2], 1)
+
+    def run_mode(use_affinity: bool) -> dict:
+        pf_env = {
+            # blind mode keeps /ready byte-identical to PR 17 (bits=0);
+            # affinity mode advertises fast-rebuilt filters so the
+            # 0.25s probe cycle sees fresh cache contents between turns
+            "LLMK_PREFIX_FILTER_BITS": "4096" if use_affinity else "0",
+            "LLMK_PREFIX_FILTER_HASHES": "4",
+            "LLMK_PREFIX_FILTER_INTERVAL_S": "0.05",
+        }
+        prev_env = {k: os.environ.get(k) for k in pf_env}
+        os.environ.update(pf_env)
+
+        ports: dict = {}
+        engines: list = []
+        replica_urls: list = []
+        ready = threading.Event()
+        stop_holder: dict = {}
+
+        def run_stack():
+            import asyncio
+
+            async def main_async():
+                stop = asyncio.Event()
+                stop_holder["stop"] = stop
+                stop_holder["loop"] = asyncio.get_running_loop()
+                runners = []
+                for _ in range(n_replicas):
+                    eng = build_engine(ecfg, cfg)
+                    engines.append(eng)
+                    srv = OpenAIServer(eng, ByteTokenizer(), model)
+                    runner = web.AppRunner(srv.make_app())
+                    await runner.setup()
+                    site = web.TCPSite(runner, "127.0.0.1", 0)
+                    await site.start()
+                    runners.append(runner)
+                    replica_urls.append(
+                        f"http://127.0.0.1:{runner.addresses[0][1]}")
+                # the prober is ON here (unlike the chaos stack): the
+                # /ready sweep is what carries each replica's digest
+                # filter to the router between turns
+                router = Router(
+                    {model: replica_urls}, default_model=model,
+                    strict=False, retry_backoff_s=0.02,
+                    breaker_threshold=1000, probe_interval_s=0.25,
+                    outlier_ejection=outlier_cfg if use_affinity else None,
+                    prefix_affinity=affinity_cfg if use_affinity else None)
+                r_runner = web.AppRunner(router.make_app())
+                await r_runner.setup()
+                r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
+                await r_site.start()
+                runners.append(r_runner)
+                ports["router"] = r_runner.addresses[0][1]
+                ready.set()
+                await stop.wait()
+                for r in runners:
+                    await r.cleanup()
+
+            asyncio.new_event_loop().run_until_complete(main_async())
+
+        rt = threading.Thread(target=run_stack, daemon=True)
+        rt.start()
+        try:
+            if not ready.wait(timeout=180):
+                raise RuntimeError("affinity bench: stack failed to start")
+            rport = ports["router"]
+
+            def stream_once(body: str, drops: list,
+                            port: int = 0) -> float | None:
+                t_send = time.monotonic()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port or rport, timeout=120)
+                try:
+                    conn.request("POST", "/v1/completions", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        drops[0] += 1
+                        resp.read()
+                        return None
+                    first = None
+                    chunks = []
+                    while True:
+                        piece = resp.read1(65536)
+                        if not piece:
+                            break
+                        if first is None:
+                            first = time.monotonic()
+                        chunks.append(piece)
+                    if (first is None
+                            or b"data: [DONE]" not in b"".join(chunks)):
+                        drops[0] += 1
+                        return None
+                    return (first - t_send) * 1000.0
+                except OSError:
+                    drops[0] += 1
+                    return None
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+            def turn_body(sess: int, turn: int) -> str:
+                return _json.dumps({
+                    "model": model, "prompt": session_prompt(sess, turn),
+                    "max_tokens": 12, "temperature": 0.0, "stream": True,
+                    "user": f"sess-{sess}",
+                })
+
+            def scrape() -> str:
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=10)
+                conn.request("GET", "/metrics")
+                text = conn.getresponse().read().decode()
+                conn.close()
+                return text
+
+            def affinity_counts(text: str) -> tuple[float, float, float]:
+                hits = sum(float(v) for v in _re.findall(
+                    r"llm_affinity_hits_total\{[^}]*\} ([0-9.e+-]+)",
+                    text))
+                fb_all = fb_quar = 0.0
+                for labels, v in _re.findall(
+                        r"llm_affinity_fallback_total\{([^}]*)\} "
+                        r"([0-9.e+-]+)", text):
+                    fb_all += float(v)
+                    if 'reason="quarantined"' in labels:
+                        fb_quar += float(v)
+                return hits, fb_all, fb_quar
+
+            # warmup (uncounted), DIRECTLY against every replica so both
+            # prefill buckets and the decode graph compile everywhere
+            # before either mode's measured waves; disjoint token range
+            # (100..188) so warmup pages never satisfy a session prefix
+            warm_drops = [0]
+            for url in replica_urls:
+                port = int(url.rsplit(":", 1)[1])
+                # 132 twice: the repeat adopts the cached 8-page prefix,
+                # compiling the adoption prefill path the measured hit
+                # turns will take
+                for n_tok in (20, 132, 132):
+                    wbody = _json.dumps({
+                        "model": model,
+                        "prompt": [100 + (j % 89) for j in range(n_tok)],
+                        "max_tokens": 12, "temperature": 0.0,
+                        "stream": True,
+                    })
+                    stream_once(wbody, warm_drops, port=port)
+
+            # baselines AFTER warmup so the measured deltas are the
+            # session waves' alone
+            base_hits = sum(e.allocator.hit_tokens_total for e in engines)
+
+            def prefill_ms() -> float:
+                total = 0.0
+                for e in engines:
+                    led = getattr(e, "ledger", None)
+                    if led is not None:
+                        total += led.snapshot()["phase_ms"].get(
+                            "prefill", 0.0)
+                return total
+
+            base_prefill = prefill_ms()
+
+            drops = [0]
+            ttfts: list = []
+
+            def session_worker(sess: int):
+                for turn in range(n_turns):
+                    t = stream_once(turn_body(sess, turn), drops)
+                    if t is not None:
+                        ttfts.append(t)
+                    # think time: real sessions don't fire turns
+                    # back-to-back, and the gap keeps the tiny CPU
+                    # stack's queueing noise out of the TTFT comparison
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=session_worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_sessions)]
+            for th in threads:
+                th.start()
+                # slight stagger: real sessions don't arrive in one
+                # thundering herd, and the offset keeps the tiny CPU
+                # stack's queueing noise out of the TTFT comparison
+                time.sleep(0.03)
+            for th in threads:
+                th.join(timeout=600)
+
+            hit_tokens = sum(e.allocator.hit_tokens_total
+                             for e in engines) - base_hits
+            hit_ratio = round(
+                hit_tokens / (n_sessions * n_turns * cacheable_tokens), 3)
+            out = {
+                "ttft_p50_ms": p50(ttfts),
+                "hit_ratio": hit_ratio,
+                "prefill_chip_ms": round(prefill_ms() - base_prefill, 1),
+                "dropped": drops[0],
+                "warm_dropped": warm_drops[0],
+            }
+            if use_affinity:
+                out["hits"], out["fallbacks"], _ = affinity_counts(
+                    scrape())
+
+                # --- quarantine re-pin wave: degrade one replica while
+                # its probes stay green; affinity keys pinned to it must
+                # re-pin without a single dropped stream
+                def quarantined() -> int:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", rport, timeout=10)
+                    conn.request("GET", "/debug/replicas")
+                    doc = _json.loads(conn.getresponse().read())
+                    conn.close()
+                    return sum(
+                        1 for r in doc["models"][model]["replicas"]
+                        if (r.get("outlier") or {}).get("quarantined"))
+
+                def round_of_turns(turn: int, rdrops: list):
+                    ths = [threading.Thread(
+                        target=lambda s=s: stream_once(
+                            turn_body(s, turn), rdrops) is not None,
+                        daemon=True) for s in range(n_sessions)]
+                    # detector food: rendezvous may have pinned ZERO
+                    # sessions to the fault's victim, and a replica
+                    # that serves no traffic produces no in-band TTFT
+                    # observations — fresh-key probes spread over the
+                    # whole pool so every replica keeps getting judged
+                    # (their drops count: re-pin is a zero-drop gate)
+                    for j in range(n_bg):
+                        pbody = _json.dumps({
+                            "model": model,
+                            "prompt": [100 + ((turn * 11 + j * 3 + k)
+                                              % 89) for k in range(20)],
+                            "max_tokens": 8, "temperature": 0.0,
+                            "stream": True,
+                            "user": f"bg-{turn}-{j}",
+                        })
+                        ths.append(threading.Thread(
+                            target=lambda b=pbody: stream_once(b, rdrops),
+                            daemon=True))
+                    for th in ths:
+                        th.start()
+                    for th in ths:
+                        th.join(timeout=600)
+
+                prev_fault = os.environ.get("LLMK_FAULT")
+                repin_drops = [0]
+                n_bg = 6
+                detected = False
+                try:
+                    faults.reset_claims()
+                    # factor 4 (not the chaos phase's 8): pacing
+                    # stretches the victim's REAL first-event wait, and
+                    # on this loaded CPU stack a 160-token prefill
+                    # behind a 15-stream round is already seconds — 8x
+                    # compounds into client-timeout territory while 4x
+                    # keeps the wave bounded and still trips z=3
+                    os.environ["LLMK_FAULT"] = "degraded_replica:4"
+                    turn = n_turns
+                    for _ in range(12):
+                        round_of_turns(turn, repin_drops)
+                        turn += 1
+                        if quarantined():
+                            detected = True
+                            break
+                        time.sleep(0.05)
+                    pre_hits, pre_fb, _ = affinity_counts(scrape())
+                    post_rounds = 2
+                    if detected:
+                        # post-quarantine rounds: every decision must
+                        # still resolve (decide() never picks a
+                        # quarantined replica, so the victim's keys have
+                        # necessarily re-pinned — to a filter claimer
+                        # when a peer holds the shared prefix, to the
+                        # quarantined-fallback path otherwise)
+                        for _ in range(post_rounds):
+                            round_of_turns(turn, repin_drops)
+                            turn += 1
+                finally:
+                    if prev_fault is None:
+                        os.environ.pop("LLMK_FAULT", None)
+                    else:
+                        os.environ["LLMK_FAULT"] = prev_fault
+                    faults.reset_claims()
+                post_hits, post_fb, post_quar = affinity_counts(scrape())
+                decisions = (post_hits + post_fb) - (pre_hits + pre_fb)
+                out["repin_quarantined_ok"] = detected
+                out["repin_dropped"] = repin_drops[0]
+                out["repin_fallback_quarantined"] = post_quar
+                out["repin_ok"] = (detected and repin_drops[0] == 0
+                                   and decisions
+                                   == (n_sessions + n_bg) * post_rounds
+                                   and (post_quar > 0
+                                        or post_hits > pre_hits))
+            return out
+        finally:
+            if "stop" in stop_holder:
+                stop_holder["loop"].call_soon_threadsafe(
+                    stop_holder["stop"].set)
+            rt.join(timeout=30)
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    blind = run_mode(use_affinity=False)
+    aff = run_mode(use_affinity=True)
+
+    return {
+        "affinity_blind_ttft_p50_ms": blind["ttft_p50_ms"],
+        "affinity_ttft_p50_ms": aff["ttft_p50_ms"],
+        "affinity_blind_hit_ratio": blind["hit_ratio"],
+        "affinity_hit_ratio": aff["hit_ratio"],
+        "affinity_blind_prefill_chip_ms": blind["prefill_chip_ms"],
+        "affinity_prefill_chip_ms": aff["prefill_chip_ms"],
+        "affinity_dropped_streams": (blind["dropped"] + aff["dropped"]
+                                     + blind["warm_dropped"]
+                                     + aff["warm_dropped"]),
+        "affinity_hits_total": aff.get("hits"),
+        "affinity_fallback_total": aff.get("fallbacks"),
+        "affinity_quarantined_ok": aff.get("repin_quarantined_ok"),
+        "affinity_repin_dropped_streams": aff.get("repin_dropped"),
+        "affinity_repin_fallback_quarantined":
+            aff.get("repin_fallback_quarantined"),
+        "affinity_repin_ok": aff.get("repin_ok"),
+    }
+
+
 def fairness_bench() -> dict:
     """Noisy-neighbor fairness under per-tenant QoS (ISSUE 10).
 
@@ -2467,6 +2891,16 @@ def _main() -> int:
     if smoke or os.environ.get("BENCH_CHAOS"):
         chaos = with_retries("chaos", chaos_bench, errors, attempts=1) or {}
 
+    # --- phase 11: prefix-affinity cache-aware routing (blind P2C vs
+    # affinity-first over a shared-system-prompt session workload) ------
+    # Tiny-CPU-sized; ci.sh gates the TTFT-p50 and prefill-chip-ms
+    # orderings, the session reuse hit ratio and zero dropped streams
+    # (including the quarantine re-pin wave) on the smoke run.
+    aff = {}
+    if smoke or os.environ.get("BENCH_AFFINITY"):
+        aff = with_retries("affinity", affinity_bench, errors,
+                           attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -2485,6 +2919,7 @@ def _main() -> int:
         **session,
         **disagg,
         **chaos,
+        **aff,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

@@ -216,7 +216,8 @@ def main(argv: list[str] | None = None) -> int:
         stream_resume = resume_attempts = hedge_ms = None
         qos = roles = handoff_retries = None
         # None = let Router fall back to LLMK_OUTLIER / LLMK_RETRY_BUDGET
-        outlier_ejection = retry_budget = None
+        # / LLMK_AFFINITY
+        outlier_ejection = retry_budget = prefix_affinity = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -245,6 +246,10 @@ def main(argv: list[str] | None = None) -> int:
                 outlier_ejection = cfg["outlier_ejection"]
             if "retry_budget" in cfg:
                 retry_budget = cfg["retry_budget"]
+            if "prefix_affinity" in cfg:
+                # prefix-affinity + cache-aware routing, passed verbatim
+                # (non-empty block = enabled)
+                prefix_affinity = cfg["prefix_affinity"]
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -268,7 +273,8 @@ def main(argv: list[str] | None = None) -> int:
                    resume_attempts=resume_attempts, hedge_ms=hedge_ms,
                    qos=qos, roles=roles, handoff_retries=handoff_retries,
                    outlier_ejection=outlier_ejection,
-                   retry_budget=retry_budget)
+                   retry_budget=retry_budget,
+                   prefix_affinity=prefix_affinity)
         return 0
 
     # serve

@@ -183,6 +183,17 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
                          "value": str(ap.threshold)})
         c["env"].append({"name": "LLMK_ANOMALY_COOLDOWN_S",
                          "value": str(ap.cooldown_s)})
+    if spec.prefix_affinity is not None:
+        # cache-aware routing (ISSUE 18): the replica's /ready filter
+        # geometry must match what the router config promised, so the
+        # spec block's bits/hashes thread through to the API server
+        aff = spec.prefix_affinity.to_wire()
+        if "filter_bits" in aff:
+            c["env"].append({"name": "LLMK_PREFIX_FILTER_BITS",
+                             "value": str(int(aff["filter_bits"]))})
+        if "filter_hashes" in aff:
+            c["env"].append({"name": "LLMK_PREFIX_FILTER_HASHES",
+                             "value": str(int(aff["filter_hashes"]))})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on
@@ -581,6 +592,10 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         cfg["outlier_ejection"] = spec.outlier_ejection.to_wire()
     if spec.retry_budget is not None:
         cfg["retry_budget"] = spec.retry_budget.to_wire()
+    if spec.prefix_affinity is not None:
+        # prefix-affinity + cache-aware routing (ISSUE 18): a non-empty
+        # block enables the layer in both router implementations
+        cfg["prefix_affinity"] = spec.prefix_affinity.to_wire()
     return cfg
 
 

@@ -454,6 +454,40 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKAffinityDegraded",
+                        # cache-aware routing is configured but most
+                        # keyed requests are falling back to plain P2C:
+                        # pinned replicas unhealthy/quarantined/hot, or
+                        # prompts that never produce a key. Prefill is
+                        # being re-paid across the fleet — a ticket, not
+                        # a page: serving still works, just slower.
+                        "expr": (
+                            "sum(rate(llm_affinity_fallback_total[15m]))"
+                            " / (sum(rate(llm_affinity_hits_total[15m]))"
+                            " + sum(rate("
+                            "llm_affinity_fallback_total[15m])))"
+                            " > 0.5"
+                        ),
+                        "for": "15m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "prefix-affinity routing mostly "
+                                       "falling back to P2C",
+                            "description": (
+                                "Over half of affinity-keyed requests "
+                                "fell back to plain P2C for 15m, so "
+                                "prefix caches are going cold and "
+                                "prefill is re-paid. Break down "
+                                "llm_affinity_fallback_total by reason: "
+                                "unhealthy/quarantined pins mean sick "
+                                "replicas, overloaded means the pool is "
+                                "too hot for pinning, miss means the "
+                                "workload's prompts never form a key "
+                                "(consider disabling the layer)."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -612,6 +646,13 @@ def grafana_dashboard() -> dict[str, Any]:
                 "(rate(llm_outlier_ejections_total[5m]))"], 0, 112),
         _panel(30, "Retry budget: exhaustion rate",
                ["rate(llm_retry_budget_exhausted_total[5m])"], 12, 112),
+        _panel(31, "Prefix affinity: cache-aware placements / fallbacks",
+               ["sum by (model) (rate(llm_affinity_hits_total[5m]))",
+                "sum by (model, reason) "
+                "(rate(llm_affinity_fallback_total[5m]))"], 0, 120),
+        _panel(32, "Prefix affinity: filter age (stale = blind routing)",
+               ["max by (model, replica) "
+                "(llm_prefix_filter_age_seconds)"], 12, 120, unit="s"),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -263,6 +263,15 @@ _OUTLIER_KEYS = frozenset({
     "max_eject_fraction", "shadow_every", "readmit_successes",
 })
 _RETRY_BUDGET_KEYS = frozenset({"ratio", "min_per_s", "burst"})
+# prefix-affinity + cache-aware routing (ISSUE 18): wire keys of the
+# router.json "prefix_affinity" block — server/affinity.py is the
+# executable spec, tests/data/affinity_vectors.json pins both routers
+_AFFINITY_KEYS = frozenset({
+    "enabled", "prefix_chars", "filter_bits", "filter_hashes",
+    "overload_factor", "overload_slack", "key_cache", "max_digests",
+    "kv_fetch",
+})
+_AFFINITY_BOOL_KEYS = frozenset({"enabled", "kv_fetch"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -327,6 +336,45 @@ class RetryBudgetSpec:
                     f"retryBudget.{k} must be a number, got {v!r}")
             if v < 0:
                 raise SpecError(f"retryBudget.{k} must be >= 0, got {v}")
+
+    def to_wire(self) -> dict:
+        return self.raw  # callers serialize, never mutate
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixAffinitySpec:
+    """Prefix-affinity + KV-cache-aware routing config
+    (``prefixAffinity:``): pins same-prefix sessions to a rendezvous-hashed
+    replica and steers to peers whose advertised digest filters claim the
+    request's prefix chain. Rendered verbatim into router.json — a
+    non-empty block enables the layer in both routers; absent = dormant
+    (pure P2C, byte-identical to the layer not existing)."""
+
+    raw: dict = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        unknown = set(self.raw) - _AFFINITY_KEYS
+        if unknown:
+            raise SpecError(
+                f"unknown prefixAffinity keys: {sorted(unknown)} "
+                f"(known: {sorted(_AFFINITY_KEYS)})")
+        for k, v in self.raw.items():
+            if k in _AFFINITY_BOOL_KEYS:
+                if not isinstance(v, bool):
+                    raise SpecError(
+                        f"prefixAffinity.{k} must be a bool, got {v!r}")
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SpecError(
+                    f"prefixAffinity.{k} must be a number, got {v!r}")
+            if v < 0:
+                raise SpecError(
+                    f"prefixAffinity.{k} must be >= 0, got {v}")
+        hashes = self.raw.get("filter_hashes")
+        if hashes is not None and not (1 <= hashes <= 4):
+            raise SpecError(
+                f"prefixAffinity.filter_hashes must be in [1, 4], "
+                f"got {hashes}")
 
     def to_wire(self) -> dict:
         return self.raw  # callers serialize, never mutate
@@ -591,6 +639,8 @@ class DeploySpec:
     # cluster retry budgets; None = layer disabled (dormant in routers)
     outlier_ejection: Optional[OutlierEjectionSpec] = None
     retry_budget: Optional[RetryBudgetSpec] = None
+    # prefix-affinity + cache-aware routing (ISSUE 18); None = dormant
+    prefix_affinity: Optional[PrefixAffinitySpec] = None
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -640,6 +690,8 @@ class DeploySpec:
             self.outlier_ejection.validate()
         if self.retry_budget is not None:
             self.retry_budget.validate()
+        if self.prefix_affinity is not None:
+            self.prefix_affinity.validate()
 
     @property
     def resolved_default(self) -> str:
@@ -784,6 +836,14 @@ def _retry_budget_from(d: Optional[dict]) -> Optional[RetryBudgetSpec]:
     return RetryBudgetSpec(raw=d)
 
 
+def _affinity_from(d: Optional[dict]) -> Optional[PrefixAffinitySpec]:
+    if not d:
+        return None
+    if not isinstance(d, dict):
+        raise SpecError("prefixAffinity must be a mapping")
+    return PrefixAffinitySpec(raw=d)
+
+
 def _adapter_from(d: dict, model_name: str) -> AdapterSpec:
     if not isinstance(d, dict):
         raise SpecError(
@@ -901,6 +961,7 @@ def load_spec(source: "str | dict") -> DeploySpec:
         qos=_qos_from(data.get("qos")),
         outlier_ejection=_outlier_from(data.get("outlierEjection")),
         retry_budget=_retry_budget_from(data.get("retryBudget")),
+        prefix_affinity=_affinity_from(data.get("prefixAffinity")),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -689,6 +689,20 @@ class PageAllocator:
             self._prefix_map[d] = p
             self._page_digest[p] = d
 
+    def prefix_digests(self) -> "list[bytes]":
+        """Snapshot of the published device prefix-cache digests, for the
+        replica's /ready membership filter. Server threads call this off
+        the engine thread; the engine mutates ``_prefix_map`` without a
+        lock, so retry the rare resize-during-copy race instead of adding
+        locking to the admission hot path — the filter is a routing hint
+        and a one-cycle-stale (or empty) snapshot is harmless."""
+        for _ in range(4):
+            try:
+                return list(self._prefix_map)
+            except RuntimeError:
+                continue
+        return []
+
 
 class HostKVCache:
     """Host-RAM offload tier for inactive sessions' KV pages
@@ -781,6 +795,15 @@ class HostKVCache:
                 matched.append(d)
                 out.append(e)
         return matched, out
+
+    def digests(self) -> "list[bytes]":
+        """Digest part of every resident entry key (all tenants), for the
+        replica's /ready membership filter. Pure peek — no stats, no
+        recency. The filter is digest-only: tenancy is still enforced at
+        adoption time by the (tenant, digest) entry key, a cross-tenant
+        filter hit just fails to match there and re-prefills."""
+        with self._lock:
+            return [d for (_t, d) in self._entries]
 
     def export(self, tenant: str, digests: "list[bytes]") \
             -> "list[Optional[dict]]":

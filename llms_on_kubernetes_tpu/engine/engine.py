@@ -2392,6 +2392,17 @@ class Engine:
             return []
         return self.allocator._digests(tokens[:n_full * page], salt=salt)
 
+    def prefix_filter_digests(self) -> "list[bytes]":
+        """Every chained page digest currently addressable as cache on
+        this replica — device prefix cache plus host tier — feeding the
+        /ready bloom-filter advertisement the routers use for cache-aware
+        placement. Snapshot semantics, safe from server threads."""
+        out = self.allocator.prefix_digests()
+        if self.host_kv is not None:
+            seen = set(out)
+            out.extend(d for d in self.host_kv.digests() if d not in seen)
+        return out
+
     def host_kv_export(self, tenant: str, digests: "list[bytes]") \
             -> "list[Optional[dict]]":
         """Host-tier payloads for a pulling decode replica (None per

@@ -1,0 +1,454 @@
+"""Prefix-affinity + KV-cache-aware routing decisions for the edge.
+
+Both routers still balanced with blind power-of-two-choices before this
+module: at millions-of-users scale identical system prompts and returning
+sessions re-prefill on every replica, because the per-engine prefix cache
+(``engine/cache.py`` chained page digests), the host KV tier, and the
+disaggregated handoff substrate are invisible to the edge. This module
+makes them visible, in the style of SGLang's radix-cache router and
+Mooncake's KVCache-centric scheduling, done at the k8s edge:
+
+- **Affinity key** — a chained digest over (tenant, normalized
+  prompt-prefix of the first N characters):
+  ``sha256(sha256(tenant_utf8) || prefix_utf8)``. Identical bytes from
+  Python and C++ (pinned by shared vectors), so both routers pin the same
+  key to the same replica.
+- **Rendezvous hashing** — the key's pinned replica is the max of
+  ``LE64(sha256(key_bytes || url_utf8)[:8])`` over ALL replica URLs
+  (health-independent, so pins are stable across blips and a recovering
+  replica gets its sessions back).
+- **Cache-awareness beyond blind hashing** — each replica's API server
+  advertises a compact bloom-filter membership summary over its device
+  prefix-cache + host-tier digests (piggybacked on the /ready probe
+  cycle, serialized byte-identically engine-side), and the API server
+  returns the canonical engine digest chain on a response header
+  (``X-LLMK-Cache-Digests``) so the router learns where a key's KV
+  actually lives. A pinned replica whose filter denies the request's
+  digests loses it to a claiming peer; a gray (breaker-open, browned-out
+  or quarantined) pinned replica loses its sessions to peers instead of
+  holding them hostage.
+
+This module is the EXECUTABLE SPEC: the native router
+(``native/router/router.cpp``) reimplements the same decisions in C++,
+and ``tests/data/affinity_vectors.json`` holds both byte-compatible —
+the vectors run through this module via ``tests/test_affinity.py`` and
+through the native build via ``llkt-router --affinity-selftest``. Change
+semantics here and you must change the vectors and the C++ together.
+
+Routing must never change tokens, only placement: every decision below
+either names a replica or falls back to P2C — it never rewrites the
+request.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from collections import OrderedDict
+
+# Fallback/outcome names are wire-visible (metrics labels, /debug/replicas,
+# shared vectors) — both routers must emit exactly these strings.
+OUTCOME_AFFINITY = "affinity"      # pinned replica chosen (hit)
+OUTCOME_FILTER = "filter"          # claiming peer chosen by its filter (hit)
+FALLBACK_UNHEALTHY = "unhealthy"   # pinned unroutable (probe/breaker), no claimer
+FALLBACK_QUARANTINED = "quarantined"  # pinned gray-quarantined, no claimer
+FALLBACK_OVERLOADED = "overloaded"    # pinned hot-spotted, no claimer
+FALLBACK_MISS = "miss"             # no affinity key derivable from the request
+
+
+# ---------------------------------------------------------------------------
+# Pure decision functions (mirrored verbatim in router.cpp)
+# ---------------------------------------------------------------------------
+
+
+def normalize_prefix(text, prefix_chars):
+    """Canonical prompt prefix: CRLF folded to LF, first N code points.
+
+    Folding ``\\r\\n`` means a Windows client and a Unix client sending
+    the same system prompt share one affinity key. Truncation is by
+    Unicode code point (not byte), so a multi-byte character is never
+    split — both sides must measure in code points for identical bytes.
+    """
+    text = str(text).replace("\r\n", "\n")
+    n = max(0, int(prefix_chars))
+    return text[:n]
+
+
+def affinity_key(tenant, prompt, prefix_chars):
+    """Chained digest over (tenant, normalized prompt prefix), hex.
+
+    ``sha256(sha256(tenant_utf8).digest() + prefix_utf8)`` — chaining the
+    tenant digest (rather than concatenating raw strings) removes any
+    ambiguity between tenant and prompt bytes, and matches the host-KV
+    tier's (tenant, digest) keying discipline.
+    """
+    prefix = normalize_prefix(prompt, prefix_chars)
+    inner = hashlib.sha256(str(tenant).encode("utf-8")).digest()
+    return hashlib.sha256(inner + prefix.encode("utf-8")).hexdigest()
+
+
+def canonical_prompt(body):
+    """The request body's canonical prompt text, or None (= no key).
+
+    - completions: ``prompt`` as a string is used verbatim; a token-id
+      list canonicalizes to comma-joined decimal ints (``"12,55,4"``) so
+      pre-tokenized clients still get affinity; anything else → None.
+    - chat: messages concatenate as ``role + "\\n" + content + "\\n"``
+      per message; a non-string content part (multimodal) → None — the
+      image hash lives engine-side and the router must not guess.
+
+    None means "miss": the request routes by plain P2C and is counted in
+    ``llm_affinity_fallback_total{reason="miss"}``.
+    """
+    if not isinstance(body, dict):
+        return None
+    msgs = body.get("messages")
+    if isinstance(msgs, list):
+        parts = []
+        for m in msgs:
+            if not isinstance(m, dict):
+                return None
+            content = m.get("content")
+            if not isinstance(content, str):
+                return None
+            parts.append(str(m.get("role", "")) + "\n" + content + "\n")
+        return "".join(parts) if parts else None
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        return prompt if prompt else None
+    if isinstance(prompt, list):
+        ids = []
+        for t in prompt:
+            # bools are ints in python; both are rejected as token ids
+            if not isinstance(t, (int, float)) or isinstance(t, bool):
+                return None
+            if float(t) != int(t):
+                return None
+            ids.append(str(int(t)))
+        return ",".join(ids) if ids else None
+    return None
+
+
+def request_tenant(body, model):
+    """Affinity tenant = the body's ``user`` field, else the model id —
+    the exact resolution the QoS gate uses, so one tenant's sessions pin
+    together under both layers."""
+    if isinstance(body, dict):
+        user = body.get("user")
+        if isinstance(user, str) and user:
+            return user
+    return str(model)
+
+
+def rendezvous_score(key_hex, url):
+    """Rendezvous (HRW) weight of one replica for one key:
+    ``LE64(sha256(key_bytes || url_utf8)[:8])``. The key travels as hex;
+    scoring hashes its RAW 32 bytes so C++ need not re-hex."""
+    key_bytes = bytes.fromhex(key_hex)
+    digest = hashlib.sha256(key_bytes + str(url).encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def rendezvous_pick(key_hex, urls):
+    """The key's pinned replica: max rendezvous score over ALL replicas
+    (ties — astronomically unlikely — break to the lexicographically
+    smaller URL so both routers agree)."""
+    best_url = None
+    best_score = -1
+    for url in urls:
+        s = rendezvous_score(key_hex, url)
+        if s > best_score or (s == best_score
+                              and str(url) < str(best_url)):
+            best_url, best_score = url, s
+    return best_url
+
+
+def overloaded(inflight, peer_inflights, factor, slack):
+    """Hot-spot guard: the pinned replica is overloaded when its inflight
+    count exceeds ``slack + factor * mean(pool inflights)``.
+
+    The slack floor keeps affinity sticky at low traffic (where one
+    request of imbalance is 100% of the load); the factor bounds how hot
+    a popular prefix may run one replica before its sessions spill to
+    peers. ``peer_inflights`` is the FULL pool including the pinned
+    replica, so the mean is stable when sessions concentrate.
+    """
+    if not peer_inflights:
+        return False
+    mean = sum(float(v) for v in peer_inflights) / len(peer_inflights)
+    return float(inflight) > float(slack) + float(factor) * mean
+
+
+# ---------------------------------------------------------------------------
+# Bloom filter (serialized byte-identically engine-side, parsed by both
+# routers)
+# ---------------------------------------------------------------------------
+
+
+class BloomFilter:
+    """Digest-membership summary over a replica's cached prefix chains.
+
+    Keys are 32-byte chained sha256 page digests, which already carry
+    256 bits of entropy — so the k probe positions are simply the first
+    k little-endian 8-byte words of the digest mod ``bits`` (no extra
+    hashing; ``hashes`` is clamped to the 4 words available). The bit
+    array serializes as standard base64 of ``ceil(bits/8)`` bytes,
+    byte-identical from the engine builder and re-parseable by both
+    routers; false positives cost one misrouted request (it still
+    serves, just re-prefills), never correctness.
+    """
+
+    __slots__ = ("bits", "hashes", "data", "count")
+
+    def __init__(self, bits=8192, hashes=4):
+        self.bits = max(8, int(bits))
+        self.hashes = min(4, max(1, int(hashes)))
+        self.data = bytearray((self.bits + 7) // 8)
+        self.count = 0
+
+    def _positions(self, digest):
+        digest = bytes(digest)
+        for i in range(self.hashes):
+            word = int.from_bytes(digest[8 * i:8 * i + 8], "little")
+            yield word % self.bits
+
+    def add(self, digest):
+        for pos in self._positions(digest):
+            self.data[pos >> 3] |= 1 << (pos & 7)
+        self.count += 1
+
+    def contains(self, digest):
+        return all(self.data[pos >> 3] & (1 << (pos & 7))
+                   for pos in self._positions(digest))
+
+    def serialize(self):
+        """Wire form carried in the /ready body's ``prefix_filter`` key."""
+        return {
+            "bits": self.bits,
+            "hashes": self.hashes,
+            "data": base64.b64encode(bytes(self.data)).decode("ascii"),
+            "count": self.count,
+        }
+
+    @classmethod
+    def parse(cls, doc):
+        """Router-side parse of an advertised filter; None on any
+        malformation (a bad advertisement degrades to blind affinity,
+        never an error)."""
+        if not isinstance(doc, dict):
+            return None
+        try:
+            bits = int(doc["bits"])
+            hashes = int(doc["hashes"])
+            raw = base64.b64decode(str(doc["data"]), validate=True)
+        except (KeyError, TypeError, ValueError):
+            return None
+        if bits < 8 or not 1 <= hashes <= 4:
+            return None
+        if len(raw) != (bits + 7) // 8:
+            return None
+        f = cls(bits, hashes)
+        f.data = bytearray(raw)
+        try:
+            f.count = max(0, int(doc.get("count", 0)))
+        except (TypeError, ValueError):
+            f.count = 0
+        return f
+
+
+def filter_claim(bloom, digests):
+    """How many leading digests of the request's chain the filter claims.
+
+    The chain is ordered (page i+1's digest folds page i's), so only a
+    LEADING run is adoptable cache — a match deeper in the chain without
+    its prefix is unusable. Returns 0 for no filter or no digests.
+    """
+    if bloom is None:
+        return 0
+    n = 0
+    for d in digests:
+        if not bloom.contains(d):
+            break
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Decision ladder (the router's affinity-first pick)
+# ---------------------------------------------------------------------------
+
+
+def decide(key_hex, replicas, digests, factor, slack):
+    """Affinity-first replica choice for one request.
+
+    ``replicas`` is the model's role-eligible pool: dicts with ``url``,
+    ``healthy``, ``breaker_open``, ``quarantined``, ``inflight`` and an
+    optional parsed ``filter``. ``digests`` is the request's learned
+    digest chain (raw bytes, possibly empty). Returns ``(url, outcome)``:
+
+    - ``(pinned, "affinity")`` — the rendezvous replica is routable, not
+      overloaded, and its filter (if any) does not deny the digests.
+    - ``(peer, "filter")`` — a claiming peer takes the request: either
+      the pinned replica denies the digests while a peer claims them, or
+      the pinned replica is unroutable/overloaded and a claimer exists
+      (the KV survives the replica's failure on whichever peer cached
+      it).
+    - ``(None, reason)`` — fall back to P2C, with
+      ``reason ∈ {unhealthy, quarantined, overloaded}``.
+
+    An unknown-digest request on a routable pinned replica routes THERE
+    (outcome "affinity") even when nobody claims it: scattering cold
+    prefixes would defeat the cache this layer exists to build.
+    """
+    by_url = {str(r["url"]): r for r in replicas}
+    pool = [float(r.get("inflight", 0)) for r in replicas]
+
+    def routable(r):
+        return (bool(r.get("healthy", True))
+                and not r.get("breaker_open")
+                and not r.get("quarantined"))
+
+    def hot(r):
+        return overloaded(r.get("inflight", 0), pool, factor, slack)
+
+    def best_claimer(exclude_url):
+        best = None
+        best_rank = None
+        for r in replicas:
+            url = str(r["url"])
+            if url == exclude_url or not routable(r) or hot(r):
+                continue
+            claim = filter_claim(r.get("filter"), digests)
+            if claim <= 0:
+                continue
+            rank = (claim, rendezvous_score(key_hex, url))
+            if best_rank is None or rank > best_rank:
+                best, best_rank = url, rank
+        return best
+
+    pinned = rendezvous_pick(key_hex, [str(r["url"]) for r in replicas])
+    if pinned is None:
+        return None, FALLBACK_UNHEALTHY
+    p = by_url[pinned]
+
+    if routable(p) and not hot(p):
+        if digests and p.get("filter") is not None \
+                and filter_claim(p["filter"], digests) == 0:
+            peer = best_claimer(pinned)
+            if peer is not None:
+                return peer, OUTCOME_FILTER
+        return pinned, OUTCOME_AFFINITY
+
+    peer = best_claimer(pinned)
+    if peer is not None:
+        return peer, OUTCOME_FILTER
+    if p.get("quarantined"):
+        return None, FALLBACK_QUARANTINED
+    if not routable(p):
+        return None, FALLBACK_UNHEALTHY
+    return None, FALLBACK_OVERLOADED
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+class AffinityConfig:
+    """Parsed ``prefix_affinity`` config block (raw dict, like
+    OutlierConfig). The block travels verbatim through Helm
+    ``prefixAffinity`` values → router.json → both routers, so key names
+    here ARE the wire format. Absent/empty block = dormant (pure P2C,
+    byte-identical routing to the pre-affinity router).
+    """
+
+    def __init__(self, raw=None):
+        raw = raw or {}
+        self.enabled = _bool(raw.get("enabled"), bool(raw))
+        # code points of normalized prompt hashed into the affinity key
+        self.prefix_chars = int(_num(raw.get("prefix_chars"), 256))
+        # advertised bloom geometry (engine-side builder; routers parse
+        # whatever each replica advertises, so mixed fleets roll safely)
+        self.filter_bits = int(_num(raw.get("filter_bits"), 8192))
+        self.filter_hashes = min(
+            4, max(1, int(_num(raw.get("filter_hashes"), 4))))
+        # hot-spot fallback: pinned inflight > slack + factor * pool mean
+        self.overload_factor = _num(raw.get("overload_factor"), 2.0)
+        self.overload_slack = _num(raw.get("overload_slack"), 4.0)
+        # router-side key -> digest-chain LRU (learned from the
+        # X-LLMK-Cache-Digests response header)
+        self.key_cache = max(1, int(_num(raw.get("key_cache"), 4096)))
+        # digests accepted from one response header / matched per filter
+        self.max_digests = max(1, int(_num(raw.get("max_digests"), 16)))
+        # stretch (network KV tier): on a filter miss at the chosen
+        # replica while a peer claims the chain, attach handoff headers
+        # so the replica pulls spilled pages from the peer's host tier
+        # via /internal/kv/fetch instead of re-prefilling
+        self.kv_fetch = _bool(raw.get("kv_fetch"), False)
+
+
+def _num(v, default):
+    try:
+        if v is None:
+            return float(default)
+        return float(v)
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def _bool(v, default):
+    if isinstance(v, bool):
+        return v
+    return bool(default)
+
+
+# ---------------------------------------------------------------------------
+# Router-side learned state
+# ---------------------------------------------------------------------------
+
+
+class KeyDigestCache:
+    """LRU map: affinity key (hex) -> the canonical engine digest chain
+    (list of raw 32-byte digests) learned from ``X-LLMK-Cache-Digests``
+    response headers. Converges router-side keys on real cache contents:
+    the first request of a session routes by bare rendezvous, every
+    later one can be filter-checked against actual engine pages."""
+
+    def __init__(self, capacity=4096):
+        self.capacity = max(1, int(capacity))
+        self._map: OrderedDict[str, list] = OrderedDict()
+
+    def get(self, key):
+        chain = self._map.get(key)
+        if chain is not None:
+            self._map.move_to_end(key)
+        return chain or []
+
+    def put(self, key, digests):
+        if not digests:
+            return
+        self._map[key] = list(digests)
+        self._map.move_to_end(key)
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+    def __len__(self):
+        return len(self._map)
+
+
+def parse_digest_header(value, max_digests):
+    """``X-LLMK-Cache-Digests`` → list of raw digest bytes (leading run
+    of well-formed 64-hex entries, capped); junk entries end the chain
+    instead of erroring — a partial chain is still useful."""
+    out = []
+    for part in str(value).split(","):
+        part = part.strip()
+        if len(part) != 64:
+            break
+        try:
+            out.append(bytes.fromhex(part))
+        except ValueError:
+            break
+        if len(out) >= max_digests:
+            break
+    return out

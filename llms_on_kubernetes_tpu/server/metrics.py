@@ -628,4 +628,24 @@ def router_metrics(registry: Registry) -> dict:
             "Retries (connect failover, stream resume, hedges, handoff "
             "retries) refused because the per-model retry budget was "
             "exhausted — the anti-retry-storm throttle", registry),
+        "affinity_hits": Counter(
+            "llm_affinity_hits_total",
+            "Requests the prefix-affinity layer placed on a cache-bearing "
+            "replica: the rendezvous-pinned one, or a peer whose "
+            "advertised digest filter claimed the request's prefix chain",
+            registry, label_names=("model",)),
+        "affinity_fallback": Counter(
+            "llm_affinity_fallback_total",
+            "Affinity-keyed requests that fell back to plain P2C, by "
+            "reason: unhealthy = pinned replica down/breaker-open, "
+            "quarantined = pinned replica gray-ejected, overloaded = "
+            "pinned replica's inflight beyond the brownout guard, miss = "
+            "request had no affinity key (no prompt prefix)",
+            registry, label_names=("model", "reason")),
+        "prefix_filter_age": Gauge(
+            "llm_prefix_filter_age_seconds",
+            "Seconds since the replica's digest-membership filter was "
+            "last refreshed from its /ready advertisement (stale filters "
+            "degrade cache-aware placement to pure rendezvous)",
+            registry, label_names=("model", "replica")),
     }

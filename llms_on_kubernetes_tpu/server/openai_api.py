@@ -50,10 +50,11 @@ from llms_on_kubernetes_tpu.server.runtime_telemetry import RuntimeTelemetry
 # already relayed; the engine continues decoding from that exact position,
 # and this layer journals token ids / suppresses the replayed prefix.
 from llms_on_kubernetes_tpu.server.router import (
-    DEADLINE_HEADER, HANDOFF_ADOPTED_HEADER, HANDOFF_DIGESTS_HEADER,
-    HANDOFF_HEADER, HANDOFF_SEED_HEADER, HANDOFF_SOURCE_HEADER,
-    HANDOFF_TENANT_HEADER, HANDOFF_TICKET_HEADER, JOURNAL_HEADER,
-    RESUME_CREATED_HEADER, RESUME_STREAM_ID_HEADER, RESUME_TOKENS_HEADER,
+    CACHE_DIGESTS_HEADER, DEADLINE_HEADER, HANDOFF_ADOPTED_HEADER,
+    HANDOFF_DIGESTS_HEADER, HANDOFF_HEADER, HANDOFF_SEED_HEADER,
+    HANDOFF_SOURCE_HEADER, HANDOFF_TENANT_HEADER, HANDOFF_TICKET_HEADER,
+    JOURNAL_HEADER, RESUME_CREATED_HEADER, RESUME_STREAM_ID_HEADER,
+    RESUME_TOKENS_HEADER,
 )
 from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 
@@ -61,6 +62,12 @@ from llms_on_kubernetes_tpu.server.tracing import REQUEST_ID_HEADER
 # request consumed (all phases, waste included); the phase breakdown rides
 # the response body's usage.chip_ms object
 CHIP_MS_HEADER = "X-LLMK-Chip-Ms"
+
+# cache-aware routing: CACHE_DIGESTS_HEADER (canonical definition at
+# server/router.py) carries the engine digest chain of the request's full
+# prompt pages on every completion response; capped so the header stays
+# ~2 KiB (routers cap further at their configured max_digests)
+CACHE_DIGESTS_MAX = 32
 
 
 def _chip_ms_total(reqs) -> dict:
@@ -635,6 +642,19 @@ class OpenAIServer:
         # 1/factor speed while probes stay green (degraded_replica fault,
         # claimed in _maybe_claim_degraded at startup or mid-run)
         self._degraded_factor = 1.0
+        # cache-aware routing: bloom-filter advertisement of the digests
+        # resident in the device prefix cache + host KV tier, rebuilt at
+        # most every LLMK_PREFIX_FILTER_INTERVAL_S seconds and piggybacked
+        # on /ready for the routers' probe cycle (LLMK_PREFIX_FILTER_BITS=0
+        # disables the advertisement entirely)
+        self._pf_doc: Optional[dict] = None
+        self._pf_built = 0.0
+        self._pf_bits = int(os.environ.get("LLMK_PREFIX_FILTER_BITS",
+                                           "8192"))
+        self._pf_hashes = int(os.environ.get("LLMK_PREFIX_FILTER_HASHES",
+                                             "4"))
+        self._pf_interval = float(os.environ.get(
+            "LLMK_PREFIX_FILTER_INTERVAL_S", "2.0"))
 
     # ------------------------------------------------------------------
 
@@ -845,12 +865,61 @@ class OpenAIServer:
             state = "draining"
         self.metrics["engine_state"].set(self.STATE_CODES.get(state, 0))
         if state == "serving":
-            return web.json_response({"state": state})
+            doc = {"state": state}
+            pf = self._prefix_filter_doc()
+            if pf is not None:
+                doc["prefix_filter"] = pf
+            return web.json_response(doc)
         return web.json_response(
             {"state": state,
              "error": {"message": f"not ready: {state}",
                        "type": "service_unavailable"}},
             status=503)
+
+    def _prefix_filter_doc(self) -> Optional[dict]:
+        """Serialized digest-membership filter for /ready piggybacking,
+        rebuilt at most every ``_pf_interval`` seconds (the probe cycle is
+        much faster than cache contents churn). None when the engine has
+        no digest surface (stub engines in tests) or bits=0 disabled it —
+        the /ready body then stays byte-identical to PR 17."""
+        digests_fn = getattr(self.engine, "prefix_filter_digests", None)
+        if digests_fn is None or self._pf_bits <= 0:
+            return None
+        now = time.monotonic()
+        if (self._pf_doc is not None
+                and now - self._pf_built < self._pf_interval):
+            return self._pf_doc
+        from llms_on_kubernetes_tpu.server.affinity import BloomFilter
+
+        f = BloomFilter(self._pf_bits, self._pf_hashes)
+        try:
+            for d in digests_fn():
+                f.add(d)
+        except Exception:
+            return self._pf_doc  # keep advertising the last good filter
+        self._pf_doc = f.serialize()
+        self._pf_built = now
+        return self._pf_doc
+
+    def _cache_digest_header(self, reqs) -> Optional[str]:
+        """Canonical engine digest chain for the first request's prompt
+        (n>1 fan-out shares one prompt), hex-joined for the
+        ``X-LLMK-Cache-Digests`` response header. Same chain and same
+        last-page cap as the handoff ticket — exactly what a returning
+        identical prompt can adopt from this replica's caches."""
+        fn = getattr(self.engine, "handoff_digests", None)
+        alloc = getattr(self.engine, "allocator", None)
+        if fn is None or alloc is None or not reqs:
+            return None
+        prompt = getattr(reqs[0], "prompt", None) or []
+        n_pages = max(0, (len(prompt) - 1) // alloc.page_size)
+        if n_pages <= 0:
+            return None
+        digests = fn(prompt[:n_pages * alloc.page_size],
+                     salt=getattr(reqs[0], "cache_salt", b"") or b"")
+        if not digests:
+            return None
+        return ",".join(d.hex() for d in digests[:CACHE_DIGESTS_MAX])
 
     # On-demand bounded profiling (SURVEY §5 tracing gap: the reference
     # exposed no profiling at all). POST /debug/profile captures a trace
@@ -2268,6 +2337,9 @@ class OpenAIServer:
         })
         if chip:
             resp.headers[CHIP_MS_HEADER] = str(round(sum(chip.values()), 3))
+        cd = self._cache_digest_header(reqs)
+        if cd:
+            resp.headers[CACHE_DIGESTS_HEADER] = cd
         return resp
 
     async def _stream_response(self, request, reqs, rid, created, chat, stops,
@@ -2295,6 +2367,11 @@ class OpenAIServer:
             # pages actually landed — the router counts 0-with-digests as
             # a degraded (re-prefill) handoff, never a client error
             resp.headers[HANDOFF_ADOPTED_HEADER] = str(adopted)
+        cd = self._cache_digest_header(reqs)
+        if cd:
+            # set before prepare() like the ids above: the router learns
+            # this stream's key→digest chain for cache-aware re-routing
+            resp.headers[CACHE_DIGESTS_HEADER] = cd
         await resp.prepare(request)
         obj = "chat.completion.chunk" if chat else "text_completion"
         resp_model = self._resp_model(reqs)

@@ -67,7 +67,7 @@ import aiohttp
 from aiohttp import web
 
 from llms_on_kubernetes_tpu import faults
-from llms_on_kubernetes_tpu.server import outlier, tracing
+from llms_on_kubernetes_tpu.server import affinity, outlier, tracing
 from llms_on_kubernetes_tpu.server.cluster_metrics import (
     SLOTracker, merge_expositions, slo_gauges,
 )
@@ -120,6 +120,15 @@ HANDOFF_TENANT_HEADER = "X-LLMK-Handoff-Tenant"
 HANDOFF_SEED_HEADER = "X-LLMK-Handoff-Seed"
 HANDOFF_TICKET_HEADER = "X-LLMK-Handoff-Ticket"
 HANDOFF_ADOPTED_HEADER = "X-LLMK-Handoff-Adopted"
+
+# Cache-aware routing (router <-> API server, internal): every completion
+# response carries the canonical engine digest chain of the prompt's full
+# pages on this header. The router caches the chain per affinity key,
+# matches it against the digest-membership filters replicas piggyback on
+# their /ready bodies, and steers returning sessions to the replica whose
+# caches actually hold the chain (server/affinity.py is the executable
+# spec; the native router mirrors it on tests/data/affinity_vectors.json).
+CACHE_DIGESTS_HEADER = "X-LLMK-Cache-Digests"
 
 HOP_BY_HOP = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
@@ -445,6 +454,7 @@ class Router:
         handoff_retries: Optional[int] = None,
         outlier_ejection: Optional[dict] = None,
         retry_budget: Optional[dict] = None,
+        prefix_affinity: Optional[dict] = None,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -569,6 +579,27 @@ class Router:
             for name in self.backends:
                 self.retry_budgets[name] = outlier.RetryBudget(
                     self.retry_budget_cfg, clock=clock)
+        # prefix-affinity + cache-aware routing (server/affinity.py is the
+        # executable spec; the native router mirrors it byte-for-byte on
+        # tests/data/affinity_vectors.json). Dormant unless configured —
+        # pick decisions stay pure P2C and probes ignore filter payloads.
+        self.affinity_cfg = affinity.AffinityConfig(
+            prefix_affinity if prefix_affinity is not None
+            else _env_json("LLMK_AFFINITY"))
+        self.affinity_digests = affinity.KeyDigestCache(
+            self.affinity_cfg.key_cache)
+        # replica URL -> last adopted /ready filter and its clock stamp
+        self._filters: dict[str, affinity.BloomFilter] = {}
+        self._filter_at: dict[str, float] = {}
+        if self.affinity_cfg.enabled:
+            for name in self.backends:
+                self.metrics["affinity_hits"].labels(model=name)
+                for reason in (affinity.FALLBACK_UNHEALTHY,
+                               affinity.FALLBACK_QUARANTINED,
+                               affinity.FALLBACK_OVERLOADED,
+                               affinity.FALLBACK_MISS):
+                    self.metrics["affinity_fallback"].labels(
+                        model=name, reason=reason)
         self._session: Optional[aiohttp.ClientSession] = None
         self._probe_task: Optional[asyncio.Task] = None
 
@@ -628,11 +659,29 @@ class Router:
                 rep.url + self.probe_path,
                 timeout=aiohttp.ClientTimeout(total=self.probe_timeout_s),
             ) as resp:
-                await resp.read()
+                raw = await resp.read()
                 healthy = resp.status != 503
+                if self.affinity_cfg.enabled and resp.status == 200:
+                    self._refresh_filter(rep, raw)
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
             healthy = False
         self._set_health(rep, healthy)
+
+    def _refresh_filter(self, rep: Replica, raw: bytes) -> None:
+        """Adopt the digest-membership filter the replica piggybacked on
+        its /ready body. Absent or malformed keeps the last good filter —
+        the age gauge makes staleness visible, and a stale filter only
+        degrades cache-aware placement to pure rendezvous."""
+        try:
+            doc = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            return
+        pf = doc.get("prefix_filter") if isinstance(doc, dict) else None
+        f = affinity.BloomFilter.parse(pf) if isinstance(pf, dict) else None
+        if f is None:
+            return
+        self._filters[rep.url] = f
+        self._filter_at[rep.url] = self.clock()
 
     def _set_health(self, rep: Replica, healthy: bool) -> None:
         if healthy != rep.healthy:
@@ -657,6 +706,12 @@ class Router:
                 self.metrics["breaker_open"].labels(
                     model=r.model, replica=r.url, role=r.role).set(
                         0 if r.breaker.state == CircuitBreaker.CLOSED else 1)
+                if self.affinity_cfg.enabled:
+                    at = self._filter_at.get(r.url)
+                    if at is not None:
+                        self.metrics["prefix_filter_age"].labels(
+                            model=r.model, replica=r.url).set(
+                                max(0.0, self.clock() - at))
         return web.Response(text=self.registry.render(),
                             content_type="text/plain")
 
@@ -851,6 +906,84 @@ class Router:
         return choice if choice.breaker.allow() else None
 
     # ------------------------------------------------------------------
+    # prefix-affinity + cache-aware placement (server/affinity.py holds
+    # the semantics; routing never changes tokens, only placement)
+
+    def _affinity_route(self, model: str, doc: Optional[dict],
+                        trace: "tracing.Trace") \
+            -> tuple[Optional[str], Optional[str], Optional[str]]:
+        """(affinity key, chosen replica URL, kv-pull source URL) for one
+        completion request — (key, None, None) when the decision ladder
+        fell back to P2C, (None, None, None) when the request has no
+        affinity key at all. Counted into the hits/fallback series here,
+        at decision time, not at dispatch."""
+        cfg = self.affinity_cfg
+        text = affinity.canonical_prompt(doc)
+        if text is None:
+            self.metrics["affinity_fallback"].labels(
+                model=model, reason=affinity.FALLBACK_MISS).inc()
+            return None, None, None
+        key = affinity.affinity_key(
+            affinity.request_tenant(doc, model), text, cfg.prefix_chars)
+        pool = self.replicas[model]
+        if any(r.role == "prefill" for r in pool):
+            # mirror _serve_roles: a full generation never pins to a
+            # prefill pod (it would starve the disagg ticket flow); the
+            # two-hop handoff path has its own KV-aware placement
+            pool = [r for r in pool if r.role in ("both", "decode")]
+        if not pool:
+            self.metrics["affinity_fallback"].labels(
+                model=model, reason=affinity.FALLBACK_UNHEALTHY).inc()
+            return key, None, None
+        det = self.outliers.get(model)
+        reps = [{
+            "url": r.url,
+            "healthy": r.healthy,
+            "breaker_open": r.breaker.blocked(),
+            "quarantined": bool(det is not None
+                                and det.is_quarantined(r.url)),
+            "inflight": r.inflight,
+            "filter": self._filters.get(r.url),
+        } for r in pool]
+        digests = self.affinity_digests.get(key)
+        url, outcome = affinity.decide(key, reps, digests,
+                                       cfg.overload_factor,
+                                       cfg.overload_slack)
+        if url is None:
+            self.metrics["affinity_fallback"].labels(
+                model=model, reason=outcome).inc()
+            return key, None, None
+        self.metrics["affinity_hits"].labels(model=model).inc()
+        trace.event("affinity", outcome=outcome, replica=url)
+        pull = None
+        if cfg.kv_fetch and digests:
+            # stretch flag: the chosen replica's filter claims none of
+            # the chain but a peer's does — have the chosen replica pull
+            # the spilled pages over /internal/kv/fetch (PR-16 substrate)
+            # instead of re-prefilling
+            chosen = next((x for x in reps if x["url"] == url), None)
+            if chosen is not None and affinity.filter_claim(
+                    chosen["filter"], digests) == 0:
+                best_claim = 0
+                for x in reps:
+                    if x["url"] == url:
+                        continue
+                    c = affinity.filter_claim(x["filter"], digests)
+                    if c > best_claim:
+                        pull, best_claim = x["url"], c
+        return key, url, pull
+
+    def _learn_digests(self, key: str, resp_headers) -> None:
+        """Fold a completion response's canonical digest chain into the
+        per-key cache so the NEXT request with this key can be matched
+        against replica filters (router-side keys converge on what the
+        engine actually caches)."""
+        raw = resp_headers.get(CACHE_DIGESTS_HEADER)
+        if raw:
+            self.affinity_digests.put(key, affinity.parse_digest_header(
+                raw, self.affinity_cfg.max_digests))
+
+    # ------------------------------------------------------------------
     # gray-failure layer plumbing (server/outlier.py holds the semantics)
 
     def _outlier_group(self, rep: Replica) -> list:
@@ -981,6 +1114,14 @@ class Router:
                 }
                 if det is not None:
                     d["outlier"] = det.snapshot(r.url)
+                if self.affinity_cfg.enabled:
+                    f = self._filters.get(r.url)
+                    if f is not None:
+                        d["prefix_filter"] = {
+                            "count": f.count,
+                            "age_s": round(max(0.0, self.clock()
+                                               - self._filter_at[r.url]), 3),
+                        }
                 entry["replicas"].append(d)
             budget = self.retry_budgets.get(name)
             if budget is not None:
@@ -994,6 +1135,7 @@ class Router:
         return web.json_response({
             "outlier_ejection_enabled": self.outlier_cfg.enabled,
             "retry_budget_enabled": self.retry_budget_cfg.enabled,
+            "prefix_affinity_enabled": self.affinity_cfg.enabled,
             "models": models,
         })
 
@@ -1163,6 +1305,25 @@ class Router:
                 outcome="fallback_colocated").inc()
             trace.event("handoff_fallback_colocated")
 
+        # --- prefix-affinity + cache-aware placement: an affinity-keyed
+        # completion prefers its rendezvous-pinned replica, or a peer
+        # whose advertised /ready filter claims the prompt's digest
+        # chain. The connect loop below uses the choice as its attempt-1
+        # target only — every fallback (breaker race, retry, shadow
+        # trickle) is the unchanged P2C path, so routing can change
+        # placement but never tokens.
+        aff_key: Optional[str] = None
+        aff_url: Optional[str] = None
+        aff_pull: Optional[str] = None
+        if (self.affinity_cfg.enabled and request.method == "POST"
+                and doc is not None
+                and request.match_info["path"].rstrip("/").endswith(
+                    "completions")):
+            aff_key, aff_url, aff_pull = self._affinity_route(
+                model, doc, trace)
+            if aff_key:
+                request["llmk_affinity_key"] = aff_key
+
         # --- connect/request phase: bounded retries with backoff+jitter.
         # Only failures BEFORE a response head are retried (the buffered
         # body makes the resend safe); each transport failure feeds the
@@ -1199,9 +1360,20 @@ class Router:
                         "retry_budget_exhausted"),
                     status=503, headers=self._rid_headers(
                         rid, {"Retry-After": "1"}))
-            replica = self._pick(model, tried,
-                                 roles=self._serve_roles(model),
-                                 shadow=shadow and attempt == 1)
+            replica = None
+            if aff_url is not None and attempt == 1 and not shadow:
+                # affinity target for the first attempt (shadow trickle
+                # outranks it: a quarantined replica must still get its
+                # 1-in-N chance to earn re-admission); any breaker race
+                # since the decision falls through to P2C
+                replica = next((r for r in self.replicas[model]
+                                if r.url == aff_url), None)
+                if replica is not None and not replica.breaker.allow():
+                    replica = None
+            if replica is None:
+                replica = self._pick(model, tried,
+                                     roles=self._serve_roles(model),
+                                     shadow=shadow and attempt == 1)
             if replica is None:
                 if attempt > 1:
                     self._refund_retry(model)
@@ -1219,10 +1391,22 @@ class Router:
             url = f"{replica.url}/{request.match_info['path']}"
             if request.query_string:
                 url += f"?{request.query_string}"
+            send_headers = headers
+            if aff_pull and attempt == 1 and replica.url == aff_url:
+                # kv_fetch stretch: the chosen replica's caches hold none
+                # of the chain but a peer's do — name that peer so the
+                # replica pulls the spilled pages over /internal/kv/fetch
+                # (PR-16 substrate) instead of re-prefilling
+                send_headers = dict(headers)
+                send_headers[HANDOFF_SOURCE_HEADER] = aff_pull
+                send_headers[HANDOFF_DIGESTS_HEADER] = ",".join(
+                    d.hex() for d in self.affinity_digests.get(aff_key))
+                send_headers[HANDOFF_TENANT_HEADER] = tenant
             replica.inflight += 1
             try:
                 upstream = await self._session.request(
-                    request.method, url, data=body or None, headers=headers,
+                    request.method, url, data=body or None,
+                    headers=send_headers,
                 )
                 replica.breaker.record_success()
                 active = replica
@@ -1273,6 +1457,9 @@ class Router:
             return await self._relay_stream(
                 request, trace, rid, model, headers, body, deadline,
                 upstream, active, tried, t0, journal)
+
+        if aff_key and upstream.status == 200:
+            self._learn_digests(aff_key, upstream.headers)
 
         # --- relay phase (non-journaled): stream the response; never
         # retried (the upstream may have executed the request).
@@ -1524,6 +1711,9 @@ class Router:
                         error_body(f"upstream error: {e}", "bad_gateway",
                                    "upstream_error"),
                         status=502, headers=self._rid_headers(rid))
+            akey = request.get("llmk_affinity_key")
+            if akey and upstream.status == 200:
+                self._learn_digests(akey, upstream.headers)
             while True:  # one iteration per upstream segment
                 if resp is None:
                     sse = upstream.headers.get(
@@ -1857,6 +2047,7 @@ def run_router(
     handoff_retries: Optional[int] = None,
     outlier_ejection: Optional[dict] = None,
     retry_budget: Optional[dict] = None,
+    prefix_affinity: Optional[dict] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s,
@@ -1864,6 +2055,7 @@ def run_router(
                     resume_attempts=resume_attempts, hedge_ms=hedge_ms,
                     qos=qos, roles=roles, handoff_retries=handoff_retries,
                     outlier_ejection=outlier_ejection,
-                    retry_budget=retry_budget)
+                    retry_budget=retry_budget,
+                    prefix_affinity=prefix_affinity)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

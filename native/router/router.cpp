@@ -74,11 +74,13 @@
 #include <cmath>
 #include <cstdarg>
 #include <csignal>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <deque>
+#include <list>
 #include <fstream>
 #include <functional>
 #include <map>
@@ -154,6 +156,25 @@ struct BudgetCfg {
   double burst = 10.0;     // bucket cap (and the starting level)
 };
 
+// ---------------------------------------------------------------------------
+// Prefix-affinity + cache-aware routing config (mirrors server/affinity.py
+// AffinityConfig — that module is the executable spec; the two are held
+// byte-compatible by tests/data/affinity_vectors.json, driven here via
+// --affinity-selftest)
+// ---------------------------------------------------------------------------
+
+struct AffinityCfg {
+  bool enabled = false;
+  int prefix_chars = 256;        // code points hashed into the affinity key
+  int filter_bits = 8192;        // advertised bloom geometry (engine-side)
+  int filter_hashes = 4;         // clamped 1..4 (digest has 4 LE64 words)
+  double overload_factor = 2.0;  // pinned hot when > slack + factor * mean
+  double overload_slack = 4.0;
+  int key_cache = 4096;          // key -> digest-chain LRU capacity
+  int max_digests = 16;          // digests accepted per response header
+  bool kv_fetch = false;         // stretch: pull spilled KV from a claimer
+};
+
 struct Config {
   // insertion-ordered: first model is the default (like the reference's
   // `default_backend` = first entry, model-gateway.yaml:20-22). Each model
@@ -202,6 +223,9 @@ struct Config {
   // budget ("retry_budget" block / LLMK_RETRY_BUDGET); absent = dormant
   OutlierCfg outlier;
   BudgetCfg retry_budget;
+  // prefix-affinity + KV-cache-aware routing ("prefix_affinity" block /
+  // LLMK_AFFINITY); absent = dormant (pure P2C, byte-identical)
+  AffinityCfg affinity;
   // disaggregated prefill/decode (mirrors server/router.py): replica
   // (host, port) -> role; absent = "both". A model with any prefill
   // replica gets the two-hop ticket flow; handoff_retries bounds the
@@ -1314,6 +1338,593 @@ class BreakerRegistry {
 static BreakerRegistry g_breakers;
 
 // ---------------------------------------------------------------------------
+// Prefix-affinity + cache-aware routing (mirrors server/affinity.py — that
+// module is the executable spec; tests/data/affinity_vectors.json holds the
+// two byte-compatible, driven here via --affinity-selftest)
+// ---------------------------------------------------------------------------
+
+// Self-contained SHA-256 (FIPS 180-4): the affinity key, the rendezvous
+// weights and the bloom probe positions all derive from it, and a static
+// gateway binary must not grow an OpenSSL dependency for that.
+struct Sha256 {
+  uint32_t h[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+                   0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+  uint8_t buf[64];
+  uint64_t total = 0;
+  size_t fill = 0;
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const void* data, size_t len) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    total += len;
+    while (len) {
+      size_t take = std::min(len, sizeof buf - fill);
+      std::memcpy(buf + fill, p, take);
+      fill += take;
+      p += take;
+      len -= take;
+      if (fill == sizeof buf) {
+        block(buf);
+        fill = 0;
+      }
+    }
+  }
+
+  // 32 raw digest bytes
+  std::string final() {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (fill != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    // bypass update()'s total bookkeeping for the length words
+    std::memcpy(buf + fill, lenb, 8);
+    block(buf);
+    std::string out(32, '\0');
+    for (int i = 0; i < 8; ++i)
+      for (int j = 0; j < 4; ++j)
+        out[4 * i + j] = char(uint8_t(h[i] >> (24 - 8 * j)));
+    return out;
+  }
+};
+
+static std::string sha256_raw(const std::string& data) {
+  Sha256 s;
+  s.update(data.data(), data.size());
+  return s.final();
+}
+
+static std::string to_hex(const std::string& raw) {
+  static const char hexd[] = "0123456789abcdef";
+  std::string out;
+  out.reserve(raw.size() * 2);
+  for (unsigned char c : raw) {
+    out.push_back(hexd[c >> 4]);
+    out.push_back(hexd[c & 15]);
+  }
+  return out;
+}
+
+static int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+static bool hex_to_raw(const std::string& hex, std::string* out) {
+  if (hex.size() % 2) return false;
+  out->clear();
+  out->reserve(hex.size() / 2);
+  for (size_t i = 0; i < hex.size(); i += 2) {
+    int hi = hex_val(hex[i]), lo = hex_val(hex[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(char((hi << 4) | lo));
+  }
+  return true;
+}
+
+// standard base64 (the bloom filter's wire alphabet; strict decode like
+// python's b64decode(validate=True) — any junk byte rejects the filter)
+static const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+static std::string b64_encode(const std::string& raw) {
+  std::string out;
+  out.reserve((raw.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 3 <= raw.size()) {
+    uint32_t v = (uint8_t(raw[i]) << 16) | (uint8_t(raw[i + 1]) << 8) |
+                 uint8_t(raw[i + 2]);
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out.push_back(kB64[v & 63]);
+    i += 3;
+  }
+  size_t rem = raw.size() - i;
+  if (rem == 1) {
+    uint32_t v = uint8_t(raw[i]) << 16;
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out += "==";
+  } else if (rem == 2) {
+    uint32_t v = (uint8_t(raw[i]) << 16) | (uint8_t(raw[i + 1]) << 8);
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(kB64[(v >> 6) & 63]);
+    out += "=";
+  }
+  return out;
+}
+
+static bool b64_decode(const std::string& text, std::string* out) {
+  if (text.size() % 4) return false;
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  out->clear();
+  out->reserve(text.size() / 4 * 3);
+  for (size_t i = 0; i < text.size(); i += 4) {
+    int pad = 0;
+    int v[4];
+    for (int j = 0; j < 4; ++j) {
+      char c = text[i + j];
+      if (c == '=') {
+        // padding only in the last two positions of the last quad
+        if (i + 4 != text.size() || j < 2) return false;
+        v[j] = 0;
+        ++pad;
+      } else {
+        if (pad) return false;  // data after '='
+        v[j] = val(c);
+        if (v[j] < 0) return false;
+      }
+    }
+    uint32_t w = (uint32_t(v[0]) << 18) | (uint32_t(v[1]) << 12) |
+                 (uint32_t(v[2]) << 6) | uint32_t(v[3]);
+    out->push_back(char((w >> 16) & 0xff));
+    if (pad < 2) out->push_back(char((w >> 8) & 0xff));
+    if (pad < 1) out->push_back(char(w & 0xff));
+  }
+  return true;
+}
+
+// normalize_prefix: CRLF folded to LF, first N Unicode CODE POINTS (the
+// python spec slices str — so truncation here counts UTF-8 lead bytes,
+// never splitting a multi-byte character)
+static std::string aff_normalize_prefix(const std::string& text,
+                                        int prefix_chars) {
+  std::string folded;
+  folded.reserve(text.size());
+  for (size_t i = 0; i < text.size(); ++i) {
+    if (text[i] == '\r' && i + 1 < text.size() && text[i + 1] == '\n') {
+      folded.push_back('\n');
+      ++i;
+    } else {
+      folded.push_back(text[i]);
+    }
+  }
+  int n = std::max(0, prefix_chars);
+  int count = 0;
+  size_t cut = folded.size();
+  for (size_t i = 0; i < folded.size(); ++i) {
+    if ((uint8_t(folded[i]) & 0xC0) != 0x80) {  // code-point lead byte
+      if (count == n) {
+        cut = i;
+        break;
+      }
+      ++count;
+    }
+  }
+  return folded.substr(0, cut);
+}
+
+// affinity_key: sha256(sha256(tenant_utf8) || normalized_prefix_utf8), hex
+static std::string aff_key_hex(const std::string& tenant,
+                               const std::string& prompt, int prefix_chars) {
+  std::string prefix = aff_normalize_prefix(prompt, prefix_chars);
+  return to_hex(sha256_raw(sha256_raw(tenant) + prefix));
+}
+
+// canonical_prompt: the request body's canonical prompt text, or false
+// (= no key, fallback reason "miss"). Mirrors server/affinity.py: string
+// prompts verbatim (empty = miss), integer token-id lists comma-joined,
+// chat messages as role\ncontent\n per message; any non-string content
+// part (multimodal) or non-integer token = miss.
+static bool aff_canonical_prompt(const Json* body, std::string* out) {
+  if (!body || !body->is_object()) return false;
+  if (const Json* msgs = body->get("messages");
+      msgs && msgs->type == Json::Type::Array) {
+    std::string joined;
+    for (const auto& m : msgs->arr) {
+      if (!m->is_object()) return false;
+      const Json* content = m->get("content");
+      if (!content || !content->is_string()) return false;
+      const Json* role = m->get("role");
+      joined += (role && role->is_string() ? role->str : std::string());
+      joined += "\n";
+      joined += content->str;
+      joined += "\n";
+    }
+    if (msgs->arr.empty()) return false;
+    *out = joined;
+    return true;
+  }
+  const Json* prompt = body->get("prompt");
+  if (!prompt) return false;
+  if (prompt->is_string()) {
+    if (prompt->str.empty()) return false;
+    *out = prompt->str;
+    return true;
+  }
+  if (prompt->type == Json::Type::Array) {
+    std::string ids;
+    for (const auto& t : prompt->arr) {
+      if (t->type != Json::Type::Number) return false;  // bools are Bool here
+      double v = t->number;
+      long long iv = static_cast<long long>(v);
+      if (double(iv) != v) return false;  // non-integer token id
+      if (!ids.empty()) ids += ",";
+      ids += std::to_string(iv);
+    }
+    if (prompt->arr.empty()) return false;
+    *out = ids;
+    return true;
+  }
+  return false;
+}
+
+// request_tenant: the body's "user" field, else the model id (the exact
+// resolution the QoS gate uses for its tenant key)
+static std::string aff_request_tenant(const Json* body,
+                                      const std::string& model) {
+  if (body && body->is_object())
+    if (const Json* u = body->get("user"); u && u->is_string() && !u->str.empty())
+      return u->str;
+  return model;
+}
+
+// rendezvous (HRW) weight: LE64(sha256(key_raw32 || url_utf8)[:8])
+static uint64_t aff_rendezvous_score(const std::string& key_raw,
+                                     const std::string& url) {
+  std::string digest = sha256_raw(key_raw + url);
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | uint8_t(digest[i]);
+  return v;
+}
+
+// max score over ALL replicas; ties break to the smaller URL string
+static std::string aff_rendezvous_pick(const std::string& key_raw,
+                                       const std::vector<std::string>& urls) {
+  std::string best;
+  uint64_t best_score = 0;
+  bool have = false;
+  for (const std::string& url : urls) {
+    uint64_t s = aff_rendezvous_score(key_raw, url);
+    if (!have || s > best_score || (s == best_score && url < best)) {
+      best = url;
+      best_score = s;
+      have = true;
+    }
+  }
+  return best;  // "" = empty pool
+}
+
+static bool aff_overloaded(double inflight, const std::vector<double>& pool,
+                           double factor, double slack) {
+  if (pool.empty()) return false;
+  double sum = 0.0;
+  for (double v : pool) sum += v;
+  return inflight > slack + factor * (sum / double(pool.size()));
+}
+
+// parsed digest-membership bloom filter (wire form built engine-side; the
+// k probe positions are the first k LE64 words of the digest mod bits)
+struct AffBloom {
+  int bits = 0;
+  int hashes = 0;
+  std::string data;  // (bits + 7) / 8 bytes
+  long count = 0;
+
+  bool contains(const std::string& digest) const {
+    for (int i = 0; i < hashes; ++i) {
+      uint64_t word = 0;
+      for (int j = 7; j >= 0; --j) {
+        size_t idx = size_t(8 * i + j);
+        word = (word << 8) | (idx < digest.size() ? uint8_t(digest[idx]) : 0);
+      }
+      uint64_t pos = word % uint64_t(bits);
+      if (!(uint8_t(data[pos >> 3]) & (1u << (pos & 7)))) return false;
+    }
+    return true;
+  }
+
+  void add(const std::string& digest) {
+    for (int i = 0; i < hashes; ++i) {
+      uint64_t word = 0;
+      for (int j = 7; j >= 0; --j) {
+        size_t idx = size_t(8 * i + j);
+        word = (word << 8) | (idx < digest.size() ? uint8_t(digest[idx]) : 0);
+      }
+      uint64_t pos = word % uint64_t(bits);
+      data[pos >> 3] = char(uint8_t(data[pos >> 3]) | (1u << (pos & 7)));
+    }
+    ++count;
+  }
+};
+
+static AffBloom aff_bloom_make(int bits, int hashes) {
+  AffBloom f;
+  f.bits = std::max(8, bits);
+  f.hashes = std::min(4, std::max(1, hashes));
+  f.data.assign(size_t((f.bits + 7) / 8), '\0');
+  return f;
+}
+
+// router-side parse of an advertised filter; false on any malformation
+// (a bad advertisement degrades to blind affinity, never an error)
+static bool aff_bloom_parse(const Json* doc, AffBloom* out) {
+  if (!doc || !doc->is_object()) return false;
+  const Json* b = doc->get("bits");
+  const Json* h = doc->get("hashes");
+  const Json* d = doc->get("data");
+  if (!b || b->type != Json::Type::Number || !h ||
+      h->type != Json::Type::Number || !d || !d->is_string())
+    return false;
+  int bits = static_cast<int>(b->number);
+  int hashes = static_cast<int>(h->number);
+  if (bits < 8 || hashes < 1 || hashes > 4) return false;
+  std::string raw;
+  if (!b64_decode(d->str, &raw)) return false;
+  if (raw.size() != size_t((bits + 7) / 8)) return false;
+  out->bits = bits;
+  out->hashes = hashes;
+  out->data = std::move(raw);
+  out->count = 0;
+  if (const Json* c = doc->get("count"); c && c->type == Json::Type::Number)
+    out->count = std::max(0L, static_cast<long>(c->number));
+  return true;
+}
+
+// leading-run claim: only a LEADING run of the ordered chain is adoptable
+// cache (page i+1's digest folds page i's)
+static int aff_filter_claim(const AffBloom* bloom,
+                            const std::vector<std::string>& digests) {
+  if (!bloom) return 0;
+  int n = 0;
+  for (const std::string& d : digests) {
+    if (!bloom->contains(d)) break;
+    ++n;
+  }
+  return n;
+}
+
+// one replica's routing snapshot for the decision ladder (the proxy path
+// fills it from g_health/g_breakers/outlier state; the selftest from the
+// vector docs directly)
+struct AffReplica {
+  std::string url;  // "http://host:port" — the rendezvous hash input
+  bool healthy = true;
+  bool breaker_open = false;
+  bool quarantined = false;
+  double inflight = 0.0;
+  bool has_filter = false;
+  AffBloom filter;
+};
+
+// decision ladder (mirrors affinity.decide verbatim): first = chosen url
+// ("" = P2C fallback), second = outcome/reason label
+static std::pair<std::string, std::string> aff_decide(
+    const std::string& key_hex, const std::vector<AffReplica>& replicas,
+    const std::vector<std::string>& digests, double factor, double slack) {
+  std::string key_raw;
+  if (!hex_to_raw(key_hex, &key_raw)) return {"", "unhealthy"};
+  std::vector<double> pool;
+  pool.reserve(replicas.size());
+  for (const AffReplica& r : replicas) pool.push_back(r.inflight);
+
+  auto routable = [](const AffReplica& r) {
+    return r.healthy && !r.breaker_open && !r.quarantined;
+  };
+  auto hot = [&](const AffReplica& r) {
+    return aff_overloaded(r.inflight, pool, factor, slack);
+  };
+  auto best_claimer = [&](const std::string& exclude) -> std::string {
+    std::string best;
+    int best_claim = 0;
+    uint64_t best_score = 0;
+    for (const AffReplica& r : replicas) {
+      if (r.url == exclude || !routable(r) || hot(r)) continue;
+      int claim = aff_filter_claim(r.has_filter ? &r.filter : nullptr, digests);
+      if (claim <= 0) continue;
+      uint64_t score = aff_rendezvous_score(key_raw, r.url);
+      if (best.empty() || claim > best_claim ||
+          (claim == best_claim && score > best_score)) {
+        best = r.url;
+        best_claim = claim;
+        best_score = score;
+      }
+    }
+    return best;
+  };
+
+  std::vector<std::string> urls;
+  urls.reserve(replicas.size());
+  for (const AffReplica& r : replicas) urls.push_back(r.url);
+  std::string pinned = aff_rendezvous_pick(key_raw, urls);
+  if (pinned.empty()) return {"", "unhealthy"};
+  const AffReplica* p = nullptr;
+  for (const AffReplica& r : replicas)
+    if (r.url == pinned) { p = &r; break; }
+
+  if (routable(*p) && !hot(*p)) {
+    if (!digests.empty() && p->has_filter &&
+        aff_filter_claim(&p->filter, digests) == 0) {
+      std::string peer = best_claimer(pinned);
+      if (!peer.empty()) return {peer, "filter"};
+    }
+    return {pinned, "affinity"};
+  }
+  std::string peer = best_claimer(pinned);
+  if (!peer.empty()) return {peer, "filter"};
+  if (p->quarantined) return {"", "quarantined"};
+  if (!routable(*p)) return {"", "unhealthy"};
+  return {"", "overloaded"};
+}
+
+// X-LLMK-Cache-Digests header -> leading run of well-formed 64-hex
+// entries as raw bytes, capped; junk ends the chain instead of erroring
+static std::vector<std::string> aff_parse_digest_header(
+    const std::string& value, int max_digests) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= value.size()) {
+    size_t comma = value.find(',', start);
+    std::string part = value.substr(
+        start, comma == std::string::npos ? std::string::npos : comma - start);
+    part = strip_copy(part);
+    std::string raw;
+    if (part.size() != 64 || !hex_to_raw(part, &raw)) break;
+    out.push_back(raw);
+    if (static_cast<int>(out.size()) >= max_digests) break;
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return out;
+}
+
+// --- learned router state: per-replica advertised filters (refreshed by
+// the /ready probe cycle) and the affinity-key -> digest-chain LRU
+// (learned from X-LLMK-Cache-Digests response headers)
+struct AffFilterEntry {
+  bool has = false;   // parse failure keeps the stamp but drops the filter
+  AffBloom filter;
+  double at = 0.0;    // mono_s() of the last refresh
+};
+
+static std::mutex g_aff_mu;
+static std::map<std::string, AffFilterEntry> g_aff_filters;  // rep_key(u)
+static std::list<std::pair<std::string, std::vector<std::string>>> g_aff_lru;
+static std::map<std::string,
+                std::list<std::pair<std::string,
+                                    std::vector<std::string>>>::iterator>
+    g_aff_lru_idx;
+
+static std::mutex g_aff_metrics_mu;
+static std::map<std::string, long> g_aff_hits_by_model;
+static std::map<std::pair<std::string, std::string>, long>
+    g_aff_fallback_by_model_reason;
+
+static void aff_count_hit(const std::string& model) {
+  std::lock_guard<std::mutex> lock(g_aff_metrics_mu);
+  ++g_aff_hits_by_model[model];
+}
+
+static void aff_count_fallback(const std::string& model,
+                               const std::string& reason) {
+  std::lock_guard<std::mutex> lock(g_aff_metrics_mu);
+  ++g_aff_fallback_by_model_reason[{model, reason}];
+}
+
+static std::vector<std::string> aff_cache_get(const std::string& key) {
+  std::lock_guard<std::mutex> lock(g_aff_mu);
+  auto it = g_aff_lru_idx.find(key);
+  if (it == g_aff_lru_idx.end()) return {};
+  g_aff_lru.splice(g_aff_lru.end(), g_aff_lru, it->second);  // move_to_end
+  return it->second->second;
+}
+
+static void aff_cache_put(const AffinityCfg& cfg, const std::string& key,
+                          const std::vector<std::string>& digests) {
+  if (digests.empty()) return;
+  std::lock_guard<std::mutex> lock(g_aff_mu);
+  auto it = g_aff_lru_idx.find(key);
+  if (it != g_aff_lru_idx.end()) {
+    it->second->second = digests;
+    g_aff_lru.splice(g_aff_lru.end(), g_aff_lru, it->second);
+  } else {
+    g_aff_lru.emplace_back(key, digests);
+    g_aff_lru_idx[key] = std::prev(g_aff_lru.end());
+  }
+  size_t cap = size_t(std::max(1, cfg.key_cache));
+  while (g_aff_lru.size() > cap) {
+    g_aff_lru_idx.erase(g_aff_lru.front().first);
+    g_aff_lru.pop_front();
+  }
+}
+
+static void aff_learn(const AffinityCfg& cfg, const std::string& key,
+                      const std::string& header_value) {
+  aff_cache_put(cfg, key, aff_parse_digest_header(header_value,
+                                                  cfg.max_digests));
+}
+
+// fold one /ready advertisement into the replica's filter slot; a body
+// without a parseable prefix_filter still stamps the refresh time (the
+// age gauge measures probe liveness, not filter presence)
+static void aff_refresh_filter(const Url& u, const std::string& body) {
+  JsonPtr doc = JsonParser::parse(body);
+  const Json* pf = doc && doc->is_object() ? doc->get("prefix_filter")
+                                           : nullptr;
+  AffFilterEntry e;
+  e.at = mono_s();
+  e.has = aff_bloom_parse(pf, &e.filter);
+  std::lock_guard<std::mutex> lock(g_aff_mu);
+  g_aff_filters[rep_key(u)] = std::move(e);
+}
+
+// ---------------------------------------------------------------------------
 // Replica health + selection (mirrors server/router.py Replica/_pick)
 // ---------------------------------------------------------------------------
 
@@ -1344,6 +1955,7 @@ static std::string debug_replicas_json(const Config& cfg) {
   auto root = Json::make(Json::Type::Object);
   root->set("outlier_ejection_enabled", Json::of_bool(cfg.outlier.enabled));
   root->set("retry_budget_enabled", Json::of_bool(cfg.retry_budget.enabled));
+  root->set("prefix_affinity_enabled", Json::of_bool(cfg.affinity.enabled));
   auto models = Json::make(Json::Type::Object);
   for (const auto& kv : cfg.models) {
     auto entry = Json::make(Json::Type::Object);
@@ -1387,6 +1999,17 @@ static std::string debug_replicas_json(const Config& cfg) {
           o->set("quarantined_age_s",
                  Json::of_number(std::max(0.0, mono_s() - s.quarantined_at)));
         d->set("outlier", o);
+      }
+      if (cfg.affinity.enabled) {
+        std::lock_guard<std::mutex> lock(g_aff_mu);
+        auto it = g_aff_filters.find(rep_key(u));
+        if (it != g_aff_filters.end() && it->second.has) {
+          auto pf = Json::make(Json::Type::Object);
+          pf->set("count", Json::of_number(double(it->second.filter.count)));
+          pf->set("age_s",
+                  Json::of_number(std::max(0.0, mono_s() - it->second.at)));
+          d->set("prefix_filter", pf);
+        }
       }
       reps->arr.push_back(d);
     }
@@ -1539,10 +2162,17 @@ static bool has_untried_alternate(const Config& cfg,
   return false;
 }
 
+static bool read_body_text(SockReader& up, const ResponseHead& head,
+                           std::string* out,
+                           size_t cap = 1 << 20);  // defined below
+
 // One active health probe: GET <base>/ready. A replica is unhealthy iff
 // the probe cannot CONNECT/read a response head, or the server answered
 // 503 (draining/wedged — the engine's own readiness contract). Any other
 // status (200, 404 from a bare backend without /ready) keeps it routable.
+// With the affinity layer on, a 200 body is read through for the
+// replica's piggybacked prefix_filter advertisement (the probe cycle IS
+// the filter refresh cycle — no extra connections).
 static bool probe_replica(const Config& cfg, const Url& u) {
   int fd = connect_to(u.host, u.port, cfg.probe_timeout_s,
                       cfg.probe_timeout_s);
@@ -1558,6 +2188,10 @@ static bool probe_replica(const Config& cfg, const Url& u) {
                    std::chrono::seconds(cfg.probe_timeout_s));
     ResponseHead head;
     ok = read_response_head(r, head) && head.status != 503;
+    if (ok && cfg.affinity.enabled && head.status == 200) {
+      std::string body;
+      if (read_body_text(r, head, &body)) aff_refresh_filter(u, body);
+    }
   }
   ::close(fd);
   return ok;
@@ -2207,7 +2841,7 @@ struct StreamBodyReader {
 // any framing StreamBodyReader understands, bounded by `cap`. True only
 // when the body ended cleanly per its framing (or EOF for unframed).
 static bool read_body_text(SockReader& up, const ResponseHead& head,
-                           std::string* out, size_t cap = 1 << 20) {
+                           std::string* out, size_t cap) {
   StreamBodyReader br(up, head);
   char buf[8 * 1024];
   while (true) {
@@ -2337,6 +2971,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   // counter need it); the upstream only emits tok comments when asked,
   // so the journal header rides only when resume is on
   bool journal_mode = false;
+  bool completions_path = false;
   if (req.method == "POST" && !req.body.empty()) {
     std::string path = req.target.substr(0, req.target.find('?'));
     while (!path.empty() && path.back() == '/') path.pop_back();
@@ -2344,10 +2979,113 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     if (path.size() >= sizeof kSuffix - 1 &&
         path.compare(path.size() - (sizeof kSuffix - 1), sizeof kSuffix - 1,
                      kSuffix) == 0) {
+      completions_path = true;
       JsonPtr parsed = JsonParser::parse(req.body);
       if (parsed && parsed->is_object()) {
         const Json* st = parsed->get("stream");
         journal_mode = st && st->type == Json::Type::Bool && st->boolean;
+      }
+    }
+  }
+
+  // --- prefix-affinity routing (mirrors server/router.py _affinity_route):
+  // derive the request's affinity key and run the cache-aware decision
+  // ladder BEFORE the connect loop. The chosen replica overrides the
+  // FIRST pick only — the shadow trickle outranks it, and every retry or
+  // fallback path below is plain P2C. Requests a disaggregated model will
+  // serve through the two-hop handoff never take affinity (the ticket
+  // flow already places KV deliberately).
+  std::string aff_key;          // hex; empty = no key for this request
+  const Url* aff_target = nullptr;
+  std::string aff_pull_extra;   // kv_fetch stretch: handoff pull headers
+  if (cfg.affinity.enabled && !hctx && completions_path &&
+      !(journal_mode && cfg.is_disagg(model))) {
+    JsonPtr parsed = JsonParser::parse(req.body);
+    const Json* doc =
+        parsed && parsed->is_object() ? parsed.get() : nullptr;
+    std::string text;
+    if (!aff_canonical_prompt(doc, &text)) {
+      aff_count_fallback(model, "miss");
+    } else {
+      aff_key = aff_key_hex(aff_request_tenant(doc, model), text,
+                            cfg.affinity.prefix_chars);
+      // role-eligible pool mirrors the python router: a model with any
+      // prefill-role replica pins sessions only on both/decode replicas
+      const bool any_prefill = cfg.has_prefill(model);
+      std::vector<const Url*> pool;
+      for (const Url& u : replicas)
+        if (!any_prefill || cfg.role_of(u) != "prefill") pool.push_back(&u);
+      if (pool.empty()) {
+        aff_count_fallback(model, "unhealthy");
+      } else {
+        std::vector<AffReplica> areps;
+        areps.reserve(pool.size());
+        for (const Url* u : pool) {
+          AffReplica r;
+          r.url = "http://" + u->host + ":" + std::to_string(u->port);
+          ReplicaHealth& h = g_health.get(u->host, u->port);
+          r.healthy = h.healthy.load(std::memory_order_relaxed);
+          r.inflight = h.inflight.load(std::memory_order_relaxed);
+          r.breaker_open =
+              g_breakers.get(u->host, u->port).blocked(cfg.breaker_open_s);
+          r.quarantined =
+              cfg.outlier.enabled && outlier_is_quarantined(model, *u);
+          {
+            std::lock_guard<std::mutex> lock(g_aff_mu);
+            auto it = g_aff_filters.find(rep_key(*u));
+            if (it != g_aff_filters.end() && it->second.has) {
+              r.has_filter = true;
+              r.filter = it->second.filter;
+            }
+          }
+          areps.push_back(std::move(r));
+        }
+        std::vector<std::string> digests = aff_cache_get(aff_key);
+        auto picked = aff_decide(aff_key, areps, digests,
+                                 cfg.affinity.overload_factor,
+                                 cfg.affinity.overload_slack);
+        if (picked.first.empty()) {
+          aff_count_fallback(model, picked.second);
+        } else {
+          aff_count_hit(model);
+          for (size_t i = 0; i < pool.size(); ++i)
+            if (areps[i].url == picked.first) { aff_target = pool[i]; break; }
+          // kv_fetch stretch: the chosen replica's own filter denies the
+          // chain while a peer claims it — attach handoff pull headers so
+          // the replica adopts the peer's spilled host-tier pages via
+          // /internal/kv/fetch instead of re-prefilling
+          if (cfg.affinity.kv_fetch && !digests.empty() && aff_target) {
+            const AffReplica* chosen = nullptr;
+            for (const AffReplica& r : areps)
+              if (r.url == picked.first) { chosen = &r; break; }
+            if (chosen &&
+                aff_filter_claim(chosen->has_filter ? &chosen->filter
+                                                    : nullptr,
+                                 digests) == 0) {
+              std::string pull;
+              int best_claim = 0;
+              for (const AffReplica& r : areps) {
+                if (r.url == picked.first) continue;
+                int c = aff_filter_claim(r.has_filter ? &r.filter : nullptr,
+                                         digests);
+                if (c > best_claim) { pull = r.url; best_claim = c; }
+              }
+              if (!pull.empty()) {
+                std::string hexes;
+                for (const std::string& d : digests) {
+                  if (!hexes.empty()) hexes += ",";
+                  hexes += to_hex(d);
+                }
+                std::ostringstream px;
+                px << "X-LLMK-Handoff-Source: " << pull << "\r\n"
+                   << "X-LLMK-Handoff-Digests: " << hexes << "\r\n"
+                   << "X-LLMK-Handoff-Tenant: "
+                   << qos_tenant_of(doc, model) << "\r\n";
+                aff_pull_extra = px.str();
+              }
+            }
+          }
+        }
       }
     }
   }
@@ -2590,8 +3328,19 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
   if (!got_head)
   for (int attempt = 0; attempt < max_attempts; ++attempt) {
     if (budget_ms >= 0 && remaining_ms() <= 0) return deadline_response();
-    target = pick_replica(cfg, replicas, tried, role_mode, &model,
-                          shadow && attempt == 0);
+    // affinity overrides the FIRST pick only; the tried.empty() guard keeps
+    // the breaker-race `--attempt; continue` path below from re-picking the
+    // same pinned replica forever
+    target = nullptr;
+    if (aff_target && attempt == 0 && tried.empty() && !shadow &&
+        g_health.get(aff_target->host, aff_target->port)
+            .healthy.load(std::memory_order_relaxed) &&
+        !g_breakers.get(aff_target->host, aff_target->port)
+             .blocked(cfg.breaker_open_s))
+      target = aff_target;
+    if (!target)
+      target = pick_replica(cfg, replicas, tried, role_mode, &model,
+                            shadow && attempt == 0);
     if (!target) break;
     Breaker& breaker = g_breakers.get(target->host, target->port);
     double retry_after_s = 0.0;
@@ -2636,7 +3385,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
     attempted = true;
     health = &g_health.get(target->host, target->port);
     health->inflight.fetch_add(1, std::memory_order_relaxed);
-    const std::string head_bytes = build_head(*target, std::string());
+    const std::string head_bytes = build_head(
+        *target, (target == aff_target && attempt == 0) ? aff_pull_extra
+                                                        : std::string());
     bool pooled = false;
     up_fd = g_upstream_pool.acquire(target->host, target->port);
     if (up_fd >= 0) {
@@ -2790,6 +3541,13 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                  502, ms_since(t0), 0.0, ms_since(t0));
     return req.keep_alive;
   }
+
+  // learn the request's canonical digest chain from the serving replica's
+  // response header — next request with the same affinity key consults it
+  // against the advertised filters
+  if (!aff_key.empty() && head.status == 200)
+    if (const std::string* cd = head.headers.get("x-llmk-cache-digests"))
+      aff_learn(cfg.affinity, aff_key, *cd);
 
   // connect_ms: arrival -> upstream socket established (incl. failover
   // attempts); head_ms: arrival -> response head received (the upstream's
@@ -3520,6 +4278,62 @@ static void handle_connection(const Config& cfg, int client_fd,
         << "llm_retry_budget_exhausted_total "
         << g_retry_budget_exhausted_total.load(std::memory_order_relaxed)
         << "\n";
+      // prefix-affinity layer (same family names + HELP as
+      // server/metrics.py router_metrics(); series pre-seeded per model
+      // when the layer is configured, matching the python router)
+      m << "# HELP llm_affinity_hits_total Requests the prefix-affinity "
+           "layer placed on a cache-bearing replica: the rendezvous-pinned "
+           "one, or a peer whose advertised digest filter claimed the "
+           "request's prefix chain\n"
+        << "# TYPE llm_affinity_hits_total counter\n";
+      if (cfg.affinity.enabled) {
+        std::lock_guard<std::mutex> lock(g_aff_metrics_mu);
+        for (const auto& kv : cfg.models) {
+          long n = 0;
+          auto it = g_aff_hits_by_model.find(kv.first);
+          if (it != g_aff_hits_by_model.end()) n = it->second;
+          m << "llm_affinity_hits_total{model=\"" << prom_escape(kv.first)
+            << "\"} " << n << "\n";
+        }
+      }
+      m << "# HELP llm_affinity_fallback_total Affinity-keyed requests "
+           "that fell back to plain P2C, by reason: unhealthy = pinned "
+           "replica down/breaker-open, quarantined = pinned replica "
+           "gray-ejected, overloaded = pinned replica's inflight beyond "
+           "the brownout guard, miss = request had no affinity key (no "
+           "prompt prefix)\n"
+        << "# TYPE llm_affinity_fallback_total counter\n";
+      if (cfg.affinity.enabled) {
+        std::lock_guard<std::mutex> lock(g_aff_metrics_mu);
+        for (const auto& kv : cfg.models)
+          for (const char* reason :
+               {"unhealthy", "quarantined", "overloaded", "miss"}) {
+            long n = 0;
+            auto it = g_aff_fallback_by_model_reason.find(
+                {kv.first, reason});
+            if (it != g_aff_fallback_by_model_reason.end()) n = it->second;
+            m << "llm_affinity_fallback_total{model=\""
+              << prom_escape(kv.first) << "\",reason=\"" << reason
+              << "\"} " << n << "\n";
+          }
+      }
+      m << "# HELP llm_prefix_filter_age_seconds Seconds since the "
+           "replica's digest-membership filter was last refreshed from "
+           "its /ready advertisement (stale filters degrade cache-aware "
+           "placement to pure rendezvous)\n"
+        << "# TYPE llm_prefix_filter_age_seconds gauge\n";
+      if (cfg.affinity.enabled) {
+        std::lock_guard<std::mutex> lock(g_aff_mu);
+        for (const auto& kv : cfg.models)
+          for (const Url& u : kv.second) {
+            auto it = g_aff_filters.find(rep_key(u));
+            if (it == g_aff_filters.end()) continue;
+            m << "llm_prefix_filter_age_seconds{model=\""
+              << prom_escape(kv.first) << "\",replica=\"http://" << u.host
+              << ":" << u.port << "\"} "
+              << std::max(0.0, mono_s() - it->second.at) << "\n";
+          }
+      }
       keep = send_all(client_fd,
                       simple_response(200, "OK",
                                       "text/plain; version=0.0.4", m.str(),
@@ -3691,6 +4505,36 @@ static void parse_budget_config(const Json* b, BudgetCfg& out) {
     out.min_per_s = v->number;
   if (const Json* v = b->get("burst"); v && v->type == Json::Type::Number)
     out.burst = v->number;
+}
+
+// "prefix_affinity" block -> AffinityCfg (mirrors
+// server/affinity.AffinityConfig: a present non-empty block enables the
+// layer, explicit `enabled` bool wins, junk-typed fields keep defaults)
+static void parse_affinity_config(const Json* a, AffinityCfg& out) {
+  if (!a || !a->is_object()) return;
+  out.enabled = !a->obj.empty();
+  if (const Json* v = a->get("enabled"); v && v->type == Json::Type::Bool)
+    out.enabled = v->boolean;
+  auto num_field = [&](const char* key, double& dst) {
+    if (const Json* v = a->get(key); v && v->type == Json::Type::Number)
+      dst = v->number;
+  };
+  auto int_field = [&](const char* key, int& dst) {
+    if (const Json* v = a->get(key); v && v->type == Json::Type::Number)
+      dst = static_cast<int>(v->number);
+  };
+  int_field("prefix_chars", out.prefix_chars);
+  int_field("filter_bits", out.filter_bits);
+  int_field("filter_hashes", out.filter_hashes);
+  out.filter_hashes = std::min(4, std::max(1, out.filter_hashes));
+  num_field("overload_factor", out.overload_factor);
+  num_field("overload_slack", out.overload_slack);
+  int_field("key_cache", out.key_cache);
+  out.key_cache = std::max(1, out.key_cache);
+  int_field("max_digests", out.max_digests);
+  out.max_digests = std::max(1, out.max_digests);
+  if (const Json* v = a->get("kv_fetch"); v && v->type == Json::Type::Bool)
+    out.kv_fetch = v->boolean;
 }
 
 static void parse_qos_config(const Json* q, QosConfig& out) {
@@ -4044,6 +4888,258 @@ static int outlier_selftest(const std::string& file) {
   return failures ? 1 : 0;
 }
 
+// --affinity-selftest: drive the shared byte-compat vectors
+// (tests/data/affinity_vectors.json) through the C++ affinity layer — the
+// same file tests/test_affinity.py drives through server/affinity.py.
+// Together they hold the two routers byte-compatible.
+static int affinity_selftest(const std::string& file) {
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "affinity-selftest: cannot open %s\n", file.c_str());
+    return 1;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  JsonPtr root = JsonParser::parse(ss.str());
+  if (!root || !root->is_object()) {
+    fprintf(stderr, "affinity-selftest: malformed vectors file\n");
+    return 1;
+  }
+  int checks = 0, failures = 0;
+  auto fail = [&](const std::string& what) {
+    fprintf(stderr, "affinity-selftest: FAIL %s\n", what.c_str());
+    ++failures;
+  };
+  auto num = [](const Json* o, const char* k, double d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Number ? v->number : d;
+  };
+  auto str = [](const Json* o, const char* k,
+                const std::string& d) -> std::string {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->is_string() ? v->str : d;
+  };
+  auto flag = [](const Json* o, const char* k, bool d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Bool ? v->boolean : d;
+  };
+  // hex digest list -> raw bytes; junk entries are the vector's bug, not
+  // a tolerated input, so count them as failures
+  auto raw_digests = [&](const Json* o,
+                         const char* k) -> std::vector<std::string> {
+    std::vector<std::string> out;
+    const Json* v = o ? o->get(k) : nullptr;
+    if (v && v->type == Json::Type::Array)
+      for (const auto& d : v->arr) {
+        std::string raw;
+        if (d->is_string() && hex_to_raw(d->str, &raw))
+          out.push_back(raw);
+        else
+          fail(std::string(k) + " vector holds a non-hex digest");
+      }
+    return out;
+  };
+
+  if (const Json* sec = root->get("key");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string got = aff_key_hex(
+          str(it.get(), "tenant", ""), str(it.get(), "prompt", ""),
+          static_cast<int>(num(it.get(), "prefix_chars", 256.0)));
+      if (got != str(it.get(), "expect", ""))
+        fail("key #" + std::to_string(i) + " = " + got);
+    }
+  }
+
+  if (const Json* sec = root->get("request_key");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      const Json* body = it->get("body");
+      const Json* expect = it->get("expect");
+      bool want_key = expect && expect->is_string();
+      std::string text;
+      bool has = aff_canonical_prompt(
+          body && body->is_object() ? body : nullptr, &text);
+      std::string tag = "request_key #" + std::to_string(i);
+      if (has != want_key) {
+        fail(tag + (has ? " keyed a no-key body" : " missed a keyed body"));
+        continue;
+      }
+      if (!has) continue;
+      std::string model = str(it.get(), "model", "");
+      std::string got = aff_key_hex(
+          aff_request_tenant(body, model), text,
+          static_cast<int>(num(it.get(), "prefix_chars", 256.0)));
+      if (got != expect->str) fail(tag + " = " + got);
+    }
+  }
+
+  if (const Json* sec = root->get("rendezvous");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string tag = "rendezvous #" + std::to_string(i);
+      std::string key_raw;
+      if (!hex_to_raw(str(it.get(), "key", ""), &key_raw)) {
+        fail(tag + " non-hex key");
+        continue;
+      }
+      std::vector<std::string> urls;
+      if (const Json* u = it->get("urls"); u && u->type == Json::Type::Array)
+        for (const auto& v : u->arr)
+          if (v->is_string()) urls.push_back(v->str);
+      std::string got = aff_rendezvous_pick(key_raw, urls);
+      if (got != str(it.get(), "expect", "")) fail(tag + " pick=" + got);
+      // per-url scores: uint64 exceeds 2^53, but the JSON parser and this
+      // cast round the same true integer to the same double
+      if (const Json* sc = it->get("scores");
+          sc && sc->type == Json::Type::Array && sc->arr.size() == urls.size())
+        for (size_t j = 0; j < urls.size(); ++j) {
+          ++checks;
+          uint64_t score = aff_rendezvous_score(key_raw, urls[j]);
+          if (sc->arr[j]->type != Json::Type::Number ||
+              static_cast<double>(score) != sc->arr[j]->number)
+            fail(tag + " score[" + std::to_string(j) +
+                 "]=" + std::to_string(score));
+        }
+    }
+  }
+
+  if (const Json* sec = root->get("filter");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++i;
+      std::string tag = "filter #" + std::to_string(i);
+      AffBloom f =
+          aff_bloom_make(static_cast<int>(num(it.get(), "bits", 8192.0)),
+                         static_cast<int>(num(it.get(), "hashes", 4.0)));
+      for (const std::string& d : raw_digests(it.get(), "add")) f.add(d);
+      ++checks;
+      if (b64_encode(f.data) != str(it.get(), "expect_data", ""))
+        fail(tag + " serialized bytes diverge");
+      if (const Json* cs = it->get("contains");
+          cs && cs->type == Json::Type::Array) {
+        int j = -1;
+        for (const auto& c : cs->arr) {
+          ++checks;
+          ++j;
+          std::string raw;
+          if (!hex_to_raw(str(c.get(), "digest", ""), &raw)) {
+            fail(tag + " contains #" + std::to_string(j) + " non-hex");
+            continue;
+          }
+          if (f.contains(raw) != flag(c.get(), "expect", false))
+            fail(tag + " contains #" + std::to_string(j));
+        }
+      }
+      if (const Json* cl = it->get("claims");
+          cl && cl->type == Json::Type::Array) {
+        int j = -1;
+        for (const auto& c : cl->arr) {
+          ++checks;
+          ++j;
+          int got = aff_filter_claim(&f, raw_digests(c.get(), "digests"));
+          if (got != static_cast<int>(num(c.get(), "expect", -1.0)))
+            fail(tag + " claim #" + std::to_string(j) + "=" +
+                 std::to_string(got));
+        }
+      }
+    }
+  }
+
+  if (const Json* sec = root->get("filter_parse_reject");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      AffBloom f;
+      if (aff_bloom_parse(it->get("doc"), &f))
+        fail("filter_parse_reject #" + std::to_string(i) + " accepted");
+    }
+  }
+
+  if (const Json* sec = root->get("overload");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::vector<double> pool;
+      if (const Json* p = it->get("pool"); p && p->type == Json::Type::Array)
+        for (const auto& v : p->arr)
+          if (v->type == Json::Type::Number) pool.push_back(v->number);
+      bool got = aff_overloaded(num(it.get(), "inflight", 0.0), pool,
+                                num(it.get(), "factor", 2.0),
+                                num(it.get(), "slack", 4.0));
+      if (got != flag(it.get(), "expect", !got))
+        fail("overload #" + std::to_string(i));
+    }
+  }
+
+  if (const Json* sec = root->get("digest_header");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::vector<std::string> got = aff_parse_digest_header(
+          str(it.get(), "value", ""),
+          static_cast<int>(num(it.get(), "max_digests", 16.0)));
+      std::vector<std::string> want = raw_digests(it.get(), "expect");
+      if (got != want)
+        fail("digest_header #" + std::to_string(i) + " run=" +
+             std::to_string(got.size()));
+    }
+  }
+
+  if (const Json* sec = root->get("decide");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string tag = "decide #" + std::to_string(i);
+      std::vector<AffReplica> reps;
+      if (const Json* rs = it->get("replicas");
+          rs && rs->type == Json::Type::Array)
+        for (const auto& rd : rs->arr) {
+          AffReplica r;
+          r.url = str(rd.get(), "url", "");
+          r.healthy = flag(rd.get(), "healthy", true);
+          r.breaker_open = flag(rd.get(), "breaker_open", false);
+          r.quarantined = flag(rd.get(), "quarantined", false);
+          r.inflight = num(rd.get(), "inflight", 0.0);
+          if (const Json* fd = rd->get("filter"))
+            r.has_filter = aff_bloom_parse(fd, &r.filter);
+          reps.push_back(std::move(r));
+        }
+      auto got = aff_decide(str(it.get(), "key", ""), reps,
+                            raw_digests(it.get(), "digests"),
+                            num(it.get(), "factor", 2.0),
+                            num(it.get(), "slack", 4.0));
+      const Json* expect = it->get("expect");
+      const Json* eu = expect ? expect->get("url") : nullptr;
+      std::string want_url = eu && eu->is_string() ? eu->str : "";
+      if (got.first != want_url) fail(tag + " url=" + got.first);
+      if (got.second != str(expect, "outcome", ""))
+        fail(tag + " outcome=" + got.second);
+    }
+  }
+
+  printf("affinity-selftest: %d checks, %d failures\n", checks, failures);
+  return failures ? 1 : 0;
+}
+
 static bool load_config_json(const std::string& file, Config& cfg) {
   std::ifstream in(file);
   if (!in) {
@@ -4168,6 +5264,7 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   parse_qos_config(root->get("qos"), cfg.qos);
   parse_outlier_config(root->get("outlier_ejection"), cfg.outlier);
   parse_budget_config(root->get("retry_budget"), cfg.retry_budget);
+  parse_affinity_config(root->get("prefix_affinity"), cfg.affinity);
   return true;
 }
 
@@ -4275,7 +5372,7 @@ int main(int argc, char** argv) {
       1, static_cast<int>(env_double("LLMK_HANDOFF_RETRIES",
                                      cfg.handoff_retries)));
   std::string config_file, models_inline, adapters_inline, qos_selftest_file,
-      outlier_selftest_file;
+      outlier_selftest_file, affinity_selftest_file;
   // gray-failure knobs share the python router's env vars (JSON blocks in
   // LLMK_OUTLIER / LLMK_RETRY_BUDGET); config-file keys override
   if (const char* oj = getenv("LLMK_OUTLIER"); oj && *oj)
@@ -4284,6 +5381,9 @@ int main(int argc, char** argv) {
   if (const char* bj = getenv("LLMK_RETRY_BUDGET"); bj && *bj)
     if (JsonPtr doc = JsonParser::parse(bj); doc && doc->is_object())
       parse_budget_config(doc.get(), cfg.retry_budget);
+  if (const char* aj = getenv("LLMK_AFFINITY"); aj && *aj)
+    if (JsonPtr doc = JsonParser::parse(aj); doc && doc->is_object())
+      parse_affinity_config(doc.get(), cfg.affinity);
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* {
@@ -4375,6 +5475,10 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       outlier_selftest_file = v;
+    } else if (a == "--affinity-selftest") {
+      const char* v = next();
+      if (!v) return 2;
+      affinity_selftest_file = v;
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
@@ -4386,7 +5490,8 @@ int main(int argc, char** argv) {
               "[--probe-interval S] [--no-stream-resume] "
               "[--resume-attempts N] [--hedge-ms MS] "
               "[--qos-selftest VECTORS_JSON] "
-              "[--outlier-selftest VECTORS_JSON]\n");
+              "[--outlier-selftest VECTORS_JSON] "
+              "[--affinity-selftest VECTORS_JSON]\n");
       return 2;
     }
   }
@@ -4397,6 +5502,8 @@ int main(int argc, char** argv) {
   if (!qos_selftest_file.empty()) return qos_selftest(qos_selftest_file);
   if (!outlier_selftest_file.empty())
     return outlier_selftest(outlier_selftest_file);
+  if (!affinity_selftest_file.empty())
+    return affinity_selftest(affinity_selftest_file);
 
   if (!config_file.empty()) {
     if (!load_config_json(config_file, cfg)) return 1;

@@ -49,7 +49,8 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|cache_misses|wasted_chip_fraction"
                            r"|disagg_decode_idle_frac|handoff_reprefill"
                            r"|handoff_fallback|detection_s$|ttft_ratio"
-                           r"|retry_volume|budget_exhausted)")
+                           r"|retry_volume|budget_exhausted"
+                           r"|affinity_fallback|repin_fallback)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"

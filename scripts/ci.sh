@@ -274,6 +274,40 @@ sys.exit(0 if doc.get("chaos_quarantined_ok") is True
     fails=$((fails + 1))
   fi
 
+  note "affinity smoke (cache-aware routing vs blind P2C)"
+  # the smoke's affinity phase runs the same shared-system-prompt
+  # session workload against a 3-replica stack twice: blind P2C, then
+  # with prefix_affinity armed. Gates: affinity-routed TTFT p50 below
+  # blind, the session reuse hit ratio above 0.5, total prefill chip-ms
+  # below blind (the cache hits the router placed are real chip-time
+  # saved, read from the per-pod ledgers), zero dropped streams in every
+  # wave, and the quarantine-integration wave: a degraded-but-probe-
+  # green pinned replica must be quarantined AND its keys re-pinned to
+  # peers with zero drops
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+p50 = doc.get("affinity_ttft_p50_ms")
+blind_p50 = doc.get("affinity_blind_ttft_p50_ms")
+chip = doc.get("affinity_prefill_chip_ms")
+blind_chip = doc.get("affinity_blind_prefill_chip_ms")
+ratio = doc.get("affinity_hit_ratio")
+sys.exit(0 if None not in (p50, blind_p50, chip, blind_chip, ratio)
+         and p50 < blind_p50
+         and chip < blind_chip
+         and ratio > 0.5
+         and doc.get("affinity_dropped_streams") == 0
+         and doc.get("affinity_quarantined_ok") is True
+         and doc.get("affinity_repin_dropped_streams") == 0
+         and doc.get("affinity_repin_ok") is True else 1)'; then
+    echo "ci: affinity smoke OK (TTFT/chip-ms below blind P2C, re-pin clean)"
+  else
+    echo "ci: affinity smoke FAILED (TTFT or prefill chip-ms not below"
+    echo "    blind P2C, hit ratio <= 0.5, dropped streams, or the"
+    echo "    quarantine re-pin wave broke)"
+    fails=$((fails + 1))
+  fi
+
   note "goodput ledger smoke (chip-time conservation within 5%)"
   # the engine-phase ledger must conserve wall time: attributed (prefill
   # + decode) + wasted (spec tails, early exits) + idle device gaps

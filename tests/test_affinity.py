@@ -1,0 +1,210 @@
+"""Python gate for the shared prefix-affinity / cache-aware routing vectors.
+
+tests/data/affinity_vectors.json pins the affinity-key derivation,
+rendezvous pinning, bloom-filter serialization, and pick-decision
+semantics both routers must agree on: this module drives the vectors
+through the executable spec (server/affinity.py), and the native router
+replays the same file via `llkt-router --affinity-selftest`
+(tests/test_native_router.py). A change that breaks one side must update
+the vectors AND the other implementation.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from llms_on_kubernetes_tpu.server import affinity
+
+VECTORS = json.loads(
+    (pathlib.Path(__file__).parent / "data" /
+     "affinity_vectors.json").read_text())
+
+
+def _ids(section):
+    return [c.get("_comment", f"case{i}")[:60]
+            for i, c in enumerate(VECTORS[section])]
+
+
+# ---------------------------------------------------------------------------
+# Key derivation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["key"], ids=_ids("key"))
+def test_key_vectors(case):
+    got = affinity.affinity_key(case["tenant"], case["prompt"],
+                                case["prefix_chars"])
+    assert got == case["expect"]
+
+
+@pytest.mark.parametrize("case", VECTORS["request_key"],
+                         ids=_ids("request_key"))
+def test_request_key_vectors(case):
+    text = affinity.canonical_prompt(case["body"])
+    if case["expect"] is None:
+        assert text is None
+        return
+    tenant = affinity.request_tenant(case["body"], case["model"])
+    got = affinity.affinity_key(tenant, text, case["prefix_chars"])
+    assert got == case["expect"]
+
+
+def test_crlf_and_tail_invariance():
+    a = affinity.affinity_key("t", "sys\r\nprompt tail A", 10)
+    b = affinity.affinity_key("t", "sys\nprompt tail B", 10)
+    assert a == b  # same normalized 10-cp prefix → same key
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous pinning
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["rendezvous"], ids=_ids("rendezvous"))
+def test_rendezvous_vectors(case):
+    assert affinity.rendezvous_pick(case["key"], case["urls"]) \
+        == case["expect"]
+    got_scores = [affinity.rendezvous_score(case["key"], u)
+                  for u in case["urls"]]
+    assert got_scores == case["scores"]
+
+
+def test_rendezvous_stability_under_pool_growth():
+    # adding a replica only moves the keys that rendezvous onto it;
+    # removing the pinned replica re-pins, restoring it pins back
+    urls = [f"http://10.9.0.{i}:8080" for i in range(1, 5)]
+    keys = [affinity.affinity_key("t", f"prompt {i}", 64) for i in range(64)]
+    pins = {k: affinity.rendezvous_pick(k, urls) for k in keys}
+    grown = urls + ["http://10.9.0.9:8080"]
+    moved = sum(1 for k in keys
+                if affinity.rendezvous_pick(k, grown) != pins[k])
+    # every moved key must have moved TO the new replica, none shuffled
+    for k in keys:
+        got = affinity.rendezvous_pick(k, grown)
+        assert got == pins[k] or got == "http://10.9.0.9:8080"
+    assert 0 < moved < len(keys)
+
+
+# ---------------------------------------------------------------------------
+# Bloom filter
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["filter"], ids=_ids("filter"))
+def test_filter_vectors(case):
+    f = affinity.BloomFilter(case["bits"], case["hashes"])
+    for h in case["add"]:
+        f.add(bytes.fromhex(h))
+    ser = f.serialize()
+    assert ser["data"] == case["expect_data"]
+    assert ser["bits"] == case["bits"] and ser["hashes"] == case["hashes"]
+    # round-trip: parse(serialize) answers identically
+    parsed = affinity.BloomFilter.parse(ser)
+    assert parsed is not None
+    for check in case["contains"]:
+        d = bytes.fromhex(check["digest"])
+        assert f.contains(d) is check["expect"], check["digest"]
+        assert parsed.contains(d) is check["expect"], check["digest"]
+    for claim in case["claims"]:
+        digests = [bytes.fromhex(h) for h in claim["digests"]]
+        assert affinity.filter_claim(f, digests) == claim["expect"]
+
+
+@pytest.mark.parametrize("case", VECTORS["filter_parse_reject"],
+                         ids=_ids("filter_parse_reject"))
+def test_filter_parse_rejects(case):
+    assert affinity.BloomFilter.parse(case["doc"]) is None
+
+
+def test_filter_claim_no_filter_is_zero():
+    assert affinity.filter_claim(None, [b"\x00" * 32]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Overload + digest-header parse
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["overload"], ids=_ids("overload"))
+def test_overload_vectors(case):
+    got = affinity.overloaded(case["inflight"], case["pool"],
+                              case["factor"], case["slack"])
+    assert got is case["expect"]
+
+
+@pytest.mark.parametrize("case", VECTORS["digest_header"],
+                         ids=_ids("digest_header"))
+def test_digest_header_vectors(case):
+    got = affinity.parse_digest_header(case["value"], case["max_digests"])
+    assert [d.hex() for d in got] == case["expect"]
+
+
+# ---------------------------------------------------------------------------
+# Decision ladder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", VECTORS["decide"], ids=_ids("decide"))
+def test_decide_vectors(case):
+    replicas = []
+    for r in case["replicas"]:
+        rr = dict(r)
+        if "filter" in rr:
+            rr["filter"] = affinity.BloomFilter.parse(rr["filter"])
+            assert rr["filter"] is not None
+        replicas.append(rr)
+    digests = [bytes.fromhex(h) for h in case["digests"]]
+    url, outcome = affinity.decide(case["key"], replicas, digests,
+                                   case["factor"], case["slack"])
+    assert url == case["expect"]["url"]
+    assert outcome == case["expect"]["outcome"]
+
+
+# ---------------------------------------------------------------------------
+# Spec details the vectors can't express directly
+# ---------------------------------------------------------------------------
+
+
+def test_config_defaults_and_enablement():
+    cfg = affinity.AffinityConfig(None)
+    assert not cfg.enabled
+    assert cfg.prefix_chars == 256
+    assert cfg.filter_bits == 8192
+    assert cfg.filter_hashes == 4
+    assert cfg.overload_factor == pytest.approx(2.0)
+    assert cfg.overload_slack == pytest.approx(4.0)
+    assert not cfg.kv_fetch
+    assert affinity.AffinityConfig({"prefix_chars": 64}).enabled
+    # explicit enabled:false beats block presence (staged rollout knob)
+    assert not affinity.AffinityConfig(
+        {"enabled": False, "prefix_chars": 64}).enabled
+    # junk values fall back instead of raising (config comes off the wire)
+    assert affinity.AffinityConfig({"prefix_chars": "x"}).prefix_chars == 256
+    # filter hashes clamp to the 4 words a sha256 digest provides
+    assert affinity.AffinityConfig({"filter_hashes": 9}).filter_hashes == 4
+
+
+def test_key_digest_cache_lru():
+    cache = affinity.KeyDigestCache(capacity=2)
+    cache.put("a", [b"\x01" * 32])
+    cache.put("b", [b"\x02" * 32])
+    assert cache.get("a") == [b"\x01" * 32]  # touch: a is now MRU
+    cache.put("c", [b"\x03" * 32])           # evicts b
+    assert cache.get("b") == []
+    assert cache.get("a") and cache.get("c")
+    cache.put("c", [])                        # empty chain never stored
+    assert cache.get("c") == [b"\x03" * 32]
+    assert len(cache) == 2
+
+
+def test_decide_never_mutates_request_shape():
+    # the ladder names a replica or falls back — it must never invent a
+    # URL outside the pool
+    key = affinity.affinity_key("t", "p", 8)
+    reps = [{"url": u, "healthy": True, "breaker_open": False,
+             "quarantined": False, "inflight": 0}
+            for u in ("http://a:1", "http://b:1")]
+    url, outcome = affinity.decide(key, reps, [], 2.0, 4.0)
+    assert url in ("http://a:1", "http://b:1")
+    assert outcome == affinity.OUTCOME_AFFINITY

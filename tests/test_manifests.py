@@ -821,6 +821,100 @@ def test_values_schema_gray_failure_parity():
             jsonschema.validate(bad, schema)
 
 
+def test_router_config_prefix_affinity_block():
+    """ISSUE 18: prefixAffinity flows verbatim into router.json (both
+    routers parse identical wire keys, pinned by
+    tests/data/affinity_vectors.json), validates at spec load, arms the
+    python Router, and rolls the router pods via the config hash."""
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    cfg = router_config(load_spec(BASE_YAML))
+    assert "prefix_affinity" not in cfg  # absent block = no key at all
+
+    aff_yaml = BASE_YAML + """
+prefixAffinity:
+  prefix_chars: 512
+  filter_bits: 16384
+  filter_hashes: 3
+  overload_factor: 1.5
+  overload_slack: 4
+  key_cache: 2048
+  max_digests: 16
+  kv_fetch: true
+"""
+    spec = load_spec(aff_yaml)
+    cfg2 = router_config(spec)
+    # passed verbatim — field-level parity with the Go template's toJson
+    assert cfg2["prefix_affinity"] == {
+        "prefix_chars": 512, "filter_bits": 16384, "filter_hashes": 3,
+        "overload_factor": 1.5, "overload_slack": 4, "key_cache": 2048,
+        "max_digests": 16, "kv_fetch": True,
+    }
+    assert config_hash(spec) != config_hash(load_spec(BASE_YAML))
+    # the python Router accepts the rendered block and arms the layer
+    r = Router(cfg2["backends"], cfg2["default_model"], cfg2["strict"],
+               prefix_affinity=cfg2["prefix_affinity"])
+    assert r.affinity_cfg.enabled
+    assert r.affinity_cfg.prefix_chars == 512
+    assert r.affinity_cfg.kv_fetch
+
+    # an EMPTY block disables cleanly (matches both routers' truthiness)
+    cfg3 = router_config(load_spec(BASE_YAML + "\nprefixAffinity: {}\n"))
+    assert "prefix_affinity" not in cfg3
+
+    # explicit enabled:false renders but stays dormant in the Router
+    cfg4 = router_config(load_spec(
+        BASE_YAML + "\nprefixAffinity: {enabled: false, filter_bits: 64}\n"))
+    r4 = Router(cfg4["backends"], cfg4["default_model"], cfg4["strict"],
+                prefix_affinity=cfg4["prefix_affinity"])
+    assert not r4.affinity_cfg.enabled
+
+    # unknown keys and invalid values are rejected at spec load
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nprefixAffinity: {prefixChars: 128}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nprefixAffinity: {filter_hashes: 9}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nprefixAffinity: {prefix_chars: -1}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nprefixAffinity: {kv_fetch: 1}\n")
+    with pytest.raises(SpecError):
+        load_spec(BASE_YAML + "\nprefixAffinity: {enabled: yes_please}\n")
+
+
+def test_values_schema_prefix_affinity_parity():
+    """Both charts schematize prefixAffinity with the wire key names
+    (schema drift between the charts and the renderer is the failure
+    mode this pins), ship a demo block, and reject unknown knobs."""
+    import copy
+    import json
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    from llms_on_kubernetes_tpu.deploy.spec import _AFFINITY_KEYS
+    root = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+    for chart in ("tpu-models", "local-models"):
+        cdir = root / chart / "helm-chart"
+        schema = json.loads((cdir / "values.schema.json").read_text())
+        aprops = schema["properties"]["prefixAffinity"]["properties"]
+        # schema keys == the spec's accepted wire keys, verbatim
+        assert set(aprops) == set(_AFFINITY_KEYS), chart
+
+        values = yaml.safe_load((cdir / "values.yaml").read_text())
+        assert values.get("prefixAffinity"), (
+            f"{chart}: shipped values.yaml should demo cache-aware "
+            f"routing")
+        jsonschema.validate(values, schema)
+        bad = copy.deepcopy(values)
+        bad["prefixAffinity"]["prefixChars"] = 128  # unknown knob
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+        bad = copy.deepcopy(values)
+        bad["prefixAffinity"]["filter_hashes"] = 9  # out of [1, 4]
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+
+
 # ---------------------------------------------------------------------------
 # ISSUE 16: disaggregated prefill/decode roles
 # ---------------------------------------------------------------------------

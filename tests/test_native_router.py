@@ -2028,13 +2028,15 @@ def test_native_outlier_selftest_shared_vectors(binary):
 
 
 def _start_gray_router(binary, tmp_path, urls, outlier=None, budget=None,
-                       extra_args=()):
+                       affinity=None, extra_args=()):
     cfg = tmp_path / "router.json"
     doc = {"backends": {"m": urls}, "default_model": "m"}
     if outlier is not None:
         doc["outlier_ejection"] = outlier
     if budget is not None:
         doc["retry_budget"] = budget
+    if affinity is not None:
+        doc["prefix_affinity"] = affinity
     cfg.write_text(json.dumps(doc))
     port = free_port()
     proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
@@ -2195,3 +2197,166 @@ def test_native_error_outlier_quarantines_dead_replica(binary, tmp_path):
         proc.wait(timeout=5)
         b1.shutdown()
         b2.shutdown()
+
+
+# -- prefix-affinity + cache-aware routing (ISSUE 18): shared-vector
+# parity + live pinning / filter steering
+
+
+def test_native_affinity_selftest_shared_vectors(binary):
+    """tests/data/affinity_vectors.json is the byte-compatibility contract
+    for the affinity layer (key derivation, rendezvous scores, bloom
+    filters, overload guard, digest-header parsing, the decision ladder)
+    between the Python and native routers; the native side validates
+    every expectation in-process via --affinity-selftest (the Python side
+    runs the same file in tests/test_affinity.py)."""
+    out = subprocess.run(
+        [str(binary), "--affinity-selftest",
+         str(REPO / "tests" / "data" / "affinity_vectors.json")],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ", 0 failures" in out.stdout
+    checks = int(out.stdout.split("affinity-selftest:")[1].split("checks")[0])
+    assert checks >= 70
+
+
+def test_native_affinity_pins_and_counts(binary, tmp_path):
+    """With prefix_affinity armed, repeated requests for one (tenant,
+    prompt-prefix) land on ONE rendezvous-pinned replica and count into
+    llm_affinity_hits_total; /debug/replicas reports the layer armed."""
+    b1 = start_backend("pin1")
+    b2 = start_backend("pin2")
+    b3 = start_backend("pin3")
+    urls = [f"http://127.0.0.1:{b.server_address[1]}" for b in (b1, b2, b3)]
+    proc, port = _start_gray_router(
+        binary, tmp_path, urls, affinity={"prefix_chars": 64})
+    try:
+        body = {"model": "m", "prompt": "the shared system prompt, sess 1",
+                "user": "tenant-a"}
+        served = set()
+        for _ in range(6):
+            status, data, _ = _qos_post(port, body)
+            assert status == 200
+            served.add(json.loads(data)["served_by"])
+        assert len(served) == 1
+        text = _get_metrics(port)
+        assert 'llm_affinity_hits_total{model="m"} 6' in text
+        # every fallback series pre-seeded and still zero
+        for reason in ("unhealthy", "quarantined", "overloaded", "miss"):
+            assert (f'llm_affinity_fallback_total{{model="m",'
+                    f'reason="{reason}"}} 0') in text
+        _, doc = _get_json(port, "/debug/replicas")
+        assert doc["prefix_affinity_enabled"] is True
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        b1.shutdown()
+        b2.shutdown()
+        b3.shutdown()
+
+
+def test_native_affinity_dormant_without_config(binary, tmp_path):
+    """No prefix_affinity block: the layer is dormant — debug flag off,
+    HELP lines exposed for dashboards but zero series emitted."""
+    backend = start_backend("b1")
+    url = f"http://127.0.0.1:{backend.server_address[1]}"
+    proc, port = _start_gray_router(binary, tmp_path, [url])
+    try:
+        status, _, _ = _qos_post(port, {"model": "m", "prompt": "hi",
+                                        "user": "t"})
+        assert status == 200
+        _, doc = _get_json(port, "/debug/replicas")
+        assert doc["prefix_affinity_enabled"] is False
+        text = _get_metrics(port)
+        assert "# HELP llm_affinity_hits_total" in text
+        assert "llm_affinity_hits_total{" not in text
+        assert "llm_prefix_filter_age_seconds{" not in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+
+
+def test_native_affinity_filter_steers_to_claimer(binary, tmp_path):
+    """Full cache-aware loop against live probes: the first response's
+    X-LLMK-Cache-Digests header teaches the router the key's chain; the
+    /ready probe cycle adopts each replica's advertised bloom filter; a
+    pinned replica that DENIES the chain while a peer claims it redirects
+    the next request to the claimer (outcome "filter", still a hit)."""
+    from llms_on_kubernetes_tpu.server import affinity as aff
+
+    digests = [bytes([7]) * 32, bytes([9]) * 32]
+    header = ",".join(d.hex() for d in digests)
+
+    class AffBackend(FakeBackend):
+        ready_filter = None
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/ready":
+                doc = {"state": "serving"}
+                if type(self).ready_filter is not None:
+                    doc["prefix_filter"] = type(self).ready_filter
+                payload = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            payload = json.dumps({"served_by": self.name}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("X-LLMK-Cache-Digests", header)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    handlers = {}
+    servers = {}
+    urls = []
+    for name in ("aff-a", "aff-b"):
+        h = type(f"Aff_{name}", (AffBackend,), {"name": name})
+        handlers[name] = h
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), h)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers[name] = srv
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+
+    proc, port = _start_gray_router(
+        binary, tmp_path, urls, affinity={"filter_bits": 256},
+        extra_args=("--probe-interval", "0.1"))
+    try:
+        body = {"model": "m", "prompt": "shared system prompt, session 7",
+                "user": "tenant-7"}
+        status, data, _ = _qos_post(port, body)
+        assert status == 200
+        pinned = json.loads(data)["served_by"]
+        peer = next(n for n in handlers if n != pinned)
+
+        deny = aff.BloomFilter(256, 4)
+        deny.add(bytes([1]) * 32)
+        claim = aff.BloomFilter(256, 4)
+        for d in digests:
+            claim.add(d)
+        handlers[pinned].ready_filter = deny.serialize()
+        handlers[peer].ready_filter = claim.serialize()
+        time.sleep(0.4)  # a couple of probe cycles adopt the filters
+
+        status, data, _ = _qos_post(port, body)
+        assert status == 200
+        assert json.loads(data)["served_by"] == peer
+        text = _get_metrics(port)
+        assert 'llm_affinity_hits_total{model="m"} 2' in text
+        assert "llm_prefix_filter_age_seconds{" in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        for srv in servers.values():
+            srv.shutdown()

@@ -634,6 +634,141 @@ def _drive(binary: Path):
         assert "runtime error:" not in (bx_err or ""), bx_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (bx_err or ""), bx_err[-3000:]
 
+        # prefix-affinity layer under the sanitizer: the affinity key
+        # cache (LRU), the per-replica bloom filters (rewritten by the
+        # probe thread on every /ready refresh) and the hit/fallback
+        # counters are shared state that every request thread reads while
+        # the probe thread swaps filters underneath it; drive concurrent
+        # multi-tenant traffic against fast probes with mid-wave filter
+        # flips so refresh churn and routing decisions genuinely overlap
+        from llms_on_kubernetes_tpu.server import affinity as aff_mod
+        af_digests = [bytes([21]) * 32, bytes([23]) * 32]
+        af_header = ",".join(d.hex() for d in af_digests)
+        af_claim = aff_mod.BloomFilter(512, 4)
+        for d in af_digests:
+            af_claim.add(d)
+        af_deny = aff_mod.BloomFilter(512, 4)
+        af_deny.add(bytes([1]) * 32)
+        af_filters = {}  # name -> serialized filter; flipped mid-wave
+
+        class AffSanBackend(FakeBackend):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/ready":
+                    doc = {"state": "serving"}
+                    filt = af_filters.get(self.name)
+                    if filt is not None:
+                        doc["prefix_filter"] = filt
+                    payload = json.dumps(doc).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                payload = json.dumps({"served_by": self.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("X-LLMK-Cache-Digests", af_header)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        af_dir = tempfile.mkdtemp(prefix="llmk-aff-san-")
+        af_srvs, af_urls = [], []
+        for i in range(3):
+            h = type(f"AffSan{i}", (AffSanBackend,), {"name": f"afsan{i}"})
+            srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), h)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            af_srvs.append(srv)
+            af_urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+        af_cfg = Path(af_dir) / "router.json"
+        af_cfg.write_text(json.dumps({
+            "backends": {"m": af_urls},
+            "default_model": "m",
+            "prefix_affinity": {"prefix_chars": 64, "filter_bits": 512,
+                                "filter_hashes": 4, "key_cache": 64,
+                                "max_digests": 8},
+        }))
+        af_port = free_port()
+        af = subprocess.Popen(
+            [str(binary), "router", "--config", str(af_cfg),
+             "--port", str(af_port), "--quiet",
+             "--probe-interval", "0.05",
+             "--retries", "2", "--retry-backoff-ms", "1",
+             "--breaker-threshold", "1000"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", af_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            stop_flip = threading.Event()
+
+            def af_flip() -> None:
+                # keep the claim/deny split moving between replicas so
+                # filter-redirect decisions overlap with probe refreshes
+                j = 0
+                while not stop_flip.is_set():
+                    for i in range(3):
+                        fl = af_claim if (i + j) % 2 == 0 else af_deny
+                        af_filters[f"afsan{i}"] = fl.serialize()
+                    j += 1
+                    time.sleep(0.05)
+
+            flipper = threading.Thread(target=af_flip, daemon=True)
+            flipper.start()
+
+            def af_wave(i: int) -> None:
+                # 4 distinct session prefixes x 3 tenants: distinct
+                # affinity keys churn the bounded key cache concurrently
+                for t in range(6):
+                    status, body, _ = _qos_post(af_port, {
+                        "model": "m",
+                        "prompt": f"shared system prompt, sess {i % 4}",
+                        "user": f"tenant-{t % 3}"})
+                    assert status == 200, body
+
+            for _ in range(3):
+                with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                    list(pool.map(af_wave, range(8)))
+            stop_flip.set()
+            flipper.join(timeout=5)
+
+            c = http.client.HTTPConnection("127.0.0.1", af_port, timeout=15)
+            c.request("GET", "/metrics")
+            text = c.getresponse().read().decode()
+            c.close()
+            assert 'llm_affinity_hits_total{model="m"}' in text, text[-500:]
+            assert "llm_prefix_filter_age_seconds{" in text, text[-500:]
+        finally:
+            af.terminate()
+            try:
+                _, af_err = af.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                af.kill()
+                _, af_err = af.communicate()
+            for srv in af_srvs:
+                srv.shutdown()
+            shutil.rmtree(af_dir, ignore_errors=True)
+        assert "ERROR: " not in (af_err or ""), af_err[-3000:]
+        assert "runtime error:" not in (af_err or ""), af_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (af_err or ""), af_err[-3000:]
+
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")
     finally:
