@@ -2636,6 +2636,365 @@ def disagg_bench() -> dict:
 # ---------------------------------------------------------------------------
 
 
+def trace_bench() -> dict:
+    """Cross-hop distributed tracing (ISSUE 19): hedged, resume-spliced
+    and prefill/decode-handoff waves through the Python router, each
+    checked to stitch into exactly ONE waterfall tree on
+    ``GET /debug/trace/<id>`` — every replica fragment parented under the
+    router hop that reached it (no orphans), the expected hop count
+    present, and the interval-union of all spans bounded by the stitched
+    e2e. Every hop exports spans to a local OTLP/HTTP collector at
+    ``sample=1.0``.
+
+    Reports, for scripts/ci.sh to gate on the smoke run:
+
+    - ``trace_stitch_ok``       — every wave produced one fully-parented
+      tree with the expected hops and annotations (hard 1)
+    - ``trace_hops_p50``        — median stitched hop count
+    - ``trace_export_failures`` — ``llm_trace_spans_exported_total``
+      {outcome="error"} summed over every hop's /metrics (hard 0)
+    - ``trace_exported_spans`` / ``trace_collector_spans`` — spans the
+      exporters counted vs what the collector actually received
+
+    Runs on the tiny CPU config regardless of BENCH_MODEL: the scenario
+    measures the tracing control loop, not the model.
+    """
+    import http.client
+    import json as _json
+    import re as _re
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from aiohttp import web
+
+    from llms_on_kubernetes_tpu import faults
+    from llms_on_kubernetes_tpu.configs import get_config
+    from llms_on_kubernetes_tpu.engine.engine import EngineConfig
+    from llms_on_kubernetes_tpu.engine.tokenizer import ByteTokenizer
+    from llms_on_kubernetes_tpu.server.openai_api import OpenAIServer
+    from llms_on_kubernetes_tpu.server.router import Router
+
+    model = "debug-tiny"
+    cfg = get_config(model)
+    ecfg = EngineConfig(model=model, dtype="float32", max_decode_slots=8,
+                        page_size=16, pages_per_slot=8, num_pages=8 * 8 + 1,
+                        prefill_buckets=(32,),
+                        kv_host_cache_gb=0.25)  # prefill role needs a tier
+
+    # -- local OTLP/HTTP collector (counts what actually arrives) -------
+    recv_lock = threading.Lock()
+    received = {"posts": 0, "spans": 0}
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            spans = 0
+            try:
+                doc = _json.loads(body)
+                for rs in doc.get("resourceSpans", ()):
+                    for ss in rs.get("scopeSpans", ()):
+                        spans += len(ss.get("spans", ()))
+            except ValueError:
+                pass
+            with recv_lock:
+                received["posts"] += 1
+                received["spans"] += spans
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):  # silence per-request stderr noise
+            pass
+
+    collector = ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=collector.serve_forever, daemon=True).start()
+    otlp_url = f"http://127.0.0.1:{collector.server_address[1]}/v1/traces"
+    tracing_cfg = {"otlpEndpoint": otlp_url, "sample": 1.0,
+                   "tailSlowMs": 60000}
+
+    import dataclasses as _dc
+
+    def start_stack(roles=None, hedge_ms=0.0):
+        """Replicas (+optional roles) behind a tracing router."""
+        ports: dict = {}
+        ready = threading.Event()
+        stop_holder: dict = {}
+        servers: list = []
+
+        def run_stack():
+            import asyncio
+
+            async def main_async():
+                stop = asyncio.Event()
+                stop_holder["stop"] = stop
+                stop_holder["loop"] = asyncio.get_running_loop()
+                runners = []
+                urls, role_map = [], {}
+                for role in (roles or ["both", "both"]):
+                    e = build_engine(_dc.replace(ecfg, role=role), cfg)
+                    srv = OpenAIServer(e, ByteTokenizer(), model)
+                    servers.append(srv)
+                    runner = web.AppRunner(srv.make_app())
+                    await runner.setup()
+                    site = web.TCPSite(runner, "127.0.0.1", 0)
+                    await site.start()
+                    runners.append(runner)
+                    u = f"http://127.0.0.1:{runner.addresses[0][1]}"
+                    urls.append(u)
+                    if role != "both":
+                        role_map[u] = role
+                router = Router({model: urls}, default_model=model,
+                                strict=False, probe_interval_s=0.2,
+                                retry_backoff_s=0.05, hedge_ms=hedge_ms,
+                                roles=role_map or None,
+                                tracing_cfg=tracing_cfg)
+                stop_holder["router"] = router
+                r_runner = web.AppRunner(router.make_app())
+                await r_runner.setup()
+                r_site = web.TCPSite(r_runner, "127.0.0.1", 0)
+                await r_site.start()
+                runners.append(r_runner)
+                ports["router"] = r_runner.addresses[0][1]
+                ports["replicas"] = [int(u.rsplit(":", 1)[1])
+                                     for u in urls]
+                ready.set()
+                await stop.wait()
+                for r in runners:
+                    await r.cleanup()
+
+            asyncio.new_event_loop().run_until_complete(main_async())
+
+        t = threading.Thread(target=run_stack, daemon=True)
+        t.start()
+        if not ready.wait(timeout=120):
+            raise RuntimeError("trace bench: stack failed to start")
+        return {"port": ports["router"], "replicas": ports["replicas"],
+                "servers": servers, "stop": stop_holder, "thread": t}
+
+    def stop_stack(handle):
+        # drain every hop's exporter first so the collector tally and the
+        # exported metrics are settled before the stack disappears
+        for srv in handle["servers"]:
+            exp = getattr(srv, "exporter", None)
+            if exp is not None:
+                exp.flush(5.0)
+        rexp = getattr(handle["stop"].get("router"), "exporter", None)
+        if rexp is not None:
+            rexp.flush(5.0)
+        handle["stop"]["loop"].call_soon_threadsafe(
+            handle["stop"]["stop"].set)
+        handle["thread"].join(timeout=30)
+
+    # -- clients / scrapers ---------------------------------------------
+    def stream_ok(port, rid, gen_tokens=24):
+        """One streaming completion tagged with a caller request id;
+        True iff the client saw a complete spliced stream."""
+        body = _json.dumps({
+            "model": model, "prompt": [1, 2, 3, 4, 5, 6, 7, 8],
+            "max_tokens": gen_tokens, "temperature": 0.0, "stream": True,
+        })
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            conn.request("POST", "/v1/completions", body,
+                         {"Content-Type": "application/json",
+                          "X-LLMK-Request-Id": rid})
+            resp = conn.getresponse()
+            buf = resp.read()
+            return resp.status == 200 and b"data: [DONE]" in buf
+        except OSError:
+            return False
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def fetch_tree(port, rid):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", f"/debug/trace/{rid}")
+            resp = conn.getresponse()
+            return resp.status, _json.loads(resp.read().decode())
+        except (OSError, ValueError):
+            return 0, {}
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def scrape_export(ports_list):
+        """Sum llm_trace_spans_exported_total{outcome=...} over hops."""
+        ok = err = 0
+        for p in ports_list:
+            conn = http.client.HTTPConnection("127.0.0.1", p, timeout=10)
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for m in _re.finditer(
+                    r'llm_trace_spans_exported_total\{outcome="(\w+)"\}'
+                    r' ([0-9.e+-]+)', text):
+                if m.group(1) == "ok":
+                    ok += int(float(m.group(2)))
+                else:
+                    err += int(float(m.group(2)))
+        return ok, err
+
+    def union_ms(spans):
+        """Length of the union of all span intervals, ms (overlap-safe)."""
+        iv = sorted((float(s["start_ms"]),
+                     float(s["start_ms"]) + float(s["duration_ms"]))
+                    for s in spans
+                    if isinstance(s.get("start_ms"), (int, float))
+                    and isinstance(s.get("duration_ms"), (int, float)))
+        total, end = 0.0, None
+        for a, b in iv:
+            if end is None or a > end:
+                total += b - a
+                end = b
+            elif b > end:
+                total += b - end
+                end = b
+        return total
+
+    failures: list = []
+    hops_seen: list = []
+
+    def check_tree(tag, port, rid, min_hops, want_resume=False,
+                   want_handoff=False):
+        """Poll /debug/trace/<rid> until the expected hops land (replica
+        fragments finalize asynchronously), then assert the stitch."""
+        st, doc = 0, {}
+        for _ in range(40):
+            st, doc = fetch_tree(port, rid)
+            if (st == 200 and (doc.get("hops") or 0) >= min_hops
+                    and not doc.get("orphans")
+                    and doc.get("e2e_ms") is not None):
+                break
+            time.sleep(0.25)
+        probs = []
+        if st != 200:
+            probs.append(f"status={st}")
+        else:
+            hops_seen.append(int(doc.get("hops") or 0))
+            if (doc.get("hops") or 0) < min_hops:
+                probs.append(f"hops={doc.get('hops')} < {min_hops}")
+            if doc.get("orphans"):
+                probs.append(f"orphan spans {doc['orphans']}")
+            if len(doc.get("tree") or []) != 1:
+                probs.append(f"{len(doc.get('tree') or [])} roots, want 1")
+            ann = doc.get("annotations") or {}
+            if want_resume and not ann.get("resumes"):
+                probs.append("no resume annotation")
+            if want_handoff and not ann.get("handoff"):
+                probs.append("no handoff annotation")
+            e2e = doc.get("e2e_ms")
+            if e2e is None:
+                probs.append("no e2e (all roots parented?)")
+            else:
+                u = union_ms(doc.get("spans") or ())
+                if u > e2e + 250.0:
+                    probs.append(f"span union {u:.1f}ms > "
+                                 f"e2e {e2e:.1f}ms")
+        if probs:
+            failures.append(f"{tag}({rid}): " + "; ".join(probs))
+        return doc
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("LLMK_OTLP_ENDPOINT", "LLMK_TRACE_SAMPLE", "LLMK_FAULT")}
+    os.environ["LLMK_OTLP_ENDPOINT"] = otlp_url  # replica exporters
+    os.environ["LLMK_TRACE_SAMPLE"] = "1"
+    os.environ.pop("LLMK_FAULT", None)
+    exported_ok = export_err = 0
+    try:
+        # ---- wave 1+2: hedge, then mid-stream kill + resume splice ----
+        stack = start_stack(hedge_ms=1.0)
+        try:
+            hedged = 0
+            for i in range(3):
+                rid = f"trace-bench-hedge-{i}"
+                if not stream_ok(stack["port"], rid):
+                    failures.append(f"hedge({rid}): stream failed")
+                    continue
+                doc = check_tree("hedge", stack["port"], rid, min_hops=2)
+                if (doc.get("annotations") or {}).get("hedge"):
+                    hedged += 1
+            if not hedged:
+                failures.append("hedge: no wave request ever hedged "
+                                "(hedge_ms=1 never fired?)")
+            for i in range(2):
+                rid = f"trace-bench-resume-{i}"
+                faults.reset_claims()
+                os.environ["LLMK_FAULT"] = "kill_mid_stream:6"
+                ok = stream_ok(stack["port"], rid)
+                os.environ.pop("LLMK_FAULT", None)
+                faults.reset_claims()
+                if not ok:
+                    failures.append(f"resume({rid}): client-visible drop")
+                    continue
+                # killed replica + survivor + router = 3 stitched hops
+                check_tree("resume", stack["port"], rid, min_hops=3,
+                           want_resume=True)
+            a_ok, a_err = scrape_export([stack["port"]]
+                                        + stack["replicas"])
+            exported_ok += a_ok
+            export_err += a_err
+        finally:
+            stop_stack(stack)
+
+        # ---- wave 3: disaggregated prefill/decode handoff -------------
+        stack = start_stack(roles=["prefill", "decode"])
+        try:
+            for i in range(2):
+                rid = f"trace-bench-handoff-{i}"
+                if not stream_ok(stack["port"], rid):
+                    failures.append(f"handoff({rid}): stream failed")
+                    continue
+                # router + prefill replica + decode replica = 3 hops
+                check_tree("handoff", stack["port"], rid, min_hops=3,
+                           want_handoff=True)
+            b_ok, b_err = scrape_export([stack["port"]]
+                                        + stack["replicas"])
+            exported_ok += b_ok
+            export_err += b_err
+        finally:
+            stop_stack(stack)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults.reset_claims()
+        collector.shutdown()
+
+    with recv_lock:
+        col_posts, col_spans = received["posts"], received["spans"]
+    if exported_ok and not col_spans:
+        failures.append(f"collector saw 0 spans but exporters counted "
+                        f"{exported_ok} ok")
+
+    hops_seen.sort()
+    out = {
+        "trace_stitch_ok": 0 if failures else 1,
+        "trace_hops_p50": (hops_seen[len(hops_seen) // 2]
+                           if hops_seen else 0),
+        "trace_export_failures": export_err,
+        "trace_exported_spans": exported_ok,
+        "trace_collector_spans": col_spans,
+        "trace_collector_posts": col_posts,
+    }
+    if failures:
+        out["trace_stitch_failures"] = failures[:8]
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
 def make_configs():
     from llms_on_kubernetes_tpu.configs import get_config
     from llms_on_kubernetes_tpu.engine.engine import EngineConfig
@@ -2901,6 +3260,16 @@ def _main() -> int:
         aff = with_retries("affinity", affinity_bench, errors,
                            attempts=1) or {}
 
+    # ISSUE 19 — cross-hop distributed tracing: hedged, resume-spliced
+    # and prefill/decode-handoff waves must each stitch into ONE fully-
+    # parented waterfall on /debug/trace/<id>, with every hop exporting
+    # spans to a local OTLP collector at sample=1.0. ci.sh gates
+    # trace_stitch_ok == 1 and trace_export_failures == 0 on the smoke
+    # run.
+    trc = {}
+    if smoke or os.environ.get("BENCH_TRACE"):
+        trc = with_retries("trace", trace_bench, errors, attempts=1) or {}
+
     value = engine_stats.get("tokens_per_sec", 0.0)
     per_dollar = value / V5E_DOLLARS_PER_H
     baseline_per_dollar = A10G_TOKENS_PER_SEC / A10G_DOLLARS_PER_H
@@ -2920,6 +3289,7 @@ def _main() -> int:
         **disagg,
         **chaos,
         **aff,
+        **trc,
         "batch": ecfg.max_decode_slots,
         "quantization": ecfg.quantization,
         "pace_target_steps": ecfg.pace_target_steps,

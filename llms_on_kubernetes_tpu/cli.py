@@ -218,6 +218,9 @@ def main(argv: list[str] | None = None) -> int:
         # None = let Router fall back to LLMK_OUTLIER / LLMK_RETRY_BUDGET
         # / LLMK_AFFINITY
         outlier_ejection = retry_budget = prefix_affinity = None
+        # None = let Router fall back to LLMK_OTLP_ENDPOINT /
+        # LLMK_TRACE_SAMPLE / LLMK_SLOW_REQUEST_MS
+        tracing_cfg = None
         if args.config:
             with open(args.config) as f:
                 cfg = json.load(f)
@@ -250,6 +253,10 @@ def main(argv: list[str] | None = None) -> int:
                 # prefix-affinity + cache-aware routing, passed verbatim
                 # (non-empty block = enabled)
                 prefix_affinity = cfg["prefix_affinity"]
+            if "tracing" in cfg:
+                # cross-hop tracing: OTLP export + tail sampling, passed
+                # verbatim (non-empty block = exporter enabled)
+                tracing_cfg = cfg["tracing"]
         for spec in args.backend or ():
             name, _, urls = spec.partition("=")
             if not urls:
@@ -274,7 +281,8 @@ def main(argv: list[str] | None = None) -> int:
                    qos=qos, roles=roles, handoff_retries=handoff_retries,
                    outlier_ejection=outlier_ejection,
                    retry_budget=retry_budget,
-                   prefix_affinity=prefix_affinity)
+                   prefix_affinity=prefix_affinity,
+                   tracing_cfg=tracing_cfg)
         return 0
 
     # serve

@@ -194,6 +194,20 @@ def _engine_container(m: ModelSpec, spec: DeploySpec) -> Manifest:
         if "filter_hashes" in aff:
             c["env"].append({"name": "LLMK_PREFIX_FILTER_HASHES",
                              "value": str(int(aff["filter_hashes"]))})
+    if spec.tracing is not None:
+        # cross-hop tracing (ISSUE 19): the engine fragments export to
+        # the same OTLP endpoint under the same sampling policy as the
+        # router's, so stitched trees are complete on the backend too
+        tr = spec.tracing.to_wire()
+        if tr.get("otlpEndpoint"):
+            c["env"].append({"name": "LLMK_OTLP_ENDPOINT",
+                             "value": str(tr["otlpEndpoint"])})
+        if "sample" in tr:
+            c["env"].append({"name": "LLMK_TRACE_SAMPLE",
+                             "value": str(float(tr["sample"]))})
+        if "tailSlowMs" in tr:
+            c["env"].append({"name": "LLMK_SLOW_REQUEST_MS",
+                             "value": str(float(tr["tailSlowMs"]))})
     if m.tpu is None:
         # local/CPU profile: force the XLA-CPU backend (same env the
         # local-models chart sets) so the TPU-enabled image runs on
@@ -596,6 +610,11 @@ def router_config(spec: DeploySpec) -> dict[str, Any]:
         # prefix-affinity + cache-aware routing (ISSUE 18): a non-empty
         # block enables the layer in both router implementations
         cfg["prefix_affinity"] = spec.prefix_affinity.to_wire()
+    if spec.tracing is not None:
+        # cross-hop tracing (ISSUE 19): OTLP export + tail sampling — a
+        # non-empty block enables the exporter in both implementations
+        # (traceparent propagation itself needs no config)
+        cfg["tracing"] = spec.tracing.to_wire()
     return cfg
 
 

@@ -488,6 +488,41 @@ def alert_rules() -> dict[str, Any]:
                         },
                     },
                     {
+                        "alert": "LLMKTraceDropping",
+                        # the tail sampler guarantees errors/slow/multi-
+                        # hop traces always export; drops beyond the
+                        # deliberate reasons (sampled_out, disabled)
+                        # mean the exporter queue is overflowing or the
+                        # collector is rejecting batches — waterfalls
+                        # for exactly the requests being debugged go
+                        # missing. Ticket: observability gap, not an
+                        # availability problem.
+                        "expr": (
+                            "sum(rate(llm_trace_dropped_total"
+                            "{reason=\"queue_full\"}[10m]))"
+                            " + sum(rate(llm_trace_spans_exported_total"
+                            "{outcome=\"error\"}[10m])) > 0.1"
+                        ),
+                        "for": "10m",
+                        "labels": {"severity": "ticket"},
+                        "annotations": {
+                            "summary": "trace spans being dropped — "
+                                       "waterfalls incomplete",
+                            "description": (
+                                "Trace export on {{ $labels.instance }} "
+                                "is losing spans: the OTLP queue is "
+                                "overflowing (reason=queue_full) or the "
+                                "collector is rejecting batches "
+                                "(outcome=error). Tail-sampled traces "
+                                "(errors, slow, multi-hop) are exactly "
+                                "the ones an investigation needs. Check "
+                                "collector health and the "
+                                "tracing.otlpEndpoint value; lower "
+                                "tracing.sample if volume is the cause."
+                            ),
+                        },
+                    },
+                    {
                         "alert": "LLMKDeadlineExceeded",
                         "expr": (
                             "rate(llm_deadline_exceeded_total[5m]) > 1"
@@ -653,6 +688,12 @@ def grafana_dashboard() -> dict[str, Any]:
         _panel(32, "Prefix affinity: filter age (stale = blind routing)",
                ["max by (model, replica) "
                 "(llm_prefix_filter_age_seconds)"], 12, 120, unit="s"),
+        _panel(33, "Tracing: spans exported (by outcome)",
+               ["sum by (outcome) "
+                "(rate(llm_trace_spans_exported_total[5m]))"], 0, 128),
+        _panel(34, "Tracing: traces dropped (by reason)",
+               ["sum by (reason) "
+                "(rate(llm_trace_dropped_total[5m]))"], 12, 128),
     ]
     return {
         "title": "LLM serving on TPU — cluster overview",

@@ -273,6 +273,10 @@ _AFFINITY_KEYS = frozenset({
 })
 _AFFINITY_BOOL_KEYS = frozenset({"enabled", "kv_fetch"})
 
+# router.json "tracing" block + engine env — server/tracing.py is the
+# executable spec, tests/data/trace_vectors.json pins both routers
+_TRACING_KEYS = frozenset({"otlpEndpoint", "sample", "tailSlowMs"})
+
 
 @dataclasses.dataclass(frozen=True)
 class OutlierEjectionSpec:
@@ -375,6 +379,44 @@ class PrefixAffinitySpec:
             raise SpecError(
                 f"prefixAffinity.filter_hashes must be in [1, 4], "
                 f"got {hashes}")
+
+    def to_wire(self) -> dict:
+        return self.raw  # callers serialize, never mutate
+
+
+@dataclasses.dataclass(frozen=True)
+class TracingSpec:
+    """Cross-hop distributed tracing config (``tracing:``): OTLP/HTTP
+    endpoint for tail-sampled span export, the head sample rate for
+    unremarkable traces, and the slow-trace threshold that forces export.
+    Rendered verbatim into router.json and as LLMK_* env on the engine
+    containers — absent = dormant (no exporter thread, no headers beyond
+    the always-on traceparent propagation, rendering byte-identical)."""
+
+    raw: dict = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        unknown = set(self.raw) - _TRACING_KEYS
+        if unknown:
+            raise SpecError(
+                f"unknown tracing keys: {sorted(unknown)} "
+                f"(known: {sorted(_TRACING_KEYS)})")
+        ep = self.raw.get("otlpEndpoint")
+        if ep is not None and not isinstance(ep, str):
+            raise SpecError(
+                f"tracing.otlpEndpoint must be a string, got {ep!r}")
+        for k in ("sample", "tailSlowMs"):
+            v = self.raw.get(k)
+            if v is None:
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SpecError(f"tracing.{k} must be a number, got {v!r}")
+            if v < 0:
+                raise SpecError(f"tracing.{k} must be >= 0, got {v}")
+        sample = self.raw.get("sample")
+        if sample is not None and sample > 1:
+            raise SpecError(
+                f"tracing.sample must be in [0, 1], got {sample}")
 
     def to_wire(self) -> dict:
         return self.raw  # callers serialize, never mutate
@@ -641,6 +683,9 @@ class DeploySpec:
     retry_budget: Optional[RetryBudgetSpec] = None
     # prefix-affinity + cache-aware routing (ISSUE 18); None = dormant
     prefix_affinity: Optional[PrefixAffinitySpec] = None
+    # cross-hop distributed tracing (ISSUE 19); None = dormant (no OTLP
+    # exporter; traceparent propagation itself is always on)
+    tracing: Optional[TracingSpec] = None
     webui_enabled: bool = True
     webui_name: str = "TPU Multi-Model WebUI"
     hf_secret_name: str = "huggingface-token"
@@ -692,6 +737,8 @@ class DeploySpec:
             self.retry_budget.validate()
         if self.prefix_affinity is not None:
             self.prefix_affinity.validate()
+        if self.tracing is not None:
+            self.tracing.validate()
 
     @property
     def resolved_default(self) -> str:
@@ -844,6 +891,14 @@ def _affinity_from(d: Optional[dict]) -> Optional[PrefixAffinitySpec]:
     return PrefixAffinitySpec(raw=d)
 
 
+def _tracing_from(d: Optional[dict]) -> Optional[TracingSpec]:
+    if not d:
+        return None
+    if not isinstance(d, dict):
+        raise SpecError("tracing must be a mapping")
+    return TracingSpec(raw=d)
+
+
 def _adapter_from(d: dict, model_name: str) -> AdapterSpec:
     if not isinstance(d, dict):
         raise SpecError(
@@ -962,6 +1017,7 @@ def load_spec(source: "str | dict") -> DeploySpec:
         outlier_ejection=_outlier_from(data.get("outlierEjection")),
         retry_budget=_retry_budget_from(data.get("retryBudget")),
         prefix_affinity=_affinity_from(data.get("prefixAffinity")),
+        tracing=_tracing_from(data.get("tracing")),
         webui_enabled=bool(webui.get("enabled", True)),
         webui_name=webui.get("name", "TPU Multi-Model WebUI"),
         hf_secret_name=data.get("hfSecretName", "huggingface-token"),

@@ -105,6 +105,13 @@ def parse_exposition(text: str) -> tuple[list[Sample], dict, dict]:
             continue
         if line.startswith("#"):
             continue
+        # OpenMetrics exemplar suffix (` # {trace_id="..."} v ts`) on
+        # histogram bucket lines: drop it before parsing, or rsplit("}")
+        # would split at the exemplar's brace and lose the sample. The
+        # three-char marker ` # {` cannot appear in a sample value and is
+        # vanishingly unlikely inside a label value.
+        if " # {" in line:
+            line = line.split(" # {", 1)[0].rstrip()
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)

@@ -133,17 +133,25 @@ class _HistogramSeries:
     def __init__(self, buckets: tuple[float, ...]):
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)
+        # last exemplar per bucket: (trace_id, observed value, unix ts).
+        # Stored per bucket so the rendered exemplar value is always within
+        # its bucket's range, as OpenMetrics requires.
+        self.exemplars: list = [None] * (len(buckets) + 1)
         self.total = 0.0
         self.n = 0
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         self.total += v
         self.n += 1
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
+                if trace_id:
+                    self.exemplars[i] = (trace_id, v, time.time())
                 return
         self.counts[-1] += 1
+        if trace_id:
+            self.exemplars[-1] = (trace_id, v, time.time())
 
     def percentile(self, q: float) -> Optional[float]:
         """Approximate percentile from bucket upper bounds (for bench/tests)."""
@@ -157,6 +165,16 @@ class _HistogramSeries:
                 return b
         return float("inf")
 
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: `` # {trace_id="..."} value timestamp``.
+        Appended to bucket lines only — a trace-id breadcrumb from a
+        latency histogram straight to ``GET /debug/trace/<id>``."""
+        if ex is None:
+            return ""
+        tid, v, ts = ex
+        return f' # {{trace_id="{escape_label_value(tid)}"}} {v} {round(ts, 3)}'
+
     def _render_series(self, name: str, labels: str) -> list[str]:
         """Series lines with ``labels`` ('' or 'k="v",...') merged into the
         bucket's le label set."""
@@ -165,9 +183,11 @@ class _HistogramSeries:
         acc = 0
         for i, b in enumerate(self.buckets):
             acc += self.counts[i]
-            out.append(f'{name}_bucket{{{pre}le="{b}"}} {acc}')
+            out.append(f'{name}_bucket{{{pre}le="{b}"}} {acc}'
+                       f"{self._exemplar_suffix(self.exemplars[i])}")
         acc += self.counts[-1]
-        out.append(f'{name}_bucket{{{pre}le="+Inf"}} {acc}')
+        out.append(f'{name}_bucket{{{pre}le="+Inf"}} {acc}'
+                   f"{self._exemplar_suffix(self.exemplars[-1])}")
         suffix = f"{{{labels}}}" if labels else ""
         out.append(f"{name}_sum{suffix} {self.total}")
         out.append(f"{name}_count{suffix} {self.n}")
@@ -261,6 +281,29 @@ def build_info_metrics(registry: Registry, backend: str = "none",
         "Seconds since process start (recomputed at scrape)", registry,
         lambda: round(time.time() - _PROCESS_START_WALL, 3))
     return {"build_info": info, "start_time": start, "uptime": uptime}
+
+
+def trace_export_metrics(registry: Registry) -> dict:
+    """Tail-sampled OTLP span-export accounting, shared by every process
+    that owns a trace exporter (engine/API server and both routers). The
+    invariant the names encode: a trace that is not exported is COUNTED
+    dropped (by reason), never silently discarded."""
+    exported = Counter(
+        "llm_trace_spans_exported_total",
+        "Spans handed to the OTLP exporter by outcome (ok = accepted by "
+        "the collector, error = POST failed after the trace was already "
+        "sampled in)", registry, label_names=("outcome",))
+    dropped = Counter(
+        "llm_trace_dropped_total",
+        "Finished traces not exported, by reason (sampled_out = tail "
+        "sampler's probabilistic drop of a boring trace, queue_full = "
+        "exporter backpressure, disabled = no LLMK_OTLP_ENDPOINT)",
+        registry, label_names=("reason",))
+    # pre-seed so the rate() panels and the cluster merge see the series
+    # before the first drop/export happens
+    exported.labels(outcome="ok")
+    dropped.labels(reason="sampled_out")
+    return {"trace_spans_exported": exported, "trace_dropped": dropped}
 
 
 def engine_metrics(registry: Registry) -> dict:
@@ -457,6 +500,7 @@ def engine_metrics(registry: Registry) -> dict:
             "cooldown)",
             registry, label_names=("reason",)),
     }
+    m.update(trace_export_metrics(registry))
     # pre-seed the watchdog counter's only known reason at zero: a
     # labeled counter with no children exports no samples, so the
     # dashboard's rate() panel and the router's /metrics/cluster merge
@@ -648,4 +692,5 @@ def router_metrics(registry: Registry) -> dict:
             "last refreshed from its /ready advertisement (stale filters "
             "degrade cache-aware placement to pure rendezvous)",
             registry, label_names=("model", "replica")),
+        **trace_export_metrics(registry),
     }

@@ -385,11 +385,16 @@ class EngineLoop(threading.Thread):
                 for ev in events:
                     m["tokens_generated"].inc(len(ev.new_tokens))
                     r = ev.request
+                    # OpenMetrics exemplar: pin the latency sample to its
+                    # W3C trace id so a slow histogram bucket links
+                    # straight to the exported waterfall
+                    tid = getattr(getattr(r, "trace", None),
+                                  "trace_id", None)
                     if ev.finished:
                         m["requests_finished"].inc()
                         m["e2e_latency"].labels(model=self._mlabel(r)).observe(
                             (r.finished_at or time.monotonic())
-                            - r.submitted_at)
+                            - r.submitted_at, trace_id=tid)
                     if ev.finished and ev.finish_reason == "timeout":
                         # queue = shed before ever being prefilled;
                         # decode = aborted mid-generation at its deadline
@@ -398,7 +403,7 @@ class EngineLoop(threading.Thread):
                     if r.first_token_at and r.id not in self._ttft_seen:
                         self._ttft_seen.add(r.id)
                         m["ttft"].labels(model=self._mlabel(r)).observe(
-                            r.first_token_at - r.submitted_at)
+                            r.first_token_at - r.submitted_at, trace_id=tid)
                     if ev.finished:
                         self._ttft_seen.discard(r.id)
             if self.flight is not None:
@@ -617,6 +622,13 @@ class OpenAIServer:
             int(os.environ.get("LLMK_TRACE_RING", "256")))
         self.flight = tracing.FlightRecorder(
             int(os.environ.get("LLMK_FLIGHT_STEPS", "512")))
+        # cross-hop tracing: tail-sampled OTLP export of finished request
+        # fragments (dormant without LLMK_OTLP_ENDPOINT — every skipped
+        # trace is still counted in llm_trace_dropped_total)
+        self.tail_sampler = tracing.TailSampler()
+        self.exporter = tracing.exporter_from_env(
+            "llmk-engine", self.metrics["trace_spans_exported"],
+            self.metrics["trace_dropped"])
         self.loop_thread = EngineLoop(engine, self.metrics,
                                       model_name=model_name,
                                       flight=self.flight,
@@ -669,9 +681,17 @@ class OpenAIServer:
         """Read-or-mint the request id at the edge of this process and echo
         it on every response (Dapper-style propagation: both routers
         forward the inbound header verbatim, so the id a client quotes
-        matches the engine's trace)."""
-        rid, _ = tracing.request_id_from(request.headers)
+        matches the engine's trace). The same reconciliation adopts a
+        valid inbound ``traceparent`` (the router mints one per hop) so
+        this process's fragment parents under the exact hop that reached
+        it — a forged or malformed one is re-minted, never trusted."""
+        ctx = tracing.reconcile(
+            request.headers.get(tracing.TRACEPARENT_HEADER),
+            request.headers.get(tracing.TRACESTATE_HEADER),
+            request.headers.get(REQUEST_ID_HEADER))
+        rid = ctx["request_id"] or tracing.new_request_id()
         request["llmk_request_id"] = rid
+        request["llmk_trace_ctx"] = ctx
         try:
             resp = await handler(request)
         except web.HTTPException as ex:
@@ -816,6 +836,8 @@ class OpenAIServer:
         if self._handoff_session is not None:
             await self._handoff_session.close()
             self._handoff_session = None
+        if self.exporter is not None:
+            self.exporter.close()
         self.loop_thread.stop()
         if self.loop_thread.is_alive():
             # join OFF the event loop so cleanup isn't blocked; the join
@@ -1084,7 +1106,8 @@ class OpenAIServer:
         return self._handoff_session
 
     async def _handoff_pull(self, request: web.Request,
-                            deadline: Optional[float]) -> int:
+                            deadline: Optional[float],
+                            trace=None) -> int:
         """Decode-side half of the handoff: pull the prefill replica's
         spilled pages (named by the router's digest header) into the local
         host tier and return how many landed. Every failure mode — fault
@@ -1115,12 +1138,27 @@ class OpenAIServer:
         if deadline is not None:
             budget = max(0.05, min(budget, deadline - time.monotonic()))
         tenant = request.headers.get(HANDOFF_TENANT_HEADER, "")
+        # kv pull is a cross-replica hop of its own: carry a freshly
+        # minted traceparent (and the distributed request id) so the
+        # source replica's fetch fragment stitches under this leg
+        hop_headers = {}
+        rid = request.get("llmk_request_id")
+        if rid:
+            hop_headers[REQUEST_ID_HEADER] = rid
+        pull_sid = ""
+        if trace is not None:
+            pull_sid = tracing.new_span_id()
+            hop_headers[tracing.TRACEPARENT_HEADER] = \
+                tracing.format_traceparent(trace.trace_id, pull_sid,
+                                           trace.sampled)
+        t_pull0 = time.monotonic()
         try:
             sess = await self._handoff_session_get()
             async with sess.post(
                     src + "/internal/kv/fetch",
                     json={"tenant": tenant,
                           "digests": [d.hex() for d in digests]},
+                    headers=hop_headers,
                     timeout=aiohttp.ClientTimeout(total=budget)) as r:
                 if r.status != 200:
                     return 0
@@ -1128,6 +1166,10 @@ class OpenAIServer:
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
                 ValueError):
             return 0
+        if trace is not None:
+            trace.add_span("kv_pull", t_pull0, time.monotonic(),
+                           span_id=pull_sid,
+                           parent_span_id=trace.span_id, source=src)
         encs = doc.get("payloads") if isinstance(doc, dict) else None
         if not isinstance(encs, list):
             return 0
@@ -1689,7 +1731,12 @@ class OpenAIServer:
         adapter = _adapter_from_model(body.get("model"))
         model_label = (f"{self.model_name}:{adapter}" if adapter
                        else self.model_name)
-        trace = tracing.Trace(rid, model=model_label)
+        ctx = request.get("llmk_trace_ctx") or {}
+        trace = tracing.Trace(rid, model=model_label,
+                              trace_id=ctx.get("trace_id", ""),
+                              parent_span_id=ctx.get("parent_span_id", ""),
+                              component="api",
+                              sampled=bool(ctx.get("sampled", True)))
         trace.engine_reqs = []  # engine Requests serving this HTTP request
         status = "error"
         resp = None
@@ -1709,6 +1756,15 @@ class OpenAIServer:
         durations sum to at most the end-to-end latency."""
         now = time.monotonic()
         many = len(trace.engine_reqs) > 1
+
+        def eng_span(name, start, end, **meta):
+            # every engine-phase window is a first-class child of this
+            # process's fragment root, so the stitched cross-hop tree can
+            # nest queue/prefill/decode under the exact router hop that
+            # carried the request here
+            trace.add_span(name, start, end, span_id=tracing.new_span_id(),
+                           parent_span_id=trace.span_id, **meta)
+
         for i, req in enumerate(trace.engine_reqs):
             meta = {"choice": i} if many else {}
             sub = req.submitted_at
@@ -1716,9 +1772,9 @@ class OpenAIServer:
             ft = req.first_token_at
             fin = req.finished_at
             fin = now if fin is None else min(fin, now)
-            trace.add_span("admission", trace.t0, sub, **meta)
-            trace.add_span("queue", sub, adm if adm is not None else fin,
-                           **meta)
+            eng_span("admission", trace.t0, sub, **meta)
+            eng_span("queue", sub, adm if adm is not None else fin,
+                     **meta)
             if adm is not None:
                 pre_kw = dict(meta)
                 if req.chip_ms:
@@ -1726,8 +1782,8 @@ class OpenAIServer:
                     # actually consumed, vs the wall-clock span bounds
                     pre_kw["chip_ms"] = round(
                         req.chip_ms.get("prefill", 0.0), 3)
-                trace.add_span("prefill", adm,
-                               ft if ft is not None else fin, **pre_kw)
+                eng_span("prefill", adm,
+                         ft if ft is not None else fin, **pre_kw)
             if ft is not None:
                 dec_kw = dict(meta, tokens=len(req.output))
                 if req.chip_ms:
@@ -1737,11 +1793,11 @@ class OpenAIServer:
                              + req.chip_ms.get("early_exit", 0.0))
                     if waste:
                         dec_kw["chip_waste_ms"] = round(waste, 3)
-                trace.add_span("decode", ft, fin, **dec_kw)
+                eng_span("decode", ft, fin, **dec_kw)
             if fin < now:
                 # engine finished before the response flushed: the tail is
                 # stream/serialization time on the API side
-                trace.add_span("stream", fin, now, **meta)
+                eng_span("stream", fin, now, **meta)
         trace.finish(status)
         self.traces.add(trace)
         tracing.jlog(
@@ -1751,6 +1807,27 @@ class OpenAIServer:
             e2e_ms=round(trace.e2e_ms() or 0.0, 3),
             tokens=sum(len(r.output) for r in trace.engine_reqs))
         tracing.maybe_log_slow(trace, "api")
+        self._export_trace(trace)
+
+    def _export_trace(self, trace) -> None:
+        """Tail-sampling + OTLP enqueue for a finished fragment; never
+        raises, and a non-exported trace is counted, never silent."""
+        try:
+            d = trace.to_dict()
+            if self.exporter is None:
+                self.metrics["trace_dropped"].labels(
+                    reason="disabled").inc()
+                return
+            st = d.get("status") or ""
+            error = st == "error" or st.startswith("http_5")
+            keep, reason = self.tail_sampler.decide(
+                error, d.get("e2e_ms"), tracing.is_multi_hop(d))
+            if not keep:
+                self.metrics["trace_dropped"].labels(reason=reason).inc()
+                return
+            self.exporter.export(d)
+        except Exception:  # noqa: BLE001 — observability must not fail serving
+            pass
 
     async def _serve_inner(self, request, body, prompts, trace, *,
                            chat: bool, images=None, tools_on: bool = False,
@@ -1891,7 +1968,8 @@ class OpenAIServer:
             # to the host tier eagerly so the decode pull never races
             params = dataclasses.replace(params, max_tokens=1)
         elif request.headers.get(HANDOFF_SOURCE_HEADER):
-            adopted = await self._handoff_pull(request, deadline)
+            adopted = await self._handoff_pull(request, deadline,
+                                               trace=trace)
             request["llmk_handoff_adopted"] = adopted
         # best_of choices per prompt (prompt-major choice order, per
         # OpenAI); usage counts each UNIQUE prompt once, not n times

@@ -455,6 +455,7 @@ class Router:
         outlier_ejection: Optional[dict] = None,
         retry_budget: Optional[dict] = None,
         prefix_affinity: Optional[dict] = None,
+        tracing_cfg: Optional[dict] = None,
         clock=time.monotonic,
     ):
         """backends: model name -> base URL or list of replica base URLs.
@@ -531,6 +532,30 @@ class Router:
         self.scrape_timeout_s = 5.0
         self.traces = tracing.TraceStore(
             int(os.environ.get("LLMK_TRACE_RING", "256")))
+        # cross-hop tracing: tail sampler + OTLP exporter. Config (from
+        # router.json "tracing") overrides env; no endpoint anywhere ⇒
+        # the exporter stays dormant and drops are counted "disabled".
+        tcfg = dict(tracing_cfg or {})
+        self.tracing_cfg = tcfg
+
+        def _cfg_float(key):
+            v = tcfg.get(key)
+            try:
+                return float(v) if v is not None else None
+            except (TypeError, ValueError):
+                return None
+
+        self.tail_sampler = tracing.TailSampler(
+            sample=_cfg_float("sample"), slow_ms=_cfg_float("tailSlowMs"))
+        endpoint = str(tcfg.get("otlpEndpoint")
+                       or os.environ.get(tracing.OTLP_ENDPOINT_ENV,
+                                         "")).strip()
+        self.exporter: Optional[tracing.OtlpExporter] = None
+        if endpoint:
+            self.exporter = tracing.OtlpExporter(
+                endpoint, service_name="llmk-router",
+                exported=self.metrics["trace_spans_exported"],
+                dropped=self.metrics["trace_dropped"])
         # per-replica state; breakers indexed by replica URL for inspection
         self.replicas: dict[str, list[Replica]] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
@@ -609,6 +634,7 @@ class Router:
         app.router.add_get("/metrics", self.metrics_endpoint)
         app.router.add_get("/metrics/cluster", self.metrics_cluster)
         app.router.add_get("/debug/traces", self.debug_traces)
+        app.router.add_get("/debug/trace/{trace_id}", self.debug_trace)
         app.router.add_get("/debug/replicas", self.debug_replicas)
         app.router.add_get("/v1/models", self.models)
         app.router.add_route("*", "/{path:.*}", self.proxy)
@@ -632,6 +658,8 @@ class Router:
             self._probe_task = None
         if self._session:
             await self._session.close()
+        if self.exporter is not None:
+            self.exporter.close()
 
     # ------------------------------------------------------------------
     # active health probing
@@ -1096,6 +1124,43 @@ class Router:
             limit=limit,
         )})
 
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        """Hop-stitched waterfall for one trace: this router's local
+        fragments plus child fragments pulled on demand from every
+        replica's ``/debug/traces?id=``, assembled into one tree
+        (tracing.stitch_waterfall) with per-hop durations and retry/
+        hedge/redirect annotations."""
+        tid = request.match_info["trace_id"]
+        fragments = self.traces.snapshot(request_id=tid, limit=32)
+        urls = sorted({r.url for reps in self.replicas.values()
+                       for r in reps})
+
+        async def pull(base: str) -> list[dict]:
+            try:
+                async with self._session.get(
+                        f"{base}/debug/traces",
+                        params={"id": tid, "limit": "8"},
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.scrape_timeout_s)) as resp:
+                    if resp.status != 200:
+                        return []
+                    doc = await resp.json(content_type=None)
+            except (aiohttp.ClientError, TimeoutError, OSError, ValueError):
+                return []
+            traces = doc.get("traces") if isinstance(doc, dict) else None
+            return [t for t in traces or [] if isinstance(t, dict)]
+
+        if self._session is not None and urls:
+            for pulled in await asyncio.gather(*(pull(u) for u in urls)):
+                fragments.extend(pulled)
+        doc = tracing.stitch_waterfall(tid, fragments)
+        if not doc["fragments"]:
+            return web.json_response(
+                error_body(f"no trace fragments for {tid!r} (evicted from "
+                           "the ring, or never traced here)", "not_found",
+                           "trace_not_found"), status=404)
+        return web.json_response(doc)
+
     async def debug_replicas(self, request: web.Request) -> web.Response:
         """Per-replica routing state: health, breaker, inflight, and —
         when the gray-failure layer is on — the quarantine FSM and the
@@ -1142,8 +1207,21 @@ class Router:
     # ------------------------------------------------------------------
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
-        rid, _ = tracing.request_id_from(request.headers)
-        trace = tracing.Trace(rid, clock=self.clock)
+        # canonical reconciliation of client-supplied correlation headers
+        # (trace_vectors.json §reconcile): a valid traceparent is adopted,
+        # a forged/malformed one is re-minted; same treatment for the
+        # request id. The router's fragment is the edge root span unless
+        # an outer proxy advertised a parent.
+        ctx = tracing.reconcile(
+            request.headers.get(tracing.TRACEPARENT_HEADER),
+            request.headers.get(tracing.TRACESTATE_HEADER),
+            request.headers.get(REQUEST_ID_HEADER))
+        rid = ctx["request_id"] or tracing.new_request_id()
+        trace = tracing.Trace(rid, clock=self.clock,
+                              trace_id=ctx["trace_id"],
+                              parent_span_id=ctx["parent_span_id"],
+                              component="router", sampled=ctx["sampled"])
+        request["llmk_tracestate"] = ctx["tracestate"]
         resp: Optional[web.StreamResponse] = None
         status = "error"
         try:
@@ -1163,6 +1241,44 @@ class Router:
                  method=request.method, path=request.path,
                  e2e_ms=round(trace.e2e_ms() or 0.0, 3))
             tracing.maybe_log_slow(trace, "router")
+            self._export_trace(trace)
+
+    def _export_trace(self, trace: "tracing.Trace") -> None:
+        """Tail-sampling decision + OTLP enqueue for a finished trace.
+        Never raises, never blocks; a non-exported trace is always
+        counted (dropped by reason), never silently discarded."""
+        try:
+            d = trace.to_dict()
+            if self.exporter is None:
+                self.metrics["trace_dropped"].labels(reason="disabled").inc()
+                return
+            status = d.get("status") or ""
+            error = status == "error" or status.startswith("http_5")
+            keep, reason = self.tail_sampler.decide(
+                error, d.get("e2e_ms"), tracing.is_multi_hop(d))
+            if not keep:
+                self.metrics["trace_dropped"].labels(reason=reason).inc()
+                return
+            self.exporter.export(d)
+        except Exception:  # noqa: BLE001 — observability must not 500 a proxy
+            pass
+
+    @staticmethod
+    def _hop_headers(trace: "tracing.Trace", headers: dict) -> tuple:
+        """Copy ``headers`` and mint a fresh per-hop ``traceparent``.
+
+        Every upstream leg (connect attempt, hedge secondary, resume
+        re-issue, handoff prefill/decode) gets its own span id so the
+        receiving process can parent its fragment under the exact hop
+        that reached it — that's what lets /debug/trace stitch retries
+        and races into one tree instead of a pile of siblings.
+        Returns ``(send_headers, hop_span_id)``.
+        """
+        sid = tracing.new_span_id()
+        h = dict(headers)
+        h[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(
+            trace.trace_id, sid, trace.sampled)
+        return h, sid
 
     async def _proxy_inner(self, request: web.Request,
                            trace: "tracing.Trace",
@@ -1264,9 +1380,18 @@ class Router:
                                   HANDOFF_SOURCE_HEADER.lower(),
                                   HANDOFF_DIGESTS_HEADER.lower(),
                                   HANDOFF_TENANT_HEADER.lower(),
-                                  HANDOFF_SEED_HEADER.lower())
+                                  HANDOFF_SEED_HEADER.lower(),
+                                  tracing.TRACEPARENT_HEADER,
+                                  tracing.TRACESTATE_HEADER)
         }
         headers[REQUEST_ID_HEADER] = rid
+        # the inbound traceparent was consumed by reconcile() at the edge;
+        # every upstream send mints a fresh per-hop traceparent (see
+        # _hop_headers) so each leg gets a unique parent pointer. A valid
+        # adopted tracestate rides along unchanged; anything else is gone.
+        ts = request.get("llmk_tracestate") or ""
+        if ts:
+            headers[tracing.TRACESTATE_HEADER] = ts
         # RESOLVED priority, never the client's raw header (an invalid or
         # unauthorized value must not leak past the gateway)
         headers[PRIORITY_HEADER] = priority
@@ -1391,13 +1516,12 @@ class Router:
             url = f"{replica.url}/{request.match_info['path']}"
             if request.query_string:
                 url += f"?{request.query_string}"
-            send_headers = headers
+            send_headers, hop_sid = self._hop_headers(trace, headers)
             if aff_pull and attempt == 1 and replica.url == aff_url:
                 # kv_fetch stretch: the chosen replica's caches hold none
                 # of the chain but a peer's do — name that peer so the
                 # replica pulls the spilled pages over /internal/kv/fetch
                 # (PR-16 substrate) instead of re-prefilling
-                send_headers = dict(headers)
                 send_headers[HANDOFF_SOURCE_HEADER] = aff_pull
                 send_headers[HANDOFF_DIGESTS_HEADER] = ",".join(
                     d.hex() for d in self.affinity_digests.get(aff_key))
@@ -1411,6 +1535,8 @@ class Router:
                 replica.breaker.record_success()
                 active = replica
                 trace.add_span("connect", t_connect0, self.clock(),
+                               span_id=hop_sid,
+                               parent_span_id=trace.span_id,
                                replica=replica.url, attempts=attempt)
                 break
             except RETRYABLE_ERRORS as e:
@@ -1553,13 +1679,14 @@ class Router:
                 if p_attempt > 1:
                     self._refund_retry(model)
                 return None
-            h = dict(headers)
+            h, p_sid = self._hop_headers(trace, headers)
             h[HANDOFF_HEADER] = "ticket"
             if deadline is not None:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
                     return self._deadline_response(rid)
                 h[DEADLINE_HEADER] = str(int(remaining * 1000))
+            t_p0 = self.clock()
             replica.inflight += 1
             try:
                 up = await self._session.request(
@@ -1588,6 +1715,10 @@ class Router:
                     tried_p.add(replica.url)
                     continue
                 ticket, source = doc_t, replica
+                trace.add_span("handoff_prefill", t_p0, self.clock(),
+                               span_id=p_sid,
+                               parent_span_id=trace.span_id,
+                               replica=replica.url, attempts=p_attempt)
                 break
             if up.status == 200 and ctype.startswith("text/event-stream"):
                 # the replica DECLINED the ticket (ineligible shape) and
@@ -1630,11 +1761,17 @@ class Router:
                 if attempt > 1:
                     self._refund_retry(model)
                 break
+            # each decode attempt is its own hop: fresh traceparent so a
+            # retried adoption shows up as a distinct leg in the waterfall
+            d_sid = tracing.new_span_id()
+            h2[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(
+                trace.trace_id, d_sid, trace.sampled)
             if deadline is not None:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
                     return self._deadline_response(rid)
                 h2[DEADLINE_HEADER] = str(int(remaining * 1000))
+            t_d0 = self.clock()
             replica.inflight += 1
             try:
                 up = await self._session.request(
@@ -1668,6 +1805,9 @@ class Router:
                  pages_offered=len(digests), pages_adopted=adopted)
             trace.event("handoff", outcome=outcome, adopted=adopted,
                         prefill=source.url, decode=replica.url)
+            trace.add_span("handoff_decode", t_d0, self.clock(),
+                           span_id=d_sid, parent_span_id=trace.span_id,
+                           replica=replica.url, attempts=attempt)
             return await self._relay_stream(
                 request, trace, rid, model, h2, body, deadline, up,
                 replica, tried_d, t0, journal)
@@ -1788,7 +1928,7 @@ class Router:
                     return resp
                 nxt = await self._resume_upstream(
                     request, model, headers, body, deadline, tried, journal,
-                    rid, resumes)
+                    rid, resumes, trace)
                 if nxt is None:
                     return await self._truncate_stream(resp, model, trace)
                 upstream, active, used = nxt
@@ -1817,7 +1957,8 @@ class Router:
                                headers: dict, body: bytes,
                                deadline: Optional[float], tried: set,
                                journal: _StreamJournal, rid: str,
-                               resumes: int):
+                               resumes: int,
+                               trace: "tracing.Trace"):
         """Re-issue a died stream to another replica with the journaled
         prefix. Returns (upstream, replica, attempts_used) on a spliceable
         200 SSE response, or None to give up (disabled, exhausted,
@@ -1872,6 +2013,12 @@ class Router:
             url = f"{replica.url}/{request.match_info['path']}"
             if request.query_string:
                 url += f"?{request.query_string}"
+            # fresh traceparent per re-issue: the splice leg is its own
+            # hop, parented under the router fragment like any other
+            r_sid = tracing.new_span_id()
+            h[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(
+                trace.trace_id, r_sid, trace.sampled)
+            t_r0 = self.clock()
             replica.inflight += 1
             try:
                 up = await self._session.request(
@@ -1891,6 +2038,9 @@ class Router:
                 tried.add(replica.url)
                 continue
             replica.breaker.record_success()
+            trace.add_span("resume", t_r0, self.clock(), span_id=r_sid,
+                           parent_span_id=trace.span_id,
+                           replica=replica.url, attempts=used)
             return up, replica, used
         jlog("stream_resume_giveup", request_id=rid, component="router",
              model=model, reason=f"attempts exhausted ({self.resume_attempts})")
@@ -1968,7 +2118,7 @@ class Router:
                 tried.add(active.url)
                 raise
             return upstream, active, chunk
-        h = dict(headers)
+        h, hedge_sid = self._hop_headers(trace, headers)
         if deadline is not None:
             remaining = deadline - self.clock()
             h[DEADLINE_HEADER] = str(max(1, int(remaining * 1000)))
@@ -1978,6 +2128,7 @@ class Router:
         jlog("hedge_launch", request_id=rid, component="router", model=model,
              primary=active.url, hedge=hedge_rep.url)
         trace.event("hedge_launch", primary=active.url, hedge=hedge_rep.url)
+        t_hedge0 = self.clock()
         hedge_rep.inflight += 1
 
         async def hedge_of():
@@ -2019,6 +2170,14 @@ class Router:
                         lup.close()
                     lrep = live[loser]
                     lrep.inflight -= 1
+                    if loser is sec:
+                        # the losing hedge leg still reached a replica:
+                        # record its hop span so that replica's fragment
+                        # has a parent in the stitched waterfall
+                        trace.add_span("hedge", t_hedge0, self.clock(),
+                                       span_id=hedge_sid,
+                                       parent_span_id=trace.span_id,
+                                       replica=hedge_rep.url)
                     if loser is prim:
                         upstream.close()
                 rep.breaker.record_success()
@@ -2026,6 +2185,10 @@ class Router:
                 self.metrics["hedged"].labels(outcome=outcome).inc()
                 if fut is not prim:
                     trace.event("hedge_won", replica=rep.url)
+                    trace.add_span("hedge", t_hedge0, self.clock(),
+                                   span_id=hedge_sid,
+                                   parent_span_id=trace.span_id,
+                                   replica=rep.url)
                 return up, rep, chunk
         assert last_err is not None
         raise last_err
@@ -2048,6 +2211,7 @@ def run_router(
     outlier_ejection: Optional[dict] = None,
     retry_budget: Optional[dict] = None,
     prefix_affinity: Optional[dict] = None,
+    tracing_cfg: Optional[dict] = None,
 ) -> None:
     router = Router(backends, default_model, strict, adapters=adapters,
                     probe_interval_s=probe_interval_s,
@@ -2056,6 +2220,7 @@ def run_router(
                     qos=qos, roles=roles, handoff_retries=handoff_retries,
                     outlier_ejection=outlier_ejection,
                     retry_budget=retry_budget,
-                    prefix_affinity=prefix_affinity)
+                    prefix_affinity=prefix_affinity,
+                    tracing_cfg=tracing_cfg)
     web.run_app(router.make_app(), host=host, port=port, print=None,
                 handler_cancellation=True)

@@ -77,6 +77,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <condition_variable>
 #include <cstring>
 #include <ctime>
 #include <deque>
@@ -175,6 +176,19 @@ struct AffinityCfg {
   bool kv_fetch = false;         // stretch: pull spilled KV from a claimer
 };
 
+// ---------------------------------------------------------------------------
+// Cross-hop tracing config (mirrors server/tracing.py + the python Router's
+// "tracing" block: traceparent propagation is ALWAYS on; the block/env only
+// switches on tail-sampled OTLP export). tests/data/trace_vectors.json pins
+// the parse/reconcile/sampler semantics via --trace-selftest.
+// ---------------------------------------------------------------------------
+
+struct TracingCfg {
+  std::string endpoint;          // OTLP/HTTP-JSON target; empty = dormant
+  double sample = 0.01;          // boring-trace export probability
+  double tail_slow_ms = 10000.0; // e2e >= this always exports; 0 disables
+};
+
 struct Config {
   // insertion-ordered: first model is the default (like the reference's
   // `default_backend` = first entry, model-gateway.yaml:20-22). Each model
@@ -226,6 +240,9 @@ struct Config {
   // prefix-affinity + KV-cache-aware routing ("prefix_affinity" block /
   // LLMK_AFFINITY); absent = dormant (pure P2C, byte-identical)
   AffinityCfg affinity;
+  // cross-hop tracing ("tracing" block / LLMK_OTLP_ENDPOINT etc.):
+  // propagation is always on, the endpoint switches on OTLP export
+  TracingCfg tracing;
   // disaggregated prefill/decode (mirrors server/router.py): replica
   // (host, port) -> role; absent = "both". A model with any prefill
   // replica gets the two-hop ticket flow; handoff_retries bounds the
@@ -1036,18 +1053,13 @@ static void retry_budget_refund(const Config& cfg, const std::string& model) {
 // Request IDs + structured access log (mirrors server/tracing.py)
 // ---------------------------------------------------------------------------
 
-// X-LLMK-Request-Id: forwarded verbatim when the client (or an outer
-// proxy) sent one; minted here otherwise, so every hop of a request's
-// life can be grepped by one id.
+// X-LLMK-Request-Id: reconciled against the W3C trace context at the edge
+// (trace_reconcile below) — a safe client value is forwarded, an unsafe one
+// is re-derived from the trace id, an absent one is minted — so every hop
+// of a request's life can be grepped by one id.
 static const char kRequestIdHeader[] = "X-LLMK-Request-Id";
 
 static std::string gen_request_id();
-
-static std::string request_id_from(const Request& req) {
-  const std::string* rid = req.headers.get("x-llmk-request-id");
-  if (rid && !rid->empty()) return *rid;
-  return gen_request_id();
-}
 
 // One-line JSON access record per proxied request: the native twin of the
 // python router's tracing.jlog("request", ...) line. Strings go through
@@ -1070,6 +1082,164 @@ static void jlog_request(const Config& cfg, const std::string& rid,
   root->set("total_ms", Json::of_number(total_ms));
   std::lock_guard<std::mutex> lock(g_log_mu);
   fprintf(stderr, "%s\n", root->dump().c_str());
+}
+
+// ---------------------------------------------------------------------------
+// W3C trace context: parse / mint / reconcile / tail sampling. Mirrors
+// server/tracing.py byte-for-byte (that module is the executable spec);
+// tests/data/trace_vectors.json pins both via --trace-selftest.
+// ---------------------------------------------------------------------------
+
+static const char kTraceparentHeader[] = "traceparent";
+static const char kTracestateHeader[] = "tracestate";
+
+static std::string gen_span_id();  // 16 lowercase hex (defined with gen_request_id)
+
+static bool trace_is_hex(const std::string& s, size_t width) {
+  if (s.size() != width) return false;
+  for (char c : s)
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'))) return false;
+  return true;
+}
+
+static std::string trace_strip_ows(const std::string& v) {
+  size_t b = v.find_first_not_of(" \t");
+  if (b == std::string::npos) return "";
+  size_t e = v.find_last_not_of(" \t");
+  return v.substr(b, e - b + 1);
+}
+
+// Strict W3C parse -> trace_id/span_id/flags; false = malformed (never
+// best-effort). Same rejections as tracing.parse_traceparent: version not
+// 2 lowercase hex or the reserved ff; version 00 with a field count other
+// than 4; trace/span id wrong width, uppercase, or all zeros; bad flags.
+static bool trace_parse_traceparent(const std::string& value,
+                                    std::string* trace_id,
+                                    std::string* span_id, int* flags) {
+  std::string v = trace_strip_ows(value);
+  if (v.empty()) return false;
+  std::vector<std::string> parts;
+  size_t p = 0;
+  while (true) {
+    size_t dash = v.find('-', p);
+    if (dash == std::string::npos) {
+      parts.push_back(v.substr(p));
+      break;
+    }
+    parts.push_back(v.substr(p, dash - p));
+    p = dash + 1;
+  }
+  if (parts.size() < 4) return false;
+  const std::string& ver = parts[0];
+  if (!trace_is_hex(ver, 2) || ver == "ff") return false;
+  if (ver == "00" && parts.size() != 4) return false;
+  if (!trace_is_hex(parts[1], 32) ||
+      parts[1] == std::string(32, '0'))
+    return false;
+  if (!trace_is_hex(parts[2], 16) ||
+      parts[2] == std::string(16, '0'))
+    return false;
+  if (!trace_is_hex(parts[3], 2)) return false;
+  *trace_id = parts[1];
+  *span_id = parts[2];
+  *flags = static_cast<int>(strtol(parts[3].c_str(), nullptr, 16));
+  return true;
+}
+
+static std::string trace_format_traceparent(const std::string& trace_id,
+                                            const std::string& span_id,
+                                            bool sampled) {
+  return "00-" + trace_id + "-" + span_id + (sampled ? "-01" : "-00");
+}
+
+// passthrough filter: <=512 printable-ASCII chars, else dropped
+static bool trace_valid_tracestate(const std::string& v) {
+  if (v.empty() || v.size() > 512) return false;
+  for (unsigned char c : v)
+    if (c < 0x20 || c > 0x7E) return false;
+  return true;
+}
+
+// a client-suppliable request id we are willing to adopt: 1-64 chars of
+// [A-Za-z0-9_-]; anything else is re-minted at the edge
+static bool trace_safe_rid(const std::string& rid) {
+  if (rid.empty() || rid.size() > 64) return false;
+  for (char c : rid)
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+          (c >= 'A' && c <= 'Z') || c == '-' || c == '_'))
+      return false;
+  return true;
+}
+
+struct TraceCtx {
+  std::string trace_id;        // empty = mint fresh
+  std::string parent_span_id;  // empty = this hop is the root
+  bool sampled = true;
+  bool adopted = false;
+  std::string reason;          // adopted | malformed | absent
+  std::string request_id;      // empty = mint fresh
+  std::string tracestate;      // passthrough only when adopted + valid
+};
+
+// canonical edge reconciliation of inbound correlation headers (mirrors
+// tracing.reconcile; trace_vectors.json §reconcile pins every branch)
+static TraceCtx trace_reconcile(const std::string* traceparent,
+                                const std::string* tracestate,
+                                const std::string* request_id) {
+  TraceCtx out;
+  std::string tp = traceparent ? *traceparent : "";
+  int flags = 0;
+  if (trace_parse_traceparent(tp, &out.trace_id, &out.parent_span_id,
+                              &flags)) {
+    out.adopted = true;
+    out.reason = "adopted";
+    out.sampled = (flags & 0x01) != 0;
+  } else {
+    out.adopted = false;
+    out.sampled = true;
+    out.reason = trace_strip_ows(tp).empty() ? "absent" : "malformed";
+  }
+  std::string rid = request_id ? *request_id : "";
+  if (trace_safe_rid(rid))
+    out.request_id = rid;
+  else if (out.adopted)
+    out.request_id = out.trace_id;  // rid and trace stay correlated
+  else
+    out.request_id = "";
+  std::string state = tracestate ? *tracestate : "";
+  if (out.adopted && trace_valid_tracestate(state)) out.tracestate = state;
+  return out;
+}
+
+// keep-or-drop decision made AFTER the request finished (tail-based):
+// errors, slow, and multi-hop flows always export; the rest export with
+// probability `sample` on the caller-supplied draw. Precedence matches
+// tracing.tail_decision (trace_vectors.json §sampler).
+static bool trace_tail_decision(bool error, double e2e_ms, double slow_ms,
+                                bool multi_hop, double sample, double rand01,
+                                std::string* reason) {
+  if (error) {
+    *reason = "error";
+    return true;
+  }
+  if (slow_ms > 0 && e2e_ms >= slow_ms) {
+    *reason = "slow";
+    return true;
+  }
+  if (multi_hop) {
+    *reason = "multi_hop";
+    return true;
+  }
+  if (sample >= 1.0) {
+    *reason = "sampled";
+    return true;
+  }
+  if (sample <= 0.0 || rand01 >= sample) {
+    *reason = "sampled_out";
+    return false;
+  }
+  *reason = "sampled";
+  return true;
 }
 
 // ---------------------------------------------------------------------------
@@ -2057,6 +2227,15 @@ static std::string gen_request_id() {
   return out;
 }
 
+// 16 lowercase hex, the W3C span-id shape (python: uuid4().hex[:16]);
+// all-zero (the invalid id) is statistically unreachable here
+static std::string gen_span_id() {
+  static const char hex[] = "0123456789abcdef";
+  std::string out(16, '0');
+  for (int i = 0; i < 16; ++i) out[i] = hex[pick_rand(16)];
+  return out;
+}
+
 // Role filter for replica selection (disaggregated prefill/decode):
 // kRoleAny = every replica (no roles configured); kRolePreferServe =
 // prefer both/decode replicas but fall back to the whole set (a prefill
@@ -2887,6 +3066,645 @@ static std::string sse_truncation_event() {
 // Proxies one request; returns true iff the client connection can be
 // reused for another request.
 // Decode-hop bookkeeping for the disaggregated two-hop flow: whether the
+// ---------------------------------------------------------------------------
+// Cross-hop tracing: per-request fragment recording, a ring of recent
+// fragments (/debug/traces), tail-sampled OTLP/HTTP-JSON export, and the
+// waterfall stitcher behind /debug/trace/<id>. Mirrors server/tracing.py
+// (Trace / TraceStore / OtlpExporter / stitch_waterfall) — the python
+// module is the executable spec; trace_vectors.json pins the pure parts.
+// ---------------------------------------------------------------------------
+
+struct TraceSpanRec {
+  std::string name;
+  double start_ms = 0.0;
+  double duration_ms = -1.0;  // < 0 = still open (serialized as null)
+  std::string span_id;
+  std::string parent_span_id;
+  std::string replica;        // empty = omitted
+  int attempts = 0;           // 0 = omitted
+};
+
+struct TraceEventRec {
+  std::string name;
+  double t_ms = 0.0;
+  std::string replica;        // empty = omitted
+};
+
+// One process-local fragment of a distributed trace: this router's window
+// (span_id) in the W3C trace (trace_id), parented under whatever hop span
+// the caller advertised via traceparent. Single-threaded within the
+// owning connection worker — no lock needed until it lands in the ring.
+struct TraceFrag {
+  std::string trace_id;
+  std::string span_id;
+  std::string parent_span_id;
+  std::string request_id;
+  std::string model;
+  std::string status;      // ok | http_<code> | error; empty = unfinished
+  std::string tracestate;  // validated passthrough (rides every hop head)
+  bool sampled = true;
+  double started_wall = 0.0;  // unix seconds (aligns fragments on stitch)
+  std::chrono::steady_clock::time_point t0{};
+  double e2e_ms = -1.0;       // < 0 = unfinished (serialized as null)
+  std::vector<TraceSpanRec> spans;
+  std::vector<TraceEventRec> events;
+};
+
+static double frag_ms_at(const TraceFrag& f,
+                         std::chrono::steady_clock::time_point t) {
+  return std::chrono::duration<double, std::milli>(t - f.t0).count();
+}
+
+static void frag_add_span(TraceFrag* f, const char* name,
+                          std::chrono::steady_clock::time_point start,
+                          std::chrono::steady_clock::time_point end,
+                          const std::string& span_id,
+                          const std::string& replica, int attempts = 0) {
+  if (!f) return;
+  TraceSpanRec s;
+  s.name = name;
+  s.start_ms = std::max(0.0, frag_ms_at(*f, start));
+  s.duration_ms = std::max(
+      0.0, std::chrono::duration<double, std::milli>(end - start).count());
+  s.span_id = span_id;
+  s.parent_span_id = f->span_id;  // hop spans parent under the fragment root
+  s.replica = replica;
+  s.attempts = attempts;
+  f->spans.push_back(std::move(s));
+}
+
+static void frag_event(TraceFrag* f, const char* name,
+                       const std::string& replica = std::string()) {
+  if (!f) return;
+  TraceEventRec e;
+  e.name = name;
+  e.t_ms = std::max(0.0, frag_ms_at(*f, std::chrono::steady_clock::now()));
+  e.replica = replica;
+  f->events.push_back(std::move(e));
+}
+
+// Trace.to_dict() shape — byte-level key parity with the python fragment
+// so one stitcher (either language) assembles fragments from both.
+static JsonPtr frag_to_json(const TraceFrag& f) {
+  auto root = Json::make(Json::Type::Object);
+  root->set("id", Json::of_string(f.request_id));
+  root->set("trace_id", Json::of_string(f.trace_id));
+  root->set("span_id", Json::of_string(f.span_id));
+  root->set("parent_span_id", Json::of_string(f.parent_span_id));
+  root->set("component", Json::of_string("native_router"));
+  root->set("model", Json::of_string(f.model));
+  root->set("started", Json::of_number(f.started_wall));
+  root->set("status", f.status.empty() ? Json::make(Json::Type::Null)
+                                       : Json::of_string(f.status));
+  root->set("e2e_ms", f.e2e_ms < 0 ? Json::make(Json::Type::Null)
+                                   : Json::of_number(f.e2e_ms));
+  auto spans = Json::make(Json::Type::Array);
+  for (const TraceSpanRec& s : f.spans) {
+    auto sp = Json::make(Json::Type::Object);
+    sp->set("name", Json::of_string(s.name));
+    sp->set("start_ms", Json::of_number(s.start_ms));
+    sp->set("duration_ms", s.duration_ms < 0
+                               ? Json::make(Json::Type::Null)
+                               : Json::of_number(s.duration_ms));
+    if (!s.span_id.empty()) sp->set("span_id", Json::of_string(s.span_id));
+    if (!s.parent_span_id.empty())
+      sp->set("parent_span_id", Json::of_string(s.parent_span_id));
+    if (!s.replica.empty()) sp->set("replica", Json::of_string(s.replica));
+    if (s.attempts > 0) sp->set("attempts", Json::of_number(s.attempts));
+    spans->arr.push_back(sp);
+  }
+  root->set("spans", spans);
+  auto events = Json::make(Json::Type::Array);
+  for (const TraceEventRec& e : f.events) {
+    auto ev = Json::make(Json::Type::Object);
+    ev->set("name", Json::of_string(e.name));
+    ev->set("t_ms", Json::of_number(e.t_ms));
+    if (!e.replica.empty()) ev->set("replica", Json::of_string(e.replica));
+    events->arr.push_back(ev);
+  }
+  root->set("events", events);
+  return root;
+}
+
+// ring of recently completed fragments (GET /debug/traces)
+static std::mutex g_trace_ring_mu;
+static std::deque<TraceFrag> g_trace_ring;
+static const size_t kTraceRingCap = 256;
+
+static void trace_ring_add(const TraceFrag& f) {
+  std::lock_guard<std::mutex> lock(g_trace_ring_mu);
+  g_trace_ring.push_back(f);
+  while (g_trace_ring.size() > kTraceRingCap) g_trace_ring.pop_front();
+}
+
+// export accounting — same families/labels as server/metrics.py
+// trace_export_metrics(): a trace that is not exported is COUNTED dropped
+// (by reason), never silently discarded
+static std::atomic<long> g_trace_exported_ok_total{0};
+static std::atomic<long> g_trace_exported_error_total{0};
+static std::mutex g_trace_dropped_mu;
+static std::map<std::string, long> g_trace_dropped_by_reason;
+
+static void trace_count_dropped(const std::string& reason) {
+  std::lock_guard<std::mutex> lock(g_trace_dropped_mu);
+  ++g_trace_dropped_by_reason[reason];
+}
+
+static bool trace_is_multi_hop_event(const std::string& n) {
+  return n == "hedge_launch" || n == "hedge_won" || n == "stream_resume" ||
+         n == "handoff" || n == "handoff_declined" ||
+         n == "handoff_fallback_colocated" || n == "affinity_kv_pull" ||
+         n == "affinity_filter_deny" || n == "retry" || n == "failover";
+}
+
+static bool frag_is_multi_hop(const TraceFrag& f) {
+  for (const TraceEventRec& e : f.events)
+    if (trace_is_multi_hop_event(e.name)) return true;
+  for (const TraceSpanRec& s : f.spans)
+    if (s.attempts > 1) return true;
+  return false;
+}
+
+// OTLP/HTTP-JSON resourceSpans payload (mirrors tracing.otlp_payload):
+// each fragment becomes its root span plus one span per recorded window
+static JsonPtr trace_otlp_payload(const std::vector<TraceFrag>& batch) {
+  auto spans = Json::make(Json::Type::Array);
+  auto nanos_str = [](double ns) {
+    char buf[32];
+    snprintf(buf, sizeof buf, "%lld", static_cast<long long>(ns));
+    return std::string(buf);
+  };
+  auto attr = [](const std::string& k, const std::string& v) {
+    auto a = Json::make(Json::Type::Object);
+    a->set("key", Json::of_string(k));
+    auto val = Json::make(Json::Type::Object);
+    val->set("stringValue", Json::of_string(v));
+    a->set("value", val);
+    return a;
+  };
+  for (const TraceFrag& f : batch) {
+    double base_ns = f.started_wall * 1e9;
+    auto root = Json::make(Json::Type::Object);
+    root->set("traceId", Json::of_string(f.trace_id));
+    root->set("spanId", Json::of_string(f.span_id));
+    root->set("parentSpanId", Json::of_string(f.parent_span_id));
+    root->set("name", Json::of_string("native_router"));
+    root->set("kind", Json::of_number(2));  // SPAN_KIND_SERVER
+    root->set("startTimeUnixNano", Json::of_string(nanos_str(base_ns)));
+    root->set("endTimeUnixNano",
+              Json::of_string(nanos_str(
+                  base_ns + std::max(0.0, f.e2e_ms) * 1e6)));
+    auto rattrs = Json::make(Json::Type::Array);
+    rattrs->arr.push_back(attr("llmk.request_id", f.request_id));
+    rattrs->arr.push_back(attr("llmk.model", f.model));
+    rattrs->arr.push_back(attr("llmk.status", f.status));
+    root->set("attributes", rattrs);
+    spans->arr.push_back(root);
+    for (const TraceSpanRec& s : f.spans) {
+      double start_ns = base_ns + s.start_ms * 1e6;
+      auto sp = Json::make(Json::Type::Object);
+      sp->set("traceId", Json::of_string(f.trace_id));
+      sp->set("spanId", Json::of_string(
+                            s.span_id.empty() ? gen_span_id() : s.span_id));
+      sp->set("parentSpanId",
+              Json::of_string(s.parent_span_id.empty() ? f.span_id
+                                                       : s.parent_span_id));
+      sp->set("name", Json::of_string(s.name));
+      sp->set("kind", Json::of_number(1));  // SPAN_KIND_INTERNAL
+      sp->set("startTimeUnixNano", Json::of_string(nanos_str(start_ns)));
+      sp->set("endTimeUnixNano",
+              Json::of_string(nanos_str(
+                  start_ns + std::max(0.0, s.duration_ms) * 1e6)));
+      auto sattrs = Json::make(Json::Type::Array);
+      if (!s.replica.empty())
+        sattrs->arr.push_back(attr("replica", s.replica));
+      if (s.attempts > 0)
+        sattrs->arr.push_back(attr("attempts",
+                                   std::to_string(s.attempts)));
+      sp->set("attributes", sattrs);
+      spans->arr.push_back(sp);
+    }
+  }
+  auto scope = Json::make(Json::Type::Object);
+  auto scope_name = Json::make(Json::Type::Object);
+  scope_name->set("name", Json::of_string("llmk.tracing"));
+  scope->set("scope", scope_name);
+  scope->set("spans", spans);
+  auto scope_spans = Json::make(Json::Type::Array);
+  scope_spans->arr.push_back(scope);
+  auto resource = Json::make(Json::Type::Object);
+  auto res_attrs = Json::make(Json::Type::Array);
+  res_attrs->arr.push_back(attr("service.name", "llkt-router"));
+  resource->set("attributes", res_attrs);
+  auto rs = Json::make(Json::Type::Object);
+  rs->set("resource", resource);
+  rs->set("scopeSpans", scope_spans);
+  auto rs_arr = Json::make(Json::Type::Array);
+  rs_arr->arr.push_back(rs);
+  auto payload = Json::make(Json::Type::Object);
+  payload->set("resourceSpans", rs_arr);
+  return payload;
+}
+
+// background exporter: bounded queue + one worker thread batching POSTs.
+// Enqueue is non-blocking and never fails the serving path — a full queue
+// counts a queue_full drop instead of stalling.
+static std::mutex g_trace_q_mu;
+static std::condition_variable g_trace_q_cv;
+static std::deque<TraceFrag> g_trace_q;
+static const size_t kTraceQueueMax = 512;
+
+static bool trace_otlp_post(const Config& cfg, const std::string& body) {
+  auto u = parse_url(cfg.tracing.endpoint);
+  if (!u) return false;
+  int fd = connect_to(u->host, u->port, cfg.probe_timeout_s,
+                      cfg.probe_timeout_s);
+  if (fd < 0) return false;
+  std::ostringstream out;
+  out << "POST " << (u->path.empty() ? "/" : u->path) << " HTTP/1.1\r\n"
+      << "Host: " << u->host << ":" << u->port << "\r\n"
+      << "Content-Type: application/json\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: close\r\n\r\n";
+  bool ok = send_all(fd, out.str()) && send_all(fd, body);
+  if (ok) {
+    SockReader r(fd);
+    r.set_deadline(std::chrono::steady_clock::now() +
+                   std::chrono::seconds(cfg.probe_timeout_s + 3));
+    ResponseHead head;
+    ok = read_response_head(r, head) && head.status >= 200 &&
+         head.status < 300;
+  }
+  ::close(fd);
+  return ok;
+}
+
+// drain + POST one batch; returns spans attempted (test seam kept small:
+// the worker loop below is the only caller besides shutdown drain)
+static void trace_export_batch(const Config& cfg,
+                               std::vector<TraceFrag>& batch) {
+  if (batch.empty()) return;
+  long n = 0;
+  for (const TraceFrag& f : batch)
+    n += 1 + static_cast<long>(f.spans.size());
+  std::string body = trace_otlp_payload(batch)->dump();
+  if (trace_otlp_post(cfg, body)) {
+    g_trace_exported_ok_total.fetch_add(n, std::memory_order_relaxed);
+  } else {
+    g_trace_exported_error_total.fetch_add(n, std::memory_order_relaxed);
+    logf(cfg, "otlp export failed: %ld spans to %s", n,
+         cfg.tracing.endpoint.c_str());
+  }
+  batch.clear();
+}
+
+// tail-sampling decision + enqueue for a finished fragment. Always lands
+// in the /debug/traces ring first — export is an add-on, never a filter
+// on local observability.
+static void trace_finish(const Config& cfg, TraceFrag& f,
+                         const std::string& status) {
+  f.status = status;
+  f.e2e_ms =
+      std::max(0.0, frag_ms_at(f, std::chrono::steady_clock::now()));
+  trace_ring_add(f);
+  if (cfg.tracing.endpoint.empty()) {
+    trace_count_dropped("disabled");
+    return;
+  }
+  bool error = f.status == "error" ||
+               f.status.compare(0, 6, "http_5") == 0;
+  double rand01 = static_cast<double>(pick_rand(1000000)) / 1e6;
+  std::string reason;
+  bool keep = trace_tail_decision(error, f.e2e_ms, cfg.tracing.tail_slow_ms,
+                                  frag_is_multi_hop(f), cfg.tracing.sample,
+                                  rand01, &reason);
+  if (!keep) {
+    trace_count_dropped(reason);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_trace_q_mu);
+    if (g_trace_q.size() >= kTraceQueueMax) {
+      trace_count_dropped("queue_full");
+      return;
+    }
+    g_trace_q.push_back(f);
+  }
+  g_trace_q_cv.notify_one();
+}
+
+// ---------------------------------------------------------------------------
+// /debug/traces + /debug/trace/<id>: local snapshot, replica pulls, and
+// the waterfall stitcher (mirrors tracing.stitch_waterfall — operates on
+// generic fragment JSON so python-engine fragments stitch seamlessly)
+// ---------------------------------------------------------------------------
+
+static std::string query_param(const std::string& target,
+                               const std::string& key) {
+  size_t q = target.find('?');
+  if (q == std::string::npos) return "";
+  std::string qs = target.substr(q + 1);
+  size_t p = 0;
+  while (p <= qs.size()) {
+    size_t amp = qs.find('&', p);
+    std::string kv = qs.substr(
+        p, amp == std::string::npos ? std::string::npos : amp - p);
+    size_t eq = kv.find('=');
+    if (eq != std::string::npos && kv.compare(0, eq, key) == 0)
+      return kv.substr(eq + 1);
+    if (amp == std::string::npos) break;
+    p = amp + 1;
+  }
+  return "";
+}
+
+// most-recent-first fragment dicts, optionally filtered by id (matches
+// either the request id or the W3C trace id — stitching pulls use the
+// trace id) — TraceStore.snapshot parity
+static std::vector<JsonPtr> trace_snapshot(const std::string& id,
+                                           int limit) {
+  std::vector<TraceFrag> frags;
+  {
+    std::lock_guard<std::mutex> lock(g_trace_ring_mu);
+    frags.assign(g_trace_ring.begin(), g_trace_ring.end());
+  }
+  std::vector<JsonPtr> out;
+  for (auto it = frags.rbegin(); it != frags.rend(); ++it) {
+    if (!id.empty() && id != it->request_id && id != it->trace_id) continue;
+    out.push_back(frag_to_json(*it));
+    if (static_cast<int>(out.size()) >= std::max(1, limit)) break;
+  }
+  return out;
+}
+
+// GET <replica>/debug/traces?id=<tid> — same connect/read pattern as
+// scrape_metrics; a failed pull degrades the stitch, never errors it
+static bool trace_pull_replica(const Config& cfg, const Url& u,
+                               const std::string& tid, JsonPtr* out) {
+  int fd = connect_to(u.host, u.port, cfg.probe_timeout_s,
+                      cfg.probe_timeout_s);
+  if (fd < 0) return false;
+  std::ostringstream req;
+  req << "GET " << (u.path == "/" ? "" : u.path)
+      << "/debug/traces?id=" << tid << " HTTP/1.1\r\n"
+      << "Host: " << u.host << ":" << u.port << "\r\n"
+      << "Connection: close\r\n\r\n";
+  bool ok = send_all(fd, req.str());
+  std::string body;
+  if (ok) {
+    SockReader r(fd);
+    r.set_deadline(std::chrono::steady_clock::now() +
+                   std::chrono::seconds(cfg.probe_timeout_s + 3));
+    ResponseHead head;
+    ok = read_response_head(r, head) && head.status == 200 &&
+         read_body_text(r, head, &body);
+  }
+  ::close(fd);
+  if (!ok) return false;
+  JsonPtr doc = JsonParser::parse(body);
+  if (!doc) return false;
+  *out = doc;
+  return true;
+}
+
+static double json_num(const Json* o, const char* k, double d) {
+  const Json* v = o ? o->get(k) : nullptr;
+  return v && v->type == Json::Type::Number ? v->number : d;
+}
+
+static std::string json_str(const Json* o, const char* k,
+                            const std::string& d = std::string()) {
+  const Json* v = o ? o->get(k) : nullptr;
+  return v && v->is_string() ? v->str : d;
+}
+
+// assemble per-process fragments into one waterfall tree (the JSON twin
+// of tracing.stitch_waterfall: same keys, same orphan semantics — a
+// correctly propagated multi-hop flow has orphans == [])
+static JsonPtr trace_stitch(const std::string& trace_id,
+                            const std::vector<JsonPtr>& fragments) {
+  // filter + dedupe (the local ring and a replica pull can both return
+  // the same fragment)
+  std::vector<const Json*> uniq;
+  std::vector<std::string> seen;
+  for (const JsonPtr& fp : fragments) {
+    const Json* f = fp.get();
+    if (!f || !f->is_object()) continue;
+    if (json_str(f, "trace_id") != trace_id && json_str(f, "id") != trace_id)
+      continue;
+    std::string key = json_str(f, "span_id");
+    if (key.empty())
+      key = "rid|" + json_str(f, "id") + "|" + json_str(f, "component");
+    bool dup = false;
+    for (const std::string& s : seen)
+      if (s == key) { dup = true; break; }
+    if (dup) continue;
+    seen.push_back(key);
+    uniq.push_back(f);
+  }
+  auto out = Json::make(Json::Type::Object);
+  out->set("trace_id", Json::of_string(trace_id));
+  if (uniq.empty()) {
+    out->set("fragments", Json::of_number(0));
+    out->set("hops", Json::of_number(0));
+    out->set("orphans", Json::make(Json::Type::Array));
+    out->set("spans", Json::make(Json::Type::Array));
+    out->set("annotations", Json::make(Json::Type::Object));
+    return out;
+  }
+
+  double base_wall = 0.0;
+  bool first = true;
+  for (const Json* f : uniq) {
+    double w = json_num(f, "started", 0.0);
+    if (first || w < base_wall) base_wall = w;
+    first = false;
+  }
+
+  std::vector<JsonPtr> nodes;      // insertion order
+  std::map<std::string, JsonPtr> by_id;
+  int synth = 0;
+  auto add_node = [&](std::string sid, const std::string& parent,
+                      const std::string& name, const std::string& component,
+                      double start_ms, const Json* duration) -> JsonPtr {
+    if (sid.empty() || by_id.count(sid)) {
+      ++synth;
+      sid = (sid.empty() ? std::string("anon") : sid) + "~" +
+            std::to_string(synth);
+    }
+    auto node = Json::make(Json::Type::Object);
+    node->set("span_id", Json::of_string(sid));
+    node->set("parent_span_id", Json::of_string(parent));
+    node->set("name", Json::of_string(name));
+    node->set("component", Json::of_string(component));
+    node->set("start_ms", Json::of_number(std::max(0.0, start_ms)));
+    node->set("duration_ms",
+              duration && duration->type == Json::Type::Number
+                  ? Json::of_number(duration->number)
+                  : Json::make(Json::Type::Null));
+    nodes.push_back(node);
+    by_id[sid] = node;
+    return node;
+  };
+
+  long ann_resumes = 0, ann_redirects = 0, ann_attempts = 0;
+  bool ann_hedge = false, ann_handoff = false;
+  for (const Json* f : uniq) {
+    double f_start = (json_num(f, "started", 0.0) - base_wall) * 1000.0;
+    std::string component = json_str(f, "component", "fragment");
+    JsonPtr frag_root = add_node(
+        json_str(f, "span_id"), json_str(f, "parent_span_id"),
+        component.empty() ? "fragment" : component,
+        json_str(f, "component"), f_start, f->get("e2e_ms"));
+    frag_root->set("request_id", Json::of_string(json_str(f, "id")));
+    frag_root->set("model", Json::of_string(json_str(f, "model")));
+    frag_root->set("status", Json::of_string(json_str(f, "status")));
+    std::string root_sid = json_str(frag_root.get(), "span_id");
+    if (const Json* sps = f->get("spans");
+        sps && sps->type == Json::Type::Array) {
+      for (const auto& sp : sps->arr) {
+        if (!sp->is_object()) continue;
+        std::string parent = json_str(sp.get(), "parent_span_id");
+        if (parent.empty()) parent = root_sid;
+        JsonPtr node = add_node(
+            json_str(sp.get(), "span_id"), parent,
+            json_str(sp.get(), "name", "span"), json_str(f, "component"),
+            f_start + json_num(sp.get(), "start_ms", 0.0),
+            sp->get("duration_ms"));
+        // meta keys (replica, attempts, chip_ms, ...) ride through
+        for (const auto& kv : sp->obj) {
+          const std::string& k = kv.first;
+          if (k == "name" || k == "start_ms" || k == "duration_ms" ||
+              k == "span_id" || k == "parent_span_id")
+            continue;
+          node->set(k, kv.second);
+        }
+        ann_attempts = std::max(
+            ann_attempts,
+            static_cast<long>(json_num(sp.get(), "attempts", 0.0)));
+      }
+    }
+    if (const Json* evs = f->get("events");
+        evs && evs->type == Json::Type::Array) {
+      for (const auto& ev : evs->arr) {
+        std::string name = json_str(ev.get(), "name");
+        if (name == "stream_resume")
+          ++ann_resumes;
+        else if (name == "hedge_launch" || name == "hedge_won")
+          ann_hedge = true;
+        else if (name == "handoff" || name == "handoff_declined" ||
+                 name == "handoff_fallback_colocated")
+          ann_handoff = true;
+        else if (name == "affinity_kv_pull" ||
+                 name == "affinity_filter_deny")
+          ++ann_redirects;
+      }
+    }
+  }
+
+  // parent linking: children arrays on nodes, orphans = known-parent-id
+  // missing from the fragment set
+  for (const JsonPtr& n : nodes)
+    n->set("children", Json::make(Json::Type::Array));
+  std::vector<JsonPtr> roots;
+  auto orphans = Json::make(Json::Type::Array);
+  for (const JsonPtr& n : nodes) {
+    std::string parent = json_str(n.get(), "parent_span_id");
+    auto it = parent.empty() ? by_id.end() : by_id.find(parent);
+    if (it != by_id.end()) {
+      get_mut(it->second.get(), "children")->arr.push_back(n);
+    } else {
+      if (!parent.empty())
+        orphans->arr.push_back(
+            Json::of_string(json_str(n.get(), "span_id")));
+      roots.push_back(n);
+    }
+  }
+  auto by_start = [](const JsonPtr& a, const JsonPtr& b) {
+    return json_num(a.get(), "start_ms", 0.0) <
+           json_num(b.get(), "start_ms", 0.0);
+  };
+  for (const JsonPtr& n : nodes) {
+    Json* ch = get_mut(n.get(), "children");
+    std::stable_sort(ch->arr.begin(), ch->arr.end(), by_start);
+  }
+  std::stable_sort(roots.begin(), roots.end(), by_start);
+
+  auto flat = Json::make(Json::Type::Array);
+  std::function<void(const JsonPtr&, int)> walk =
+      [&](const JsonPtr& node, int depth) {
+        auto row = Json::make(Json::Type::Object);
+        for (const auto& kv : node->obj)
+          if (kv.first != "children") row->set(kv.first, kv.second);
+        row->set("depth", Json::of_number(depth));
+        flat->arr.push_back(row);
+        for (const JsonPtr& child : get_mut(node.get(), "children")->arr)
+          walk(child, depth + 1);
+      };
+  for (const JsonPtr& r : roots) walk(r, 0);
+
+  bool have_e2e = false;
+  double e2e = 0.0;
+  for (const JsonPtr& r : roots) {
+    if (!json_str(r.get(), "parent_span_id").empty()) continue;
+    const Json* d = r->get("duration_ms");
+    if (d && d->type == Json::Type::Number) {
+      e2e = have_e2e ? std::max(e2e, d->number) : d->number;
+      have_e2e = true;
+    }
+  }
+
+  out->set("fragments", Json::of_number(static_cast<double>(uniq.size())));
+  out->set("hops", Json::of_number(static_cast<double>(uniq.size())));
+  out->set("orphans", orphans);
+  out->set("e2e_ms", have_e2e ? Json::of_number(e2e)
+                              : Json::make(Json::Type::Null));
+  auto ann = Json::make(Json::Type::Object);
+  ann->set("resumes", Json::of_number(static_cast<double>(ann_resumes)));
+  ann->set("hedge", Json::of_bool(ann_hedge));
+  ann->set("handoff", Json::of_bool(ann_handoff));
+  ann->set("redirects",
+           Json::of_number(static_cast<double>(ann_redirects)));
+  ann->set("attempts", Json::of_number(static_cast<double>(ann_attempts)));
+  out->set("annotations", ann);
+  out->set("spans", flat);
+  auto tree = Json::make(Json::Type::Array);
+  for (const JsonPtr& r : roots) tree->arr.push_back(r);
+  out->set("tree", tree);
+  return out;
+}
+
+// full waterfall for one trace id: local fragments + a pull from every
+// replica's /debug/traces ring (the engine-side fragments)
+static JsonPtr trace_waterfall_json(const Config& cfg,
+                                    const std::string& trace_id,
+                                    bool* found) {
+  std::vector<JsonPtr> fragments = trace_snapshot(trace_id, 50);
+  std::vector<std::pair<std::string, int>> pulled;
+  for (const auto& kv : cfg.models) {
+    for (const Url& u : kv.second) {
+      bool dup = false;
+      for (const auto& hp : pulled)
+        if (hp.first == u.host && hp.second == u.port) { dup = true; break; }
+      if (dup) continue;
+      pulled.emplace_back(u.host, u.port);
+      JsonPtr doc;
+      if (!trace_pull_replica(cfg, u, trace_id, &doc)) continue;
+      const Json* arr = doc.get();
+      if (arr->type == Json::Type::Object) {
+        const Json* t = arr->get("traces");
+        if (t) arr = t;
+      }
+      if (arr->type != Json::Type::Array) continue;
+      for (const auto& item : arr->arr)
+        if (item->is_object()) fragments.push_back(item);
+    }
+  }
+  JsonPtr stitched = trace_stitch(trace_id, fragments);
+  *found = json_num(stitched.get(), "fragments", 0.0) > 0;
+  return stitched;
+}
+
 // prefill ticket offered digests (adopted=0 then counts as a reprefill)
 // and when the decode hop started (llm_handoff_seconds).
 struct HandoffCtx {
@@ -2908,10 +3726,18 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                           bool hedge_ok = true,
                           const std::string& hop_extra = std::string(),
                           HandoffCtx* hctx = nullptr,
-                          bool* served_out = nullptr) {
+                          bool* served_out = nullptr,
+                          TraceFrag* trace = nullptr) {
   const std::vector<Url>& replicas = *cfg.find(model);
   if (served_out) *served_out = true;
   const auto t0 = std::chrono::steady_clock::now();
+  // hop span id of the most recent build_head (fresh per upstream send, so
+  // every leg — failover, hedge, resume, handoff — is its own child span
+  // in the upstream fragment's eyes)
+  std::string last_hop_sid;
+  auto rep_name = [](const Url* u) {
+    return u ? u->host + ":" + std::to_string(u->port) : std::string();
+  };
   const std::string rid_header =
       std::string(kRequestIdHeader) + ": " + rid + "\r\n";
   auto ms_since = [](std::chrono::steady_clock::time_point a) {
@@ -2961,6 +3787,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
              simple_response(504, "Gateway Timeout", "application/json", body,
                              req.keep_alive, rid_header));
     g_slo.observe(504, -1.0);
+    if (trace) trace->status = "http_504";
     jlog_request(cfg, rid, model, "", 504, 0.0, 0.0, ms_since(t0));
     return req.keep_alive;
   };
@@ -3082,6 +3909,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                    << "X-LLMK-Handoff-Tenant: "
                    << qos_tenant_of(doc, model) << "\r\n";
                 aff_pull_extra = px.str();
+                frag_event(trace, "affinity_kv_pull", pull);
               }
             }
           }
@@ -3120,7 +3948,19 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
           n == "x-llmk-handoff-digests" || n == "x-llmk-handoff-tenant" ||
           n == "x-llmk-handoff-seed")
         continue;
+      // re-minted below with a per-hop span id (the client's raw value was
+      // already reconciled at the edge)
+      if (n == "traceparent" || n == "tracestate") continue;
       out << kv.first << ": " << kv.second << "\r\n";
+    }
+    if (trace) {
+      last_hop_sid = gen_span_id();
+      out << "Traceparent: "
+          << trace_format_traceparent(trace->trace_id, last_hop_sid,
+                                      trace->sampled)
+          << "\r\n";
+      if (!trace->tracestate.empty())
+        out << "Tracestate: " << trace->tracestate << "\r\n";
     }
     out << kRequestIdHeader << ": " << rid << "\r\n";
     out << kPriorityHeader << ": " << priority << "\r\n";
@@ -3223,9 +4063,11 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       }
       ResponseHead phead;
       std::optional<SockReader> pr;
+      const auto t_p0 = std::chrono::steady_clock::now();
       bool sent =
           send_all(pfd, build_head(*pt, "X-LLMK-Handoff: ticket\r\n")) &&
           (req.body.empty() || send_all(pfd, req.body));
+      const std::string p_sid = last_hop_sid;  // this leg's hop span id
       pr.emplace(pfd);
       if (!sent || !read_response_head(*pr, phead)) {
         ::close(pfd);
@@ -3268,6 +4110,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
           tkt_seed = std::to_string(static_cast<long>(sd->number));
         psrc = pt;
         have_ticket = true;
+        frag_add_span(trace, "handoff_prefill", t_p0,
+                      std::chrono::steady_clock::now(), p_sid, rep_name(pt),
+                      attempt + 1);
         break;
       }
       if (p_sse) {
@@ -3277,6 +4122,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
         pb.record_success();
         logf(cfg, "handoff declined %s: relaying from %s:%d", model.c_str(),
              pt->host.c_str(), pt->port);
+        frag_event(trace, "handoff_declined", rep_name(pt));
+        frag_add_span(trace, "connect", t_p0, std::chrono::steady_clock::now(),
+                      p_sid, rep_name(pt), attempt + 1);
         target = pt;
         health = ph;
         up = std::move(pr);
@@ -3308,20 +4156,23 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       HandoffCtx ctx;
       ctx.offered_digests = !tkt_digests.empty();
       ctx.t0 = std::chrono::steady_clock::now();
+      frag_event(trace, "handoff", rep_name(psrc));
       bool served = true;
       bool r = proxy_request(cfg, req, client_fd, client_ip, model, rid,
                              priority, /*hedge_ok=*/false, hx.str(), &ctx,
-                             &served);
+                             &served, trace);
       if (served) return r;
       g_handoff_fallback_total.fetch_add(1, std::memory_order_relaxed);
       logf(cfg, "handoff fallback_colocated %s: decode hop exhausted",
            model.c_str());
+      frag_event(trace, "handoff_fallback_colocated");
     } else if (!got_head) {
       // no prefill ticket at all (pool unroutable, or every prefill
       // replica refused): colocated fallback, counted
       g_handoff_fallback_total.fetch_add(1, std::memory_order_relaxed);
       logf(cfg, "handoff fallback_colocated %s: no prefill ticket",
            model.c_str());
+      frag_event(trace, "handoff_fallback_colocated");
     }
   }
 
@@ -3362,6 +4213,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       logf(cfg, "failover %s: %s:%d -> %s:%d", model.c_str(),
            prev->host.c_str(), prev->port, target->host.c_str(),
            target->port);
+      frag_event(trace, "failover", rep_name(target));
     }
     // connect-phase failovers beyond the first attempt draw from the
     // per-model retry budget; an exhausted budget sheds explicitly
@@ -3379,10 +4231,13 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                                body, req.keep_alive,
                                "Retry-After: 1\r\n" + rid_header));
       g_slo.observe(503, -1.0);
+      if (trace) trace->status = "http_503";
       jlog_request(cfg, rid, model, "", 503, ms_since(t0), 0.0, ms_since(t0));
       return req.keep_alive;
     }
+    if (attempt > 0) frag_event(trace, "retry", rep_name(target));
     attempted = true;
+    const auto t_att = std::chrono::steady_clock::now();
     health = &g_health.get(target->host, target->port);
     health->inflight.fetch_add(1, std::memory_order_relaxed);
     const std::string head_bytes = build_head(
@@ -3459,6 +4314,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       }
       breaker.record_success();
       got_head = true;
+      frag_add_span(trace, hctx ? "handoff_decode" : "connect", t_att,
+                    std::chrono::steady_clock::now(), last_hop_sid,
+                    rep_name(target), attempt + 1);
       break;
     }
     bool timed_out = up->timed_out();
@@ -3527,6 +4385,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                                "Retry-After: " + std::to_string(ra_s) +
                                    "\r\n" + rid_header));
       g_slo.observe(503, -1.0);
+      if (trace) trace->status = "http_503";
       jlog_request(cfg, rid, model, "", 503, ms_since(t0), 0.0, ms_since(t0));
       return req.keep_alive;
     }
@@ -3535,6 +4394,7 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
              simple_response(502, "Bad Gateway", "application/json", body,
                              req.keep_alive, rid_header));
     g_slo.observe(502, -1.0);
+    if (trace) trace->status = "http_502";
     jlog_request(cfg, rid, model,
                  target ? target->host + ":" + std::to_string(target->port)
                         : "",
@@ -3624,14 +4484,23 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
           logf(cfg, "hedge %s: %s:%d late, racing %s:%d", model.c_str(),
                target->host.c_str(), target->port, hr->host.c_str(),
                hr->port);
+          frag_event(trace, "hedge_launch", rep_name(hr));
+          const auto t_h0 = std::chrono::steady_clock::now();
           std::optional<SockReader> up2;
           ResponseHead head2;
           int fd2 = issue_to(*hr, std::string(), up2, &head2);
+          const std::string h_sid = last_hop_sid;  // the hedge leg's hop id
           if (fd2 < 0 || head2.status != 200) {
             // secondary never reached the race: fall back to the primary.
             // Only a transport failure feeds the breaker — a non-200
             // answer means the replica is alive but refused.
             if (fd2 >= 0) {
+              // the leg reached a replica (alive but refused): record its
+              // hop span so that replica's fragment keeps a parent in the
+              // stitched waterfall
+              frag_add_span(trace, "hedge", t_h0,
+                            std::chrono::steady_clock::now(), h_sid,
+                            rep_name(hr));
               ::close(fd2);
             } else {
               g_breakers.get(hr->host, hr->port)
@@ -3665,8 +4534,17 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                                                  std::memory_order_relaxed);
               logf(cfg, "hedge won %s: %s:%d", model.c_str(),
                    hr->host.c_str(), hr->port);
+              frag_add_span(trace, "hedge", t_h0,
+                            std::chrono::steady_clock::now(), h_sid,
+                            rep_name(hr));
+              frag_event(trace, "hedge_won", rep_name(hr));
             } else {
-              // deterministic primary preference when both land together
+              // deterministic primary preference when both land together;
+              // the losing leg still served — record its hop span so the
+              // loser replica's fragment has a parent in the stitch
+              frag_add_span(trace, "hedge", t_h0,
+                            std::chrono::steady_clock::now(), h_sid,
+                            rep_name(hr));
               ::close(fd2);
               hh->inflight.fetch_sub(1, std::memory_order_relaxed);
               g_hedged_primary_won_total.fetch_add(
@@ -3762,6 +4640,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       std::optional<SockReader> up2;
       ResponseHead head2;
       int fd2 = -1;
+      std::chrono::steady_clock::time_point t_r0{};
+      std::string r_sid;  // winning re-issue's hop span id
+      int r_used = 0;
       if (why.empty()) {
         std::string extra;
         if (journal.saw_data || !journal.tokens.empty()) {
@@ -3801,9 +4682,12 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
           }
           ++used;
           ++resumes;
+          r_used = used;
           ReplicaHealth* nh = &g_health.get(nt->host, nt->port);
           nh->inflight.fetch_add(1, std::memory_order_relaxed);
+          t_r0 = std::chrono::steady_clock::now();
           int fd = issue_to(*nt, extra, up2, &head2);
+          r_sid = last_hop_sid;
           if (fd < 0) {
             nh->inflight.fetch_sub(1, std::memory_order_relaxed);
             g_breakers.get(nt->host, nt->port)
@@ -3847,6 +4731,9 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
       logf(cfg, "stream resume %s -> %s:%d (prefix %zu tokens, echo %zu)",
            model.c_str(), nt->host.c_str(), nt->port, journal.tokens.size(),
            journal.echo_skip);
+      frag_add_span(trace, "resume", t_r0, std::chrono::steady_clock::now(),
+                    r_sid, rep_name(nt), r_used);
+      frag_event(trace, "stream_resume", rep_name(nt));
       target = nt;
       up = std::move(up2);
       up_fd = fd2;
@@ -3871,6 +4758,10 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                   first_at == std::chrono::steady_clock::time_point{}
                       ? -1.0
                       : ttfb_ms);
+    if (trace)
+      trace->status = head.status < 400
+                          ? "ok"
+                          : "http_" + std::to_string(head.status);
     jlog_request(cfg, rid, model,
                  target->host + ":" + std::to_string(target->port),
                  head.status, connect_ms, ttfb_ms, ms_since(t0));
@@ -3932,6 +4823,10 @@ static bool proxy_request(const Config& cfg, const Request& req, int client_fd,
                     : ttfb_ms);
   if (first_at != std::chrono::steady_clock::time_point{})
     outlier_observe(cfg, model, replicas, *target, ttfb_ms, false);
+  if (trace)
+    trace->status = head.status < 400
+                        ? "ok"
+                        : "http_" + std::to_string(head.status);
   jlog_request(cfg, rid, model,
                target->host + ":" + std::to_string(target->port),
                head.status, connect_ms, ttfb_ms, ms_since(t0));
@@ -4038,6 +4933,43 @@ static void handle_connection(const Config& cfg, int client_fd,
                                       req.keep_alive)) &&
              req.keep_alive;
       logf(cfg, "GET /debug/replicas -> 200");
+    } else if (path == "/debug/traces" && req.method == "GET") {
+      // this process's recent fragments (raw, unstitched) — what a peer
+      // router pulls while assembling a /debug/trace waterfall
+      std::string id = query_param(req.target, "id");
+      int limit = 50;
+      std::string ls = query_param(req.target, "limit");
+      if (!ls.empty()) limit = std::max(1, atoi(ls.c_str()));
+      auto arr = Json::make(Json::Type::Array);
+      for (JsonPtr& f : trace_snapshot(id, limit)) arr->arr.push_back(f);
+      keep = send_all(client_fd,
+                      simple_response(200, "OK", "application/json",
+                                      arr->dump(), req.keep_alive)) &&
+             req.keep_alive;
+      logf(cfg, "GET /debug/traces -> 200");
+    } else if (path.compare(0, 13, "/debug/trace/") == 0 &&
+               req.method == "GET") {
+      // stitched cross-hop waterfall: local fragments + a pull from every
+      // replica's own /debug/traces ring
+      std::string tid = path.substr(13);
+      bool found = false;
+      JsonPtr w = trace_waterfall_json(cfg, tid, &found);
+      if (found) {
+        keep = send_all(client_fd,
+                        simple_response(200, "OK", "application/json",
+                                        w->dump(), req.keep_alive)) &&
+               req.keep_alive;
+        logf(cfg, "GET /debug/trace -> 200 (stitched)");
+      } else {
+        auto err = Json::make(Json::Type::Object);
+        err->set("error", Json::of_string("trace_not_found"));
+        err->set("trace_id", Json::of_string(tid));
+        keep = send_all(client_fd,
+                        simple_response(404, "Not Found", "application/json",
+                                        err->dump(), req.keep_alive)) &&
+               req.keep_alive;
+        logf(cfg, "GET /debug/trace -> 404");
+      }
     } else if (path == "/metrics" && req.method == "GET") {
       SloTracker::Snap slo = g_slo.snapshot();
       double uptime_s = std::chrono::duration<double>(
@@ -4334,6 +5266,32 @@ static void handle_connection(const Config& cfg, int client_fd,
               << std::max(0.0, mono_s() - it->second.at) << "\n";
           }
       }
+      // tracing export accounting (same family names + HELP as
+      // server/metrics.py trace_export_metrics(); outcome=ok and
+      // reason=sampled_out pre-seeded like the python registry)
+      m << "# HELP llm_trace_spans_exported_total Spans handed to the "
+           "OTLP exporter by outcome (ok = accepted by the collector, "
+           "error = POST failed after the trace was already sampled in)\n"
+        << "# TYPE llm_trace_spans_exported_total counter\n"
+        << "llm_trace_spans_exported_total{outcome=\"ok\"} "
+        << g_trace_exported_ok_total.load(std::memory_order_relaxed) << "\n";
+      if (long ne = g_trace_exported_error_total.load(
+              std::memory_order_relaxed))
+        m << "llm_trace_spans_exported_total{outcome=\"error\"} " << ne
+          << "\n";
+      m << "# HELP llm_trace_dropped_total Finished traces not exported, "
+           "by reason (sampled_out = tail sampler's probabilistic drop of "
+           "a boring trace, queue_full = exporter backpressure, disabled "
+           "= no LLMK_OTLP_ENDPOINT)\n"
+        << "# TYPE llm_trace_dropped_total counter\n";
+      {
+        std::lock_guard<std::mutex> lock(g_trace_dropped_mu);
+        if (!g_trace_dropped_by_reason.count("sampled_out"))
+          m << "llm_trace_dropped_total{reason=\"sampled_out\"} 0\n";
+        for (const auto& kv : g_trace_dropped_by_reason)
+          m << "llm_trace_dropped_total{reason=\"" << prom_escape(kv.first)
+            << "\"} " << kv.second << "\n";
+      }
       keep = send_all(client_fd,
                       simple_response(200, "OK",
                                       "text/plain; version=0.0.4", m.str(),
@@ -4345,7 +5303,29 @@ static void handle_connection(const Config& cfg, int client_fd,
       bool adapter_not_found = false;
       std::string model =
           select_backend(cfg, req.body, &not_found, &adapter_not_found);
-      std::string rid = request_id_from(req);
+      // trace-context edge reconciliation (mirrors tracing.reconcile, pinned
+      // by tests/data/trace_vectors.json): a valid inbound traceparent is
+      // adopted, everything else gets a fresh trace; the request id is
+      // canonicalized against the trace so logs and spans correlate
+      TraceCtx tctx = trace_reconcile(req.headers.get("traceparent"),
+                                      req.headers.get("tracestate"),
+                                      req.headers.get("x-llmk-request-id"));
+      std::string rid =
+          tctx.request_id.empty() ? gen_request_id() : tctx.request_id;
+      TraceFrag frag;
+      frag.trace_id =
+          tctx.trace_id.empty() ? gen_request_id() : tctx.trace_id;
+      frag.span_id = gen_span_id();
+      frag.parent_span_id = tctx.parent_span_id;
+      frag.request_id = rid;
+      frag.model = model;
+      frag.sampled = tctx.sampled;
+      frag.tracestate = tctx.tracestate;
+      frag.started_wall = std::chrono::duration<double>(
+                              std::chrono::system_clock::now()
+                                  .time_since_epoch())
+                              .count();
+      frag.t0 = std::chrono::steady_clock::now();
       if (not_found || adapter_not_found) {
         std::string body =
             adapter_not_found
@@ -4361,6 +5341,7 @@ static void handle_connection(const Config& cfg, int client_fd,
                req.keep_alive;
         g_slo.observe(404, -1.0);
         jlog_request(cfg, rid, model, "", 404, 0.0, 0.0, 0.0);
+        trace_finish(cfg, frag, "http_404");
       } else {
         count_model_request(model);
         // --- edge QoS: tenant + priority are resolved for EVERY request
@@ -4413,6 +5394,7 @@ static void handle_connection(const Config& cfg, int client_fd,
                    req.keep_alive;
             g_slo.observe(429, -1.0);
             jlog_request(cfg, rid, model, "", 429, 0.0, 0.0, 0.0);
+            trace_finish(cfg, frag, "http_429");
             qos_shed = true;
           } else if (v.action == "degrade") {
             {
@@ -4437,9 +5419,13 @@ static void handle_connection(const Config& cfg, int client_fd,
             g_tenant_tokens[tenant] += charge;
           }
         }
-        if (!qos_shed)
+        if (!qos_shed) {
           keep = proxy_request(cfg, req, client_fd, client_ip, model, rid,
-                               priority, hedge_ok);
+                               priority, hedge_ok, std::string(), nullptr,
+                               nullptr, &frag);
+          trace_finish(cfg, frag,
+                       frag.status.empty() ? "error" : frag.status);
+        }
       }
     }
     if (!keep) break;
@@ -4535,6 +5521,20 @@ static void parse_affinity_config(const Json* a, AffinityCfg& out) {
   out.max_digests = std::max(1, out.max_digests);
   if (const Json* v = a->get("kv_fetch"); v && v->type == Json::Type::Bool)
     out.kv_fetch = v->boolean;
+}
+
+// "tracing" config block (same wire keys the Helm charts render into
+// router.json and server/router.py reads: otlpEndpoint/sample/tailSlowMs).
+// Propagation needs no config — this only switches on OTLP export.
+static void parse_tracing_config(const Json* t, TracingCfg& out) {
+  if (!t || !t->is_object()) return;
+  if (const Json* v = t->get("otlpEndpoint"); v && v->is_string())
+    out.endpoint = strip_copy(v->str);
+  if (const Json* v = t->get("sample"); v && v->type == Json::Type::Number)
+    out.sample = std::min(1.0, std::max(0.0, v->number));
+  if (const Json* v = t->get("tailSlowMs");
+      v && v->type == Json::Type::Number)
+    out.tail_slow_ms = std::max(0.0, v->number);
 }
 
 static void parse_qos_config(const Json* q, QosConfig& out) {
@@ -5140,6 +6140,136 @@ static int affinity_selftest(const std::string& file) {
   return failures ? 1 : 0;
 }
 
+// --trace-selftest FILE: drive the shared trace-context vectors
+// (tests/data/trace_vectors.json) against this implementation. The python
+// side runs the same file through server/tracing.py (tests/test_tracing.py)
+// — together they hold the two routers' propagation and tail sampling
+// byte-compatible.
+static int trace_selftest(const std::string& file) {
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "trace-selftest: cannot open %s\n", file.c_str());
+    return 1;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  JsonPtr root = JsonParser::parse(ss.str());
+  if (!root || !root->is_object()) {
+    fprintf(stderr, "trace-selftest: malformed vectors file\n");
+    return 1;
+  }
+  int checks = 0, failures = 0;
+  auto fail = [&](const std::string& what) {
+    fprintf(stderr, "trace-selftest: FAIL %s\n", what.c_str());
+    ++failures;
+  };
+  auto num = [](const Json* o, const char* k, double d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Number ? v->number : d;
+  };
+  auto str = [](const Json* o, const char* k,
+                const std::string& d) -> std::string {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->is_string() ? v->str : d;
+  };
+  auto flag = [](const Json* o, const char* k, bool d) {
+    const Json* v = o ? o->get(k) : nullptr;
+    return v && v->type == Json::Type::Bool ? v->boolean : d;
+  };
+
+  if (const Json* sec = root->get("parse");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string tag = "parse #" + std::to_string(i);
+      std::string tid, sid;
+      int flags = 0;
+      bool ok = trace_parse_traceparent(str(it.get(), "traceparent", ""),
+                                        &tid, &sid, &flags);
+      const Json* expect = it->get("expect");
+      bool want = expect && expect->is_object();
+      if (ok != want) {
+        fail(tag + (ok ? " adopted an invalid header"
+                       : " rejected a valid header"));
+        continue;
+      }
+      if (!ok) continue;
+      if (tid != str(expect, "trace_id", "")) fail(tag + " trace_id=" + tid);
+      if (sid != str(expect, "span_id", "")) fail(tag + " span_id=" + sid);
+      if (flags != static_cast<int>(num(expect, "flags", -1.0)))
+        fail(tag + " flags=" + std::to_string(flags));
+    }
+  }
+
+  if (const Json* sec = root->get("format");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string got = trace_format_traceparent(
+          str(it.get(), "trace_id", ""), str(it.get(), "span_id", ""),
+          flag(it.get(), "sampled", true));
+      if (got != str(it.get(), "expect", ""))
+        fail("format #" + std::to_string(i) + " = " + got);
+    }
+  }
+
+  if (const Json* sec = root->get("reconcile");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string tag = "reconcile #" + std::to_string(i);
+      std::string tp = str(it.get(), "traceparent", "");
+      std::string ts = str(it.get(), "tracestate", "");
+      std::string rid = str(it.get(), "request_id", "");
+      TraceCtx got = trace_reconcile(&tp, &ts, &rid);
+      const Json* e = it->get("expect");
+      if (got.trace_id != str(e, "trace_id", ""))
+        fail(tag + " trace_id=" + got.trace_id);
+      if (got.parent_span_id != str(e, "parent_span_id", ""))
+        fail(tag + " parent_span_id=" + got.parent_span_id);
+      if (got.sampled != flag(e, "sampled", true))
+        fail(tag + " sampled=" + std::to_string(got.sampled));
+      if (got.adopted != flag(e, "adopted", false))
+        fail(tag + " adopted=" + std::to_string(got.adopted));
+      if (got.reason != str(e, "reason", ""))
+        fail(tag + " reason=" + got.reason);
+      if (got.request_id != str(e, "request_id", ""))
+        fail(tag + " request_id=" + got.request_id);
+      if (got.tracestate != str(e, "tracestate", ""))
+        fail(tag + " tracestate=" + got.tracestate);
+    }
+  }
+
+  if (const Json* sec = root->get("sampler");
+      sec && sec->type == Json::Type::Array) {
+    int i = -1;
+    for (const auto& it : sec->arr) {
+      ++checks;
+      ++i;
+      std::string tag = "sampler #" + std::to_string(i);
+      std::string reason;
+      bool keep = trace_tail_decision(
+          flag(it.get(), "error", false), num(it.get(), "e2e_ms", 0.0),
+          num(it.get(), "slow_ms", 0.0), flag(it.get(), "multi_hop", false),
+          num(it.get(), "sample", 0.0), num(it.get(), "rand01", 0.0),
+          &reason);
+      const Json* e = it->get("expect");
+      if (keep != flag(e, "export", false))
+        fail(tag + " export=" + std::to_string(keep));
+      if (reason != str(e, "reason", "")) fail(tag + " reason=" + reason);
+    }
+  }
+
+  printf("trace-selftest: %d checks, %d failures\n", checks, failures);
+  return failures ? 1 : 0;
+}
+
 static bool load_config_json(const std::string& file, Config& cfg) {
   std::ifstream in(file);
   if (!in) {
@@ -5265,7 +6395,49 @@ static bool load_config_json(const std::string& file, Config& cfg) {
   parse_outlier_config(root->get("outlier_ejection"), cfg.outlier);
   parse_budget_config(root->get("retry_budget"), cfg.retry_budget);
   parse_affinity_config(root->get("prefix_affinity"), cfg.affinity);
+  parse_tracing_config(root->get("tracing"), cfg.tracing);
   return true;
+}
+
+// OTLP exporter worker: drains the tail-sampled queue in batches. Counted
+// in g_live_connections like the prober so main's drain loop waits for it;
+// wakes within ~500 ms of g_shutdown, flushing whatever is queued.
+extern std::atomic<int> g_shutdown;  // defined below with the signal handler
+static void trace_exporter_start(const Config& cfg) {
+  g_live_connections.fetch_add(1, std::memory_order_acquire);
+  std::thread([&cfg]() {
+    struct Live {
+      ~Live() { g_live_connections.fetch_sub(1, std::memory_order_release); }
+    } live;
+    std::vector<TraceFrag> batch;
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lock(g_trace_q_mu);
+        // wait_until on system_clock, not wait_for: the steady-clock
+        // path lowers to pthread_cond_clockwait, which the sanitizer
+        // runtimes shipped with this toolchain do not intercept, so
+        // TSan loses the unlock inside the wait and reports phantom
+        // double-locks on g_trace_q_mu
+        g_trace_q_cv.wait_until(
+            lock,
+            std::chrono::system_clock::now() + std::chrono::milliseconds(500),
+            [] { return !g_trace_q.empty() || g_shutdown.load(); });
+        while (!g_trace_q.empty() && batch.size() < 64) {
+          batch.push_back(std::move(g_trace_q.front()));
+          g_trace_q.pop_front();
+        }
+      }
+      trace_export_batch(cfg, batch);
+      if (g_shutdown) {
+        bool drained;
+        {
+          std::lock_guard<std::mutex> lock(g_trace_q_mu);
+          drained = g_trace_q.empty();
+        }
+        if (drained) break;
+      }
+    }
+  }).detach();
 }
 
 // "name=url[|url...],name2=url" — | separates replica URLs of one model
@@ -5372,7 +6544,15 @@ int main(int argc, char** argv) {
       1, static_cast<int>(env_double("LLMK_HANDOFF_RETRIES",
                                      cfg.handoff_retries)));
   std::string config_file, models_inline, adapters_inline, qos_selftest_file,
-      outlier_selftest_file, affinity_selftest_file;
+      outlier_selftest_file, affinity_selftest_file, trace_selftest_file;
+  // tracing export knobs share the python router's env vars; the config
+  // file's "tracing" block overrides (propagation itself is always on)
+  if (const char* oe = getenv("LLMK_OTLP_ENDPOINT"); oe && *oe)
+    cfg.tracing.endpoint = strip_copy(oe);
+  cfg.tracing.sample = std::min(
+      1.0, std::max(0.0, env_double("LLMK_TRACE_SAMPLE", cfg.tracing.sample)));
+  cfg.tracing.tail_slow_ms = std::max(
+      0.0, env_double("LLMK_SLOW_REQUEST_MS", cfg.tracing.tail_slow_ms));
   // gray-failure knobs share the python router's env vars (JSON blocks in
   // LLMK_OUTLIER / LLMK_RETRY_BUDGET); config-file keys override
   if (const char* oj = getenv("LLMK_OUTLIER"); oj && *oj)
@@ -5479,6 +6659,10 @@ int main(int argc, char** argv) {
       const char* v = next();
       if (!v) return 2;
       affinity_selftest_file = v;
+    } else if (a == "--trace-selftest") {
+      const char* v = next();
+      if (!v) return 2;
+      trace_selftest_file = v;
     } else {
       fprintf(stderr,
               "usage: llkt-router (--config FILE | --models n=url|url2,...) "
@@ -5491,7 +6675,8 @@ int main(int argc, char** argv) {
               "[--resume-attempts N] [--hedge-ms MS] "
               "[--qos-selftest VECTORS_JSON] "
               "[--outlier-selftest VECTORS_JSON] "
-              "[--affinity-selftest VECTORS_JSON]\n");
+              "[--affinity-selftest VECTORS_JSON] "
+              "[--trace-selftest VECTORS_JSON]\n");
       return 2;
     }
   }
@@ -5504,6 +6689,8 @@ int main(int argc, char** argv) {
     return outlier_selftest(outlier_selftest_file);
   if (!affinity_selftest_file.empty())
     return affinity_selftest(affinity_selftest_file);
+  if (!trace_selftest_file.empty())
+    return trace_selftest(trace_selftest_file);
 
   if (!config_file.empty()) {
     if (!load_config_json(config_file, cfg)) return 1;
@@ -5559,6 +6746,10 @@ int main(int argc, char** argv) {
   fprintf(stderr, "llkt-router: listening on :%d (%zu models, default=%s%s)\n",
           cfg.port, cfg.models.size(), cfg.default_model.c_str(),
           cfg.strict ? ", strict" : "");
+
+  // OTLP exporter: only when configured — without an endpoint every
+  // finished trace is a counted "disabled" drop and no thread starts
+  if (!cfg.tracing.endpoint.empty()) trace_exporter_start(cfg);
 
   if (cfg.probe_interval_s > 0) {
     // background /ready prober: ejects draining/wedged/unreachable

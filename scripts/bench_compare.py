@@ -13,14 +13,15 @@ when any metric moved more than the threshold in the BAD direction:
   ``resume_gap_ms_*`` stalls and ``*visible_drops``, KV footprint
   ``kv_bytes_per_token`` and host-tier ``*cache_misses``, goodput
   ``wasted_chip_fraction``, gray-failure ``*detection_s``/
-  ``*ttft_ratio``/``*retry_volume``/``*budget_exhausted``): higher is
-  worse;
+  ``*ttft_ratio``/``*retry_volume``/``*budget_exhausted``, tracing
+  ``trace_export_failures``/``trace_dropped`` spans): higher is worse;
 - throughput-ish metrics (``*tokens_per_sec*`` — including the
   multi-tenant ``adapter_decode_tokens_per_sec``, ``*throughput*``,
   cache ``*hit*`` ratios, ``value`` — bench.py's headline tokens/s —
   and ``resumed_streams``, proof the failover drill actually spliced;
   session-density ``*max_streams_ratio``, goodput
-  ``goodput_tokens_per_chip_s`` and ``mfu``): lower is worse;
+  ``goodput_tokens_per_chip_s`` and ``mfu``, tracing
+  ``trace_stitch_ok``): lower is worse;
 - anything else is reported but never gates (no direction known).
 
 Runs whose ``parsed`` is null (crashed sessions) are skipped but named
@@ -50,7 +51,8 @@ _LOWER_BETTER = re.compile(r"(_ms$|ttft|latency|admit|evictions|load_seconds"
                            r"|disagg_decode_idle_frac|handoff_reprefill"
                            r"|handoff_fallback|detection_s$|ttft_ratio"
                            r"|retry_volume|budget_exhausted"
-                           r"|affinity_fallback|repin_fallback)")
+                           r"|affinity_fallback|repin_fallback"
+                           r"|trace_export_failures|trace_dropped)")
 _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|completed_streams|tokens_per_dispatch"
                             r"|steps_per_dispatch|resumed_streams"
@@ -58,7 +60,8 @@ _HIGHER_BETTER = re.compile(r"(tokens_per_sec|throughput|^value$|hit"
                             r"|accept_ratio|spec_drafted_tokens"
                             r"|max_streams_ratio|decode_tps_ratio"
                             r"|handoff_ok"
-                            r"|goodput_tokens_per_chip_s|^mfu$)")
+                            r"|goodput_tokens_per_chip_s|^mfu$"
+                            r"|trace_stitch_ok)")
 
 
 def _numeric_items(parsed: dict) -> dict[str, float]:

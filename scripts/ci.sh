@@ -308,6 +308,27 @@ sys.exit(0 if None not in (p50, blind_p50, chip, blind_chip, ratio)
     fails=$((fails + 1))
   fi
 
+  note "trace smoke (hop-stitched waterfalls + OTLP export)"
+  # the smoke's trace phase pushes hedged, resume-spliced and
+  # prefill/decode-handoff waves through the tracing router: every wave
+  # must stitch into exactly ONE fully-parented waterfall on
+  # /debug/trace/<id> (expected hop count, no orphan spans, span
+  # interval-union bounded by the stitched e2e) and every hop's spans
+  # must reach the local OTLP collector with zero export failures
+  if printf '%s\n' "$smoke_out" | tail -n 1 | "$PY" -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+sys.exit(0 if doc.get("trace_stitch_ok") == 1
+         and doc.get("trace_export_failures") == 0
+         and (doc.get("trace_hops_p50") or 0) >= 2
+         and (doc.get("trace_collector_spans") or 0) > 0 else 1)'; then
+    echo "ci: trace smoke OK (stitched waterfalls, clean OTLP export)"
+  else
+    echo "ci: trace smoke FAILED (unstitched or orphaned waterfall,"
+    echo "    missing hops, or OTLP span export failures)"
+    fails=$((fails + 1))
+  fi
+
   note "goodput ledger smoke (chip-time conservation within 5%)"
   # the engine-phase ledger must conserve wall time: attributed (prefill
   # + decode) + wasted (spec tails, early exits) + idle device gaps

@@ -12,7 +12,11 @@ of the text exposition format that have actually bitten this repo:
   escapes limited to ``\\\\``, ``\\"`` and ``\\n`` (a raw quote or stray
   backslash in a model name makes the whole scrape unparseable);
 - no duplicate series (same name + same label set twice);
-- sample values parse as floats (inf/NaN included).
+- sample values parse as floats (inf/NaN included);
+- OpenMetrics exemplar suffixes (`` # {trace_id="..."} value [ts]``) are
+  well-formed (label block parses, exemplar value is a float, at most one
+  trailing timestamp) and appear ONLY where the spec allows them:
+  histogram ``_bucket`` samples and counters.
 
 stdlib-only by design — it runs inside scripts/ci.sh on machines with no
 prometheus tooling installed. Exit 0 when every file is clean; exit 1
@@ -107,6 +111,46 @@ def family_of(sample_name: str, declared: dict) -> str:
     return sample_name
 
 
+def _lint_exemplar(part: str, name: str, kind, loc: str) -> list[str]:
+    """Validate one OpenMetrics exemplar suffix (everything after the
+    `` # `` separator): ``{labels} value [timestamp]``, allowed only on
+    histogram ``_bucket`` samples and counter samples."""
+    problems: list[str] = []
+    on_bucket = name.endswith("_bucket") and kind == "histogram"
+    if not on_bucket and kind != "counter":
+        problems.append(
+            f"{loc}: exemplar on {name} ({kind or 'untyped'}) — exemplars "
+            f"are only legal on histogram buckets and counters")
+    if not part.startswith("{"):
+        problems.append(f"{loc}: exemplar on {name} has no label block "
+                        f"(got {part[:20]!r})")
+        return problems
+    try:
+        _labels, ex_rest = parse_labels(part)
+    except ValueError as e:
+        problems.append(f"{loc}: exemplar on {name}: {e}")
+        return problems
+    ex_fields = ex_rest.split()
+    if not ex_fields:
+        problems.append(f"{loc}: exemplar on {name} has no value")
+        return problems
+    try:
+        float(ex_fields[0].replace("+Inf", "inf").replace("-Inf", "-inf"))
+    except ValueError:
+        problems.append(f"{loc}: exemplar on {name} value "
+                        f"{ex_fields[0]!r} is not a number")
+    if len(ex_fields) > 2:
+        problems.append(f"{loc}: exemplar on {name} has trailing junk "
+                        f"{' '.join(ex_fields[2:])[:20]!r}")
+    elif len(ex_fields) == 2:
+        try:
+            float(ex_fields[1])
+        except ValueError:
+            problems.append(f"{loc}: exemplar on {name} timestamp "
+                            f"{ex_fields[1]!r} is not a number")
+    return problems
+
+
 def lint(text: str, where: str, require: tuple = ()) -> list[str]:
     """Lint one exposition. ``require`` lists family names that must have
     at least one sample (empty by default so snippet-level callers are
@@ -148,6 +192,9 @@ def lint(text: str, where: str, require: tuple = ()) -> list[str]:
             except ValueError as e:
                 problems.append(f"{loc}: {e}")
                 continue
+        exemplar_part = None
+        if " # " in rest:
+            rest, _, exemplar_part = rest.partition(" # ")
         fields = rest.split()
         if not fields:
             problems.append(f"{loc}: sample {name} has no value")
@@ -158,6 +205,9 @@ def lint(text: str, where: str, require: tuple = ()) -> list[str]:
             problems.append(f"{loc}: sample {name} value {fields[0]!r} "
                             f"is not a number")
         family = family_of(name, typed)
+        if exemplar_part is not None:
+            problems += _lint_exemplar(exemplar_part.strip(), name,
+                                       typed.get(family), loc)
         if family not in typed:
             problems.append(f"{loc}: sample {name} has no # TYPE")
         if family not in helped:

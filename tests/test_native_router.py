@@ -97,6 +97,8 @@ class FakeBackend(http.server.BaseHTTPRequestHandler):
             "deadline_ms": self.headers.get("X-LLMK-Deadline-Ms", ""),
             "rid": self.headers.get("X-LLMK-Request-Id", ""),
             "priority": self.headers.get("X-LLMK-Priority", ""),
+            "traceparent": self.headers.get("Traceparent", ""),
+            "tracestate": self.headers.get("Tracestate", ""),
         }).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -2360,3 +2362,259 @@ def test_native_affinity_filter_steers_to_claimer(binary, tmp_path):
         proc.wait(timeout=5)
         for srv in servers.values():
             srv.shutdown()
+
+
+# -- cross-hop distributed tracing (ISSUE 19): shared-vector parity +
+# live propagation / stitching / export
+
+
+def test_native_trace_selftest_shared_vectors(binary):
+    """tests/data/trace_vectors.json is the byte-compatibility contract
+    for the tracing layer (traceparent parse/format, edge reconciliation
+    of traceparent/tracestate/X-LLMK-Request-Id, the tail-sampling
+    decision ladder) between the Python and native routers; the native
+    side validates every expectation in-process via --trace-selftest
+    (the Python side runs the same file in tests/test_tracing.py)."""
+    out = subprocess.run(
+        [str(binary), "--trace-selftest",
+         str(REPO / "tests" / "data" / "trace_vectors.json")],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ", 0 failures" in out.stdout
+    checks = int(out.stdout.split("trace-selftest:")[1].split("checks")[0])
+    assert checks >= 38
+
+
+_HEX = set("0123456789abcdef")
+
+
+def _is_hex(s, n):
+    return len(s) == n and set(s) <= _HEX
+
+
+def test_native_trace_propagation_and_reconcile(binary):
+    """Edge reconciliation on the live wire: a valid inbound traceparent
+    is adopted (same trace id upstream) but the hop span id is re-minted;
+    tracestate rides along; an unsafe request id canonicalizes to the
+    trace id; a malformed traceparent mints a fresh trace."""
+    backend = start_backend("tr1")
+    router = RouterProc(binary, {"m": backend.server_address[1]})
+    try:
+        tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+        psid = "00f067aa0ba902b7"
+        # adopted: same trace id, fresh hop span id, tracestate verbatim
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "Traceparent": f"00-{tid}-{psid}-01",
+             "Tracestate": "vendor=x",
+             "X-LLMK-Request-Id": "my-rid-1"})
+        assert status == 200
+        doc = json.loads(data)
+        ver, out_tid, out_sid, flags = doc["traceparent"].split("-")
+        assert (ver, out_tid, flags) == ("00", tid, "01")
+        assert _is_hex(out_sid, 16) and out_sid != psid
+        assert doc["tracestate"] == "vendor=x"
+        assert doc["rid"] == "my-rid-1"
+        # unsafe rid + adopted trace: rid canonicalizes to the trace id
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "Traceparent": f"00-{tid}-{psid}-01",
+             "X-LLMK-Request-Id": "bad rid!"})
+        assert status == 200
+        assert json.loads(data)["rid"] == tid
+        # unsafe rid + no trace context: a fresh 32-hex id is minted
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "X-LLMK-Request-Id": "bad rid!"})
+        assert status == 200
+        assert _is_hex(json.loads(data)["rid"], 32)
+        # malformed traceparent (ver ff is reserved-invalid): not adopted
+        # -- upstream gets a freshly minted trace, tracestate dropped
+        status, data = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "Traceparent": f"ff-{tid}-{psid}-01",
+             "Tracestate": "vendor=x"})
+        assert status == 200
+        doc = json.loads(data)
+        mint_tid = doc["traceparent"].split("-")[1]
+        assert _is_hex(mint_tid, 32) and mint_tid != tid
+        assert doc["tracestate"] == ""
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_native_debug_trace_stitch_and_404(binary):
+    """/debug/traces ring + /debug/trace/<id> waterfall: a proxied
+    request leaves one fragment whose connect span parents under the
+    fragment root, stitched into ONE orphan-free tree with an e2e; an
+    unknown id 404s with code=trace_not_found."""
+    backend = start_backend("tr2")
+    router = RouterProc(binary, {"m": backend.server_address[1]})
+    try:
+        status, _ = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "X-LLMK-Request-Id": "stitch-rid-1"})
+        assert status == 200
+        status, frags = _get_json(router.port,
+                                  "/debug/traces?id=stitch-rid-1")
+        assert status == 200 and len(frags) == 1
+        frag = frags[0]
+        assert frag["component"] == "native_router"
+        assert frag["status"] == "ok"
+        assert _is_hex(frag["trace_id"], 32) and _is_hex(frag["span_id"], 16)
+        connects = [s for s in frag["spans"] if s["name"] == "connect"]
+        assert connects and connects[0]["parent_span_id"] == frag["span_id"]
+        assert _is_hex(connects[0]["span_id"], 16)
+
+        status, doc = _get_json(router.port, "/debug/trace/stitch-rid-1")
+        assert status == 200
+        assert doc["trace_id"] == "stitch-rid-1"  # echoes the queried key
+        assert doc["hops"] == 1 and doc["orphans"] == []
+        assert len(doc["tree"]) == 1
+        assert doc["e2e_ms"] is not None and doc["e2e_ms"] >= 0
+        # the connect hop nests under the root in the flat walk
+        depths = {s["name"]: s["depth"] for s in doc["spans"]}
+        assert depths["native_router"] == 0 and depths["connect"] == 1
+
+        # an ADOPTED trace keeps the caller's trace id; its root parents
+        # to the caller's (external) span, so the fragment root is a
+        # flagged orphan root and e2e stays null -- the caller owns it
+        tid = "aaaabbbbccccddddeeeeffff00001111"
+        status, _ = router.request(
+            "POST", "/v1/chat/completions", {"model": "m"},
+            {"Content-Type": "application/json",
+             "Traceparent": f"00-{tid}-00f067aa0ba902b7-01",
+             "X-LLMK-Request-Id": "stitch-rid-2"})
+        assert status == 200
+        status, doc = _get_json(router.port, f"/debug/trace/{tid}")
+        assert status == 200
+        assert doc["trace_id"] == tid
+        assert len(doc["orphans"]) == 1 and doc["e2e_ms"] is None
+
+        status, doc = _get_json(router.port, "/debug/trace/deadbeef")
+        assert status == 404
+        assert doc["error"] == "trace_not_found"
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_native_trace_metrics_dormant_export(binary):
+    """Without an OTLP endpoint the exporter is dormant but NEVER silent:
+    both metric families are pre-seeded and every finished trace counts a
+    reason="disabled" drop."""
+    backend = start_backend("tr3")
+    router = RouterProc(binary, {"m": backend.server_address[1]})
+    try:
+        for _ in range(2):
+            status, _ = router.request(
+                "POST", "/v1/chat/completions", {"model": "m"},
+                {"Content-Type": "application/json"})
+            assert status == 200
+        text = _get_metrics(router.port)
+        assert "# HELP llm_trace_spans_exported_total " in text
+        assert "# HELP llm_trace_dropped_total " in text
+        assert 'llm_trace_spans_exported_total{outcome="ok"} 0' in text
+        assert 'llm_trace_dropped_total{reason="sampled_out"} 0' in text
+        assert 'llm_trace_dropped_total{reason="disabled"} 2' in text
+    finally:
+        router.stop()
+        backend.shutdown()
+
+
+def test_native_trace_otlp_export(binary, tmp_path):
+    """With a tracing block in router.json every trace exports (sample=1)
+    to the OTLP/HTTP collector: resourceSpans carry the llkt-router
+    service, a kind=2 root span named native_router with the request id
+    attribute, and outcome="ok" counts the spans handed over."""
+    hits = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            hits.append((self.path, json.loads(self.rfile.read(n))))
+            payload = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    col = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=col.serve_forever, daemon=True).start()
+    backend = start_backend("tr4")
+
+    cfg = tmp_path / "router.json"
+    cfg.write_text(json.dumps({
+        "backends": {
+            "m": [f"http://127.0.0.1:{backend.server_address[1]}"]},
+        "default_model": "m",
+        "tracing": {
+            "otlpEndpoint":
+                f"http://127.0.0.1:{col.server_address[1]}/v1/traces",
+            "sample": 1.0, "tailSlowMs": 60000},
+    }))
+    port = free_port()
+    proc = subprocess.Popen([str(binary), "router", "--config", str(cfg),
+                             "--port", str(port), "--quiet"])
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=1)
+                conn.request("GET", "/health")
+                if conn.getresponse().read() == b"OK":
+                    conn.close()
+                    break
+            except OSError:
+                time.sleep(0.02)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/v1/chat/completions",
+                     json.dumps({"model": "m"}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-LLMK-Request-Id": "otlp-rid-1"})
+        assert conn.getresponse().status == 200
+        conn.close()
+        deadline = time.monotonic() + 10
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert hits, "collector never saw an OTLP POST"
+        path, payload = hits[0]
+        assert path == "/v1/traces"
+        rs = payload["resourceSpans"][0]
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in rs["resource"]["attributes"]}
+        assert attrs["service.name"] == "llkt-router"
+        spans = rs["scopeSpans"][0]["spans"]
+        root = [s for s in spans if s["name"] == "native_router"]
+        assert root and root[0]["kind"] == 2
+        sattrs = {a["key"]: a["value"]["stringValue"]
+                  for a in root[0]["attributes"]}
+        assert sattrs["llmk.request_id"] == "otlp-rid-1"
+        n_spans = len(spans)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            text = _get_metrics(port)
+            if (f'llm_trace_spans_exported_total{{outcome="ok"}} '
+                    f'{n_spans}') in text:
+                break
+            time.sleep(0.1)
+        assert (f'llm_trace_spans_exported_total{{outcome="ok"}} '
+                f'{n_spans}') in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        backend.shutdown()
+        col.shutdown()

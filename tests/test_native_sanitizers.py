@@ -9,7 +9,10 @@ errors get CI coverage).
 - llkt-router under ThreadSanitizer: concurrent requests across threads,
   including the gray-failure layer (outlier quarantine → revival →
   shadow re-admission, and retry-budget exhaustion) whose per-replica
-  EWMA state and per-model token bucket every request thread mutates.
+  EWMA state and per-model token bucket every request thread mutates,
+  and the tracing layer (fragment assembly into the shared trace ring,
+  waterfall stitching reads racing ring-wraparound eviction, and the
+  OTLP exporter queue/worker).
 - libstload under ASan via a dedicated probe binary is skipped here —
   the ctypes path runs in-process with Python; the loader's bounds
   behaviour is covered by corrupt-file tests instead.
@@ -768,6 +771,123 @@ def _drive(binary: Path):
         assert "ERROR: " not in (af_err or ""), af_err[-3000:]
         assert "runtime error:" not in (af_err or ""), af_err[-3000:]
         assert "WARNING: ThreadSanitizer" not in (af_err or ""), af_err[-3000:]
+
+        # cross-hop tracing under the sanitizer: every request thread
+        # builds a fragment (span/event appends), reconciles inbound
+        # traceparents and pushes into the shared 256-slot trace ring +
+        # exporter queue; reader threads stitch waterfalls out of the
+        # ring (/debug/trace JSON assembly + replica-pull error paths)
+        # while the export worker batches OTLP POSTs — and the writers
+        # wrap the ring several times over so eviction races with the
+        # snapshot reads
+        tr_hits = []
+
+        class TraceCollector(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                tr_hits.append(self.path)
+                payload = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        tr_col = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                 TraceCollector)
+        threading.Thread(target=tr_col.serve_forever, daemon=True).start()
+        tr_dir = tempfile.mkdtemp(prefix="llmk-trace-san-")
+        tr_cfg = Path(tr_dir) / "router.json"
+        tr_cfg.write_text(json.dumps({
+            "backends": {
+                "sanmodel": f"http://127.0.0.1:{backend.server_address[1]}"},
+            "default_model": "sanmodel",
+            "tracing": {
+                "otlpEndpoint": (f"http://127.0.0.1:"
+                                 f"{tr_col.server_address[1]}/v1/traces"),
+                "sample": 1.0, "tailSlowMs": 60000},
+        }))
+        tr_port = free_port()
+        tr = subprocess.Popen(
+            [str(binary), "router", "--config", str(tr_cfg),
+             "--port", str(tr_port), "--quiet"],
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", tr_port,
+                                                   timeout=1)
+                    c.request("GET", "/health")
+                    c.getresponse().read()
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+
+            def tr_wave(i: int) -> None:
+                for j in range(14):
+                    rid = f"tr-{i}-{j}"
+                    tid = f"{i * 1000 + j + 1:032x}"
+                    c = http.client.HTTPConnection("127.0.0.1", tr_port,
+                                                   timeout=15)
+                    c.request("POST", "/v1/chat/completions",
+                              body=json.dumps({"model": "sanmodel"}).encode(),
+                              headers={"Content-Type": "application/json",
+                                       "X-LLMK-Request-Id": rid,
+                                       "Traceparent":
+                                       f"00-{tid}-00f067aa0ba902b7-01",
+                                       "Tracestate": "vendor=x"})
+                    assert c.getresponse().status == 200
+                    c.close()
+                    if j % 3 == 0:
+                        # stitch while the writers churn the ring: the
+                        # fragment may already be evicted (200 or 404 are
+                        # both fine), the race is the point
+                        c = http.client.HTTPConnection("127.0.0.1",
+                                                       tr_port, timeout=15)
+                        c.request("GET", f"/debug/trace/{rid}")
+                        r = c.getresponse()
+                        assert r.status in (200, 404)
+                        r.read()
+                        c.close()
+                        c = http.client.HTTPConnection("127.0.0.1",
+                                                       tr_port, timeout=15)
+                        c.request("GET", "/debug/traces?limit=8")
+                        assert len(json.loads(c.getresponse().read())) <= 8
+                        c.close()
+
+            # 24 x 14 = 336 traced requests: the 256-slot ring wraps while
+            # eight threads write and the pollers stitch
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(pool.map(tr_wave, range(24)))
+            deadline = time.monotonic() + 10
+            while not tr_hits and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert tr_hits, "OTLP collector never saw an export"
+            c = http.client.HTTPConnection("127.0.0.1", tr_port, timeout=15)
+            c.request("GET", "/metrics")
+            text = c.getresponse().read().decode()
+            c.close()
+            assert 'llm_trace_spans_exported_total{outcome="ok"}' in text
+        finally:
+            tr.terminate()
+            try:
+                _, tr_err = tr.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                tr.kill()
+                _, tr_err = tr.communicate()
+            tr_col.shutdown()
+            shutil.rmtree(tr_dir, ignore_errors=True)
+        assert "ERROR: " not in (tr_err or ""), tr_err[-3000:]
+        assert "runtime error:" not in (tr_err or ""), tr_err[-3000:]
+        assert "WARNING: ThreadSanitizer" not in (tr_err or ""), tr_err[-3000:]
 
         assert proc.poll() is None, (
             f"router died under sanitizer: {proc.stderr.read()[-2000:]}")

@@ -360,3 +360,69 @@ def test_known_emitted_names_covers_alert_expressions():
                  "llm_cluster_replica_up"):
         assert name in known, name
     assert referenced_metric_names() <= known
+
+
+# ---------------------------------------------------------------------------
+# TraceStore: ring wraparound under concurrent add/snapshot (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_trace_store_wraparound_race():
+    """Writers roll a small ring while readers snapshot it and a mutator
+    keeps appending spans to traces that are already stored. snapshot()
+    copies the deque under the store lock and serializes each trace under
+    its own lock, so every observed dict must be internally consistent
+    even while its trace is being written to."""
+    import threading
+
+    store = tracing.TraceStore(capacity=16)
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(wid: int) -> None:
+        try:
+            for i in range(300):
+                t = tracing.Trace(f"w{wid}-{i}", model="m",
+                                  component="router")
+                t.add_span("connect", t.t0, t.t0 + 0.001,
+                           span_id="00f067aa0ba902b7",
+                           parent_span_id=t.span_id)
+                t.event("queued", depth=i)
+                store.add(t)          # ring rolls: 4*300 adds into 16 slots
+                t.finish("ok")        # finish AFTER add: readers may see
+                                      # an unfinished trace mid-snapshot
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    def reader() -> None:
+        try:
+            while not stop.is_set():
+                for doc in store.snapshot(limit=16):
+                    # each dict must be self-consistent regardless of the
+                    # writer racing the serialization
+                    assert doc["id"].startswith("w")
+                    assert len(doc["trace_id"]) == 32
+                    for s in doc["spans"]:
+                        assert s["start_ms"] >= 0.0
+                    if doc["status"] is not None:
+                        assert doc["e2e_ms"] is not None
+                # filtered path exercises the id-or-trace-id match too
+                store.snapshot(request_id="w0-0", limit=4)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    writers = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    for th in readers + writers:
+        th.start()
+    for th in writers:
+        th.join(timeout=30)
+    stop.set()
+    for th in readers:
+        th.join(timeout=30)
+    assert not errors, errors[:3]
+
+    final = store.snapshot(limit=100)
+    # ring capacity bounds the survivors; everything left is well-formed
+    # and most-recent-first
+    assert len(final) == 16
+    assert all(doc["status"] == "ok" for doc in final)
